@@ -1,0 +1,20 @@
+"""ceph_tpu — TPU-native batch CRUSH placement and erasure coding.
+
+A from-scratch JAX/XLA/Pallas framework with the capabilities of the
+reference storage stack's placement + erasure-coding slice (see
+SURVEY.md): CRUSH straw2 placement vectorized over millions of objects,
+OSDMap object->PG->OSD pipeline, upmap balancer, and Reed-Solomon /
+bit-matrix erasure codes as MXU matmuls.
+
+x64 note: CRUSH's straw2 draw is defined in 64-bit integer arithmetic
+(48-bit fixed-point log divided by a 16.16 weight).  The package enables
+JAX x64 mode at import so uint64 is available on all backends; all
+framework arrays carry explicit dtypes, so user code is unaffected
+except that 64-bit types become representable.
+"""
+
+from jax import config as _jax_config
+
+_jax_config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

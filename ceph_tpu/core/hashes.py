@@ -1,0 +1,135 @@
+"""Vectorized (jnp) CRUSH core primitives.
+
+Bit-exact counterparts of :mod:`ceph_tpu.core.ref`, written as
+elementwise ops over ``uint32``/``uint64`` arrays so they can be
+``vmap``-ed / fused by XLA.  Requires x64 mode (enabled at package
+import): the straw2 draw needs a 64-bit unsigned divide, which XLA
+emulates exactly on TPU via 32-bit pairs.
+
+Design note (TPU-first): the signed ``div64_s64(ln, w)`` from the spec
+(SURVEY.md §2.1, upstream ``src/crush/mapper.c :: bucket_straw2_choose``)
+is rewritten as an UNSIGNED quantity ``negdraw = (2^48 - crush_ln(u)) // w``
+-- ``ln <= 0`` and truncating signed division of a negative by a positive
+equals the negated floor division of magnitudes, so ``argmax draw`` (ties:
+first) becomes ``argmin negdraw`` (ties: first), with zero weight mapping
+to ``UINT64_MAX``.  This keeps the hot loop in unsigned integer ops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from ._crush_ln_tables import LL_TBL, RH_LH_TBL
+
+CRUSH_HASH_SEED = np.uint32(1315423911)
+U64_MAX = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+# Host-side constants; jnp.asarray at use site embeds them as XLA
+# constants (safe under tracing, deduped by the compiler).
+_RH_LH_NP = np.array(RH_LH_TBL, dtype=np.uint64)
+_LL_NP = np.array(LL_TBL, dtype=np.uint64)
+
+
+def _tables():
+    return jnp.asarray(_RH_LH_NP), jnp.asarray(_LL_NP)
+
+
+def _u32(x):
+    return jnp.asarray(x).astype(jnp.uint32)
+
+
+def hashmix(a, b, c):
+    """One rjenkins mix round; wrapping uint32 elementwise."""
+    a = a - b - c
+    a = a ^ (c >> 13)
+    b = b - c - a
+    b = b ^ (a << 8)
+    c = c - a - b
+    c = c ^ (b >> 13)
+    a = a - b - c
+    a = a ^ (c >> 12)
+    b = b - c - a
+    b = b ^ (a << 16)
+    c = c - a - b
+    c = c ^ (b >> 5)
+    a = a - b - c
+    a = a ^ (c >> 3)
+    b = b - c - a
+    b = b ^ (a << 10)
+    c = c - a - b
+    c = c ^ (b >> 15)
+    return a, b, c
+
+
+def crush_hash32_2(a, b):
+    a = _u32(a)
+    b = _u32(b)
+    h = CRUSH_HASH_SEED ^ a ^ b
+    x = jnp.full_like(a, 231232)
+    y = jnp.full_like(a, 1232)
+    a, b, h = hashmix(a, b, h)
+    x, a, h = hashmix(x, a, h)
+    b, y, h = hashmix(b, y, h)
+    return h
+
+
+def crush_hash32_3(a, b, c):
+    a = _u32(a)
+    b = _u32(b)
+    c = _u32(c)
+    h = CRUSH_HASH_SEED ^ a ^ b ^ c
+    x = jnp.full_like(a, 231232)
+    y = jnp.full_like(a, 1232)
+    a, b, h = hashmix(a, b, h)
+    c, x, h = hashmix(c, x, h)
+    y, a, h = hashmix(y, a, h)
+    b, x, h = hashmix(b, x, h)
+    y, c, h = hashmix(y, c, h)
+    return h
+
+
+def ceph_stable_mod(x, b, bmask):
+    """Vectorized stable_mod; all args broadcastable uint32/int32."""
+    x = jnp.asarray(x)
+    return jnp.where((x & bmask) < b, x & bmask, x & (bmask >> 1))
+
+
+def crush_ln(u):
+    """~2^44 * log2(u+1) for u in [0, 0xffff]; returns uint64."""
+    rh_lh, ll_tbl = _tables()
+    x = _u32(u) + np.uint32(1)  # [1, 0x10000]
+    p = (np.int32(31) - lax.clz(x.astype(jnp.int32))).astype(jnp.uint32)
+    need = p < 15
+    shift = jnp.where(need, np.uint32(15) - p, np.uint32(0))
+    xs = x << shift
+    iexpon = jnp.where(need, p, np.uint32(15)).astype(jnp.uint64)
+    index1 = ((xs >> 8) << 1).astype(jnp.int32)
+    rh = rh_lh[index1 - 256]
+    lh = rh_lh[index1 - 255]
+    xl64 = (xs.astype(jnp.uint64) * rh) >> np.uint64(48)
+    index2 = (xl64 & np.uint64(0xFF)).astype(jnp.int32)
+    ll = ll_tbl[index2]
+    return (iexpon << np.uint64(44)) + ((lh + ll) >> np.uint64(4))
+
+
+def straw2_negdraw(x, item_id, r, weight):
+    """Negated straw2 draw (uint64); smaller wins, first index on ties.
+
+    ``weight`` is the 16.16 fixed-point u32 item weight; zero weight
+    yields UINT64_MAX (never selected unless all weights are zero).
+    """
+    u = crush_hash32_3(x, item_id, r) & np.uint32(0xFFFF)
+    ln_neg = (np.uint64(1) << np.uint64(48)) - crush_ln(u)
+    w = jnp.maximum(_u32(weight), np.uint32(1)).astype(jnp.uint64)
+    nd = ln_neg // w
+    return jnp.where(_u32(weight) == 0, U64_MAX, nd)
+
+
+def is_out(weight_osd, item, x):
+    """Vectorized reweight rejection (True = rejected)."""
+    w = _u32(weight_osd)
+    h = crush_hash32_2(x, item) & np.uint32(0xFFFF)
+    return jnp.where(w >= 0x10000, False, jnp.where(w == 0, True, h >= w))

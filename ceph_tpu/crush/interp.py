@@ -1,0 +1,675 @@
+"""Vectorized CRUSH rule interpreter (the TPU hot path).
+
+Re-implements the placement semantics of the reference's rule engine
+(upstream ``src/crush/mapper.c :: crush_do_rule / crush_choose_firstn /
+crush_choose_indep / crush_bucket_choose / bucket_perm_choose``) as a
+batch program: one ``vmap`` over object seeds ``x`` replaces the
+reference's serial per-object loop (its own batch answer is a CPU
+threadpool, ``src/osd/OSDMapMapping.h :: ParallelPGMapper``).
+
+Design notes (TPU-first, not a translation):
+
+- **Trace-time specialization.**  Rule steps, replica counts, tunables
+  and map *shape* (bucket count, fanout, depth) are Python-static: every
+  rule compiles to a straight-line XLA program of bounded loops.  SET_*
+  steps fold into static tunables at trace time.
+- **Bounded masked loops instead of goto ladders.**  Each replica slot
+  runs a ``lax.while_loop`` over full-descent retries; the hierarchy
+  descent itself is a masked ``fori_loop`` over the map's static max
+  depth.  Under ``vmap`` all lanes step together until the slowest lane
+  finishes -- the price of SIMD divergence, paid for with ~10^3x ALU
+  width versus one CPU core.
+- **Hard-fail vs soft-fail retries.**  The reference distinguishes
+  ``skip_rep`` (malformed item / wrong-type device: abandon the replica
+  slot) from ``reject`` (collision/out/empty: retry with r' = r+ftotal).
+  The descent returns both flags so the ladders match exactly.
+- **straw2 as unsigned argmin.**  See ceph_tpu.core.hashes: the signed
+  64-bit draw division becomes an unsigned negdraw; argmin's first-index
+  tie rule matches the reference's strict-greater scan.
+- **Whole-bucket vector choose.**  A straw2 choose hashes all
+  ``max_fanout`` slots of a bucket row at once (padded weights are 0 =>
+  never win), turning the reference's per-item scalar loop into a lane-
+  parallel reduction.
+
+The result for each x is a fixed ``[result_max]`` int32 vector padded
+with ITEM_NONE -- FIRSTN results are compacted to the front, INDEP
+results positional with NONE holes, exactly like the reference.
+
+Current scope limits (explicit, enforced with clear errors): rules must
+be single-TAKE chains with one choose step ("take; [set_*;] choose*;
+emit"), covering the standard replicated/EC rules; legacy local-retry
+tunables (argonaut profile) are CPU-reference-only.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ceph_tpu.core import hashes
+from .map import (
+    ALG_STRAW2,
+    ALG_UNIFORM,
+    ITEM_NONE,
+    DenseCrushMap,
+    OP_CHOOSE_FIRSTN,
+    OP_CHOOSE_INDEP,
+    OP_CHOOSELEAF_FIRSTN,
+    OP_CHOOSELEAF_INDEP,
+    OP_EMIT,
+    OP_SET_CHOOSE_TRIES,
+    OP_SET_CHOOSELEAF_TRIES,
+    OP_SET_CHOOSE_LOCAL_TRIES,
+    OP_SET_CHOOSE_LOCAL_FALLBACK_TRIES,
+    OP_SET_CHOOSELEAF_VARY_R,
+    OP_SET_CHOOSELEAF_STABLE,
+    OP_TAKE,
+    Rule,
+)
+
+ITEM_UNDEF = 0x7FFFFFFE
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+FALSE = lambda: jnp.asarray(False)  # noqa: E731
+
+
+class StaticCrushMap:
+    """Device-resident dense map + static shape/tunable info (pytree)."""
+
+    def __init__(self, dense: DenseCrushMap):
+        self.n_buckets = dense.n_buckets
+        self.max_fanout = dense.max_fanout
+        self.max_devices = dense.max_devices
+        self.max_depth = max(dense.max_depth, 1)
+        self.tunables = dense.tunables
+        self.algs = frozenset(dense.algs_present())
+        unsupported = self.algs - {ALG_UNIFORM, ALG_STRAW2}
+        if unsupported:
+            raise NotImplementedError(
+                f"bucket algs {sorted(unsupported)} (list/tree/straw1) are "
+                "legacy and not supported on the TPU path; use straw2/uniform"
+            )
+        self.alg = jnp.asarray(dense.alg, I32)
+        self.btype = jnp.asarray(dense.btype, I32)
+        self.size = jnp.asarray(dense.size, I32)
+        self.items = jnp.asarray(dense.items, I32)
+        self.weights = jnp.asarray(dense.weights, U32)
+
+    def tree_flatten(self):
+        arrays = (self.alg, self.btype, self.size, self.items, self.weights)
+        static = (
+            self.n_buckets,
+            self.max_fanout,
+            self.max_devices,
+            self.max_depth,
+            self.tunables,
+            self.algs,
+        )
+        return arrays, static
+
+    @classmethod
+    def tree_unflatten(cls, static, arrays):
+        obj = cls.__new__(cls)
+        (
+            obj.n_buckets,
+            obj.max_fanout,
+            obj.max_devices,
+            obj.max_depth,
+            obj.tunables,
+            obj.algs,
+        ) = static
+        obj.alg, obj.btype, obj.size, obj.items, obj.weights = arrays
+        return obj
+
+
+jax.tree_util.register_pytree_node(
+    StaticCrushMap,
+    lambda m: m.tree_flatten(),
+    StaticCrushMap.tree_unflatten,
+)
+
+
+def _straw2_choose(smap: StaticCrushMap, bidx, x, r):
+    """items[argmin negdraw] for bucket row bidx; padded weights never win."""
+    ids = smap.items[bidx]  # [F] i32 (original ids; hashed as u32)
+    ws = smap.weights[bidx]  # [F] u32
+    valid = jnp.arange(smap.max_fanout) < smap.size[bidx]
+    ws = jnp.where(valid, ws, np.uint32(0))
+    nd = hashes.straw2_negdraw(
+        jnp.full((smap.max_fanout,), x, U32),
+        ids.astype(U32),
+        jnp.full((smap.max_fanout,), r, U32).astype(U32),
+        ws,
+    )
+    # All-zero weights: argmin picks index 0 = first real item, matching
+    # the reference's scan initialization (size > 0 ensured by callers).
+    return smap.items[bidx, jnp.argmin(nd)]
+
+
+def _perm_choose(smap: StaticCrushMap, bidx, x, r):
+    """Uniform bucket: seeded Fisher-Yates permutation, stateless."""
+    size = smap.size[bidx]
+    bucket_id = (-1 - bidx).astype(I32)
+    size_u = jnp.maximum(size, 1).astype(U32)
+    pr = (r.astype(U32) % size_u).astype(I32)
+    F = smap.max_fanout
+
+    def body(p, perm):
+        active = (p <= pr) & (p < size - 1)
+        i = (
+            hashes.crush_hash32_3(
+                x, bucket_id.astype(U32), jnp.asarray(p, I32).astype(U32)
+            )
+            % jnp.maximum(size - p, 1).astype(U32)
+        ).astype(I32)
+        do_swap = active & (i > 0)
+        i = jnp.where(do_swap, i, 0)
+        pi = perm[p + i]
+        pp = perm[p]
+        perm = perm.at[p + i].set(jnp.where(do_swap, pp, pi))
+        perm = perm.at[p].set(jnp.where(do_swap, pi, pp))
+        return perm
+
+    perm = lax.fori_loop(0, F, body, jnp.arange(F, dtype=I32))
+    return smap.items[bidx, perm[pr]]
+
+
+def _bucket_choose(smap: StaticCrushMap, bidx, x, r):
+    if smap.algs <= {ALG_STRAW2}:
+        return _straw2_choose(smap, bidx, x, r)
+    if smap.algs <= {ALG_UNIFORM}:
+        return _perm_choose(smap, bidx, x, r)
+    return lax.cond(
+        smap.alg[bidx] == ALG_UNIFORM,
+        lambda: _perm_choose(smap, bidx, x, r),
+        lambda: _straw2_choose(smap, bidx, x, r),
+    )
+
+
+def _is_out(osd_weight, item, x):
+    wmax = osd_weight.shape[0]
+    oob = item >= wmax
+    w = osd_weight[jnp.clip(item, 0, wmax - 1)]
+    return oob | hashes.is_out(w, item.astype(U32), x)
+
+
+def _descend(
+    smap: StaticCrushMap,
+    x,
+    start_bucket_idx,
+    target_type: int,
+    level_r_fn,
+    empty_is_hard: bool = False,
+):
+    """Walk down from a bucket until an item of target_type is chosen.
+
+    ``level_r_fn(bidx)`` gives the r used at each level (constant for
+    FIRSTN; alg-dependent for INDEP spacing).
+
+    Returns (item, ok, hard, r_final):
+      ok   -- an item of target_type was chosen
+      hard -- unrecoverable failure (bad device id, device met while a
+              bucket type was wanted, malformed bucket id): the caller
+              must abandon the slot (reference's skip_rep / NONE-break)
+      neither -- soft failure (empty bucket / depth exhausted): retry.
+      r_final -- the r used at the level where the walk stopped (the
+              chooseleaf-indep recursion's parent_r).
+
+    ``empty_is_hard``: INDEP marks a slot permanently NONE on an empty
+    bucket, while FIRSTN retries the descent (the reference's reject
+    ladder) -- the caller picks the behavior.
+    """
+
+    def body(_, st):
+        bidx, item, done, ok, hard, r_out = st
+        r = level_r_fn(bidx)
+        empty = smap.size[bidx] == 0
+        chosen = _bucket_choose(smap, bidx, x, r)
+        bad_dev = chosen >= smap.max_devices
+        is_bucket = chosen < 0
+        sub_idx = jnp.clip(-1 - chosen, 0, smap.n_buckets - 1)
+        bad_bucket = is_bucket & ((-1 - chosen) >= smap.n_buckets)
+        itemtype = jnp.where(is_bucket, smap.btype[sub_idx], 0)
+        reached = itemtype == target_type
+        # wrong type and not descendable => hard fail
+        wrong_dev = (~is_bucket) & (~reached)
+        if empty_is_hard:
+            hard_now = empty | bad_dev | bad_bucket | wrong_dev
+            soft_now = FALSE()
+        else:
+            hard_now = (~empty) & (bad_dev | bad_bucket | wrong_dev)
+            soft_now = empty
+        new_done = done | hard_now | soft_now | reached
+        new_ok = jnp.where(done, ok, reached & ~hard_now & ~soft_now)
+        new_hard = jnp.where(done, hard, hard_now)
+        new_item = jnp.where(done, item, chosen)
+        new_r = jnp.where(done, r_out, r)
+        descend = (~new_done) & is_bucket
+        new_bidx = jnp.where(descend, sub_idx, bidx)
+        return (new_bidx, new_item, new_done, new_ok, new_hard, new_r)
+
+    init = (
+        start_bucket_idx.astype(I32),
+        jnp.asarray(ITEM_NONE, I32),
+        FALSE(),
+        FALSE(),
+        FALSE(),
+        jnp.asarray(0, I32),
+    )
+    bidx, item, done, ok, hard, r_out = lax.fori_loop(
+        0, smap.max_depth + 1, body, init
+    )
+    # depth exhausted without reaching target: soft failure
+    return item, ok, hard, r_out
+
+
+def _leaf_descend_firstn(
+    smap: StaticCrushMap,
+    osd_weight,
+    x,
+    bucket_item,
+    sub_r,
+    recurse_tries: int,
+    out2,
+    outpos,
+    stable: int,
+):
+    """chooseleaf-firstn recursion: one replica slot, target type 0.
+
+    The reference's recursive crush_choose_firstn call uses
+    numrep = stable ? 1 : outpos+1, which always runs exactly one
+    iteration at rep = stable ? 0 : outpos.  Collisions are checked
+    against previously chosen leaves out2[0:outpos].
+    Returns (leaf, ok).
+    """
+    rep = jnp.asarray(0, I32) if stable else outpos.astype(I32)
+    bidx = jnp.clip(-1 - bucket_item, 0, smap.n_buckets - 1)
+    npos = out2.shape[0]
+
+    def cond(st):
+        ftotal, done, hard_stop, _ = st
+        return (~done) & (~hard_stop) & (ftotal < recurse_tries)
+
+    def body(st):
+        ftotal, _, _, leaf = st
+        r = rep + sub_r + ftotal
+        item, ok, hard, _ = _descend(smap, x, bidx, 0, lambda _b: r)
+        collide = ok & jnp.any((jnp.arange(npos) < outpos) & (out2 == item))
+        rejected = ok & (collide | _is_out(osd_weight, item, x))
+        good = ok & ~rejected
+        return (ftotal + 1, good, hard, jnp.where(good, item, leaf))
+
+    _, ok, _, leaf = lax.while_loop(
+        cond,
+        body,
+        (jnp.asarray(0, I32), FALSE(), FALSE(), jnp.asarray(ITEM_NONE, I32)),
+    )
+    return leaf, ok
+
+
+def _choose_firstn(
+    smap: StaticCrushMap,
+    osd_weight,
+    x,
+    take_bucket_idx,
+    numrep: int,
+    target_type: int,
+    out_size: int,
+    tries: int,
+    recurse_tries: int,
+    recurse_to_leaf: bool,
+    vary_r: int,
+    stable: int,
+):
+    """FIRSTN selection below one take bucket.
+
+    Iterates all numrep replica slots (the reference's
+    ``rep < numrep && count > 0`` loop) but places at most ``out_size``
+    results -- slots whose retries are exhausted are skipped while later
+    slots can still fill the quota.
+
+    Returns (out [out_size], out2 [out_size], n_placed).  out is
+    compacted (chosen items first, ITEM_NONE padding); out2 holds the
+    leaves when recurse_to_leaf.
+    """
+    cap = out_size
+    out = jnp.full((cap,), ITEM_NONE, I32)
+    out2 = jnp.full((cap,), ITEM_NONE, I32)
+    outpos = jnp.asarray(0, I32)
+
+    for rep in range(numrep):
+
+        def cond(st):
+            ftotal, done, skip, item, leaf = st
+            return (~done) & (~skip) & (ftotal < tries)
+
+        def body(st, _rep=rep):
+            ftotal, _, _, item, leaf = st
+            r = _rep + ftotal
+            cand, ok, hard, _ = _descend(
+                smap, x, take_bucket_idx, target_type, lambda _b: jnp.asarray(r, I32)
+            )
+            collide = ok & jnp.any((jnp.arange(cap) < outpos) & (out == cand))
+            reject = FALSE()
+            new_leaf = leaf
+            if recurse_to_leaf:
+                is_bucket = cand < 0
+                sub_r = jnp.asarray(r >> (vary_r - 1) if vary_r else 0, I32)
+                lf, lok = _leaf_descend_firstn(
+                    smap,
+                    osd_weight,
+                    x,
+                    jnp.where(is_bucket, cand, -1),
+                    sub_r,
+                    recurse_tries,
+                    out2,
+                    outpos,
+                    stable,
+                )
+                leaf_ok = jnp.where(is_bucket, lok, True)
+                cand_leaf = jnp.where(is_bucket, lf, cand)
+                reject = reject | (ok & ~collide & ~leaf_ok)
+                new_leaf = jnp.where(ok & ~collide & leaf_ok, cand_leaf, leaf)
+            if target_type == 0:
+                reject = reject | (ok & ~collide & _is_out(osd_weight, cand, x))
+            good = ok & ~collide & ~reject
+            return (
+                ftotal + 1,
+                good,
+                hard,  # skip_rep: abandon this slot entirely
+                jnp.where(good, cand, item),
+                new_leaf,
+            )
+
+        init = (
+            jnp.asarray(0, I32),
+            FALSE(),
+            FALSE(),
+            jnp.asarray(ITEM_NONE, I32),
+            jnp.asarray(ITEM_NONE, I32),
+        )
+        _, done, _, item, leaf = lax.while_loop(cond, body, init)
+        place = done & (outpos < cap)
+        wpos = jnp.minimum(outpos, cap - 1)
+        out = out.at[wpos].set(jnp.where(place, item, out[wpos]))
+        if recurse_to_leaf:
+            out2 = out2.at[wpos].set(jnp.where(place, leaf, out2[wpos]))
+        outpos = outpos + place.astype(I32)
+
+    return out, out2, outpos
+
+
+def _indep_leaf(
+    smap: StaticCrushMap,
+    osd_weight,
+    x,
+    bucket_item,
+    rep,
+    numrep: int,
+    parent_r,
+    recurse_tries: int,
+):
+    """chooseleaf-indep recursion: left=1 at slot rep, parent_r threaded.
+
+    r at each level = rep + parent_r + numrep*ftotal' (uniform-divisible
+    buckets use (numrep+1)*ftotal').  Returns (leaf, ok).
+    """
+    bidx0 = jnp.clip(-1 - bucket_item, 0, smap.n_buckets - 1)
+
+    def ftotal_body(ft, st):
+        done, failed, leaf = st
+
+        def level_r(bidx):
+            uni = (smap.alg[bidx] == ALG_UNIFORM) & (smap.size[bidx] % numrep == 0)
+            return jnp.where(
+                uni,
+                rep + parent_r + (numrep + 1) * ft,
+                rep + parent_r + numrep * ft,
+            ).astype(I32)
+
+        item, ok, hard, _ = _descend(
+            smap, x, bidx0, 0, level_r, empty_is_hard=True
+        )
+        ok = ok & ~_is_out(osd_weight, item, x)
+        newly = (~done) & (~failed) & ok
+        # hard failure permanently fails the slot in the reference
+        # (out[rep]=NONE, and later rounds skip non-UNDEF slots).
+        new_failed = failed | ((~done) & hard)
+        return (done | newly, new_failed, jnp.where(newly, item, leaf))
+
+    done, _, leaf = lax.fori_loop(
+        0,
+        recurse_tries,
+        ftotal_body,
+        (FALSE(), FALSE(), jnp.asarray(ITEM_NONE, I32)),
+    )
+    return jnp.where(done, leaf, ITEM_NONE), done
+
+
+def _choose_indep(
+    smap: StaticCrushMap,
+    osd_weight,
+    x,
+    take_bucket_idx,
+    out_size: int,
+    numrep: int,
+    target_type: int,
+    tries: int,
+    recurse_tries: int,
+    recurse_to_leaf: bool,
+):
+    """INDEP (positional/EC) selection; NONE holes on failure.
+
+    Returns (out [out_size], out2 [out_size]).
+    """
+    out = jnp.full((out_size,), ITEM_UNDEF, I32)
+    out2 = jnp.full((out_size,), ITEM_UNDEF, I32)
+
+    def ftotal_body(ftotal, st):
+        out, out2 = st
+        for rep in range(out_size):
+            undef = out[rep] == ITEM_UNDEF
+
+            def level_r(bidx, _rep=rep, _ft=ftotal):
+                uni = (smap.alg[bidx] == ALG_UNIFORM) & (
+                    smap.size[bidx] % numrep == 0
+                )
+                return jnp.where(
+                    uni, _rep + (numrep + 1) * _ft, _rep + numrep * _ft
+                ).astype(I32)
+
+            item, ok, hard, r_final = _descend(
+                smap, x, take_bucket_idx, target_type, level_r,
+                empty_is_hard=True,
+            )
+            collide = ok & jnp.any(out == item)
+            good = ok & ~collide
+            leaf = item
+            if recurse_to_leaf:
+                is_bucket = item < 0
+                lf, lok = _indep_leaf(
+                    smap,
+                    osd_weight,
+                    x,
+                    jnp.where(is_bucket, item, -1),
+                    jnp.asarray(rep, I32),
+                    numrep,
+                    r_final,
+                    recurse_tries,
+                )
+                leaf_ok = jnp.where(is_bucket, lok, True)
+                leaf = jnp.where(is_bucket, lf, item)
+                good = good & leaf_ok
+            if target_type == 0:
+                good = good & ~_is_out(osd_weight, item, x)
+            write_item = undef & good
+            write_none = undef & hard  # permanent NONE on hard failure
+            newv = jnp.where(
+                write_item, item, jnp.where(write_none, ITEM_NONE, out[rep])
+            )
+            out = out.at[rep].set(newv)
+            if recurse_to_leaf:
+                newl = jnp.where(
+                    write_item, leaf, jnp.where(write_none, ITEM_NONE, out2[rep])
+                )
+                out2 = out2.at[rep].set(newl)
+        return (out, out2)
+
+    out, out2 = lax.fori_loop(0, tries, ftotal_body, (out, out2))
+    out = jnp.where(out == ITEM_UNDEF, ITEM_NONE, out)
+    out2 = jnp.where(out2 == ITEM_UNDEF, ITEM_NONE, out2)
+    return out, out2
+
+
+def compile_rule(smap: StaticCrushMap, rule: Rule, result_max: int):
+    """Build a jittable ``f(smap, osd_weight, x) -> ([result_max], len)``.
+
+    Specialized on the rule's steps and the map's static shape; vmap/jit
+    over x batches.
+    """
+    tun = smap.tunables
+    if tun.choose_local_tries or tun.choose_local_fallback_tries:
+        raise NotImplementedError(
+            "legacy local-retry tunables are CPU-reference-only; "
+            "use the bobtail+ profiles on the TPU path"
+        )
+    for s in rule.steps:
+        if s.op in (OP_SET_CHOOSE_LOCAL_TRIES, OP_SET_CHOOSE_LOCAL_FALLBACK_TRIES):
+            if s.arg1 > 0:
+                raise NotImplementedError(
+                    "legacy local retry tunables not supported on the TPU path"
+                )
+
+    def run(smap_: StaticCrushMap, osd_weight, x):
+        x = jnp.asarray(x, U32)
+        result = jnp.full((result_max,), ITEM_NONE, I32)
+        result_len = jnp.asarray(0, I32)
+        w: jnp.ndarray | None = None  # working vector after a choose
+        wsize = jnp.asarray(0, I32)
+        take_static: int | None = None
+        # SET_* steps apply sequentially, affecting only later chooses
+        # (all values are rule constants, so this stays trace-static).
+        choose_tries = tun.choose_total_tries
+        chooseleaf_tries = 0
+        vary_r = tun.chooseleaf_vary_r
+        stable = tun.chooseleaf_stable
+
+        for s in rule.steps:
+            if s.op == OP_TAKE:
+                take_static = s.arg1
+            elif s.op == OP_SET_CHOOSE_TRIES:
+                if s.arg1 > 0:
+                    choose_tries = s.arg1
+            elif s.op == OP_SET_CHOOSELEAF_TRIES:
+                if s.arg1 > 0:
+                    chooseleaf_tries = s.arg1
+            elif s.op == OP_SET_CHOOSELEAF_VARY_R:
+                if s.arg1 >= 0:
+                    vary_r = s.arg1
+            elif s.op == OP_SET_CHOOSELEAF_STABLE:
+                if s.arg1 >= 0:
+                    stable = s.arg1
+            elif s.op in (
+                OP_CHOOSE_FIRSTN,
+                OP_CHOOSELEAF_FIRSTN,
+                OP_CHOOSE_INDEP,
+                OP_CHOOSELEAF_INDEP,
+            ):
+                if take_static is None or take_static >= 0:
+                    raise NotImplementedError(
+                        "TPU path supports single-TAKE single-choose rules; "
+                        "this rule chains chooses or takes a raw device"
+                    )
+                numrep = s.arg1
+                if numrep <= 0:
+                    numrep += result_max
+                if numrep <= 0:
+                    continue
+                recurse = s.op in (OP_CHOOSELEAF_FIRSTN, OP_CHOOSELEAF_INDEP)
+                firstn = s.op in (OP_CHOOSE_FIRSTN, OP_CHOOSELEAF_FIRSTN)
+                bidx = jnp.asarray(-1 - take_static, I32)
+                if firstn:
+                    recurse_tries_firstn = (
+                        chooseleaf_tries
+                        if chooseleaf_tries
+                        else (1 if tun.chooseleaf_descend_once else choose_tries)
+                    )
+                    o, o2, osize = _choose_firstn(
+                        smap_,
+                        osd_weight,
+                        x,
+                        bidx,
+                        numrep,
+                        s.arg2,
+                        min(numrep, result_max),
+                        choose_tries,
+                        recurse_tries_firstn,
+                        recurse,
+                        vary_r,
+                        stable,
+                    )
+                else:
+                    out_size = min(numrep, result_max)
+                    o, o2 = _choose_indep(
+                        smap_,
+                        osd_weight,
+                        x,
+                        bidx,
+                        out_size,
+                        numrep,
+                        s.arg2,
+                        choose_tries,
+                        chooseleaf_tries if chooseleaf_tries else 1,
+                        recurse,
+                    )
+                    osize = jnp.asarray(out_size, I32)
+                w = o2 if recurse else o
+                wsize = osize
+                take_static = None
+            elif s.op == OP_EMIT:
+                if w is None:
+                    if take_static is not None:
+                        # bare take;emit: emit the taken item
+                        w = jnp.full((1,), take_static, I32)
+                        wsize = jnp.asarray(1, I32)
+                        take_static = None
+                    else:
+                        continue
+                pad = result_max - w.shape[0]
+                wv = (
+                    jnp.concatenate([w, jnp.full((pad,), ITEM_NONE, I32)])
+                    if pad > 0
+                    else w[:result_max]
+                )
+                idx = jnp.arange(result_max, dtype=I32)
+                shift = idx - result_len
+                src = wv[jnp.clip(shift, 0, result_max - 1)]
+                write = (shift >= 0) & (shift < wsize)
+                result = jnp.where(write, src, result)
+                result_len = jnp.minimum(result_len + wsize, result_max)
+                w = None
+                wsize = jnp.asarray(0, I32)
+        return result, result_len
+
+    return run
+
+
+def batch_do_rule(smap: StaticCrushMap, rule: Rule, xs, osd_weight, result_max: int):
+    """vmapped rule execution over a batch of x seeds (jit-compiled).
+
+    Returns (results [n, result_max] int32, lens [n] int32).
+    """
+    run = compile_rule(smap, rule, result_max)
+
+    @partial(jax.jit, static_argnames=())
+    def go(smap_, wgt, xs_):
+        return jax.vmap(lambda x: run(smap_, wgt, x))(xs_)
+
+    return go(smap, jnp.asarray(osd_weight, U32), jnp.asarray(xs, U32))

@@ -1,0 +1,369 @@
+"""CRUSH map model: hierarchy, rules, tunables, and dense packing.
+
+The mutable Python model plays the role of the reference's CrushWrapper
+mutation/serialization API (upstream ``src/crush/CrushWrapper.{h,cc}`` --
+add_bucket / insert_item / adjust_item_weight / rule management /
+tunable profiles), re-designed for a TPU pipeline: a map is *compiled*
+(``to_dense``) into flat dense arrays -- the form both the C++ CPU
+reference and the JAX interpreter consume -- rather than walked through
+pointers.
+
+Weights are 16.16 fixed point u32 (0x10000 == 1.0) exactly as in the
+spec; bucket ids are negative, devices (OSDs) non-negative.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field, asdict
+
+import numpy as np
+
+ITEM_NONE = 0x7FFFFFFF
+
+ALG_UNIFORM = 1
+ALG_LIST = 2
+ALG_TREE = 3
+ALG_STRAW = 4
+ALG_STRAW2 = 5
+
+ALG_NAMES = {
+    ALG_UNIFORM: "uniform",
+    ALG_LIST: "list",
+    ALG_TREE: "tree",
+    ALG_STRAW: "straw",
+    ALG_STRAW2: "straw2",
+}
+ALG_IDS = {v: k for k, v in ALG_NAMES.items()}
+
+# Rule step opcodes (shared with cpp/crush_ref.cpp :: RuleStep).
+OP_TAKE = 1
+OP_CHOOSE_FIRSTN = 2
+OP_CHOOSE_INDEP = 3
+OP_CHOOSELEAF_FIRSTN = 4
+OP_CHOOSELEAF_INDEP = 5
+OP_EMIT = 6
+OP_SET_CHOOSE_TRIES = 7
+OP_SET_CHOOSELEAF_TRIES = 8
+OP_SET_CHOOSE_LOCAL_TRIES = 9
+OP_SET_CHOOSE_LOCAL_FALLBACK_TRIES = 10
+OP_SET_CHOOSELEAF_VARY_R = 11
+OP_SET_CHOOSELEAF_STABLE = 12
+
+
+@dataclass(frozen=True)
+class Tunables:
+    """Retry/stability knobs (upstream ``crush_map`` fields, crush.h)."""
+
+    choose_total_tries: int = 50
+    choose_local_tries: int = 0
+    choose_local_fallback_tries: int = 0
+    chooseleaf_descend_once: int = 1
+    chooseleaf_vary_r: int = 1
+    chooseleaf_stable: int = 1
+
+    @staticmethod
+    def profile(name: str) -> "Tunables":
+        profiles = {
+            # historical profiles; jewel == optimal == default
+            "legacy": Tunables(19, 2, 5, 0, 0, 0),
+            "argonaut": Tunables(19, 2, 5, 0, 0, 0),
+            "bobtail": Tunables(50, 0, 0, 1, 0, 0),
+            "firefly": Tunables(50, 0, 0, 1, 1, 0),
+            "hammer": Tunables(50, 0, 0, 1, 1, 0),
+            "jewel": Tunables(50, 0, 0, 1, 1, 1),
+            "optimal": Tunables(50, 0, 0, 1, 1, 1),
+            "default": Tunables(50, 0, 0, 1, 1, 1),
+        }
+        return profiles[name]
+
+
+@dataclass
+class Step:
+    op: int
+    arg1: int = 0
+    arg2: int = 0
+
+
+@dataclass
+class Bucket:
+    id: int  # negative
+    name: str
+    type_id: int
+    alg: int = ALG_STRAW2
+    items: list[int] = field(default_factory=list)
+    item_weights: list[int] = field(default_factory=list)  # 16.16
+
+    @property
+    def weight(self) -> int:
+        return sum(self.item_weights)
+
+
+@dataclass
+class Rule:
+    id: int
+    name: str
+    kind: str = "replicated"  # or "erasure"
+    steps: list[Step] = field(default_factory=list)
+
+
+class CrushMap:
+    """Mutable CRUSH map with a CrushWrapper-parity mutation API."""
+
+    def __init__(self, tunables: Tunables | None = None):
+        self.tunables = tunables or Tunables.profile("default")
+        self.types: dict[int, str] = {0: "osd"}
+        self.buckets: dict[int, Bucket] = {}  # id (negative) -> bucket
+        self.rules: dict[int, Rule] = {}
+        self.device_names: dict[int, str] = {}  # osd id -> name
+        self.device_classes: dict[int, str] = {}  # osd id -> class name
+
+    # ---- types ----
+
+    def add_type(self, type_id: int, name: str) -> None:
+        self.types[type_id] = name
+
+    def type_id(self, name: str) -> int:
+        for tid, tname in self.types.items():
+            if tname == name:
+                return tid
+        raise KeyError(name)
+
+    # ---- devices ----
+
+    def add_device(self, osd: int, name: str | None = None, device_class: str | None = None) -> None:
+        self.device_names[osd] = name or f"osd.{osd}"
+        if device_class is not None:
+            self.device_classes[osd] = device_class
+
+    @property
+    def max_devices(self) -> int:
+        ids = list(self.device_names)
+        for b in self.buckets.values():
+            ids.extend(i for i in b.items if i >= 0)
+        return max(ids, default=-1) + 1
+
+    # ---- buckets ----
+
+    def add_bucket(
+        self,
+        name: str,
+        type_name: str,
+        alg: int = ALG_STRAW2,
+        bucket_id: int | None = None,
+    ) -> Bucket:
+        if bucket_id is None:
+            bucket_id = min(self.buckets, default=0) - 1
+        if bucket_id >= 0 or bucket_id in self.buckets:
+            raise ValueError(f"bad bucket id {bucket_id}")
+        if any(b.name == name for b in self.buckets.values()):
+            raise ValueError(f"duplicate bucket name {name}")
+        b = Bucket(id=bucket_id, name=name, type_id=self.type_id(type_name), alg=alg)
+        self.buckets[bucket_id] = b
+        return b
+
+    def bucket_by_name(self, name: str) -> Bucket:
+        for b in self.buckets.values():
+            if b.name == name:
+                return b
+        raise KeyError(name)
+
+    def item_name(self, item: int) -> str:
+        if item >= 0:
+            return self.device_names.get(item, f"osd.{item}")
+        return self.buckets[item].name
+
+    def insert_item(self, bucket_id: int, item: int, weight: int) -> None:
+        """Add item (device >= 0 or bucket < 0) with 16.16 weight."""
+        b = self.buckets[bucket_id]
+        if item in b.items:
+            raise ValueError(f"item {item} already in bucket {b.name}")
+        if item >= 0 and item not in self.device_names:
+            self.add_device(item)
+        b.items.append(item)
+        b.item_weights.append(int(weight))
+
+    def remove_item(self, bucket_id: int, item: int) -> None:
+        b = self.buckets[bucket_id]
+        i = b.items.index(item)
+        del b.items[i]
+        del b.item_weights[i]
+
+    def adjust_item_weight(self, bucket_id: int, item: int, weight: int) -> None:
+        b = self.buckets[bucket_id]
+        b.item_weights[b.items.index(item)] = int(weight)
+
+    def adjust_subtree_weights(self, bucket_id: int) -> int:
+        """Recompute this subtree's item weights bottom-up; returns total."""
+        b = self.buckets[bucket_id]
+        total = 0
+        for i, item in enumerate(b.items):
+            if item < 0:
+                b.item_weights[i] = self.adjust_subtree_weights(item)
+            total += b.item_weights[i]
+        return total
+
+    def parent_of(self, item: int) -> int | None:
+        for b in self.buckets.values():
+            if item in b.items:
+                return b.id
+        return None
+
+    # ---- rules ----
+
+    def add_rule(self, name: str, steps: list[Step], kind: str = "replicated", rule_id: int | None = None) -> Rule:
+        if rule_id is None:
+            rule_id = max(self.rules, default=-1) + 1
+        r = Rule(id=rule_id, name=name, kind=kind, steps=steps)
+        self.rules[rule_id] = r
+        return r
+
+    def rule_by_name(self, name: str) -> Rule:
+        for r in self.rules.values():
+            if r.name == name:
+                return r
+        raise KeyError(name)
+
+    def make_replicated_rule(self, name: str, root: str, failure_domain: str) -> Rule:
+        """`take root; chooseleaf firstn 0 type fd; emit` (the common rule)."""
+        root_id = self.bucket_by_name(root).id
+        fd = self.type_id(failure_domain)
+        steps = [Step(OP_TAKE, root_id), Step(OP_CHOOSELEAF_FIRSTN, 0, fd), Step(OP_EMIT)]
+        return self.add_rule(name, steps)
+
+    def make_erasure_rule(self, name: str, root: str, failure_domain: str) -> Rule:
+        root_id = self.bucket_by_name(root).id
+        fd = self.type_id(failure_domain)
+        steps = [
+            Step(OP_SET_CHOOSELEAF_TRIES, 5),
+            Step(OP_TAKE, root_id),
+            Step(OP_CHOOSELEAF_INDEP, 0, fd) if fd != 0 else Step(OP_CHOOSE_INDEP, 0, 0),
+            Step(OP_EMIT),
+        ]
+        return self.add_rule(name, steps, kind="erasure")
+
+    # ---- hierarchy queries ----
+
+    def max_depth(self) -> int:
+        """Longest bucket chain (root bucket -> ... -> device edge count)."""
+
+        def depth(bid: int) -> int:
+            b = self.buckets[bid]
+            sub = [depth(i) for i in b.items if i < 0]
+            return 1 + max(sub, default=0)
+
+        roots = [bid for bid in self.buckets if self.parent_of(bid) is None]
+        return max((depth(r) for r in roots), default=0)
+
+    # ---- serialization (framework-native, versioned JSON) ----
+
+    def to_obj(self) -> dict:
+        return {
+            "version": 1,
+            "tunables": asdict(self.tunables),
+            "types": self.types,
+            "devices": {str(k): v for k, v in self.device_names.items()},
+            "device_classes": {str(k): v for k, v in self.device_classes.items()},
+            "buckets": [
+                {
+                    "id": b.id,
+                    "name": b.name,
+                    "type_id": b.type_id,
+                    "alg": b.alg,
+                    "items": b.items,
+                    "item_weights": b.item_weights,
+                }
+                for b in self.buckets.values()
+            ],
+            "rules": [
+                {
+                    "id": r.id,
+                    "name": r.name,
+                    "kind": r.kind,
+                    "steps": [[s.op, s.arg1, s.arg2] for s in r.steps],
+                }
+                for r in self.rules.values()
+            ],
+        }
+
+    def encode(self) -> bytes:
+        return json.dumps(self.to_obj(), sort_keys=True).encode()
+
+    @staticmethod
+    def from_obj(obj: dict) -> "CrushMap":
+        m = CrushMap(Tunables(**obj["tunables"]))
+        m.types = {int(k): v for k, v in obj["types"].items()}
+        m.device_names = {int(k): v for k, v in obj["devices"].items()}
+        m.device_classes = {int(k): v for k, v in obj.get("device_classes", {}).items()}
+        for bo in obj["buckets"]:
+            b = Bucket(
+                id=bo["id"],
+                name=bo["name"],
+                type_id=bo["type_id"],
+                alg=bo["alg"],
+                items=list(bo["items"]),
+                item_weights=list(bo["item_weights"]),
+            )
+            m.buckets[b.id] = b
+        for ro in obj["rules"]:
+            m.rules[ro["id"]] = Rule(
+                id=ro["id"],
+                name=ro["name"],
+                kind=ro["kind"],
+                steps=[Step(*s) for s in ro["steps"]],
+            )
+        return m
+
+    @staticmethod
+    def decode(data: bytes) -> "CrushMap":
+        return CrushMap.from_obj(json.loads(data.decode()))
+
+    # ---- dense packing ----
+
+    def to_dense(self) -> "DenseCrushMap":
+        n_buckets = max((-bid for bid in self.buckets), default=0)
+        max_fanout = max((len(b.items) for b in self.buckets.values()), default=1)
+        max_fanout = max(max_fanout, 1)
+        alg = np.zeros(n_buckets, np.int32)
+        btype = np.zeros(n_buckets, np.int32)
+        size = np.zeros(n_buckets, np.int32)
+        items = np.zeros((n_buckets, max_fanout), np.int32)
+        weights = np.zeros((n_buckets, max_fanout), np.uint32)
+        for bid, b in self.buckets.items():
+            i = -1 - bid
+            alg[i] = b.alg
+            btype[i] = b.type_id
+            size[i] = len(b.items)
+            items[i, : len(b.items)] = b.items
+            weights[i, : len(b.items)] = b.item_weights
+        return DenseCrushMap(
+            n_buckets=n_buckets,
+            max_fanout=max_fanout,
+            max_devices=self.max_devices,
+            max_depth=self.max_depth(),
+            tunables=self.tunables,
+            alg=alg,
+            btype=btype,
+            size=size,
+            items=items,
+            weights=weights,
+        )
+
+
+@dataclass
+class DenseCrushMap:
+    """Flat dense form consumed by the C++ reference and the JAX path."""
+
+    n_buckets: int
+    max_fanout: int
+    max_devices: int
+    max_depth: int
+    tunables: Tunables
+    alg: np.ndarray  # [n_buckets] int32
+    btype: np.ndarray  # [n_buckets] int32
+    size: np.ndarray  # [n_buckets] int32
+    items: np.ndarray  # [n_buckets, max_fanout] int32
+    weights: np.ndarray  # [n_buckets, max_fanout] uint32
+
+    def algs_present(self) -> set[int]:
+        return set(int(a) for a in np.unique(self.alg[self.size > 0]))

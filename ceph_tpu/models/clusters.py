@@ -1,0 +1,110 @@
+"""Synthetic cluster-map builders (the framework's "model zoo").
+
+Equivalents of the reference's synthetic map constructors used
+throughout its tests and tools (upstream ``OSDMap::build_simple`` in
+``src/osd/OSDMap.cc`` and ``crushtool --build``): generate flat or
+multi-tier CRUSH hierarchies from device counts, for tests and
+benchmarks.
+"""
+
+from __future__ import annotations
+
+from ceph_tpu.crush.map import ALG_STRAW2, CrushMap, Tunables
+
+W1 = 0x10000  # weight 1.0 in 16.16
+
+
+def build_flat(n_osds: int, weight: int = W1, alg: int = ALG_STRAW2,
+               tunables: Tunables | None = None) -> CrushMap:
+    """One root bucket holding all OSDs."""
+    m = CrushMap(tunables)
+    m.add_type(1, "root")
+    root = m.add_bucket("default", "root", alg=alg)
+    for o in range(n_osds):
+        m.insert_item(root.id, o, weight)
+    m.make_replicated_rule("replicated_rule", "default", "osd")
+    return m
+
+
+def build_hierarchy(
+    spec: list[tuple[str, int]],
+    osds_per_leaf: int,
+    weight: int = W1,
+    alg: int = ALG_STRAW2,
+    tunables: Tunables | None = None,
+    failure_domain: str | None = None,
+) -> CrushMap:
+    """Multi-tier map.
+
+    ``spec`` is outer-to-inner, e.g. ``[("rack", 4), ("host", 8)]`` with
+    ``osds_per_leaf=4`` builds root -> 4 racks -> 8 hosts each -> 4 osds
+    each (128 OSDs).  A replicated rule over ``failure_domain`` (default:
+    the innermost non-osd tier) is added.
+    """
+    m = CrushMap(tunables)
+    m.add_type(1, "root")
+    for lvl, (tname, _) in enumerate(spec):
+        m.add_type(len(spec) + 1 - lvl, tname)
+
+    osd = [0]
+
+    def build_level(lvl: int, prefix: str) -> tuple[int, int]:
+        """Returns (bucket_id, subtree weight)."""
+        tname = spec[lvl][0] if lvl < len(spec) else None
+        if tname is None:
+            raise AssertionError
+        b = m.add_bucket(f"{tname}{prefix}", tname, alg=alg)
+        total = 0
+        if lvl == len(spec) - 1:
+            for _ in range(osds_per_leaf):
+                m.insert_item(b.id, osd[0], weight)
+                osd[0] += 1
+                total += weight
+        else:
+            for j in range(spec[lvl + 1][1]):
+                cid, cw = build_level(lvl + 1, f"{prefix}_{j}")
+                m.insert_item(b.id, cid, cw)
+                total += cw
+        return b.id, total
+
+    root = m.add_bucket("default", "root", alg=alg)
+    for i in range(spec[0][1]):
+        cid, cw = build_level(0, f"{i}")
+        m.insert_item(root.id, cid, cw)
+    fd = failure_domain or spec[-1][0]
+    m.make_replicated_rule("replicated_rule", "default", fd)
+    return m
+
+
+def build_simple(n_osds: int, osds_per_host: int = 4, hosts_per_rack: int = 8,
+                 tunables: Tunables | None = None) -> CrushMap:
+    """root -> racks -> hosts -> osds sized to cover ``n_osds`` devices."""
+    import math
+
+    n_hosts = math.ceil(n_osds / osds_per_host)
+    n_racks = max(1, math.ceil(n_hosts / hosts_per_rack))
+    m = CrushMap(tunables)
+    m.add_type(1, "root")
+    m.add_type(2, "rack")
+    m.add_type(3, "host")
+    root = m.add_bucket("default", "root")
+    osd = 0
+    for r in range(n_racks):
+        rack = m.add_bucket(f"rack{r}", "rack")
+        rack_w = 0
+        for h in range(hosts_per_rack):
+            if osd >= n_osds:
+                break
+            host = m.add_bucket(f"host{r}_{h}", "host")
+            host_w = 0
+            for _ in range(osds_per_host):
+                if osd >= n_osds:
+                    break
+                m.insert_item(host.id, osd, W1)
+                host_w += W1
+                osd += 1
+            m.insert_item(rack.id, host.id, host_w)
+            rack_w += host_w
+        m.insert_item(root.id, rack.id, rack_w)
+    m.make_replicated_rule("replicated_rule", "default", "host")
+    return m

@@ -1,0 +1,549 @@
+// CPU reference implementation of the CRUSH placement algorithm.
+//
+// Role in this framework (see SURVEY.md §2.1, §7): the reference mount is
+// empty, so this file is the repo's own ground truth for CRUSH semantics,
+// written from the recorded spec of upstream ceph's `src/crush/mapper.c`
+// (crush_do_rule / crush_choose_firstn / crush_choose_indep /
+// bucket_straw2_choose / bucket_perm_choose), `src/crush/hash.c`
+// (crush_hash32_rjenkins1_{2,3}) and `src/crush/crush.h` (tunables).
+// It is used for (a) differential testing of the JAX/TPU interpreter,
+// (b) golden placement vectors, (c) the single-core CPU baseline that the
+// TPU batch placement benchmark is compared against (BASELINE config 1).
+//
+// Deliberately structured differently from upstream (flat dense arrays, a
+// stateless permutation recompute instead of the upstream per-bucket work
+// cache) -- behavior-equivalent, not a source port.
+//
+// Build: g++ -O2 -shared -fPIC -o libcrushref.so crush_ref.cpp
+// Consumed via ctypes from ceph_tpu/testing/cppref.py.
+
+#include <cstdint>
+#include <cstring>
+
+#include "crush_ln_tables.h"
+
+namespace {
+
+constexpr uint32_t kHashSeed = 1315423911u;
+constexpr int32_t kItemNone = 0x7fffffff;   // CRUSH_ITEM_NONE
+constexpr int32_t kItemUndef = 0x7ffffffe;  // internal indep placeholder
+
+// Bucket algorithms (subset; ids match the spec's enum values).
+constexpr int32_t kAlgUniform = 1;
+constexpr int32_t kAlgList = 2;
+constexpr int32_t kAlgTree = 3;
+constexpr int32_t kAlgStraw = 4;
+constexpr int32_t kAlgStraw2 = 5;
+
+inline void mix(uint32_t& a, uint32_t& b, uint32_t& c) {
+  a = a - b - c; a ^= c >> 13;
+  b = b - c - a; b ^= a << 8;
+  c = c - a - b; c ^= b >> 13;
+  a = a - b - c; a ^= c >> 12;
+  b = b - c - a; b ^= a << 16;
+  c = c - a - b; c ^= b >> 5;
+  a = a - b - c; a ^= c >> 3;
+  b = b - c - a; b ^= a << 10;
+  c = c - a - b; c ^= b >> 15;
+}
+
+uint32_t hash2(uint32_t a, uint32_t b) {
+  uint32_t h = kHashSeed ^ a ^ b;
+  uint32_t x = 231232u, y = 1232u;
+  mix(a, b, h);
+  mix(x, a, h);
+  mix(b, y, h);
+  return h;
+}
+
+uint32_t hash3(uint32_t a, uint32_t b, uint32_t c) {
+  uint32_t h = kHashSeed ^ a ^ b ^ c;
+  uint32_t x = 231232u, y = 1232u;
+  mix(a, b, h);
+  mix(c, x, h);
+  mix(y, a, h);
+  mix(b, x, h);
+  mix(y, c, h);
+  return h;
+}
+
+// ~2^44 * log2(x+1) for x in [0, 0xffff]; 48-bit fixed point.
+uint64_t crush_ln(uint32_t xin) {
+  uint32_t x = xin + 1;
+  uint32_t iexpon = 15;
+  if (!(x & 0x18000)) {
+    int p = 31 - __builtin_clz(x);  // x >= 1
+    x <<= (15 - p);
+    iexpon = static_cast<uint32_t>(p);
+  }
+  uint32_t index1 = (x >> 8) << 1;
+  uint64_t rh = CRUSH_RH_LH_TBL[index1 - 256];
+  uint64_t lh = CRUSH_RH_LH_TBL[index1 - 255];
+  uint64_t xl64 = (static_cast<uint64_t>(x) * rh) >> 48;
+  uint64_t ll = CRUSH_LL_TBL[xl64 & 0xff];
+  return (static_cast<uint64_t>(iexpon) << 44) + ((lh + ll) >> 4);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Exposed for differential tests against the Python oracle / JAX path.
+uint32_t ct_hash2(uint32_t a, uint32_t b) { return hash2(a, b); }
+uint32_t ct_hash3(uint32_t a, uint32_t b, uint32_t c) { return hash3(a, b, c); }
+uint64_t ct_crush_ln(uint32_t x) { return crush_ln(x); }
+
+uint32_t ct_str_hash_rjenkins(const uint8_t* data, uint32_t length) {
+  uint32_t a = 0x9e3779b9u, b = 0x9e3779b9u, c = 0;
+  uint32_t n = length;
+  const uint8_t* k = data;
+  while (n >= 12) {
+    a += k[0] | (uint32_t)k[1] << 8 | (uint32_t)k[2] << 16 | (uint32_t)k[3] << 24;
+    b += k[4] | (uint32_t)k[5] << 8 | (uint32_t)k[6] << 16 | (uint32_t)k[7] << 24;
+    c += k[8] | (uint32_t)k[9] << 8 | (uint32_t)k[10] << 16 | (uint32_t)k[11] << 24;
+    mix(a, b, c);
+    k += 12;
+    n -= 12;
+  }
+  c += length;
+  switch (n) {
+    case 11: c += (uint32_t)k[10] << 24; [[fallthrough]];
+    case 10: c += (uint32_t)k[9] << 16; [[fallthrough]];
+    case 9:  c += (uint32_t)k[8] << 8; [[fallthrough]];
+    case 8:  b += (uint32_t)k[7] << 24; [[fallthrough]];
+    case 7:  b += (uint32_t)k[6] << 16; [[fallthrough]];
+    case 6:  b += (uint32_t)k[5] << 8; [[fallthrough]];
+    case 5:  b += k[4]; [[fallthrough]];
+    case 4:  a += (uint32_t)k[3] << 24; [[fallthrough]];
+    case 3:  a += (uint32_t)k[2] << 16; [[fallthrough]];
+    case 2:  a += (uint32_t)k[1] << 8; [[fallthrough]];
+    case 1:  a += k[0]; break;
+    default: break;
+  }
+  mix(a, b, c);
+  return c;
+}
+
+// Flat, ctypes-friendly map description.  Buckets are dense: bucket id b
+// (negative) lives at index (-1 - b).  items/weights are padded
+// [n_buckets x max_fanout] row-major; weights are 16.16 fixed point.
+struct MapSpec {
+  int32_t n_buckets;
+  int32_t max_fanout;
+  int32_t max_devices;
+  int32_t choose_total_tries;
+  int32_t choose_local_tries;
+  int32_t choose_local_fallback_tries;
+  int32_t chooseleaf_descend_once;
+  int32_t chooseleaf_vary_r;
+  int32_t chooseleaf_stable;
+  const int32_t* alg;        // [n_buckets]
+  const int32_t* type;       // [n_buckets]
+  const int32_t* size;       // [n_buckets]
+  const int32_t* items;      // [n_buckets * max_fanout]
+  const uint32_t* weights;   // [n_buckets * max_fanout]
+};
+
+// One rule step.  op codes are this framework's own enum (the text
+// compiler maps keywords to these):
+//   1 take(arg1=bucket id)          6 emit
+//   2 choose firstn(arg1=n, arg2=type)    3 choose indep
+//   4 chooseleaf firstn             5 chooseleaf indep
+//   7 set_choose_tries(arg1)        8 set_chooseleaf_tries(arg1)
+//   9 set_choose_local_tries       10 set_choose_local_fallback_tries
+//  11 set_chooseleaf_vary_r        12 set_chooseleaf_stable
+struct RuleStep {
+  int32_t op;
+  int32_t arg1;
+  int32_t arg2;
+};
+
+}  // extern "C"
+
+namespace {
+
+struct Ctx {
+  const MapSpec* map;
+  const uint32_t* osd_weight;  // [weight_max] 16.16 reweights
+  int32_t weight_max;
+  uint32_t x;
+  // effective tunables for the current rule execution
+  int32_t tries;
+  int32_t recurse_tries;
+  int32_t local_retries;
+  int32_t local_fallback_retries;
+  int32_t vary_r;
+  int32_t stable;
+};
+
+inline const int32_t* bucket_items(const MapSpec* m, int32_t bidx) {
+  return m->items + static_cast<int64_t>(bidx) * m->max_fanout;
+}
+inline const uint32_t* bucket_weights(const MapSpec* m, int32_t bidx) {
+  return m->weights + static_cast<int64_t>(bidx) * m->max_fanout;
+}
+
+bool is_out(const Ctx& c, int32_t item) {
+  if (item >= c.weight_max) return true;
+  uint32_t w = c.osd_weight[item];
+  if (w >= 0x10000u) return false;
+  if (w == 0) return true;
+  return (hash2(c.x, static_cast<uint32_t>(item)) & 0xffff) >= w;
+}
+
+int32_t straw2_choose(const Ctx& c, int32_t bidx, int32_t r) {
+  const MapSpec* m = c.map;
+  const int32_t* items = bucket_items(m, bidx);
+  const uint32_t* ws = bucket_weights(m, bidx);
+  int32_t size = m->size[bidx];
+  int32_t high = 0;
+  int64_t high_draw = 0;
+  for (int32_t i = 0; i < size; i++) {
+    int64_t draw;
+    if (ws[i]) {
+      uint32_t u = hash3(c.x, static_cast<uint32_t>(items[i]),
+                         static_cast<uint32_t>(r)) & 0xffff;
+      int64_t ln = static_cast<int64_t>(crush_ln(u)) - (1ll << 48);
+      draw = ln / static_cast<int64_t>(ws[i]);  // trunc toward zero, ln <= 0
+    } else {
+      draw = INT64_MIN;
+    }
+    if (i == 0 || draw > high_draw) {
+      high = i;
+      high_draw = draw;
+    }
+  }
+  return items[high];
+}
+
+// Stateless re-derivation of the seeded Fisher-Yates permutation the
+// uniform bucket uses (upstream memoizes it in per-bucket work space;
+// recomputing gives identical output).
+int32_t perm_choose(const Ctx& c, int32_t bidx, int32_t r) {
+  const MapSpec* m = c.map;
+  int32_t size = m->size[bidx];
+  if (size == 0) return kItemNone;
+  int32_t bucket_id = -1 - bidx;
+  uint32_t pr = static_cast<uint32_t>(r) % static_cast<uint32_t>(size);
+  // perm[] starts as identity; step p swaps perm[p] with perm[p + i]
+  // where i = hash(x, bucket_id, p) % (size - p).
+  int32_t perm[4096];
+  if (size > 4096) return kItemNone;  // fanout cap; build layer enforces
+  for (int32_t i = 0; i < size; i++) perm[i] = i;
+  for (uint32_t p = 0; p <= pr; p++) {
+    if (static_cast<int32_t>(p) < size - 1) {
+      uint32_t i = hash3(c.x, static_cast<uint32_t>(bucket_id), p) %
+                   static_cast<uint32_t>(size - p);
+      if (i) {
+        int32_t t = perm[p + i];
+        perm[p + i] = perm[p];
+        perm[p] = t;
+      }
+    }
+  }
+  return bucket_items(m, bidx)[perm[pr]];
+}
+
+int32_t bucket_choose(const Ctx& c, int32_t bidx, int32_t r) {
+  switch (c.map->alg[bidx]) {
+    case kAlgUniform:
+      return perm_choose(c, bidx, r);
+    case kAlgStraw2:
+      return straw2_choose(c, bidx, r);
+    default:
+      return kItemNone;  // list/tree/straw1 unsupported in the ref tier
+  }
+}
+
+// FIRSTN selection with the full retry ladder.  Returns new outpos.
+int32_t choose_firstn(const Ctx& c, int32_t bucket_idx, int32_t numrep,
+                      int32_t type, int32_t* out, int32_t outpos,
+                      int32_t out_size, int32_t tries, int32_t recurse_tries,
+                      int32_t local_retries, int32_t local_fallback_retries,
+                      bool recurse_to_leaf, int32_t* out2, int32_t parent_r) {
+  const MapSpec* m = c.map;
+  int32_t count = out_size;
+  for (int32_t rep = (c.stable ? 0 : outpos); rep < numrep && count > 0;
+       rep++) {
+    int32_t ftotal = 0;
+    bool skip_rep = false;
+    int32_t item = 0;
+    bool retry_descent;
+    do {
+      retry_descent = false;
+      int32_t in = bucket_idx;  // restart from the take bucket
+      int32_t flocal = 0;
+      bool retry_bucket;
+      do {
+        retry_bucket = false;
+        int32_t r = rep + parent_r + ftotal;
+        bool reject = false;
+        bool collide = false;
+        int32_t in_size = m->size[in];
+        if (in_size == 0) {
+          reject = true;
+        } else {
+          if (local_fallback_retries > 0 && flocal >= (in_size >> 1) &&
+              flocal > local_fallback_retries) {
+            item = perm_choose(c, in, r);  // exhaustive fallback search
+          } else {
+            item = bucket_choose(c, in, r);
+          }
+          if (item >= m->max_devices) {
+            skip_rep = true;
+            break;
+          }
+          int32_t itemtype =
+              item < 0 ? m->type[-1 - item] : 0;
+          if (itemtype != type) {
+            if (item >= 0 || (-1 - item) >= m->n_buckets) {
+              skip_rep = true;
+              break;
+            }
+            in = -1 - item;  // descend one level, same r
+            retry_bucket = true;
+            continue;
+          }
+          for (int32_t i = 0; i < outpos; i++) {
+            if (out[i] == item) {
+              collide = true;
+              break;
+            }
+          }
+          if (!collide && recurse_to_leaf) {
+            if (item < 0) {
+              int32_t sub_r = c.vary_r ? (r >> (c.vary_r - 1)) : 0;
+              if (choose_firstn(c, -1 - item, c.stable ? 1 : outpos + 1, 0,
+                                out2, outpos, count, recurse_tries, 0,
+                                local_retries, local_fallback_retries, false,
+                                nullptr, sub_r) <= outpos) {
+                reject = true;  // didn't reach a leaf
+              }
+            } else {
+              out2[outpos] = item;  // already a leaf
+            }
+          }
+          if (!reject && !collide && type == 0) {
+            reject = is_out(c, item);
+          }
+        }
+        if (reject || collide) {
+          ftotal++;
+          flocal++;
+          if (collide && flocal <= local_retries) {
+            retry_bucket = true;  // retry the same bucket a few times
+          } else if (local_fallback_retries > 0 &&
+                     flocal <= in_size + local_fallback_retries) {
+            retry_bucket = true;  // exhaustive bucket search
+          } else if (ftotal < tries) {
+            retry_descent = true;  // then restart the descent
+          } else {
+            skip_rep = true;  // give up on this replica slot
+          }
+        }
+      } while (retry_bucket);
+    } while (retry_descent);
+    if (skip_rep) continue;
+    out[outpos] = item;
+    outpos++;
+    count--;
+  }
+  return outpos;
+}
+
+// INDEP (positional, EC) selection; failures leave kItemNone holes.
+void choose_indep(const Ctx& c, int32_t bucket_idx, int32_t left,
+                  int32_t numrep, int32_t type, int32_t* out, int32_t outpos,
+                  int32_t tries, int32_t recurse_tries, bool recurse_to_leaf,
+                  int32_t* out2, int32_t parent_r) {
+  const MapSpec* m = c.map;
+  int32_t endpos = outpos + left;
+  for (int32_t rep = outpos; rep < endpos; rep++) {
+    out[rep] = kItemUndef;
+    if (out2) out2[rep] = kItemUndef;
+  }
+  for (int32_t ftotal = 0; left > 0 && ftotal < tries; ftotal++) {
+    for (int32_t rep = outpos; rep < endpos; rep++) {
+      if (out[rep] != kItemUndef) continue;
+      int32_t in = bucket_idx;
+      for (;;) {
+        int32_t r = rep + parent_r;
+        if (m->alg[in] == kAlgUniform &&
+            m->size[in] % numrep == 0) {
+          r += (numrep + 1) * ftotal;
+        } else {
+          r += numrep * ftotal;
+        }
+        if (m->size[in] == 0) {
+          out[rep] = kItemNone;
+          break;
+        }
+        int32_t item = bucket_choose(c, in, r);
+        if (item >= m->max_devices) {
+          out[rep] = kItemNone;
+          break;
+        }
+        int32_t itemtype = item < 0 ? m->type[-1 - item] : 0;
+        if (itemtype != type) {
+          if (item >= 0 || (-1 - item) >= m->n_buckets) {
+            out[rep] = kItemNone;
+            break;
+          }
+          in = -1 - item;
+          continue;
+        }
+        bool collide = false;
+        for (int32_t i = outpos; i < endpos; i++) {
+          if (out[i] == item) {
+            collide = true;
+            break;
+          }
+        }
+        if (collide) break;
+        if (recurse_to_leaf) {
+          if (item < 0) {
+            choose_indep(c, -1 - item, 1, numrep, 0, out2, rep, recurse_tries,
+                         0, false, nullptr, r);
+            if (out2[rep] == kItemNone) break;
+          } else {
+            out2[rep] = item;
+          }
+        }
+        if (type == 0 && is_out(c, item)) break;
+        out[rep] = item;
+        left--;
+        break;
+      }
+    }
+  }
+  for (int32_t rep = outpos; rep < endpos; rep++) {
+    if (out[rep] == kItemUndef) out[rep] = kItemNone;
+    if (out2 && out2[rep] == kItemUndef) out2[rep] = kItemNone;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Execute one rule for one x.  Returns number of results written to
+// `result` (each kItemNone for indep holes).  Scratch arrays sized
+// result_max are caller-provided to keep this allocation-free.
+int32_t ct_do_rule(const MapSpec* map, const RuleStep* steps, int32_t n_steps,
+                   uint32_t x, const uint32_t* osd_weight, int32_t weight_max,
+                   int32_t* result, int32_t result_max, int32_t* scratch_w,
+                   int32_t* scratch_o, int32_t* scratch_c) {
+  Ctx c;
+  c.map = map;
+  c.osd_weight = osd_weight;
+  c.weight_max = weight_max;
+  c.x = x;
+  int32_t choose_tries = map->choose_total_tries;
+  int32_t choose_leaf_tries = 0;
+  int32_t local_retries = map->choose_local_tries;
+  int32_t local_fallback_retries = map->choose_local_fallback_tries;
+  c.vary_r = map->chooseleaf_vary_r;
+  c.stable = map->chooseleaf_stable;
+
+  int32_t* w = scratch_w;
+  int32_t* o = scratch_o;
+  int32_t* cc = scratch_c;
+  int32_t wsize = 0;
+  int32_t result_len = 0;
+
+  for (int32_t s = 0; s < n_steps; s++) {
+    const RuleStep& st = steps[s];
+    switch (st.op) {
+      case 1: {  // take
+        int32_t a = st.arg1;
+        bool ok = (a >= 0 && a < map->max_devices) ||
+                  (a < 0 && (-1 - a) < map->n_buckets);
+        if (ok) {
+          w[0] = a;
+          wsize = 1;
+        }
+        break;
+      }
+      case 7: if (st.arg1 > 0) choose_tries = st.arg1; break;
+      case 8: if (st.arg1 > 0) choose_leaf_tries = st.arg1; break;
+      case 9: if (st.arg1 >= 0) local_retries = st.arg1; break;
+      case 10: if (st.arg1 >= 0) local_fallback_retries = st.arg1; break;
+      case 11: if (st.arg1 >= 0) c.vary_r = st.arg1; break;
+      case 12: if (st.arg1 >= 0) c.stable = st.arg1; break;
+      case 2:    // choose firstn
+      case 3:    // choose indep
+      case 4:    // chooseleaf firstn
+      case 5: {  // chooseleaf indep
+        bool firstn = (st.op == 2 || st.op == 4);
+        bool recurse_to_leaf = (st.op == 4 || st.op == 5);
+        int32_t osize = 0;
+        for (int32_t i = 0; i < wsize; i++) {
+          int32_t numrep = st.arg1;
+          if (numrep <= 0) {
+            numrep += result_max;
+            if (numrep <= 0) continue;
+          }
+          if (w[i] >= 0) continue;  // can't choose inside a device
+          int32_t bidx = -1 - w[i];
+          if (firstn) {
+            int32_t recurse_tries =
+                choose_leaf_tries
+                    ? choose_leaf_tries
+                    : (map->chooseleaf_descend_once ? 1 : choose_tries);
+            osize += choose_firstn(
+                c, bidx, numrep, st.arg2, o + osize, 0, result_max - osize,
+                choose_tries, recurse_tries, local_retries,
+                local_fallback_retries, recurse_to_leaf, cc + osize, 0);
+          } else {
+            int32_t out_size = (numrep < result_max - osize)
+                                   ? numrep
+                                   : (result_max - osize);
+            choose_indep(c, bidx, out_size, numrep, st.arg2, o + osize, 0,
+                         choose_tries,
+                         choose_leaf_tries ? choose_leaf_tries : 1,
+                         recurse_to_leaf, cc + osize, 0);
+            osize += out_size;
+          }
+        }
+        if (recurse_to_leaf) {
+          std::memcpy(o, cc, sizeof(int32_t) * osize);
+        }
+        // swap w <-> o
+        int32_t* t = w;
+        w = o;
+        o = t;
+        wsize = osize;
+        break;
+      }
+      case 6: {  // emit
+        for (int32_t i = 0; i < wsize && result_len < result_max; i++) {
+          result[result_len++] = w[i];
+        }
+        wsize = 0;
+        break;
+      }
+      default:
+        break;
+    }
+  }
+  return result_len;
+}
+
+// Batch driver: the CrushTester-equivalent inner loop (serial over x).
+// results is [n_x * result_max]; lens is [n_x].
+void ct_do_rule_batch(const MapSpec* map, const RuleStep* steps,
+                      int32_t n_steps, const uint32_t* xs, int64_t n_x,
+                      const uint32_t* osd_weight, int32_t weight_max,
+                      int32_t* results, int32_t* lens, int32_t result_max) {
+  int32_t sw[256], so[256], sc[256];
+  if (result_max > 256) return;
+  for (int64_t i = 0; i < n_x; i++) {
+    lens[i] = ct_do_rule(map, steps, n_steps, xs[i], osd_weight, weight_max,
+                         results + i * result_max, result_max, sw, so, sc);
+    for (int32_t j = lens[i]; j < result_max; j++) {
+      results[i * result_max + j] = kItemNone;
+    }
+  }
+}
+
+}  // extern "C"

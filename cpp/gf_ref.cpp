@@ -1,0 +1,328 @@
+// CPU reference for the erasure-coding math (GF(2^8) w=8).
+//
+// Ground truth for the JAX/MXU erasure plugins (SURVEY.md §2.2): the
+// classical constructions behind the reference's jerasure plugin family --
+// systematized extended-Vandermonde Reed-Solomon ("reed_sol_van"
+// semantics), RAID6 ("reed_sol_r6_op"), original Cauchy, GF->GF(2)
+// bit-matrix expansion, matrix/bitmatrix encode & decode -- implemented
+// from their published algebraic definitions over GF(2^8) with primitive
+// polynomial 0x11d.
+//
+// Build: g++ -O2 -shared -fPIC -o libgfref.so gf_ref.cpp
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int kFieldSize = 256;
+constexpr int kPrimPoly = 0x11d;
+
+uint8_t g_log[kFieldSize];
+uint8_t g_exp[kFieldSize * 2];
+bool g_init = false;
+
+void gf_init() {
+  if (g_init) return;
+  int x = 1;
+  for (int i = 0; i < 255; i++) {
+    g_exp[i] = static_cast<uint8_t>(x);
+    g_log[x] = static_cast<uint8_t>(i);
+    x <<= 1;
+    if (x & 0x100) x ^= kPrimPoly;
+  }
+  for (int i = 255; i < 512; i++) g_exp[i] = g_exp[i - 255];
+  g_log[0] = 0;  // log(0) undefined; callers must check
+  g_init = true;
+}
+
+inline uint8_t gf_mul(uint8_t a, uint8_t b) {
+  if (a == 0 || b == 0) return 0;
+  return g_exp[g_log[a] + g_log[b]];
+}
+
+inline uint8_t gf_inv(uint8_t a) {
+  return g_exp[255 - g_log[a]];  // a != 0
+}
+
+inline uint8_t gf_div(uint8_t a, uint8_t b) {
+  if (a == 0) return 0;
+  return g_exp[(g_log[a] + 255 - g_log[b]) % 255];
+}
+
+}  // namespace
+
+extern "C" {
+
+void gfref_tables(uint8_t* log_out, uint8_t* exp_out) {
+  gf_init();
+  std::memcpy(log_out, g_log, kFieldSize);
+  std::memcpy(exp_out, g_exp, kFieldSize);
+}
+
+uint8_t gfref_mul(uint8_t a, uint8_t b) {
+  gf_init();
+  return gf_mul(a, b);
+}
+
+// m x k coding matrix with reed_sol_van semantics: build the extended
+// Vandermonde matrix (k+m rows: e_0; [1, i, i^2, ...] for i=1..k+m-2;
+// e_{k-1}), systematize the top k x k block to identity by column
+// operations, return the bottom m rows.
+int gfref_vandermonde_matrix(int k, int m, uint8_t* out /* m*k */) {
+  gf_init();
+  int rows = k + m;
+  if (rows > 256) return -1;
+  // Build extended Vandermonde (rows x k).
+  uint8_t v[256 * 256];
+  for (int j = 0; j < k; j++) v[j] = (j == 0) ? 1 : 0;
+  for (int i = 1; i < rows - 1; i++) {
+    uint8_t e = 1;
+    for (int j = 0; j < k; j++) {
+      v[i * k + j] = e;
+      e = gf_mul(e, static_cast<uint8_t>(i));
+    }
+  }
+  for (int j = 0; j < k; j++) v[(rows - 1) * k + j] = (j == k - 1) ? 1 : 0;
+
+  // Systematize: for each pivot column i make top block identity using
+  // row swaps + column scaling + column elimination (preserves the code).
+  for (int i = 1; i < k; i++) {
+    // find a row >= i with nonzero pivot, swap into place
+    int pr = -1;
+    for (int r = i; r < rows; r++) {
+      if (v[r * k + i] != 0) {
+        pr = r;
+        break;
+      }
+    }
+    if (pr < 0) return -2;
+    if (pr != i) {
+      for (int j = 0; j < k; j++) {
+        uint8_t t = v[pr * k + j];
+        v[pr * k + j] = v[i * k + j];
+        v[i * k + j] = t;
+      }
+    }
+    if (v[i * k + i] != 1) {
+      uint8_t inv = gf_div(1, v[i * k + i]);
+      for (int r = 0; r < rows; r++) {
+        v[r * k + i] = gf_mul(inv, v[r * k + i]);
+      }
+    }
+    for (int j = 0; j < k; j++) {
+      uint8_t f = v[i * k + j];
+      if (j != i && f != 0) {
+        for (int r = 0; r < rows; r++) {
+          v[r * k + j] ^= gf_mul(f, v[r * k + i]);
+        }
+      }
+    }
+  }
+  std::memcpy(out, v + k * k, static_cast<size_t>(m) * k);
+  return 0;
+}
+
+// RAID6 m=2: P row = all ones, Q row = [1, 2, 4, ...] (powers of alpha).
+void gfref_raid6_matrix(int k, uint8_t* out /* 2*k */) {
+  gf_init();
+  uint8_t e = 1;
+  for (int j = 0; j < k; j++) {
+    out[j] = 1;
+    out[k + j] = e;
+    e = gf_mul(e, 2);
+  }
+}
+
+// Original Cauchy: M[i][j] = 1 / (i ^ (m + j)).
+int gfref_cauchy_matrix(int k, int m, uint8_t* out /* m*k */) {
+  gf_init();
+  if (k + m > 256) return -1;
+  for (int i = 0; i < m; i++) {
+    for (int j = 0; j < k; j++) {
+      uint8_t d = static_cast<uint8_t>(i ^ (m + j));
+      if (d == 0) return -2;
+      out[i * k + j] = gf_inv(d);
+    }
+  }
+  return 0;
+}
+
+// coding[i] = XOR_j gf_mul(matrix[i*k+j], data[j]) over byte regions.
+void gfref_matrix_encode(int k, int m, const uint8_t* matrix,
+                         const uint8_t* const* data_ptrs,
+                         uint8_t* const* coding_ptrs, int64_t size) {
+  gf_init();
+  for (int i = 0; i < m; i++) {
+    uint8_t* out = coding_ptrs[i];
+    std::memset(out, 0, static_cast<size_t>(size));
+    for (int j = 0; j < k; j++) {
+      uint8_t e = matrix[i * k + j];
+      if (e == 0) continue;
+      const uint8_t* in = data_ptrs[j];
+      if (e == 1) {
+        for (int64_t b = 0; b < size; b++) out[b] ^= in[b];
+      } else {
+        int le = g_log[e];
+        for (int64_t b = 0; b < size; b++) {
+          if (in[b]) out[b] ^= g_exp[le + g_log[in[b]]];
+        }
+      }
+    }
+  }
+}
+
+// Contiguous-buffer convenience wrapper (ctypes-friendly): data is k
+// chunks of `size` bytes back to back; coding likewise m chunks.
+void gfref_matrix_encode_flat(int k, int m, const uint8_t* matrix,
+                              const uint8_t* data, uint8_t* coding,
+                              int64_t size) {
+  const uint8_t* dptr[256];
+  uint8_t* cptr[256];
+  for (int j = 0; j < k; j++) dptr[j] = data + static_cast<int64_t>(j) * size;
+  for (int i = 0; i < m; i++) cptr[i] = coding + static_cast<int64_t>(i) * size;
+  gfref_matrix_encode(k, m, matrix, dptr, cptr, size);
+}
+
+// Invert a k x k GF(2^8) matrix in place (Gauss-Jordan).  Returns 0 on
+// success, -1 if singular.
+int gfref_invert_matrix(int k, uint8_t* mat, uint8_t* inv_out) {
+  gf_init();
+  uint8_t a[256 * 256];
+  std::memcpy(a, mat, static_cast<size_t>(k) * k);
+  for (int i = 0; i < k; i++) {
+    for (int j = 0; j < k; j++) inv_out[i * k + j] = (i == j) ? 1 : 0;
+  }
+  for (int col = 0; col < k; col++) {
+    int pr = -1;
+    for (int r = col; r < k; r++) {
+      if (a[r * k + col] != 0) {
+        pr = r;
+        break;
+      }
+    }
+    if (pr < 0) return -1;
+    if (pr != col) {
+      for (int j = 0; j < k; j++) {
+        uint8_t t = a[pr * k + j];
+        a[pr * k + j] = a[col * k + j];
+        a[col * k + j] = t;
+        t = inv_out[pr * k + j];
+        inv_out[pr * k + j] = inv_out[col * k + j];
+        inv_out[col * k + j] = t;
+      }
+    }
+    uint8_t piv = a[col * k + col];
+    if (piv != 1) {
+      uint8_t inv = gf_inv(piv);
+      for (int j = 0; j < k; j++) {
+        a[col * k + j] = gf_mul(inv, a[col * k + j]);
+        inv_out[col * k + j] = gf_mul(inv, inv_out[col * k + j]);
+      }
+    }
+    for (int r = 0; r < k; r++) {
+      uint8_t f = a[r * k + col];
+      if (r != col && f != 0) {
+        for (int j = 0; j < k; j++) {
+          a[r * k + j] ^= gf_mul(f, a[col * k + j]);
+          inv_out[r * k + j] ^= gf_mul(f, inv_out[col * k + j]);
+        }
+      }
+    }
+  }
+  return 0;
+}
+
+// Expand an m x k GF(2^w) matrix into an (m*w) x (k*w) GF(2) bit-matrix
+// (w=8 here): block (i,j) has entry[row t][col l] = bit t of
+// (M[i][j] * alpha^l).  out is row-major bytes in {0,1}.
+void gfref_matrix_to_bitmatrix(int k, int m, const uint8_t* matrix,
+                               uint8_t* out /* (m*8)*(k*8) */) {
+  gf_init();
+  int w = 8;
+  int rowlen = k * w;
+  for (int i = 0; i < m; i++) {
+    for (int j = 0; j < k; j++) {
+      uint8_t e = matrix[i * k + j];
+      for (int l = 0; l < w; l++) {  // input bit / column within block
+        for (int t = 0; t < w; t++) {  // output bit / row within block
+          out[(i * w + t) * rowlen + (j * w + l)] = (e >> t) & 1;
+        }
+        e = gf_mul(e, 2);
+      }
+    }
+  }
+}
+
+// Bitmatrix encode with packet interleaving ("schedule" semantics):
+// each chunk is groups of w packets of `packetsize` bytes; parity packet
+// (i, t) of each group = XOR of data packets (j, l) where
+// bitmatrix[(i*w+t)][(j*w+l)] = 1.  size must be a multiple of
+// w*packetsize.
+void gfref_bitmatrix_encode(int k, int m, const uint8_t* bitmatrix,
+                            const uint8_t* data, uint8_t* coding,
+                            int64_t size, int64_t packetsize) {
+  int w = 8;
+  int rowlen = k * w;
+  int64_t group = static_cast<int64_t>(w) * packetsize;
+  int64_t ngroups = size / group;
+  std::memset(coding, 0, static_cast<size_t>(m) * size);
+  for (int i = 0; i < m; i++) {
+    for (int t = 0; t < w; t++) {
+      const uint8_t* brow = bitmatrix + (i * w + t) * rowlen;
+      for (int j = 0; j < k; j++) {
+        for (int l = 0; l < w; l++) {
+          if (!brow[j * w + l]) continue;
+          for (int64_t g = 0; g < ngroups; g++) {
+            uint8_t* out =
+                coding + i * size + g * group + t * packetsize;
+            const uint8_t* in =
+                data + j * size + g * group + l * packetsize;
+            for (int64_t b = 0; b < packetsize; b++) out[b] ^= in[b];
+          }
+        }
+      }
+    }
+  }
+}
+
+// Invert an n x n GF(2) bit-matrix (bytes in {0,1}).  Returns 0 or -1.
+int gfref_invert_bitmatrix(int n, const uint8_t* mat, uint8_t* inv_out) {
+  if (n > 512) return -1;
+  static uint8_t a[512 * 512];
+  std::memcpy(a, mat, static_cast<size_t>(n) * n);
+  for (int i = 0; i < n; i++) {
+    for (int j = 0; j < n; j++) inv_out[i * n + j] = (i == j) ? 1 : 0;
+  }
+  for (int col = 0; col < n; col++) {
+    int pr = -1;
+    for (int r = col; r < n; r++) {
+      if (a[r * n + col]) {
+        pr = r;
+        break;
+      }
+    }
+    if (pr < 0) return -1;
+    if (pr != col) {
+      for (int j = 0; j < n; j++) {
+        uint8_t t = a[pr * n + j];
+        a[pr * n + j] = a[col * n + j];
+        a[col * n + j] = t;
+        t = inv_out[pr * n + j];
+        inv_out[pr * n + j] = inv_out[col * n + j];
+        inv_out[col * n + j] = t;
+      }
+    }
+    for (int r = 0; r < n; r++) {
+      if (r != col && a[r * n + col]) {
+        for (int j = 0; j < n; j++) {
+          a[r * n + j] ^= a[col * n + j];
+          inv_out[r * n + j] ^= inv_out[col * n + j];
+        }
+      }
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,61 @@
+"""Test environment: hermetic CPU backend with a virtual 8-device mesh.
+
+Tests never depend on the real TPU chip: they force the CPU platform and
+create 8 virtual devices so multi-chip sharding paths (shard_map over a
+Mesh) are exercised.  Benchmarks (bench.py, bench/) do NOT import this
+and run on the real TPU.
+
+Re-exec note: this machine injects a TPU-tunnel JAX plugin via a
+``sitecustomize`` on PYTHONPATH that force-initializes the (single
+tenant, slow-to-attach) TPU client even under ``JAX_PLATFORMS=cpu`` --
+the first jax op in a test would blockingly attach the real TPU.  For
+hermetic CPU tests, ``pytest_configure`` re-runs pytest once in a child
+process with PYTHONPATH scrubbed of that site dir, with pytest's output
+capture suspended so the child writes to the real stdout.
+"""
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _needs_reexec() -> bool:
+    if os.environ.get("CEPH_TPU_TEST_REEXEC") == "1":
+        return False
+    return os.environ.get("_AXON_REGISTERED") is not None or any(
+        ".axon_site" in p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+    )
+
+
+def pytest_configure(config):
+    if not _needs_reexec():
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        return
+
+    import subprocess
+
+    env = dict(os.environ)
+    env["CEPH_TPU_TEST_REEXEC"] = "1"
+    env["PYTHONPATH"] = _REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+    cmd = [sys.executable, "-m", "pytest", *config.invocation_params.args]
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        with capman.global_and_fixture_disabled():
+            rc = subprocess.call(cmd, env=env)
+    else:
+        rc = subprocess.call(cmd, env=env)
+    os._exit(rc)

@@ -1,0 +1,142 @@
+"""Differential tests: JAX core primitives vs the pure-Python oracle."""
+
+import math
+import random
+
+import numpy as np
+import pytest
+
+import ceph_tpu  # noqa: F401  (enables x64)
+import jax.numpy as jnp
+
+from ceph_tpu.core import hashes, ref
+
+random.seed(1234)
+
+
+def rand_u32(n):
+    return [random.getrandbits(32) for _ in range(n)]
+
+
+def test_hash32_2_matches_oracle():
+    a, b = rand_u32(4096), rand_u32(4096)
+    want = np.array([ref.crush_hash32_2(x, y) for x, y in zip(a, b)], np.uint32)
+    got = np.asarray(hashes.crush_hash32_2(np.array(a, np.uint32), np.array(b, np.uint32)))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_hash32_3_matches_oracle():
+    a, b, c = rand_u32(4096), rand_u32(4096), rand_u32(4096)
+    want = np.array(
+        [ref.crush_hash32_3(x, y, z) for x, y, z in zip(a, b, c)], np.uint32
+    )
+    got = np.asarray(
+        hashes.crush_hash32_3(
+            np.array(a, np.uint32), np.array(b, np.uint32), np.array(c, np.uint32)
+        )
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_hash32_3_negative_ids():
+    # Bucket ids are negative ints cast to u32; both paths must agree.
+    ids = [-1, -2, -17, -100000]
+    for i in ids:
+        want = ref.crush_hash32_3(1234, i & 0xFFFFFFFF, 0)
+        got = int(hashes.crush_hash32_3(jnp.uint32(1234), jnp.int32(i).astype(jnp.uint32), jnp.uint32(0)))
+        assert got == want
+
+
+def test_crush_ln_exhaustive():
+    u = np.arange(65536, dtype=np.uint32)
+    got = np.asarray(hashes.crush_ln(u))
+    want = np.array([ref.crush_ln(int(x)) for x in range(65536)], np.uint64)
+    np.testing.assert_array_equal(got, want)
+    assert got[0] == 0
+    assert got[-1] == 1 << 48
+    # monotone non-decreasing in u
+    assert np.all(np.diff(got.astype(np.int64)) >= 0)
+
+
+def test_crush_ln_accuracy():
+    u = np.arange(65536, dtype=np.uint32)
+    got = np.asarray(hashes.crush_ln(u)).astype(np.float64) / 2**44
+    want = np.log2(u.astype(np.float64) + 1)
+    assert np.max(np.abs(got - want)) < 1e-4
+
+
+def test_stable_mod_matches_oracle():
+    for pg_num in [1, 2, 3, 6, 8, 100, 1024, 4096 + 7]:
+        bmask = ref.pg_num_mask(pg_num)
+        xs = np.array(rand_u32(512), np.uint32)
+        want = np.array(
+            [ref.ceph_stable_mod(int(x), pg_num, bmask) for x in xs], np.uint32
+        )
+        got = np.asarray(hashes.ceph_stable_mod(xs, np.uint32(pg_num), np.uint32(bmask)))
+        np.testing.assert_array_equal(got, want)
+        assert np.all(got < pg_num)
+
+
+def test_str_hash_rjenkins_known_lengths():
+    # Oracle self-checks across block boundaries (0..25 byte names).
+    for n in range(26):
+        name = bytes((i * 7 + 3) & 0xFF for i in range(n))
+        h = ref.ceph_str_hash_rjenkins(name)
+        assert 0 <= h <= 0xFFFFFFFF
+    # distinct names should essentially never collide in a tiny sample
+    hs = {ref.ceph_str_hash_rjenkins(f"obj{i}".encode()) for i in range(1000)}
+    assert len(hs) == 1000
+
+
+def test_straw2_negdraw_matches_signed_oracle():
+    n = 4096
+    xs = np.array(rand_u32(n), np.uint32)
+    ids = np.array([random.randrange(-50, 50) for _ in range(n)], np.int32)
+    rs = np.array([random.randrange(0, 60) for _ in range(n)], np.uint32)
+    ws = np.array(
+        [random.choice([0, 1, 0xFFFF, 0x10000, 0x23456, 0xFFFFFF]) for _ in range(n)],
+        np.uint32,
+    )
+    got = np.asarray(
+        hashes.straw2_negdraw(xs, ids.astype(np.uint32), rs, ws)
+    ).astype(np.uint64)
+    for i in range(n):
+        want_draw = ref.straw2_draw(int(xs[i]), int(ids[i]) & 0xFFFFFFFF, int(rs[i]), int(ws[i]))
+        if int(ws[i]) == 0:
+            assert got[i] == 0xFFFFFFFFFFFFFFFF
+        else:
+            assert int(got[i]) == -want_draw, (i, xs[i], ids[i], rs[i], ws[i])
+
+
+def test_straw2_argmin_equals_oracle_choose():
+    random.seed(99)
+    for trial in range(200):
+        nitems = random.randrange(1, 12)
+        ids = [random.randrange(-30, 30) for _ in range(nitems)]
+        ws = [random.choice([0, 0x8000, 0x10000, 0x30000]) for _ in range(nitems)]
+        x = random.getrandbits(32)
+        r = random.randrange(0, 50)
+        want = ref.bucket_straw2_choose(ids, ws, x, r)
+        nd = hashes.straw2_negdraw(
+            np.full(nitems, x, np.uint32),
+            np.array(ids, np.int32).astype(np.uint32),
+            np.full(nitems, r, np.uint32),
+            np.array(ws, np.uint32),
+        )
+        got = int(jnp.argmin(nd))
+        assert got == want
+
+
+def test_is_out_matches_oracle():
+    n = 2048
+    xs = np.array(rand_u32(n), np.uint32)
+    items = np.array([random.randrange(0, 1000) for _ in range(n)], np.uint32)
+    ws = np.array(
+        [random.choice([0, 1, 0x7FFF, 0xFFFF, 0x10000, 0x20000]) for _ in range(n)],
+        np.uint32,
+    )
+    got = np.asarray(hashes.is_out(ws, items, xs))
+    want = np.array(
+        [ref.is_out(int(w), int(i), int(x)) for w, i, x in zip(ws, items, xs)]
+    )
+    np.testing.assert_array_equal(got, want)

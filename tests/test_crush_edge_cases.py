@@ -1,0 +1,85 @@
+"""Edge-case parity tests distilled from code-review repros."""
+
+import numpy as np
+import pytest
+
+import ceph_tpu  # noqa: F401
+from ceph_tpu.crush.interp import StaticCrushMap, batch_do_rule
+from ceph_tpu.crush.map import (
+    ALG_LIST,
+    CrushMap,
+    Step,
+    OP_CHOOSE_FIRSTN,
+    OP_CHOOSE_INDEP,
+    OP_EMIT,
+    OP_SET_CHOOSE_TRIES,
+    OP_TAKE,
+)
+from ceph_tpu.models import build_flat
+from ceph_tpu.testing import cppref
+
+
+def assert_same(m, rule, xs, w, result_max):
+    dense = m.to_dense()
+    steps = [(s.op, s.arg1, s.arg2) for s in rule.steps]
+    want, want_lens = cppref.do_rule_batch(dense, steps, xs, w, result_max)
+    got, got_lens = batch_do_rule(StaticCrushMap(dense), rule, xs, w, result_max)
+    np.testing.assert_array_equal(want, np.asarray(got))
+    np.testing.assert_array_equal(want_lens, np.asarray(got_lens))
+
+
+def test_indep_empty_bucket_is_permanent_none():
+    # root -> host0 (empty), host1 (2 osds); indep must leave NONE holes
+    # whenever the descent lands in the empty host.
+    m = CrushMap()
+    m.add_type(1, "root")
+    m.add_type(2, "host")
+    h0 = m.add_bucket("host0", "host")
+    h1 = m.add_bucket("host1", "host")
+    m.insert_item(h1.id, 0, 0x10000)
+    m.insert_item(h1.id, 1, 0x10000)
+    root = m.add_bucket("default", "root")
+    m.insert_item(root.id, h0.id, 0x10000)
+    m.insert_item(root.id, h1.id, 0x20000)
+    rule = m.add_rule(
+        "ec", [Step(OP_TAKE, root.id), Step(OP_CHOOSE_INDEP, 2, 2), Step(OP_EMIT)]
+    )
+    xs = np.arange(200, dtype=np.uint32)
+    w = np.full(2, 0x10000, np.uint32)
+    assert_same(m, rule, xs, w, 2)
+
+
+def test_firstn_numrep_beyond_result_max_fills_quota():
+    # choose firstn 6 with result_max=3 and tries=1: failed early slots
+    # must not stop later slots from filling the 3-result quota.
+    m = build_flat(8)
+    root_id = m.bucket_by_name("default").id
+    rule = m.add_rule(
+        "wide",
+        [
+            Step(OP_SET_CHOOSE_TRIES, 1),
+            Step(OP_TAKE, root_id),
+            Step(OP_CHOOSE_FIRSTN, 6, 0),
+            Step(OP_EMIT),
+        ],
+    )
+    xs = np.arange(500, dtype=np.uint32)
+    w = np.full(8, 0x10000, np.uint32)
+    assert_same(m, rule, xs, w, 3)
+
+
+def test_unsupported_bucket_alg_raises():
+    m = build_flat(8, alg=ALG_LIST)
+    with pytest.raises(NotImplementedError, match="legacy"):
+        StaticCrushMap(m.to_dense())
+
+
+def test_cppref_result_max_guard():
+    m = build_flat(4)
+    dense = m.to_dense()
+    steps = [(s.op, s.arg1, s.arg2) for s in m.rules[0].steps]
+    with pytest.raises(ValueError, match="scratch cap"):
+        cppref.do_rule_batch(
+            dense, steps, np.arange(4, dtype=np.uint32),
+            np.full(4, 0x10000, np.uint32), 300,
+        )
