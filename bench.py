@@ -1,0 +1,79 @@
+"""Headline benchmark: batch CRUSH placement throughput on the TPU.
+
+Runs BASELINE config 1 (3-replica straw2 placement over a 1M-object
+batch on a rack/host/osd map) on the real device, against the in-repo
+single-core C++ CPU reference as baseline (the stand-in for the
+reference's serial `crushtool --test` loop, upstream
+``src/crush/CrushTester.cc``).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+N_OBJECTS = 1_000_000
+CPU_SAMPLE = 50_000
+N_OSDS = 1024
+REPLICAS = 3
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.crush.interp import StaticCrushMap, compile_rule
+    from ceph_tpu.models.clusters import build_simple
+    from ceph_tpu.testing import cppref
+
+    m = build_simple(N_OSDS)
+    rule = m.rule_by_name("replicated_rule")
+    dense = m.to_dense()
+    smap = StaticCrushMap(dense)
+    osd_weight_np = np.full(smap.max_devices, 0x10000, np.uint32)
+
+    # --- CPU baseline (single core, C++ reference) ---
+    steps = [(s.op, s.arg1, s.arg2) for s in rule.steps]
+    xs_cpu = np.arange(CPU_SAMPLE, dtype=np.uint32)
+    t0 = time.perf_counter()
+    cppref.do_rule_batch(dense, steps, xs_cpu, osd_weight_np, REPLICAS)
+    cpu_rate = CPU_SAMPLE / (time.perf_counter() - t0)
+
+    # --- TPU path ---
+    run = compile_rule(smap, rule, REPLICAS)
+
+    @jax.jit
+    def batch(osd_weight, xs):
+        return jax.vmap(lambda x: run(smap, osd_weight, x))(xs)
+
+    osd_weight = jnp.asarray(osd_weight_np)
+    xs = jnp.arange(N_OBJECTS, dtype=jnp.uint32)
+    jax.block_until_ready(batch(osd_weight, xs))  # compile + warm
+    iters = 3
+    t0 = time.perf_counter()
+    for i in range(iters):
+        jax.block_until_ready(batch(osd_weight, xs + np.uint32(i * N_OBJECTS)))
+    dt = (time.perf_counter() - t0) / iters
+    tpu_rate = N_OBJECTS / dt
+
+    print(
+        json.dumps(
+            {
+                "metric": "crush_placements_per_sec",
+                "value": round(tpu_rate),
+                "unit": "placements/s",
+                "vs_baseline": round(tpu_rate / cpu_rate, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,12 @@
+"""Multi-chip scale-out: meshes, sharded placement, collectives.
+
+The reference scales by adding daemons connected with a hand-written
+messenger (upstream ``src/msg/async``) and parallelizes whole-map
+placement with a CPU threadpool (``src/osd/OSDMapMapping.h ::
+ParallelPGMapper``).  The TPU-native equivalent has no sockets: a
+``jax.sharding.Mesh`` over chips, the map replicated, the object batch
+sharded, and XLA collectives (psum) for cluster-wide reductions such as
+per-OSD utilization histograms.
+"""
+
+from .placement import make_mesh, sharded_placement_step  # noqa: F401

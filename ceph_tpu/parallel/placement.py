@@ -1,0 +1,69 @@
+"""Sharded batch placement over a device mesh.
+
+One XLA launch computes placements for a global object batch sharded
+across all chips (the map and OSD reweights replicated), and reduces a
+per-OSD utilization histogram over the mesh with ``psum`` — the
+cluster-wide statistic the reference gathers through its messenger +
+mgr aggregation path (upstream ``src/mgr/DaemonServer.cc`` perf report
+flow) and that `crushtool --test --show-statistics` tallies serially.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ceph_tpu.crush.interp import StaticCrushMap, compile_rule
+from ceph_tpu.crush.map import ITEM_NONE, Rule
+
+
+def make_mesh(n_devices: int | None = None, axis: str = "objects") -> Mesh:
+    """1-D mesh over the first n devices (default: all)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def sharded_placement_step(
+    mesh: Mesh,
+    smap: StaticCrushMap,
+    rule: Rule,
+    result_max: int,
+    axis: str = "objects",
+):
+    """Build a jitted step: (osd_weight, xs) -> (results, lens, histogram).
+
+    ``xs`` is the global object-seed batch, sharded along the mesh;
+    results come back with the same sharding; the per-OSD histogram is
+    psum-reduced across chips so every chip holds the global tally.
+    """
+    run = compile_rule(smap, rule, result_max)
+    n_osds = smap.max_devices
+
+    def local_step(smap_, osd_weight, xs):
+        results, lens = jax.vmap(lambda x: run(smap_, osd_weight, x))(xs)
+        chosen = jnp.where(results == ITEM_NONE, n_osds, results)
+        hist = jnp.zeros((n_osds + 1,), jnp.int32).at[chosen.reshape(-1)].add(1)
+        hist = jax.lax.psum(hist, axis)
+        return results, lens, hist[:n_osds]
+
+    sharded = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis)),
+        out_specs=(P(axis), P(axis), P()),
+        check_rep=False,
+    )
+
+    @jax.jit
+    def step(osd_weight, xs):
+        return sharded(smap, jnp.asarray(osd_weight, jnp.uint32), jnp.asarray(xs, jnp.uint32))
+
+    return step
