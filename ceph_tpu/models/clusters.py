@@ -76,6 +76,41 @@ def build_hierarchy(
     return m
 
 
+def build_osdmap(
+    n_osds: int,
+    pg_num: int = 64,
+    size: int = 3,
+    pool_kind: str = "replicated",
+    osds_per_host: int = 4,
+    hosts_per_rack: int = 8,
+):
+    """Synthetic OSDMap (the ``OSDMap::build_simple`` analog): simple
+    rack/host/osd CRUSH tree, one pool, all OSDs up+in."""
+    from ceph_tpu.osdmap.map import OSDMap, Pool
+
+    crush = build_simple(n_osds, osds_per_host, hosts_per_rack)
+    if pool_kind == "erasure":
+        crush.make_erasure_rule("erasure_rule", "default", "host")
+    m = OSDMap(crush)
+    for o in range(n_osds):
+        m.add_osd(o)
+    rule = crush.rule_by_name(
+        "erasure_rule" if pool_kind == "erasure" else "replicated_rule"
+    )
+    m.add_pool(
+        Pool(
+            id=1,
+            name="pool1",
+            kind=pool_kind,
+            size=size,
+            pg_num=pg_num,
+            pgp_num=pg_num,
+            crush_rule=rule.id,
+        )
+    )
+    return m
+
+
 def build_simple(n_osds: int, osds_per_host: int = 4, hosts_per_rack: int = 8,
                  tunables: Tunables | None = None) -> CrushMap:
     """root -> racks -> hosts -> osds sized to cover ``n_osds`` devices."""

@@ -1,0 +1,342 @@
+"""Whole-map PG->OSD batch mapping on device.
+
+TPU-native replacement for the reference's ``src/osd/OSDMapMapping.{h,cc}``
+(``OSDMapMapping`` + ``ParallelPGMapper``): where the reference chunks
+PGs over a host threadpool, here the *entire* pool mapping — pps
+derivation, CRUSH rule execution, upmap application, up-set filtering,
+primary selection/affinity, and pg_temp overrides — is a single jitted
+program ``vmap``-ed over every PG, with dynamic cluster state (weights,
+up/down bits, upmap tables) passed as device arrays so the balancer can
+run trial remaps without recompiling.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.hashes import ceph_stable_mod, crush_hash32_2
+from ..crush.interp import StaticCrushMap, compile_rule
+from ..crush.map import ITEM_NONE
+from .map import (
+    DEFAULT_PRIMARY_AFFINITY,
+    EXISTS,
+    MAX_PRIMARY_AFFINITY,
+    UP,
+    OSDMap,
+    PGId,
+    Pool,
+)
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class PoolMapState:
+    """Dynamic (traced) cluster state for one pool's mapping program.
+
+    All tables are dense, PG-indexed; dict-shaped control-plane state
+    (upmaps, temps) is compiled to fixed-width padded arrays.
+    """
+
+    osd_weight: jnp.ndarray  # u32 [n_osd]  in/out reweight, 16.16
+    osd_up: jnp.ndarray  # bool [n_osd]  exists & up
+    osd_exists: jnp.ndarray  # bool [n_osd]
+    primary_affinity: jnp.ndarray  # u32 [n_osd]
+    upmap_full: jnp.ndarray  # i32 [pg_num, size]  ITEM_NONE pad
+    has_upmap: jnp.ndarray  # bool [pg_num]
+    upmap_items: jnp.ndarray  # i32 [pg_num, max_items, 2]
+    n_upmap_items: jnp.ndarray  # i32 [pg_num]
+    pg_temp: jnp.ndarray  # i32 [pg_num, size]  ITEM_NONE pad
+    n_pg_temp: jnp.ndarray  # i32 [pg_num]
+    primary_temp: jnp.ndarray  # i32 [pg_num]  -1 = unset
+
+    def tree_flatten(self):
+        return (
+            (
+                self.osd_weight,
+                self.osd_up,
+                self.osd_exists,
+                self.primary_affinity,
+                self.upmap_full,
+                self.has_upmap,
+                self.upmap_items,
+                self.n_upmap_items,
+                self.pg_temp,
+                self.n_pg_temp,
+                self.primary_temp,
+            ),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, arrays):
+        return cls(*arrays)
+
+
+def build_pool_state(m: OSDMap, pool: Pool, max_items: int = 8) -> PoolMapState:
+    """Compile an OSDMap's dict-shaped state into dense device tables."""
+    n_osd = max(m.max_osd, 1)
+    size = pool.size
+    pg_num = pool.pg_num
+    state = np.array(m.osd_state + [0] * (n_osd - m.max_osd), np.int32)
+    weight = np.zeros(n_osd, np.uint32)
+    weight[: m.max_osd] = m.osd_weight
+    aff = np.full(n_osd, DEFAULT_PRIMARY_AFFINITY, np.uint32)
+    aff[: m.max_osd] = m.osd_primary_affinity
+
+    upmap_full = np.full((pg_num, size), ITEM_NONE, np.int32)
+    has_upmap = np.zeros(pg_num, bool)
+    for pg, um in m.pg_upmap.items():
+        if pg.pool != pool.id or not (0 <= pg.ps < pg_num) or not um:
+            continue  # empty overrides are ignored (host 'if um:' falsy)
+        has_upmap[pg.ps] = True
+        upmap_full[pg.ps, : min(len(um), size)] = um[:size]
+
+    upmap_items = np.zeros((pg_num, max_items, 2), np.int32)
+    n_items = np.zeros(pg_num, np.int32)
+    for pg, items in m.pg_upmap_items.items():
+        if pg.pool != pool.id or not (0 <= pg.ps < pg_num):
+            continue
+        if len(items) > max_items:
+            raise ValueError(
+                f"pg {pg} has {len(items)} upmap items > max_items={max_items}; "
+                "rebuild the state with a larger max_items"
+            )
+        n_items[pg.ps] = len(items)
+        for j, (frm, to) in enumerate(items):
+            upmap_items[pg.ps, j] = (frm, to)
+
+    pg_temp = np.full((pg_num, size), ITEM_NONE, np.int32)
+    n_temp = np.zeros(pg_num, np.int32)
+    for pg, t in m.pg_temp.items():
+        if pg.pool != pool.id or not (0 <= pg.ps < pg_num):
+            continue
+        n_temp[pg.ps] = min(len(t), size)
+        pg_temp[pg.ps, : n_temp[pg.ps]] = t[:size]
+
+    ptemp = np.full(pg_num, -1, np.int32)
+    for pg, p in m.primary_temp.items():
+        if pg.pool == pool.id and 0 <= pg.ps < pg_num:
+            ptemp[pg.ps] = p
+
+    return PoolMapState(
+        osd_weight=jnp.asarray(weight),
+        osd_up=jnp.asarray((state & (EXISTS | UP)) == (EXISTS | UP)),
+        osd_exists=jnp.asarray((state & EXISTS) != 0),
+        primary_affinity=jnp.asarray(aff),
+        upmap_full=jnp.asarray(upmap_full),
+        has_upmap=jnp.asarray(has_upmap),
+        upmap_items=jnp.asarray(upmap_items),
+        n_upmap_items=jnp.asarray(n_items),
+        pg_temp=jnp.asarray(pg_temp),
+        n_pg_temp=jnp.asarray(n_temp),
+        primary_temp=jnp.asarray(ptemp),
+    )
+
+
+def _first_valid(vec, valid):
+    """Index of first True in valid, else -1."""
+    any_v = jnp.any(valid)
+    idx = jnp.argmax(valid).astype(I32)
+    return jnp.where(any_v, idx, -1)
+
+
+def _compact_left(row, valid):
+    """Stable left-shift of valid entries; invalid slots -> ITEM_NONE."""
+    order = jnp.argsort(~valid, stable=True)
+    shifted = row[order]
+    count = jnp.sum(valid.astype(I32))
+    slot = jnp.arange(row.shape[0], dtype=I32)
+    return jnp.where(slot < count, shifted, ITEM_NONE), count
+
+
+def compile_pool_mapping(smap: StaticCrushMap, pool: Pool, rule):
+    """Build ``fn(state, pg_indices) -> (up, up_primary, acting, acting_primary)``.
+
+    ``pg_indices`` are folded PG seeds (0..pg_num-1); outputs are
+    [n, size] i32 (ITEM_NONE padded) and [n] i32 primaries.  Covers the
+    reference pipeline ``_pg_to_raw_osds -> _apply_upmap ->
+    _raw_to_up_osds -> _pick_primary -> _apply_primary_affinity ->
+    _get_temp_osds`` (upstream ``src/osd/OSDMap.cc``).
+    """
+    size = pool.size
+    run = compile_rule(smap, rule, size)
+    pool_id = np.uint32(pool.id)
+    pgp_num = np.uint32(pool.pgp_num)
+    pgp_mask = np.uint32(pool.pgp_num_mask)
+    shift = pool.can_shift_osds()
+
+    def in_range(o, n_osd):
+        return (o >= 0) & (o < n_osd)
+
+    def map_one(state: PoolMapState, ps):
+        n_osd = state.osd_weight.shape[0]
+        ps = jnp.asarray(ps, U32)
+        folded = ceph_stable_mod(ps, pgp_num, pgp_mask)
+        if pool.hashpspool:
+            pps = crush_hash32_2(folded, pool_id)
+        else:
+            pps = folded + pool_id
+        raw, _raw_len = run(smap, state.osd_weight, pps)
+
+        # ---- _apply_upmap ----
+        psi = ps.astype(I32)
+        um = state.upmap_full[psi]
+        um_osd_ok = in_range(um, n_osd)
+        um_w = state.osd_weight[jnp.clip(um, 0, n_osd - 1)]
+        # any in-range target marked out voids the full override
+        um_void = jnp.any((um != ITEM_NONE) & um_osd_ok & (um_w == 0))
+        has_full = state.has_upmap[psi]
+        use_full = has_full & ~um_void
+        raw = jnp.where(use_full, um, raw)
+
+        items = state.upmap_items[psi]  # [max_items, 2]
+        n_it = state.n_upmap_items[psi]
+
+        def apply_item(j, r):
+            frm, to = items[j, 0], items[j, 1]
+            to_out = (
+                (to != ITEM_NONE)
+                & in_range(to, n_osd)
+                & (state.osd_weight[jnp.clip(to, 0, n_osd - 1)] == 0)
+            )
+            hit = r == frm
+            first = jnp.argmax(hit)
+            # a full pg_upmap entry (applied or voided) shadows items
+            do = (j < n_it) & jnp.any(hit) & ~to_out & ~has_full
+            return jnp.where(
+                do & (jnp.arange(size) == first), to, r
+            )
+
+        raw = jax.lax.fori_loop(0, items.shape[0], apply_item, raw)
+
+        # ---- _raw_to_up_osds ----
+        rc = jnp.clip(raw, 0, n_osd - 1)
+        valid = (raw != ITEM_NONE) & in_range(raw, n_osd) & state.osd_up[rc]
+        if shift:
+            up, _ = _compact_left(raw, valid)
+        else:
+            up = jnp.where(valid, raw, ITEM_NONE)
+
+        # ---- _pick_primary + _apply_primary_affinity ----
+        uvalid = up != ITEM_NONE
+        ppos = _first_valid(up, uvalid)
+        up_primary = jnp.where(ppos >= 0, up[jnp.maximum(ppos, 0)], -1)
+
+        uc = jnp.clip(up, 0, n_osd - 1)
+        aff = state.primary_affinity[uc]
+        nondefault = jnp.any(uvalid & (aff != DEFAULT_PRIMARY_AFFINITY))
+        hv = crush_hash32_2(pps, up.astype(U32)) >> np.uint32(16)
+        reject = (aff < MAX_PRIMARY_AFFINITY) & (hv >= aff)
+        ok = uvalid & ~reject
+        first_ok = _first_valid(up, ok)
+        first_any = _first_valid(up, uvalid)
+        pos = jnp.where(first_ok >= 0, first_ok, first_any)
+        aff_primary = jnp.where(pos >= 0, up[jnp.maximum(pos, 0)], up_primary)
+        up_primary = jnp.where(nondefault, aff_primary, up_primary)
+
+        # ---- _get_temp_osds ----
+        t = state.pg_temp[psi]
+        slot = jnp.arange(size, dtype=I32)
+        t_in = slot < state.n_pg_temp[psi]
+        tc = jnp.clip(t, 0, n_osd - 1)
+        t_alive = t_in & (t != ITEM_NONE) & in_range(t, n_osd) & state.osd_up[tc]
+        if shift:
+            temp, t_count = _compact_left(t, t_alive)
+            has_temp = t_count > 0
+        else:
+            # positional pools keep dead temp entries as NONE holes; a
+            # fully-dead pg_temp still overrides (acting = all NONE)
+            temp = jnp.where(t_in, jnp.where(t_alive, t, ITEM_NONE), ITEM_NONE)
+            has_temp = state.n_pg_temp[psi] > 0
+        tpos = _first_valid(temp, temp != ITEM_NONE)
+        temp_primary = jnp.where(tpos >= 0, temp[jnp.maximum(tpos, 0)], -1)
+        ptv = state.primary_temp[psi]
+        acting_primary = jnp.where(
+            ptv >= 0, ptv, jnp.where(has_temp, temp_primary, up_primary)
+        )
+        acting = jnp.where(has_temp, temp, up)
+        return up, up_primary, acting, acting_primary
+
+    @jax.jit
+    def fn(state: PoolMapState, pg_indices):
+        return jax.vmap(lambda ps: map_one(state, ps))(pg_indices)
+
+    return fn
+
+
+class OSDMapMapping:
+    """Precomputed full-map mapping + per-OSD PG counts (reference
+    ``OSDMapMapping``), backed by the device batch program."""
+
+    def __init__(self, m: OSDMap, max_items: int = 8):
+        self.osdmap = m
+        self.max_items = max_items
+        self._fns: dict[int, tuple] = {}
+        self._results: dict[int, tuple] = {}
+
+    def _fn_for(self, pool: Pool):
+        # compile cache keyed on everything baked in at trace time; a
+        # mutated crush map or resized/renumbered pool recompiles
+        # instead of silently serving stale placements
+        fp = (
+            pool.pg_num,
+            pool.pgp_num,
+            pool.size,
+            pool.kind,
+            pool.crush_rule,
+            pool.hashpspool,
+            self.osdmap.crush.encode(),
+        )
+        cached = self._fns.get(pool.id)
+        if cached is None or cached[0] != fp:
+            smap = StaticCrushMap(self.osdmap.crush.to_dense())
+            rule = self.osdmap.crush.rules[pool.crush_rule]
+            cached = (fp, smap, compile_pool_mapping(smap, pool, rule))
+            self._fns[pool.id] = cached
+        return cached[1], cached[2]
+
+    def update(self, pool_id: int | None = None) -> None:
+        """Recompute mappings for one pool (or all) on device."""
+        pools = (
+            [self.osdmap.pools[pool_id]]
+            if pool_id is not None
+            else list(self.osdmap.pools.values())
+        )
+        for pool in pools:
+            _smap, fn = self._fn_for(pool)
+            state = build_pool_state(self.osdmap, pool, self.max_items)
+            pgs = jnp.arange(pool.pg_num, dtype=jnp.uint32)
+            up, upp, acting, actp = jax.block_until_ready(fn(state, pgs))
+            self._results[pool.id] = (
+                np.asarray(up),
+                np.asarray(upp),
+                np.asarray(acting),
+                np.asarray(actp),
+            )
+
+    def get(self, pgid: PGId):
+        up, upp, acting, actp = self._results[pgid.pool]
+        row = up[pgid.ps]
+        arow = acting[pgid.ps]
+        return (
+            [int(o) for o in row if o != ITEM_NONE],
+            int(upp[pgid.ps]),
+            [int(o) for o in arow if o != ITEM_NONE],
+            int(actp[pgid.ps]),
+        )
+
+    def pg_counts_by_osd(self, pool_id: int, acting: bool = True) -> np.ndarray:
+        """PGs-per-OSD histogram for one pool (the balancer's input)."""
+        res = self._results[pool_id][2 if acting else 0]
+        n_osd = max(self.osdmap.max_osd, 1)
+        flat = res.reshape(-1)
+        sel = flat[(flat != ITEM_NONE) & (flat >= 0) & (flat < n_osd)]
+        return np.bincount(sel, minlength=n_osd)
