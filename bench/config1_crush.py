@@ -1,0 +1,66 @@
+"""BASELINE config 1: 3-replica straw2 placement, 1M objects.
+
+TPU batch placement vs the single-core C++ reference (the stand-in for
+``crushtool --test``'s serial loop).  Run on the real chip (no env
+scrub).  Emits one JSON line.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+N_OBJECTS = 1_000_000
+CPU_SAMPLE = 50_000
+N_OSDS = 1024
+REPLICAS = 3
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.crush.interp import StaticCrushMap, compile_rule
+    from ceph_tpu.models.clusters import build_simple
+    from ceph_tpu.testing import cppref
+
+    m = build_simple(N_OSDS)
+    rule = m.rule_by_name("replicated_rule")
+    dense = m.to_dense()
+    smap = StaticCrushMap(dense)
+    osd_weight_np = np.full(smap.max_devices, 0x10000, np.uint32)
+
+    steps = [(s.op, s.arg1, s.arg2) for s in rule.steps]
+    xs_cpu = np.arange(CPU_SAMPLE, dtype=np.uint32)
+    t0 = time.perf_counter()
+    cppref.do_rule_batch(dense, steps, xs_cpu, osd_weight_np, REPLICAS)
+    cpu_rate = CPU_SAMPLE / (time.perf_counter() - t0)
+
+    run = compile_rule(smap, rule, REPLICAS)
+
+    @jax.jit
+    def batch(osd_weight, xs):
+        return jax.vmap(lambda x: run(smap, osd_weight, x))(xs)
+
+    osd_weight = jnp.asarray(osd_weight_np)
+    xs = jnp.arange(N_OBJECTS, dtype=jnp.uint32)
+    jax.block_until_ready(batch(osd_weight, xs))
+    iters = 3
+    t0 = time.perf_counter()
+    for i in range(iters):
+        jax.block_until_ready(batch(osd_weight, xs + np.uint32(i)))
+    tpu_rate = N_OBJECTS * iters / (time.perf_counter() - t0)
+
+    print(json.dumps({
+        "metric": "crush_placements_per_sec",
+        "value": round(tpu_rate),
+        "unit": "placements/s",
+        "vs_baseline": round(tpu_rate / cpu_rate, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""BASELINE config 3: 10k-PG bulk re-CRUSH + upmap optimizer round.
+
+The whole-map mapping (the reference's ``OSDMapMapping`` +
+``ParallelPGMapper`` threadpool job, and the inner loop of
+``calc_pg_upmaps``) as one device launch, timed end to end, plus one
+balancer optimize round.  Emits one JSON line (PG mappings/s).
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+N_OSDS = 1024
+PG_NUM = 10_240
+
+
+def main() -> None:
+    from ceph_tpu.balancer import Balancer
+    from ceph_tpu.models.clusters import build_osdmap
+    from ceph_tpu.osdmap.mapping import OSDMapMapping
+
+    m = build_osdmap(N_OSDS, pg_num=PG_NUM)
+    mapping = OSDMapMapping(m)
+    mapping.update()  # compile + first run
+
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        mapping.update()
+    per_update = (time.perf_counter() - t0) / iters
+    rate = PG_NUM / per_update
+
+    b = Balancer(m, max_deviation=1.0, max_optimizations=32)
+    t0 = time.perf_counter()
+    b.optimize()
+    opt_s = time.perf_counter() - t0
+    print(f"bulk remap: {per_update * 1e3:.1f} ms / {PG_NUM} PGs; "
+          f"optimize round: {opt_s:.2f} s", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "bulk_pg_remap_per_sec",
+        "value": round(rate),
+        "unit": "pg_mappings/s",
+        "vs_baseline": None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

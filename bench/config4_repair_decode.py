@@ -1,0 +1,65 @@
+"""BASELINE config 4: repair-optimal EC decode (CLAY + LRC).
+
+Times single-chunk recovery through the locality-aware paths and
+reports the read amplification win vs naive k-chunk reconstruction.
+Emits one JSON line (CLAY repair decode B/s of recovered data).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main() -> None:
+    from ceph_tpu.ec import create
+
+    rng = np.random.default_rng(1)
+    obj = rng.integers(0, 256, 64 << 20, dtype=np.uint8)  # 64 MiB
+
+    clay = create({"plugin": "clay", "k": "4", "m": "2"})
+    enc = clay.encode(set(range(6)), obj)
+    subs = clay.get_sub_chunk_count()
+    sub_size = len(enc[0]) // subs
+    helpers, planes = clay.minimum_to_decode_subchunks(0, {1, 2, 3, 4, 5})
+    hs = {
+        i: {z: enc[i][z * sub_size : (z + 1) * sub_size] for z in planes}
+        for i in helpers
+    }
+    clay.repair(0, hs)  # warm (compile decode matrices)
+    iters = 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = clay.repair(0, hs)
+    dt = (time.perf_counter() - t0) / iters
+    rate = len(enc[0]) / dt
+    read_frac = len(planes) / subs * len(helpers) / 4  # vs k full chunks
+
+    lrc = create({"plugin": "lrc", "k": "4", "m": "2", "l": "3"})
+    enc2 = lrc.encode(set(range(8)), obj)
+    cs = len(enc2[0])
+    need = lrc.minimum_to_decode({0}, set(range(8)) - {0})
+    avail = {i: enc2[i] for i in need}
+    lrc.decode({0}, avail, cs)
+    t0 = time.perf_counter()
+    lrc.decode({0}, avail, cs)
+    lrc_rate = cs / (time.perf_counter() - t0)
+    print(
+        f"clay(4,2) repair: {rate / 1e9:.2f} GB/s recovered, read x{read_frac:.2f} of naive; "
+        f"lrc local repair: {lrc_rate / 1e9:.2f} GB/s from {len(need)} chunks",
+        file=sys.stderr,
+    )
+
+    print(json.dumps({
+        "metric": "clay_repair_decode_bytes_per_sec",
+        "value": round(rate),
+        "unit": "B/s",
+        "vs_baseline": round(read_frac, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

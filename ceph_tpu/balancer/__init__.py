@@ -1,0 +1,4 @@
+from .upmap import calc_pg_upmaps
+from .module import Balancer, Eval
+
+__all__ = ["calc_pg_upmaps", "Balancer", "Eval"]

@@ -1,0 +1,193 @@
+"""Upmap optimizer: deviation-minimizing pg_upmap_items search.
+
+Equivalent of the reference's ``OSDMap::calc_pg_upmaps`` (upstream
+``src/osd/OSDMap.cc``), consumed there by the mgr balancer module and
+``osdmaptool --upmap``: compute each OSD's expected PG share from CRUSH
+weights, then greedily move single replicas from the most-overfull OSD
+to compatible underfull OSDs via ``pg_upmap_items``, until the worst
+deviation is within ``max_deviation`` or no further progress.
+
+TPU-native structure: the full-map remap (the expensive part the
+reference runs on the ``ParallelPGMapper`` threadpool) is one device
+batch launch (:mod:`ceph_tpu.osdmap.mapping`), re-run per round with the
+trial upmap tables as *traced inputs* (no recompile); candidate scoring
+is vectorized on host numpy over all (pg, from, to) moves at once
+rather than the reference's per-candidate trial loop.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..crush.map import ITEM_NONE, CrushMap
+from ..osdmap.map import Incremental, OSDMap, PGId, Pool
+from ..osdmap.mapping import OSDMapMapping
+
+
+def crush_device_weights(crush: CrushMap, rule_id: int, n_osd: int) -> np.ndarray:
+    """Effective CRUSH weight per OSD under the rule's TAKE root."""
+    from ..crush.map import OP_TAKE
+
+    rule = crush.rules[rule_id]
+    roots = [s.arg1 for s in rule.steps if s.op == OP_TAKE]
+    w = np.zeros(n_osd, np.float64)
+
+    def walk(item: int, bucket_weight: int) -> None:
+        if item >= 0:
+            if item < n_osd:
+                w[item] += bucket_weight / 0x10000
+            return
+        b = crush.buckets[item]
+        for it, iw in zip(b.items, b.item_weights):
+            walk(it, iw)
+
+    for r in roots:
+        walk(r, 0)
+    return w
+
+
+def failure_domains(crush: CrushMap, rule_id: int, n_osd: int) -> np.ndarray:
+    """Failure-domain id for each OSD under the rule (its ancestor of
+    the rule's chooseleaf/choose type); domain -1 = unplaced."""
+    from ..crush.map import (
+        OP_CHOOSE_FIRSTN,
+        OP_CHOOSE_INDEP,
+        OP_CHOOSELEAF_FIRSTN,
+        OP_CHOOSELEAF_INDEP,
+    )
+
+    rule = crush.rules[rule_id]
+    fd_type = 0
+    for s in rule.steps:
+        if s.op in (
+            OP_CHOOSE_FIRSTN,
+            OP_CHOOSE_INDEP,
+            OP_CHOOSELEAF_FIRSTN,
+            OP_CHOOSELEAF_INDEP,
+        ):
+            fd_type = s.arg2
+            break
+    dom = np.full(n_osd, -1, np.int64)
+    if fd_type == 0:
+        # failure domain is the device itself
+        dom[:] = np.arange(n_osd)
+        return dom
+
+    def walk(item: int, current: int) -> None:
+        if item >= 0:
+            if item < n_osd:
+                dom[item] = current
+            return
+        b = crush.buckets[item]
+        nxt = b.id if b.type_id == fd_type else current
+        for it in b.items:
+            walk(it, nxt)
+
+    for bid, b in crush.buckets.items():
+        if crush.parent_of(bid) is None:
+            walk(bid, -1)
+    return dom
+
+
+def calc_pg_upmaps(
+    m: OSDMap,
+    max_deviation: float = 1.0,
+    max_entries: int = 100,
+    pools: list[int] | None = None,
+    mapping: OSDMapMapping | None = None,
+) -> Incremental:
+    """Compute pg_upmap_items moves; returns an Incremental (possibly
+    empty).  ``max_deviation`` is in PGs, like the reference's
+    ``upmap_max_deviation``."""
+    inc = Incremental(epoch=m.epoch + 1)
+    pool_ids = pools or sorted(m.pools)
+    mapping = mapping or OSDMapMapping(m)
+    n_osd = max(m.max_osd, 1)
+    entries = 0
+
+    for pool_id in pool_ids:
+        pool = m.pools[pool_id]
+        trial = m.clone()
+        tmap = OSDMapMapping(trial)
+        cw = crush_device_weights(m.crush, pool.crush_rule, n_osd)
+        cw *= np.asarray(m.osd_weight, np.float64)[:n_osd] / 0x10000
+        dom = failure_domains(m.crush, pool.crush_rule, n_osd)
+        total_w = cw.sum()
+        if total_w <= 0:
+            continue
+        replicas = pool.pg_num * pool.size
+        expect = replicas * cw / total_w
+
+        for _round in range(max_entries):
+            if entries >= max_entries:
+                break
+            tmap.update(pool_id)
+            up_all, _, _, _ = tmap._results[pool_id]
+            counts = tmap.pg_counts_by_osd(pool_id, acting=False)
+            deviation = counts - expect
+            if deviation.max() <= max_deviation:
+                break
+            # candidate moves: for every pg replica on an overfull osd,
+            # to every underfull osd in a compatible failure domain
+            over = int(np.argmax(deviation))
+            under_mask = (deviation < -1e-9) & (cw > 0)
+            under = np.nonzero(under_mask)[0]
+            if len(under) == 0:
+                under = np.nonzero((deviation < deviation.max() - 1) & (cw > 0))[0]
+            if len(under) == 0:
+                break
+            pgs_on_over = np.nonzero((up_all == over).any(axis=1))[0]
+            best = None  # (gain, pg, frm, to)
+            for ps in pgs_on_over:
+                row = up_all[ps]
+                row_valid = row[row != ITEM_NONE]
+                used_doms = {int(dom[o]) for o in row_valid if o < n_osd}
+                frm_dom = int(dom[over])
+                existing = trial.pg_upmap_items.get(PGId(pool_id, int(ps)), ())
+                if len(existing) >= 4:  # keep per-pg item lists short
+                    continue
+                for to in under:
+                    to = int(to)
+                    if to in row_valid or not m.is_up(to):
+                        continue
+                    to_dom = int(dom[to])
+                    if to_dom != frm_dom and to_dom in used_doms:
+                        continue  # would double up a failure domain
+                    gain = deviation[over] - deviation[to]
+                    if best is None or gain > best[0]:
+                        best = (float(gain), int(ps), over, to)
+            if best is None:
+                break
+            _, ps, frm, to = best
+            pg = PGId(pool_id, ps)
+            items = list(trial.pg_upmap_items.get(pg, ()))
+            # collapse chains: a->b then b->c becomes a->c
+            for idx, (f0, t0) in enumerate(items):
+                if t0 == frm:
+                    items[idx] = (f0, to)
+                    break
+            else:
+                items.append((frm, to))
+            items = [(f, t) for f, t in items if f != t]
+            if items:
+                trial.pg_upmap_items[pg] = tuple(items)
+                inc.new_pg_upmap_items[pg] = tuple(items)
+            else:
+                trial.pg_upmap_items.pop(pg, None)
+                inc.old_pg_upmap_items.append(pg)
+            entries += 1
+
+        # validation: the trial map's deviation must not be worse
+        tmap.update(pool_id)
+        final_counts = tmap.pg_counts_by_osd(pool_id, acting=False)
+        base = mapping
+        base.update(pool_id)
+        base_counts = base.pg_counts_by_osd(pool_id, acting=False)
+        if np.abs(final_counts - expect).max() > np.abs(
+            base_counts - expect
+        ).max():
+            # revert this pool's moves (should not happen; belt & braces)
+            for pg in list(inc.new_pg_upmap_items):
+                if pg.pool == pool_id:
+                    del inc.new_pg_upmap_items[pg]
+    return inc

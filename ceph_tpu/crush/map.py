@@ -14,6 +14,7 @@ spec; bucket ids are negative, devices (OSDs) non-negative.
 
 from __future__ import annotations
 
+import itertools
 import json
 from dataclasses import dataclass, field, asdict
 
@@ -110,6 +111,8 @@ class Rule:
 class CrushMap:
     """Mutable CRUSH map with a CrushWrapper-parity mutation API."""
 
+    _uid_counter = itertools.count(1)
+
     def __init__(self, tunables: Tunables | None = None):
         self.tunables = tunables or Tunables.profile("default")
         self.types: dict[int, str] = {0: "osd"}
@@ -117,11 +120,47 @@ class CrushMap:
         self.rules: dict[int, Rule] = {}
         self.device_names: dict[int, str] = {}  # osd id -> name
         self.device_classes: dict[int, str] = {}  # osd id -> class name
+        # (uid, version) identifies map content for compile caches: uid
+        # is process-unique (never reused, unlike id()), version bumps
+        # on every API mutation.  Direct field edits bypass it —
+        # mutate through the API.
+        self.uid = next(CrushMap._uid_counter)
+        self.version = 0
+        self._dense_cache: tuple[int, "DenseCrushMap"] | None = None
+
+    def _mutated(self) -> None:
+        self.version += 1
+        self._dense_cache = None
+
+    def set_tunables(self, tunables: Tunables | str) -> None:
+        """Switch tunables (profile name or explicit Tunables); the API
+        route so caches invalidate."""
+        if isinstance(tunables, str):
+            tunables = Tunables.profile(tunables)
+        self.tunables = tunables
+        self._mutated()
+
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d["_dense_cache"] = None  # not worth copying/pickling
+        return d
+
+    def __deepcopy__(self, memo):
+        import copy as _copy
+
+        new = CrushMap.__new__(CrushMap)
+        memo[id(self)] = new
+        state = self.__getstate__()
+        new.__dict__.update(_copy.deepcopy(state, memo))
+        # a copy is a distinct map for cache purposes
+        new.uid = next(CrushMap._uid_counter)
+        return new
 
     # ---- types ----
 
     def add_type(self, type_id: int, name: str) -> None:
         self.types[type_id] = name
+        self._mutated()
 
     def type_id(self, name: str) -> int:
         for tid, tname in self.types.items():
@@ -135,6 +174,7 @@ class CrushMap:
         self.device_names[osd] = name or f"osd.{osd}"
         if device_class is not None:
             self.device_classes[osd] = device_class
+        self._mutated()
 
     @property
     def max_devices(self) -> int:
@@ -160,6 +200,7 @@ class CrushMap:
             raise ValueError(f"duplicate bucket name {name}")
         b = Bucket(id=bucket_id, name=name, type_id=self.type_id(type_name), alg=alg)
         self.buckets[bucket_id] = b
+        self._mutated()
         return b
 
     def bucket_by_name(self, name: str) -> Bucket:
@@ -182,20 +223,24 @@ class CrushMap:
             self.add_device(item)
         b.items.append(item)
         b.item_weights.append(int(weight))
+        self._mutated()
 
     def remove_item(self, bucket_id: int, item: int) -> None:
         b = self.buckets[bucket_id]
         i = b.items.index(item)
         del b.items[i]
         del b.item_weights[i]
+        self._mutated()
 
     def adjust_item_weight(self, bucket_id: int, item: int, weight: int) -> None:
         b = self.buckets[bucket_id]
         b.item_weights[b.items.index(item)] = int(weight)
+        self._mutated()
 
     def adjust_subtree_weights(self, bucket_id: int) -> int:
         """Recompute this subtree's item weights bottom-up; returns total."""
         b = self.buckets[bucket_id]
+        self._mutated()
         total = 0
         for i, item in enumerate(b.items):
             if item < 0:
@@ -216,6 +261,7 @@ class CrushMap:
             rule_id = max(self.rules, default=-1) + 1
         r = Rule(id=rule_id, name=name, kind=kind, steps=steps)
         self.rules[rule_id] = r
+        self._mutated()
         return r
 
     def rule_by_name(self, name: str) -> Rule:
@@ -321,6 +367,14 @@ class CrushMap:
     # ---- dense packing ----
 
     def to_dense(self) -> "DenseCrushMap":
+        cached = self._dense_cache
+        if cached is not None and cached[0] == self.version:
+            return cached[1]
+        dense = self._to_dense()
+        self._dense_cache = (self.version, dense)
+        return dense
+
+    def _to_dense(self) -> "DenseCrushMap":
         n_buckets = max((-bid for bid in self.buckets), default=0)
         max_fanout = max((len(b.items) for b in self.buckets.values()), default=1)
         max_fanout = max(max_fanout, 1)

@@ -1,0 +1,12 @@
+from .interface import ErasureCode, ErasureCodeError, ErasureCodeInterface, Profile
+from .registry import ErasureCodePluginRegistry, create, register_plugin
+
+__all__ = [
+    "ErasureCode",
+    "ErasureCodeError",
+    "ErasureCodeInterface",
+    "Profile",
+    "ErasureCodePluginRegistry",
+    "create",
+    "register_plugin",
+]

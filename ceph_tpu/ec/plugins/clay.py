@@ -1,0 +1,324 @@
+"""Coupled-layer (CLAY) MSR regenerating code.
+
+Parity with the reference's ``src/erasure-code/clay/ErasureCodeClay.{h,cc}``
+(the FAST'18 "Clay codes" construction): wraps a base MDS code
+(scalar_mds, default jerasure reed_sol_van) and couples q*t node layers
+pairwise so that single-node repair reads only ``q^{t-1}`` of the
+``q^t`` sub-chunks from each of d helpers — repair-bandwidth optimal —
+while any <= m erasures remain decodable.
+
+Construction (q = d-k+1, t = (k+m+nu)/q with nu virtual zero chunks
+for shortening; sub_chunk_count = q^t):
+
+- nodes live on a q x t grid: chunk i -> (x = i % q, y = i // q);
+- sub-chunks are indexed by planes z in [0,q)^t;
+- the *uncoupled* symbols U(x,y;z) form, per plane, a codeword of the
+  base (q*t - m, m) MDS code;
+- the *coupled* (stored) symbols C relate pairwise: for x != z_y,
+  with partner node (z_y, y) at partner plane z(y->x),
+
+      C(x,y;z) = U(x,y;z) + g * U(z_y, y; z(y->x))
+
+  (g = alpha, char-2 field, pair matrix [[1,g],[g,1]] invertible since
+  det = 1 + g^2 != 0); on the diagonal (x == z_y) C = U.
+
+Decode (and encode, which is just decode with the parity nodes
+erased — the reference does the same via ``decode_layered``): process
+planes by increasing *intersection score* (count of y whose dot node
+(z_y, y) is erased); compute U at surviving nodes (partner known:
+2x2 inverse; partner erased: partner plane has lower score and is
+already fully U-decoded), then MDS-decode each plane's <= m unknown U
+symbols; finally map U back to C at the erased nodes.
+
+Single-node repair reads only planes with z_{y0} = x0 and is
+implemented for the default d = k+m-1 (all surviving real nodes are
+helpers), matching the reference's default profile.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import gf
+from ..backend import MatrixCodec
+from ..interface import ErasureCode, ErasureCodeError, Profile
+
+GAMMA = 2  # alpha; any g not in {0, 1} works (det 1 + g^2 != 0)
+
+
+class ErasureCodeClay(ErasureCode):
+    def init(self, profile: Profile) -> None:
+        self.profile = profile
+        self.k = profile.get_int("k", 4)
+        self.m = profile.get_int("m", 2)
+        self.d = profile.get_int("d", self.k + self.m - 1)
+        if self.d != self.k + self.m - 1:
+            raise ErasureCodeError(
+                "only d = k+m-1 (the reference default) is supported"
+            )
+        self.q = self.d - self.k + 1  # == m
+        km = self.k + self.m
+        self.nu = (self.q - km % self.q) % self.q  # virtual chunks
+        self.t = (km + self.nu) // self.q
+        self.n = km + self.nu  # grid nodes (incl. virtual)
+        self.sub_chunk_no = self.q**self.t
+        scalar = profile.get("scalar_mds", "jerasure")
+        technique = profile.get("technique", "reed_sol_van")
+        if scalar not in ("jerasure", "isa", "jax"):
+            raise ErasureCodeError(f"unknown scalar_mds {scalar!r}")
+        # base MDS code over all grid nodes: (n - m) data, m parity
+        if technique == "reed_sol_van":
+            base = gf.vandermonde_matrix(self.n - self.m, self.m)
+        elif technique == "cauchy_good":
+            base = gf.cauchy_good_matrix(self.n - self.m, self.m)
+        else:
+            raise ErasureCodeError(f"unknown technique {technique!r}")
+        self.base = MatrixCodec(base, "table")
+        self._ginv = gf.gf_inv(GAMMA)
+        self._det_inv = gf.gf_inv(1 ^ gf.gf_mul(GAMMA, GAMMA))
+
+    # ---- geometry ----
+
+    def _xy(self, i: int) -> tuple[int, int]:
+        return i % self.q, i // self.q
+
+    def _node(self, x: int, y: int) -> int:
+        return y * self.q + x
+
+    def _digit(self, z: int, y: int) -> int:
+        return (z // self.q ** (self.t - 1 - y)) % self.q
+
+    def _replace(self, z: int, y: int, x: int) -> int:
+        p = self.q ** (self.t - 1 - y)
+        return z + (x - self._digit(z, y)) * p
+
+    def _base_id(self, node: int) -> int:
+        """Grid node -> base-code symbol id (data 0..n-m-1, parity after).
+
+        Real data and virtual nodes are base data; real parity chunks
+        k..k+m-1 are the base parity symbols.
+        """
+        if node < self.k:
+            return node
+        if node >= self.k + self.m:  # virtual
+            return self.k + (node - self.k - self.m)
+        return (self.n - self.m) + (node - self.k)
+
+    # ---- interface ----
+
+    def get_chunk_count(self) -> int:
+        return self.k + self.m
+
+    def get_sub_chunk_count(self) -> int:
+        return self.sub_chunk_no
+
+    def get_alignment(self) -> int:
+        return self.k * self.sub_chunk_no * 8
+
+    def encode_chunks(self, chunks: dict[int, np.ndarray]) -> None:
+        size = len(chunks[0])
+        if size % self.sub_chunk_no:
+            raise ErasureCodeError(
+                f"chunk size {size} not divisible by q^t={self.sub_chunk_no}"
+            )
+        erased = set(range(self.k, self.k + self.m))
+        C = self._layout(chunks, size)
+        self._decode_layered(C, erased, size // self.sub_chunk_no)
+        for i in range(self.k, self.k + self.m):
+            chunks[i][:] = C[i].reshape(-1)
+
+    def decode_chunks(
+        self, want_to_read: set[int], chunks: dict[int, np.ndarray]
+    ) -> dict[int, np.ndarray]:
+        size = len(next(iter(chunks.values())))
+        erased = set(range(self.k + self.m)) - set(chunks)
+        if len(erased) > self.m:
+            raise ErasureCodeError(f"too many erasures: {sorted(erased)}")
+        C = self._layout(chunks, size)
+        self._decode_layered(C, erased, size // self.sub_chunk_no)
+        return {
+            i: np.ascontiguousarray(C[i].reshape(-1)) for i in want_to_read
+        }
+
+    def minimum_to_decode(
+        self, want_to_read: set[int], available: set[int]
+    ) -> set[int]:
+        if want_to_read <= available:
+            return set(want_to_read)
+        erased = want_to_read - available
+        if len(erased) == 1 and len(available) >= self.d:
+            # repair-optimal single-node path: d helpers
+            return set(sorted(available)[: self.d])
+        return self._minimum_to_decode(want_to_read, available)
+
+    def minimum_to_decode_subchunks(
+        self, lost: int, available: set[int]
+    ) -> tuple[set[int], list[int]]:
+        """Helpers + the plane indices each must supply (the reference's
+        sub-chunk-range form of minimum_to_decode)."""
+        if len(available) < self.d:
+            raise ErasureCodeError(f"need d={self.d} helpers")
+        x0, y0 = self._xy(lost)
+        planes = [
+            z for z in range(self.sub_chunk_no) if self._digit(z, y0) == x0
+        ]
+        return set(sorted(available)[: self.d]), planes
+
+    # ---- core machinery ----
+
+    def _layout(self, chunks: dict[int, np.ndarray], size: int):
+        """C[node] = [q^t, sub] array; erased nodes zero-filled."""
+        sub = size // self.sub_chunk_no
+        C = np.zeros((self.n, self.sub_chunk_no, sub), np.uint8)
+        for i, buf in chunks.items():
+            C[i] = np.asarray(buf, np.uint8).reshape(self.sub_chunk_no, sub)
+        return C
+
+    def _pair_invert(self, c1, c2):
+        """(C1, C2) -> (U1, U2) through [[1,g],[g,1]]^-1."""
+        g, di = GAMMA, self._det_inv
+        mt = gf.mul_table()
+        u1 = mt[di][c1 ^ mt[g][c2]]
+        u2 = mt[di][c2 ^ mt[g][c1]]
+        return u1, u2
+
+    def _decode_layered(
+        self, C: np.ndarray, erased: set[int], sub: int
+    ) -> None:
+        """Recover C at erased nodes in place (<= m erasures)."""
+        q, t, n = self.q, self.t, self.n
+        mt = gf.mul_table()
+        U = np.zeros_like(C)
+        have_u = np.zeros((n, self.sub_chunk_no), bool)
+
+        def score(z: int) -> int:
+            return sum(
+                1
+                for y in range(t)
+                if self._node(self._digit(z, y), y) in erased
+            )
+
+        order = sorted(range(self.sub_chunk_no), key=score)
+        for z in order:
+            # 1) U at surviving nodes
+            for node in range(n):
+                if node in erased:
+                    continue
+                x, y = self._xy(node)
+                zy = self._digit(z, y)
+                if x == zy:
+                    U[node, z] = C[node, z]
+                    have_u[node, z] = True
+                    continue
+                partner = self._node(zy, y)
+                zpair = self._replace(z, y, x)
+                if partner not in erased:
+                    u1, _ = self._pair_invert(C[node, z], C[partner, zpair])
+                    U[node, z] = u1
+                else:
+                    # partner plane has lower score: its U is complete
+                    assert have_u[partner, zpair]
+                    U[node, z] = C[node, z] ^ mt[GAMMA][U[partner, zpair]]
+                have_u[node, z] = True
+            # 2) MDS-decode the plane's erased U symbols
+            if erased:
+                avail = {
+                    self._base_id(node): U[node, z]
+                    for node in range(n)
+                    if node not in erased
+                }
+                want = {self._base_id(node) for node in erased}
+                out = self.base.decode(avail, want)
+                for node in erased:
+                    U[node, z] = out[self._base_id(node)]
+                    have_u[node, z] = True
+        # 3) U -> C at erased nodes
+        for node in erased:
+            x, y = self._xy(node)
+            for z in range(self.sub_chunk_no):
+                zy = self._digit(z, y)
+                if x == zy:
+                    C[node, z] = U[node, z]
+                else:
+                    partner = self._node(zy, y)
+                    zpair = self._replace(z, y, x)
+                    C[node, z] = U[node, z] ^ mt[GAMMA][U[partner, zpair]]
+
+    # ---- repair-optimal single-node recovery ----
+
+    def repair(
+        self,
+        lost: int,
+        helper_subchunks: dict[int, dict[int, np.ndarray]],
+    ) -> np.ndarray:
+        """Recover chunk ``lost`` from helpers supplying ONLY the repair
+        planes (z_{y0} = x0): q^{t-1} sub-chunks each.
+
+        ``helper_subchunks[i][z]`` = helper i's sub-chunk for plane z.
+        Returns the full reconstructed chunk (q^t sub-chunks).
+        """
+        q, t, n = self.q, self.t, self.n
+        mt = gf.mul_table()
+        x0, y0 = self._xy(lost)
+        planes = [
+            z for z in range(self.sub_chunk_no) if self._digit(z, y0) == x0
+        ]
+        real = set(range(self.k + self.m))
+        helpers = set(helper_subchunks)
+        if helpers != real - {lost}:
+            raise ErasureCodeError(
+                "repair needs all surviving real chunks as helpers "
+                f"(d = k+m-1); got {sorted(helpers)}"
+            )
+        sub = len(next(iter(helper_subchunks[next(iter(helpers))].values())))
+
+        def cval(node: int, z: int) -> np.ndarray:
+            if node >= self.k + self.m:  # virtual: zero
+                return np.zeros(sub, np.uint8)
+            return helper_subchunks[node][z]
+
+        # U on the repair planes
+        U = {}
+        for z in planes:
+            unknowns = set()
+            for node in range(n):
+                x, y = self._xy(node)
+                if node == lost or (y == y0 and x != x0):
+                    unknowns.add(node)
+                    continue
+                zy = self._digit(z, y)
+                if x == zy:
+                    U[(node, z)] = cval(node, z)
+                else:
+                    partner = self._node(zy, y)
+                    zpair = self._replace(z, y, x)
+                    # partner is never the lost node (y != y0 here) and
+                    # zpair stays in the repair set (y0 digit unchanged)
+                    u1, _ = self._pair_invert(cval(node, z), cval(partner, zpair))
+                    U[(node, z)] = u1
+            avail = {
+                self._base_id(node): U[(node, z)]
+                for node in range(n)
+                if node not in unknowns
+            }
+            want = {self._base_id(node) for node in unknowns}
+            out = self.base.decode(avail, want)
+            for node in unknowns:
+                U[(node, z)] = out[self._base_id(node)]
+
+        # reconstruct the lost chunk
+        out = np.zeros((self.sub_chunk_no, sub), np.uint8)
+        for z in range(self.sub_chunk_no):
+            zy0 = self._digit(z, y0)
+            if zy0 == x0:
+                out[z] = U[(lost, z)]
+            else:
+                xp = zy0  # partner column
+                partner = self._node(xp, y0)
+                zpair = self._replace(z, y0, x0)  # in the repair set
+                # partner's pair equation at plane zpair reveals U(lost, z)
+                u_lost = mt[self._ginv][
+                    cval(partner, zpair) ^ U[(partner, zpair)]
+                ]
+                out[z] = u_lost ^ mt[GAMMA][U[(partner, zpair)]]
+        return out.reshape(-1)

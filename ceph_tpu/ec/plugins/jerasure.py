@@ -1,0 +1,83 @@
+"""Reed-Solomon / Cauchy codec family (jerasure-plugin parity).
+
+Technique semantics follow the reference's
+``src/erasure-code/jerasure/ErasureCodeJerasure.{h,cc}`` classes:
+
+- ``reed_sol_van``  — Vandermonde RS over GF(2^8) (matrix technique)
+- ``reed_sol_r6_op``— RAID6 P+Q (m must be 2)
+- ``cauchy_orig``   — original Cauchy bit-matrix
+- ``cauchy_good``   — improved Cauchy bit-matrix (jerasure
+  ``cauchy_good`` matrix optimization)
+
+Matrix techniques run on device through :class:`TableEncoder`;
+bit-matrix techniques through the MXU :class:`BitmatrixEncoder`
+(packetsize-interleaved, ``jerasure_schedule_encode`` layout).  The
+``liberation``/``blaum_roth``/``liber8tion`` minimal-density codes use
+w in {7, 11, ...} and are not yet implemented (profile raises).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import gf
+from ..backend import MatrixCodec
+from ..interface import ErasureCode, ErasureCodeError, Profile
+
+MATRIX_TECHNIQUES = ("reed_sol_van", "reed_sol_r6_op")
+BITMATRIX_TECHNIQUES = ("cauchy_orig", "cauchy_good")
+SIZEOF_INT = 4
+
+
+class ErasureCodeJerasure(ErasureCode):
+    technique = "reed_sol_van"
+
+    def init(self, profile: Profile) -> None:
+        self.profile = profile
+        self.k = profile.get_int("k", 2)
+        self.m = profile.get_int("m", 1)
+        self.w = profile.get_int("w", 8)
+        self.technique = profile.get("technique", "reed_sol_van")
+        self.packetsize = profile.get_int("packetsize", 2048)
+        if self.w != 8:
+            raise ErasureCodeError(
+                f"w={self.w} unsupported: the device GF kernels are w=8 "
+                "(the reference's default)"
+            )
+        if self.k < 1 or self.m < 1 or self.k + self.m > 256:
+            raise ErasureCodeError(f"bad k={self.k} m={self.m}")
+        if self.technique == "reed_sol_van":
+            matrix = gf.vandermonde_matrix(self.k, self.m)
+        elif self.technique == "reed_sol_r6_op":
+            if self.m != 2:
+                raise ErasureCodeError("reed_sol_r6_op requires m=2")
+            matrix = gf.raid6_matrix(self.k)
+        elif self.technique == "cauchy_orig":
+            matrix = gf.cauchy_matrix(self.k, self.m)
+        elif self.technique == "cauchy_good":
+            matrix = gf.cauchy_good_matrix(self.k, self.m)
+        else:
+            raise ErasureCodeError(
+                f"technique {self.technique!r} not implemented"
+            )
+        kind = (
+            "bitmatrix" if self.technique in BITMATRIX_TECHNIQUES else "table"
+        )
+        self.codec = MatrixCodec(matrix, kind, self.packetsize)
+
+    def get_alignment(self) -> int:
+        if self.technique in BITMATRIX_TECHNIQUES:
+            # chunk must split into w*packetsize groups
+            return self.k * self.w * self.packetsize
+        return self.k * self.w * SIZEOF_INT
+
+    def encode_chunks(self, chunks: dict[int, np.ndarray]) -> None:
+        data = np.stack([chunks[i] for i in range(self.k)])
+        coding = self.codec.encode(data)
+        for i in range(self.m):
+            chunks[self.k + i][:] = coding[i]
+
+    def decode_chunks(
+        self, want_to_read: set[int], chunks: dict[int, np.ndarray]
+    ) -> dict[int, np.ndarray]:
+        return self.codec.decode(dict(chunks), set(want_to_read))

@@ -1,0 +1,216 @@
+"""Locally-repairable codes (LRC plugin parity).
+
+Semantics follow the reference's ``src/erasure-code/lrc/ErasureCodeLrc.{h,cc}``:
+a *mapping* string assigns global chunk positions ('D' = data, anything
+else = coding) and *layers* are inner codes, each applied to the subset
+of positions its descriptor selects ('D' = layer data, 'c' = layer
+coding, '_' = not in this layer).  A single lost chunk is repaired from
+its smallest covering layer (the locality win); larger failures fall
+back to wider layers.
+
+Both the generic ``mapping``/``layers`` profile and the simplified
+``k``/``m``/``l`` generator are supported.  With k/m/l, the layout is
+the reference's: one global layer (k data + m RS parities) followed by
+one XOR local parity per group of ``l`` consecutive data+global
+positions — total chunks k + m + (k+m)/l.
+
+Inner codes are built through the plugin registry, so layer profiles
+may name any registered plugin (default jerasure reed_sol_van).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..interface import ErasureCode, ErasureCodeError, Profile
+
+
+class _Layer:
+    def __init__(self, descriptor: str, profile: dict[str, str]):
+        self.descriptor = descriptor
+        # global positions participating in this layer, in order
+        self.positions = [i for i, c in enumerate(descriptor) if c != "_"]
+        self.data_pos = [i for i in self.positions if descriptor[i] == "D"]
+        self.coding_pos = [i for i in self.positions if descriptor[i] != "D"]
+        prof = dict(profile)
+        prof.setdefault("plugin", "jerasure")
+        prof["k"] = str(len(self.data_pos))
+        prof["m"] = str(len(self.coding_pos))
+        from ..registry import create
+
+        self.ec = create(prof)
+
+    def encode(self, chunks: dict[int, np.ndarray]) -> None:
+        """Fill this layer's coding positions from its data positions.
+
+        Layer-local ids: data first (order of 'D' positions), then
+        coding — remapped to the inner code's 0..k-1 / k..k+m-1.
+        """
+        k = len(self.data_pos)
+        inner = {j: chunks[p] for j, p in enumerate(self.data_pos)}
+        for j, p in enumerate(self.coding_pos):
+            inner[k + j] = chunks[p]
+        self.ec.encode_chunks(inner)
+        for j, p in enumerate(self.coding_pos):
+            chunks[p][:] = inner[k + j]
+
+    def repair(
+        self, chunks: dict[int, np.ndarray], erased: set[int], size: int
+    ) -> None:
+        k = len(self.data_pos)
+        ids = self.data_pos + self.coding_pos
+        avail = {
+            j: chunks[p] for j, p in enumerate(ids) if p not in erased
+        }
+        want = {j for j, p in enumerate(ids) if p in erased}
+        decoded = self.ec.decode_chunks(want, avail)
+        for j, p in enumerate(ids):
+            if p in erased:
+                chunks[p] = decoded[j]
+                erased.discard(p)
+
+
+class ErasureCodeLrc(ErasureCode):
+    def init(self, profile: Profile) -> None:
+        self.profile = profile
+        if "mapping" in profile:
+            mapping = profile["mapping"]
+            layers_spec = json.loads(profile["layers"])
+        else:
+            mapping, layers_spec = self._generate(
+                profile.get_int("k", 4),
+                profile.get_int("m", 2),
+                profile.get_int("l", 3),
+            )
+        self.mapping = mapping
+        self.layers = [
+            _Layer(desc, prof if isinstance(prof, dict) else {})
+            for desc, prof in layers_spec
+        ]
+        n = len(mapping)
+        self.k = sum(1 for c in mapping if c == "D")
+        self.m = n - self.k
+        for layer in self.layers:
+            if len(layer.descriptor) != n:
+                raise ErasureCodeError(
+                    f"layer {layer.descriptor!r} length != mapping {mapping!r}"
+                )
+
+    @staticmethod
+    def _generate(k: int, m: int, l: int):
+        """k/m/l layout: k data, m global RS, (k+m)/l local XOR parities."""
+        if (k + m) % l:
+            raise ErasureCodeError(f"k+m={k + m} must be divisible by l={l}")
+        groups = (k + m) // l
+        # global positions: per group of l data/global chunks, the group
+        # followed by its local parity
+        mapping = ""
+        global_desc = ""
+        seq = "D" * k + "c" * m  # the global layer's view
+        pos = 0
+        local_descs = []
+        for g in range(groups):
+            chunk = seq[g * l : (g + 1) * l]
+            mapping += "".join("D" if c == "D" else "_" for c in chunk) + "_"
+            global_desc += "".join("D" if c == "D" else "c" for c in chunk) + "_"
+            local = ["_"] * (k + m + groups)
+            base = g * (l + 1)
+            for i in range(l):
+                local[base + i] = "D"
+            local[base + l] = "c"
+            local_descs.append("".join(local))
+        layers = [[global_desc, {"plugin": "jerasure", "technique": "reed_sol_van"}]]
+        for d in local_descs:
+            layers.append([d, {"plugin": "jerasure", "technique": "reed_sol_van"}])
+        return mapping, layers
+
+    def get_chunk_count(self) -> int:
+        return len(self.mapping)
+
+    def get_data_chunk_count(self) -> int:
+        return self.k
+
+    def get_alignment(self) -> int:
+        # chunks are shared across layers, so per-chunk alignment of
+        # w * sizeof(int) = 32 covers every inner matrix code
+        return self.k * 32
+
+    def _data_positions(self) -> list[int]:
+        return [i for i, c in enumerate(self.mapping) if c == "D"]
+
+    def _chunk_index(self, i: int) -> int:
+        """Object chunk i (0..k-1 data, k.. coding) -> global position."""
+        dp = self._data_positions()
+        if i < self.k:
+            return dp[i]
+        cp = [p for p in range(len(self.mapping)) if p not in dp]
+        return cp[i - self.k]
+
+    def encode_prepare(self, data: np.ndarray) -> dict[int, np.ndarray]:
+        blocksize = self.get_chunk_size(len(data))
+        chunks: dict[int, np.ndarray] = {
+            p: np.zeros(blocksize, np.uint8)
+            for p in range(len(self.mapping))
+        }
+        dp = self._data_positions()
+        for i in range(self.k):
+            lo = i * blocksize
+            hi = min(len(data), (i + 1) * blocksize)
+            if hi > lo:
+                chunks[dp[i]][: hi - lo] = data[lo:hi]
+        return chunks
+
+    def encode_chunks(self, chunks: dict[int, np.ndarray]) -> None:
+        for layer in self.layers:
+            layer.encode(chunks)
+
+    def minimum_to_decode(
+        self, want_to_read: set[int], available: set[int]
+    ) -> set[int]:
+        """Prefer the smallest single layer covering the losses."""
+        erased = want_to_read - available
+        if not erased:
+            return set(want_to_read)
+        for layer in sorted(self.layers, key=lambda s: len(s.positions)):
+            covered = erased <= set(layer.positions)
+            have = [p for p in layer.positions if p in available]
+            if covered and len(have) >= len(layer.data_pos):
+                return set(have[: len(layer.data_pos)]) | (
+                    want_to_read & available
+                )
+        # fall back to anything decodable
+        if len(available) < self.k:
+            raise ErasureCodeError("not enough chunks")
+        return set(sorted(available)[: self.k]) | (want_to_read & available)
+
+    def decode_chunks(
+        self, want_to_read: set[int], chunks: dict[int, np.ndarray]
+    ) -> dict[int, np.ndarray]:
+        size = len(next(iter(chunks.values())))
+        work = dict(chunks)
+        erased = set(range(len(self.mapping))) - set(work)
+        progress = True
+        while erased & self._needed(want_to_read, erased) and progress:
+            progress = False
+            for layer in sorted(self.layers, key=lambda s: len(s.positions)):
+                lost_here = [p for p in layer.positions if p in erased]
+                have = [p for p in layer.positions if p in work]
+                if lost_here and len(have) >= len(layer.data_pos):
+                    layer.repair(work, erased, size)
+                    progress = True
+                    break
+        still = [p for p in want_to_read if p not in work]
+        if still:
+            raise ErasureCodeError(f"cannot repair chunks {still}")
+        return {p: work[p] for p in want_to_read}
+
+    def _needed(self, want: set[int], erased: set[int]) -> set[int]:
+        return want & erased
+
+    def decode_concat(self, chunks: dict[int, np.ndarray]) -> bytes:
+        dp = self._data_positions()
+        chunk_size = len(next(iter(chunks.values())))
+        decoded = self.decode(set(dp), chunks, chunk_size)
+        return b"".join(decoded[p].tobytes() for p in dp)

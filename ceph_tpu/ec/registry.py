@@ -1,0 +1,78 @@
+"""EC plugin registry: profile strings -> codec instances.
+
+Parity with the reference's ``src/erasure-code/ErasureCodePlugin.{h,cc}``
+(``ErasureCodePluginRegistry::{instance,load,add,get,factory}``), minus
+``dlopen``: plugins register via :func:`register_plugin` (the
+``__erasure_code_init`` analog) at import, or lazily through the
+built-in table.  Profiles are string->string maps exactly like the
+reference's (``plugin=``, ``k``, ``m``, ``technique``, ``w``,
+``packetsize``, ``crush-failure-domain``, ...).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from .interface import ErasureCodeInterface, ErasureCodeError, Profile
+
+_PLUGINS: dict[str, Callable[[], "type[ErasureCodeInterface]"]] = {}
+
+
+def register_plugin(name: str, loader: Callable[[], type]) -> None:
+    _PLUGINS[name] = loader
+
+
+def _builtin(name: str):
+    if name in ("jerasure", "jax", "isa"):
+        # "isa" maps onto the same RS math (the reference's ISA-L plugin
+        # is an alternate CPU backend for identical codes)
+        from .plugins.jerasure import ErasureCodeJerasure
+
+        return ErasureCodeJerasure
+    if name == "lrc":
+        from .plugins.lrc import ErasureCodeLrc
+
+        return ErasureCodeLrc
+    if name == "clay":
+        from .plugins.clay import ErasureCodeClay
+
+        return ErasureCodeClay
+    if name == "shec":
+        from .plugins.shec import ErasureCodeShec
+
+        return ErasureCodeShec
+    return None
+
+
+class ErasureCodePluginRegistry:
+    """Singleton factory keyed by plugin name."""
+
+    _instance: "ErasureCodePluginRegistry | None" = None
+
+    @classmethod
+    def instance(cls) -> "ErasureCodePluginRegistry":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def load(self, name: str):
+        if name in _PLUGINS:
+            return _PLUGINS[name]()
+        klass = _builtin(name)
+        if klass is None:
+            raise ErasureCodeError(f"unknown erasure-code plugin {name!r}")
+        return klass
+
+    def factory(self, profile: dict[str, str] | Profile) -> ErasureCodeInterface:
+        if isinstance(profile, dict):
+            profile = Profile(dict(profile))
+        name = profile.get("plugin", "jerasure")
+        klass = self.load(name)
+        ec = klass()
+        ec.init(profile)
+        return ec
+
+
+def create(profile: dict[str, str]) -> ErasureCodeInterface:
+    """Convenience: build + init a codec from a profile dict."""
+    return ErasureCodePluginRegistry.instance().factory(profile)

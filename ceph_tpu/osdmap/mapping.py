@@ -293,7 +293,9 @@ class OSDMapMapping:
             pool.kind,
             pool.crush_rule,
             pool.hashpspool,
-            self.osdmap.crush.encode(),
+            self.osdmap.crush.uid,  # process-unique, never reused
+            self.osdmap.crush.version,
+            self.osdmap.crush.tunables,
         )
         cached = self._fns.get(pool.id)
         if cached is None or cached[0] != fp:

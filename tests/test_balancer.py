@@ -1,0 +1,93 @@
+"""Balancer: upmap optimizer convergence and deviation bounds
+(the reference's TestOSDMap.cc calc_pg_upmaps test pattern)."""
+
+import numpy as np
+
+from ceph_tpu.balancer import Balancer, calc_pg_upmaps
+from ceph_tpu.balancer.upmap import crush_device_weights, failure_domains
+from ceph_tpu.models.clusters import build_osdmap
+from ceph_tpu.osdmap.map import PGId
+from ceph_tpu.osdmap.mapping import OSDMapMapping
+
+
+def test_crush_device_weights():
+    m = build_osdmap(16)
+    w = crush_device_weights(m.crush, m.pools[1].crush_rule, 16)
+    assert np.allclose(w, 1.0)
+
+
+def test_failure_domains_host_level():
+    m = build_osdmap(16, osds_per_host=4)
+    dom = failure_domains(m.crush, m.pools[1].crush_rule, 16)
+    # 4 osds per host share a domain
+    assert len(set(dom.tolist())) == 4
+    for h in range(4):
+        assert len(set(dom[h * 4 : (h + 1) * 4].tolist())) == 1
+
+
+def test_balancer_reduces_deviation():
+    m = build_osdmap(32, pg_num=128)
+    b = Balancer(m, max_deviation=1.0)
+    before = b.evaluate()
+    plan = b.optimize()
+    applied = b.execute(plan)
+    after = b.evaluate()
+    assert after.score <= before.score
+    if applied:
+        assert after.pool_max_deviation[1] <= before.pool_max_deviation[1]
+
+
+def test_balancer_converges_to_max_deviation():
+    m = build_osdmap(24, pg_num=256)
+    b = Balancer(m, max_deviation=1.0, max_optimizations=200)
+    for _ in range(8):
+        if not b.tick():
+            break
+    ev = b.evaluate()
+    # every OSD within 1 PG of its fair share -> max deviation <= ~2
+    # (the reference targets upmap_max_deviation=1..5)
+    assert ev.pool_max_deviation[1] <= 2.5, ev.pool_max_deviation
+
+
+def test_upmap_respects_failure_domains():
+    m = build_osdmap(32, pg_num=64, osds_per_host=4)
+    inc = calc_pg_upmaps(m, max_deviation=0.5, max_entries=50)
+    m.apply_incremental(inc)
+    mapping = OSDMapMapping(m)
+    mapping.update()
+    dom = failure_domains(m.crush, m.pools[1].crush_rule, 32)
+    up_all, _, _, _ = mapping._results[1]
+    for ps in range(64):
+        row = [o for o in up_all[ps] if o != 0x7FFFFFFF]
+        doms = [int(dom[o]) for o in row]
+        assert len(doms) == len(set(doms)), (
+            f"pg {ps}: duplicate failure domains {row}"
+        )
+
+
+def test_upmap_moves_land():
+    """Every emitted pg_upmap_item must actually change the mapping."""
+    m = build_osdmap(16, pg_num=64)
+    # unbalance: one host down-weighted via reweights
+    for o in range(4):
+        m.osd_weight[o] = 0x8000
+    inc = calc_pg_upmaps(m, max_deviation=0.5, max_entries=30)
+    if not inc.new_pg_upmap_items:
+        return
+    before = OSDMapMapping(m)
+    before.update()
+    m.apply_incremental(inc)
+    after = OSDMapMapping(m)
+    after.update()
+    changed = 0
+    for pg in inc.new_pg_upmap_items:
+        if before.get(pg)[0] != after.get(pg)[0]:
+            changed += 1
+    assert changed >= max(1, len(inc.new_pg_upmap_items) // 2)
+
+
+def test_balanced_map_yields_empty_plan():
+    m = build_osdmap(8, pg_num=8)
+    b = Balancer(m, max_deviation=3.0)
+    plan = b.optimize()
+    assert not plan.new_pg_upmap_items or len(plan.new_pg_upmap_items) < 3

@@ -1,0 +1,216 @@
+"""Erasure-coding: host GF math vs C++ reference, device encoders,
+plugin round-trips (the reference's TestErasureCodeJerasure pattern:
+technique x k x m grids, encode -> erase <= m chunks -> decode ==
+original, padding edge cases)."""
+
+import itertools
+import random
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import create, gf
+from ceph_tpu.ec.backend import BitmatrixEncoder, MatrixCodec, TableEncoder
+from ceph_tpu.testing import cppref
+
+
+def rand_bytes(rng, n):
+    return np.frombuffer(rng.randbytes(n), np.uint8).copy()
+
+
+# ---- host GF math vs the C++ reference ----
+
+
+def test_gf_tables_match_cpp():
+    log_c, exp_c = cppref.gf_tables()
+    log_p, exp_p = gf.tables()
+    assert np.array_equal(log_c, np.asarray(log_p, np.uint8))
+    assert np.array_equal(exp_c, exp_p[:256])
+
+
+def test_gf_mul_matches_cpp():
+    rng = random.Random(1)
+    for _ in range(500):
+        a, b = rng.randrange(256), rng.randrange(256)
+        assert gf.gf_mul(a, b) == cppref.gf_mul(a, b)
+
+
+@pytest.mark.parametrize("k,m", [(2, 1), (3, 2), (4, 2), (8, 3), (10, 4)])
+def test_matrices_match_cpp(k, m):
+    assert np.array_equal(gf.vandermonde_matrix(k, m), cppref.vandermonde_matrix(k, m))
+    assert np.array_equal(gf.cauchy_matrix(k, m), cppref.cauchy_matrix(k, m))
+    assert np.array_equal(gf.raid6_matrix(k), cppref.raid6_matrix(k))
+
+
+def test_bitmatrix_match_cpp():
+    mat = gf.vandermonde_matrix(4, 2)
+    assert np.array_equal(
+        gf.matrix_to_bitmatrix(mat), cppref.matrix_to_bitmatrix(mat)
+    )
+
+
+def test_invert_matrix_roundtrip():
+    rng = np.random.default_rng(2)
+    mat = gf.vandermonde_matrix(6, 3)
+    gen = np.vstack([np.eye(6, dtype=np.uint8), mat])
+    rows = [0, 2, 4, 6, 7, 8]
+    sub = gen[rows]
+    inv = gf.invert_matrix(sub)
+    assert np.array_equal(inv, cppref.invert_matrix(sub))
+    # inv @ sub == I over GF
+    prod = np.zeros((6, 6), np.uint8)
+    for i in range(6):
+        for j in range(6):
+            acc = 0
+            for l in range(6):
+                acc ^= gf.gf_mul(int(inv[i, l]), int(sub[l, j]))
+            prod[i, j] = acc
+    assert np.array_equal(prod, np.eye(6, dtype=np.uint8))
+
+
+# ---- host encode refs agree (python vs C++) ----
+
+
+@pytest.mark.parametrize("k,m", [(4, 2), (8, 3)])
+def test_host_matrix_encode_matches_cpp(k, m):
+    rng = random.Random(3)
+    mat = gf.vandermonde_matrix(k, m)
+    data = rand_bytes(rng, k * 512).reshape(k, 512)
+    assert np.array_equal(gf.matrix_encode(mat, data), cppref.matrix_encode(mat, data))
+
+
+def test_host_bitmatrix_encode_matches_cpp():
+    rng = random.Random(4)
+    mat = gf.cauchy_good_matrix(4, 2)
+    bm = gf.matrix_to_bitmatrix(mat)
+    p = 16
+    data = rand_bytes(rng, 4 * 8 * p * 3).reshape(4, 8 * p * 3)
+    assert np.array_equal(
+        gf.bitmatrix_encode(bm, data, p), cppref.bitmatrix_encode(bm, data, p)
+    )
+
+
+# ---- device encoders vs host refs ----
+
+
+@pytest.mark.parametrize("k,m", [(4, 2), (8, 3), (6, 3)])
+def test_table_encoder_matches_host(k, m):
+    rng = random.Random(5)
+    mat = gf.vandermonde_matrix(k, m)
+    data = rand_bytes(rng, k * 1024).reshape(k, 1024)
+    dev = TableEncoder(mat).encode(data)
+    assert np.array_equal(dev, gf.matrix_encode(mat, data))
+
+
+@pytest.mark.parametrize("k,m,p", [(4, 2, 16), (8, 3, 32), (3, 2, 8)])
+def test_bitmatrix_encoder_matches_host(k, m, p):
+    rng = random.Random(6)
+    mat = gf.cauchy_matrix(k, m)
+    bm = gf.matrix_to_bitmatrix(mat)
+    size = 8 * p * 4
+    data = rand_bytes(rng, k * size).reshape(k, size)
+    dev = BitmatrixEncoder(bm, p).encode(data)
+    assert np.array_equal(dev, gf.bitmatrix_encode(bm, data, p))
+
+
+def test_bitmatrix_equals_table_semantics():
+    """GF(2) bitmatrix form must compute the same code as GF(2^8).
+
+    The bitmatrix packet layout (packetsize interleave) permutes bytes
+    within a chunk relative to byte-serial GF math, but on a one-byte
+    'packet' with the bit-plane layout collapsing, parity holds per
+    byte when packetsize == chunk organization... here we verify the
+    algebra instead: encode a single group where each packet is one
+    byte and check against explicit GF(2^8) per-symbol math with
+    bit-sliced symbols.
+    """
+    rng = random.Random(7)
+    k, m, p = 4, 2, 1
+    mat = gf.cauchy_matrix(k, m)
+    bm = gf.matrix_to_bitmatrix(mat)
+    # one group of 8 packets x 1 byte: symbol s_j for chunk j is the
+    # bit-sliced value where bit l lives in packet l (8 symbols, one
+    # per bit lane of the byte)
+    data = rand_bytes(rng, k * 8).reshape(k, 8)
+    coding = gf.bitmatrix_encode(bm, data, p)
+    for lane in range(8):  # each bit lane is an independent symbol
+        symbols = [
+            sum(((int(data[j, l]) >> lane) & 1) << l for l in range(8))
+            for j in range(k)
+        ]
+        for i in range(m):
+            expect = 0
+            for j in range(k):
+                expect ^= gf.gf_mul(int(mat[i, j]), symbols[j])
+            got = sum(((int(coding[i, l]) >> lane) & 1) << l for l in range(8))
+            assert got == expect, (lane, i)
+
+
+# ---- plugin round-trips (the non-regression grid pattern) ----
+
+
+TECHS = [
+    ("reed_sol_van", dict()),
+    ("reed_sol_r6_op", dict(m=2)),
+    ("cauchy_orig", dict(packetsize=8)),
+    ("cauchy_good", dict(packetsize=8)),
+]
+
+
+@pytest.mark.parametrize("tech,overrides", TECHS)
+@pytest.mark.parametrize("k,m", [(2, 2), (4, 2), (6, 3)])
+def test_roundtrip_all_erasure_patterns(tech, overrides, k, m):
+    m = overrides.get("m", m)
+    rng = random.Random(hash((tech, k, m)) & 0xFFFF)
+    profile = {
+        "plugin": "jerasure",
+        "technique": tech,
+        "k": str(k),
+        "m": str(m),
+    }
+    if "packetsize" in overrides:
+        profile["packetsize"] = str(overrides["packetsize"])
+    ec = create(profile)
+    obj = rand_bytes(rng, 3001)  # deliberately unaligned
+    all_ids = set(range(k + m))
+    encoded = ec.encode(all_ids, obj)
+    chunk_size = len(encoded[0])
+    assert chunk_size == ec.get_chunk_size(len(obj))
+
+    # erase every subset of size <= m (bounded for big grids)
+    patterns = list(itertools.combinations(range(k + m), m))
+    if len(patterns) > 20:
+        patterns = random.Random(0).sample(patterns, 20)
+    for erased in patterns:
+        avail = {i: encoded[i] for i in all_ids if i not in erased}
+        decoded = ec.decode(set(erased) | (all_ids - set(erased)), avail, chunk_size)
+        for i in all_ids:
+            assert np.array_equal(decoded[i], encoded[i]), (erased, i)
+        # reassembled object matches (strip padding)
+        out = ec.decode_concat(avail)
+        assert out[: len(obj)] == obj.tobytes()
+
+
+def test_minimum_to_decode():
+    ec = create({"plugin": "jerasure", "k": "4", "m": "2"})
+    assert ec.minimum_to_decode({0, 1}, {0, 1, 2, 3, 4, 5}) == {0, 1}
+    got = ec.minimum_to_decode({0, 1, 2, 3}, {1, 2, 3, 4, 5})
+    assert len(got) == 4 and got <= {1, 2, 3, 4, 5}
+    from ceph_tpu.ec import ErasureCodeError
+
+    with pytest.raises(ErasureCodeError):
+        ec.minimum_to_decode({0}, {1, 2, 3})
+
+
+def test_registry_unknown_plugin():
+    from ceph_tpu.ec import ErasureCodeError
+
+    with pytest.raises(ErasureCodeError):
+        create({"plugin": "nope"})
+
+
+def test_chunk_size_alignment():
+    ec = create({"plugin": "jerasure", "k": "4", "m": "2"})
+    # alignment k*w*4 = 128 -> padded to multiple of 128, /k
+    assert ec.get_chunk_size(4096) == 1024
+    assert ec.get_chunk_size(4097) == (4097 + 128 - 4097 % 128) // 4

@@ -52,6 +52,11 @@ def _assert_pool_agrees(m: OSDMap, pool: Pool):
             assert list(up[ps]) == hup + [ITEM_NONE] * (pool.size - len(hup)), (
                 f"ps={ps} up {list(up[ps])} != {hup}"
             )
+            dact_row = list(acting[ps])
+            assert dact_row[: len(hact)] == hact, (
+                f"ps={ps} acting {dact_row} != {hact}"
+            )
+            assert all(o == ITEM_NONE for o in dact_row[len(hact) :])
         assert int(upp[ps]) == hupp, f"ps={ps} up_primary"
         assert int(actp[ps]) == hactp, f"ps={ps} acting_primary"
 
@@ -65,6 +70,10 @@ def test_erasure_pool_positional():
     m = build_osdmap(32, pg_num=32, size=4, pool_kind="erasure")
     m.mark_down(5)
     m.mark_down(6)
+    # positional pg_temp with a partially-dead set keeps NONE holes
+    m.pg_temp[PGId(1, 2)] = (5, 10, 11, 12)
+    m.pg_temp[PGId(1, 3)] = (8, 9)
+    m.primary_temp[PGId(1, 3)] = 9
     _assert_pool_agrees(m, m.pools[1])
 
 
