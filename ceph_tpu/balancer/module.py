@@ -15,7 +15,7 @@ import numpy as np
 
 from ..osdmap.map import Incremental, OSDMap
 from ..osdmap.mapping import OSDMapMapping
-from .upmap import calc_pg_upmaps, crush_device_weights
+from .upmap import calc_pg_upmaps, crush_device_weights, expected_pg_share
 
 
 @dataclass
@@ -57,14 +57,13 @@ class Balancer:
             pool = self.osdmap.pools[pool_id]
             self.mapping.update(pool_id)
             counts = self.mapping.pg_counts_by_osd(pool_id, acting=False)
+            expect = expected_pg_share(self.osdmap, pool, n_osd)
+            if expect is None:
+                continue
             cw = crush_device_weights(
                 self.osdmap.crush, pool.crush_rule, n_osd
             )
             cw *= np.asarray(self.osdmap.osd_weight, np.float64)[:n_osd] / 0x10000
-            total = cw.sum()
-            if total <= 0:
-                continue
-            expect = pool.pg_num * pool.size * cw / total
             active = cw > 0
             dev = counts[active] - expect[active]
             ev.pool_stddev[pool_id] = float(dev.std())

@@ -89,6 +89,19 @@ def failure_domains(crush: CrushMap, rule_id: int, n_osd: int) -> np.ndarray:
     return dom
 
 
+def expected_pg_share(m: OSDMap, pool: Pool, n_osd: int) -> np.ndarray | None:
+    """Per-OSD fair share of the pool's PG replicas (crush weight x
+    reweight proportional); None if the rule subtree has no weight.
+    Shared between the optimizer and the balancer's Eval so they agree
+    on what 'balanced' means."""
+    cw = crush_device_weights(m.crush, pool.crush_rule, n_osd)
+    cw *= np.asarray(m.osd_weight, np.float64)[:n_osd] / 0x10000
+    total = cw.sum()
+    if total <= 0:
+        return None
+    return pool.pg_num * pool.size * cw / total
+
+
 def calc_pg_upmaps(
     m: OSDMap,
     max_deviation: float = 1.0,
@@ -98,96 +111,119 @@ def calc_pg_upmaps(
 ) -> Incremental:
     """Compute pg_upmap_items moves; returns an Incremental (possibly
     empty).  ``max_deviation`` is in PGs, like the reference's
-    ``upmap_max_deviation``."""
+    ``upmap_max_deviation``.
+
+    Trial moves are staged in a scratch upmap table on the SAME map
+    object (restored on exit), so the already-compiled pool programs
+    are reused — only the upmap input arrays change between rounds.
+    The Incremental is diffed from the final validated trial state, so
+    the committed epoch always equals what the optimizer scored.
+    """
     inc = Incremental(epoch=m.epoch + 1)
     pool_ids = pools or sorted(m.pools)
     mapping = mapping or OSDMapMapping(m)
     n_osd = max(m.max_osd, 1)
     entries = 0
+    original_items = m.pg_upmap_items
 
     for pool_id in pool_ids:
         pool = m.pools[pool_id]
-        trial = m.clone()
-        tmap = OSDMapMapping(trial)
+        expect = expected_pg_share(m, pool, n_osd)
+        if expect is None:
+            continue
         cw = crush_device_weights(m.crush, pool.crush_rule, n_osd)
         cw *= np.asarray(m.osd_weight, np.float64)[:n_osd] / 0x10000
         dom = failure_domains(m.crush, pool.crush_rule, n_osd)
-        total_w = cw.sum()
-        if total_w <= 0:
-            continue
-        replicas = pool.pg_num * pool.size
-        expect = replicas * cw / total_w
 
-        for _round in range(max_entries):
-            if entries >= max_entries:
-                break
-            tmap.update(pool_id)
-            up_all, _, _, _ = tmap._results[pool_id]
-            counts = tmap.pg_counts_by_osd(pool_id, acting=False)
-            deviation = counts - expect
-            if deviation.max() <= max_deviation:
-                break
-            # candidate moves: for every pg replica on an overfull osd,
-            # to every underfull osd in a compatible failure domain
-            over = int(np.argmax(deviation))
-            under_mask = (deviation < -1e-9) & (cw > 0)
-            under = np.nonzero(under_mask)[0]
-            if len(under) == 0:
-                under = np.nonzero((deviation < deviation.max() - 1) & (cw > 0))[0]
-            if len(under) == 0:
-                break
-            pgs_on_over = np.nonzero((up_all == over).any(axis=1))[0]
-            best = None  # (gain, pg, frm, to)
-            for ps in pgs_on_over:
-                row = up_all[ps]
-                row_valid = row[row != ITEM_NONE]
-                used_doms = {int(dom[o]) for o in row_valid if o < n_osd}
-                frm_dom = int(dom[over])
-                existing = trial.pg_upmap_items.get(PGId(pool_id, int(ps)), ())
-                if len(existing) >= 4:  # keep per-pg item lists short
-                    continue
-                for to in under:
-                    to = int(to)
-                    if to in row_valid or not m.is_up(to):
-                        continue
-                    to_dom = int(dom[to])
-                    if to_dom != frm_dom and to_dom in used_doms:
-                        continue  # would double up a failure domain
-                    gain = deviation[over] - deviation[to]
-                    if best is None or gain > best[0]:
-                        best = (float(gain), int(ps), over, to)
-            if best is None:
-                break
-            _, ps, frm, to = best
-            pg = PGId(pool_id, ps)
-            items = list(trial.pg_upmap_items.get(pg, ()))
-            # collapse chains: a->b then b->c becomes a->c
-            for idx, (f0, t0) in enumerate(items):
-                if t0 == frm:
-                    items[idx] = (f0, to)
+        mapping.update(pool_id)
+        base_counts = mapping.pg_counts_by_osd(pool_id, acting=False)
+
+        pool_entries = 0
+        trial_items = dict(original_items)
+        m.pg_upmap_items = trial_items  # staged; restored below
+        try:
+            for _round in range(max_entries):
+                if entries + pool_entries >= max_entries:
                     break
-            else:
-                items.append((frm, to))
-            items = [(f, t) for f, t in items if f != t]
-            if items:
-                trial.pg_upmap_items[pg] = tuple(items)
-                inc.new_pg_upmap_items[pg] = tuple(items)
-            else:
-                trial.pg_upmap_items.pop(pg, None)
-                inc.old_pg_upmap_items.append(pg)
-            entries += 1
+                mapping.update(pool_id)
+                up_all, _, _, _ = mapping._results[pool_id]
+                counts = mapping.pg_counts_by_osd(pool_id, acting=False)
+                deviation = counts - expect
+                if deviation.max() <= max_deviation:
+                    break
+                # candidate moves: every pg replica on the most-overfull
+                # osd, to every underfull osd in a compatible domain
+                over = int(np.argmax(deviation))
+                under = np.nonzero((deviation < -1e-9) & (cw > 0))[0]
+                if len(under) == 0:
+                    under = np.nonzero(
+                        (deviation < deviation.max() - 1) & (cw > 0)
+                    )[0]
+                if len(under) == 0:
+                    break
+                pgs_on_over = np.nonzero((up_all == over).any(axis=1))[0]
+                best = None  # (gain, pg, frm, to)
+                for ps in pgs_on_over:
+                    row = up_all[ps]
+                    row_valid = row[row != ITEM_NONE]
+                    used_doms = {int(dom[o]) for o in row_valid if o < n_osd}
+                    frm_dom = int(dom[over])
+                    existing = trial_items.get(PGId(pool_id, int(ps)), ())
+                    if len(existing) >= 4:  # keep per-pg item lists short
+                        continue
+                    for to in under:
+                        to = int(to)
+                        if to in row_valid or not m.is_up(to):
+                            continue
+                        to_dom = int(dom[to])
+                        if to_dom != frm_dom and to_dom in used_doms:
+                            continue  # would double up a failure domain
+                        gain = deviation[over] - deviation[to]
+                        if best is None or gain > best[0]:
+                            best = (float(gain), int(ps), over, to)
+                if best is None:
+                    break
+                _, ps, frm, to = best
+                pg = PGId(pool_id, ps)
+                items = list(trial_items.get(pg, ()))
+                # collapse chains: a->b then b->c becomes a->c
+                for idx, (f0, t0) in enumerate(items):
+                    if t0 == frm:
+                        items[idx] = (f0, to)
+                        break
+                else:
+                    items.append((frm, to))
+                items = [(f, t) for f, t in items if f != t]
+                if items:
+                    trial_items[pg] = tuple(items)
+                else:
+                    trial_items.pop(pg, None)
+                pool_entries += 1
 
-        # validation: the trial map's deviation must not be worse
-        tmap.update(pool_id)
-        final_counts = tmap.pg_counts_by_osd(pool_id, acting=False)
-        base = mapping
-        base.update(pool_id)
-        base_counts = base.pg_counts_by_osd(pool_id, acting=False)
+            # validation: trial deviation must not be worse than base
+            mapping.update(pool_id)
+            final_counts = mapping.pg_counts_by_osd(pool_id, acting=False)
+        finally:
+            m.pg_upmap_items = original_items
+            mapping.update(pool_id)  # restore cached results to reality
+
+        if pool_entries == 0:
+            continue
         if np.abs(final_counts - expect).max() > np.abs(
             base_counts - expect
         ).max():
-            # revert this pool's moves (should not happen; belt & braces)
-            for pg in list(inc.new_pg_upmap_items):
-                if pg.pool == pool_id:
-                    del inc.new_pg_upmap_items[pg]
+            continue  # reject this pool's moves wholesale
+        entries += pool_entries
+        # diff trial vs live state for this pool only
+        for pg in set(trial_items) | set(original_items):
+            if pg.pool != pool_id:
+                continue
+            new = trial_items.get(pg)
+            old = original_items.get(pg)
+            if new == old:
+                continue
+            if new:
+                inc.new_pg_upmap_items[pg] = new
+            else:
+                inc.old_pg_upmap_items.append(pg)
     return inc

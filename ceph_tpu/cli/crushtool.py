@@ -1,0 +1,227 @@
+"""crushtool-parity CLI.
+
+Covers the reference's ``src/tools/crushtool.cc`` surface relevant to
+placement work: compile (``-c``) / decompile (``-d``), ``--build``
+(synthesize a hierarchy from a flat device count), ``--test`` with
+``--min-x/--max-x/--num-rep/--rule``, ``--show-mappings``,
+``--show-statistics``, ``--show-utilization``, ``--show-bad-mappings``,
+and ``--tree``.  Map files are the framework's versioned JSON encoding
+(`.json`); text crushmaps use the classic format via the compiler.
+
+The --test engine is the batch device path (one XLA launch for the
+whole x range) with the C++ CPU reference available via --cpu for
+differential runs — the reference's CrushTester loop, vectorized.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from ..crush.compiler import compile_crushmap, decompile_crushmap
+from ..crush.map import ALG_IDS, ITEM_NONE, CrushMap
+
+
+def load_map(path: str) -> CrushMap:
+    with open(path, "rb") as f:
+        data = f.read()
+    if data.lstrip()[:1] == b"{":
+        return CrushMap.decode(data)
+    return compile_crushmap(data.decode())
+
+
+def cmd_tree(m: CrushMap, out) -> None:
+    def walk(item: int, depth: int) -> None:
+        pad = "    " * depth
+        if item >= 0:
+            print(f"{pad}{m.item_name(item)}", file=out)
+            return
+        b = m.buckets[item]
+        print(
+            f"{pad}{m.types[b.type_id]} {b.name} "
+            f"(id {b.id}, weight {b.weight / 0x10000:.3f}, "
+            f"alg {b.alg})",
+            file=out,
+        )
+        for it in b.items:
+            walk(it, depth + 1)
+
+    roots = [bid for bid in m.buckets if m.parent_of(bid) is None]
+    for r in sorted(roots, reverse=True):
+        walk(r, 0)
+
+
+def run_test(m: CrushMap, args, out) -> int:
+    from ..crush.interp import StaticCrushMap, batch_do_rule
+
+    rules = (
+        [m.rules[args.rule]]
+        if args.rule is not None
+        else sorted(m.rules.values(), key=lambda r: r.id)
+    )
+    dense = m.to_dense()
+    smap = StaticCrushMap(dense)
+    xs = np.arange(args.min_x, args.max_x + 1, dtype=np.uint32)
+    weights = np.full(max(smap.max_devices, 1), 0x10000, np.uint32)
+    if args.weight:
+        for spec in args.weight:
+            osd, w = spec.split(":")
+            weights[int(osd)] = int(round(float(w) * 0x10000))
+    rc = 0
+    for rule in rules:
+        for num_rep in range(args.min_rep, args.max_rep + 1):
+            if args.cpu:
+                from ..testing import cppref
+
+                steps = [(s.op, s.arg1, s.arg2) for s in rule.steps]
+                results, lens = cppref.do_rule_batch(
+                    dense, steps, xs, weights, num_rep
+                )
+            else:
+                import jax
+
+                results, lens = jax.block_until_ready(
+                    batch_do_rule(smap, rule, xs, weights, num_rep)
+                )
+                results = np.asarray(results)
+                lens = np.asarray(lens)
+            if args.show_mappings:
+                for x, row, ln in zip(xs, results, lens):
+                    osds = [int(o) for o in row[:ln] if o != ITEM_NONE]
+                    print(
+                        f"CRUSH rule {rule.id} x {x} {osds}", file=out
+                    )
+            bad = int((lens < num_rep).sum())
+            if args.show_statistics or args.show_bad_mappings:
+                print(
+                    f"rule {rule.id} ({rule.name}) num_rep {num_rep} "
+                    f"result size == {num_rep}:\t"
+                    f"{int((lens == num_rep).sum())}/{len(xs)}",
+                    file=out,
+                )
+                if bad and args.show_bad_mappings:
+                    for x, ln in zip(xs, lens):
+                        if ln < num_rep:
+                            print(
+                                f"bad mapping rule {rule.id} x {x} "
+                                f"num_rep {num_rep} result size {ln}",
+                                file=out,
+                            )
+            if args.show_utilization:
+                flat = results[results != ITEM_NONE]
+                counts = np.bincount(flat, minlength=len(weights))
+                expected = len(xs) * num_rep / max((weights > 0).sum(), 1)
+                for osd in np.nonzero(counts)[0]:
+                    print(
+                        f"  device {osd}:\t\tstored : {counts[osd]}\t "
+                        f"expected : {expected:.2f}",
+                        file=out,
+                    )
+            if bad:
+                rc = 1 if args.show_bad_mappings else rc
+    return rc
+
+
+def build_hierarchy_from_args(args) -> CrushMap:
+    """--build parity: crushtool --build --num_osds N layer1 type1 size1 ..."""
+    from ..models.clusters import W1
+
+    m = CrushMap()
+    layers = [
+        (args.layers[i], args.layers[i + 1], int(args.layers[i + 2]))
+        for i in range(0, len(args.layers), 3)
+    ]
+    for tid, (name, _alg, _size) in enumerate(layers, start=1):
+        m.add_type(tid, name)
+    for o in range(args.num_osds):
+        m.add_device(o)
+    # bottom-up grouping; groups are consecutive slices, so weights
+    # zip by the same slice (no per-item index scans)
+    current = list(range(args.num_osds))
+    weights = [W1] * len(current)
+    for tname, algname, size in layers:
+        alg = ALG_IDS.get(algname, 5)
+        next_items: list[int] = []
+        next_weights: list[int] = []
+        step = size if size > 0 else len(current)
+        for gi, lo in enumerate(range(0, len(current), step)):
+            b = m.add_bucket(f"{tname}{gi}", tname, alg=alg)
+            for item, w in zip(current[lo : lo + step], weights[lo : lo + step]):
+                m.insert_item(b.id, item, w)
+            next_items.append(b.id)
+            next_weights.append(sum(m.buckets[b.id].item_weights))
+        current = next_items
+        weights = next_weights
+    return m
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="crushtool")
+    p.add_argument("-i", "--infn", help="input map file (json or text)")
+    p.add_argument("-o", "--outfn", help="output file")
+    p.add_argument("-c", "--compile", dest="compilefn", help="compile text crushmap")
+    p.add_argument("-d", "--decompile", dest="decompilefn", help="decompile map")
+    p.add_argument("--build", action="store_true")
+    p.add_argument("--num_osds", type=int, default=0)
+    p.add_argument("layers", nargs="*", help="--build: name alg size triples")
+    p.add_argument("--test", action="store_true")
+    p.add_argument("--tree", action="store_true")
+    p.add_argument("--rule", type=int, default=None)
+    p.add_argument("--min-x", type=int, default=0)
+    p.add_argument("--max-x", type=int, default=1023)
+    p.add_argument("--num-rep", type=int, default=None)
+    p.add_argument("--min-rep", type=int, default=3)
+    p.add_argument("--max-rep", type=int, default=3)
+    p.add_argument("--show-mappings", action="store_true")
+    p.add_argument("--show-statistics", action="store_true")
+    p.add_argument("--show-utilization", action="store_true")
+    p.add_argument("--show-bad-mappings", action="store_true")
+    p.add_argument("--weight", action="append", metavar="OSD:W")
+    p.add_argument("--cpu", action="store_true", help="use the C++ CPU reference")
+    args = p.parse_args(argv)
+    if args.num_rep is not None:
+        args.min_rep = args.max_rep = args.num_rep
+    out = sys.stdout
+
+    if args.compilefn:
+        with open(args.compilefn) as f:
+            m = compile_crushmap(f.read())
+        dest = args.outfn or args.compilefn + ".json"
+        with open(dest, "wb") as f:
+            f.write(m.encode())
+        print(f"wrote crush map to {dest}", file=sys.stderr)
+        return 0
+    if args.decompilefn:
+        m = load_map(args.decompilefn)
+        text = decompile_crushmap(m)
+        if args.outfn:
+            with open(args.outfn, "w") as f:
+                f.write(text)
+        else:
+            out.write(text)
+        return 0
+    if args.build:
+        if not args.num_osds or len(args.layers) % 3:
+            p.error("--build requires --num_osds and name/alg/size triples")
+        m = build_hierarchy_from_args(args)
+        dest = args.outfn or "crushmap.json"
+        with open(dest, "wb") as f:
+            f.write(m.encode())
+        print(f"wrote crush map to {dest}", file=sys.stderr)
+        return 0
+    if not args.infn:
+        p.error("need -i/--infn (or -c/-d/--build)")
+    m = load_map(args.infn)
+    if args.tree:
+        cmd_tree(m, out)
+        return 0
+    if args.test:
+        return run_test(m, args, out)
+    p.error("nothing to do (--test, --tree, -d ...)")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,16 @@
+from .config import Config, Option, OPT_INT, OPT_FLOAT, OPT_STR, OPT_BOOL
+from .perf_counters import PerfCounters, PerfCountersBuilder
+from .log import get_logger, set_subsys_level
+
+__all__ = [
+    "Config",
+    "Option",
+    "OPT_INT",
+    "OPT_FLOAT",
+    "OPT_STR",
+    "OPT_BOOL",
+    "PerfCounters",
+    "PerfCountersBuilder",
+    "get_logger",
+    "set_subsys_level",
+]

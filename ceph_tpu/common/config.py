@@ -1,0 +1,188 @@
+"""Typed, layered configuration.
+
+Parity with the reference's option/config system (upstream
+``src/common/options/*.yaml.in`` schemas code-generated into
+``md_config_t``, ``src/common/config.cc``): options are declared with
+name/type/default/level/description/see_also and validated; values
+layer as compiled defaults < config file (JSON) < environment
+(``CEPH_TPU_<NAME>``) < command line < runtime ``set`` — the same
+precedence order as the reference's file/env/argv/mon-db stack.
+Observers are notified on change (``md_config_obs_t`` analog).
+
+Option names mirror the reference's where the concept carries over
+(``choose_total_tries``, ``upmap_max_deviation``, ...).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+OPT_INT = "int"
+OPT_FLOAT = "float"
+OPT_STR = "str"
+OPT_BOOL = "bool"
+
+_CASTS: dict[str, Callable[[str], Any]] = {
+    OPT_INT: int,
+    OPT_FLOAT: float,
+    OPT_STR: str,
+    OPT_BOOL: lambda s: s if isinstance(s, bool) else s.lower() in ("1", "true", "yes", "on"),
+}
+
+LEVEL_BASIC = "basic"
+LEVEL_ADVANCED = "advanced"
+LEVEL_DEV = "dev"
+
+
+@dataclass(frozen=True)
+class Option:
+    name: str
+    type: str
+    default: Any
+    level: str = LEVEL_ADVANCED
+    desc: str = ""
+    min: float | None = None
+    max: float | None = None
+    enum_allowed: tuple[str, ...] = ()
+    see_also: tuple[str, ...] = ()
+
+    def validate(self, value: Any) -> Any:
+        try:
+            value = _CASTS[self.type](value) if not isinstance(value, bool) or self.type == OPT_BOOL else value
+        except (ValueError, TypeError) as e:
+            raise ValueError(f"{self.name}: cannot parse {value!r} as {self.type}") from e
+        if self.min is not None and value < self.min:
+            raise ValueError(f"{self.name}: {value} < min {self.min}")
+        if self.max is not None and value > self.max:
+            raise ValueError(f"{self.name}: {value} > max {self.max}")
+        if self.enum_allowed and value not in self.enum_allowed:
+            raise ValueError(
+                f"{self.name}: {value!r} not in {self.enum_allowed}"
+            )
+        return value
+
+
+# The framework's option schema (the *.yaml.in analog).
+SCHEMA: list[Option] = [
+    Option("choose_total_tries", OPT_INT, 50, LEVEL_ADVANCED,
+           "CRUSH retry budget per choose step", min=1,
+           see_also=("chooseleaf_vary_r",)),
+    Option("chooseleaf_vary_r", OPT_INT, 1, LEVEL_ADVANCED,
+           "vary r' by parent r on chooseleaf retries", min=0, max=1),
+    Option("chooseleaf_stable", OPT_INT, 1, LEVEL_ADVANCED,
+           "stable replica ordering on chooseleaf retries", min=0, max=1),
+    Option("upmap_max_deviation", OPT_FLOAT, 1.0, LEVEL_ADVANCED,
+           "balancer stops when every OSD is within this many PGs of "
+           "its fair share", min=0.1,
+           see_also=("upmap_max_optimizations",)),
+    Option("upmap_max_optimizations", OPT_INT, 100, LEVEL_ADVANCED,
+           "max pg_upmap_items entries per optimize round", min=1),
+    Option("balancer_mode", OPT_STR, "upmap", LEVEL_BASIC,
+           "balancing strategy", enum_allowed=("upmap", "none")),
+    Option("ec_default_packetsize", OPT_INT, 2048, LEVEL_ADVANCED,
+           "bitmatrix technique packet size (bytes)", min=8),
+    Option("placement_batch_size", OPT_INT, 4_000_000, LEVEL_DEV,
+           "objects per device batch in streamed placement", min=1),
+    Option("debug_crush", OPT_INT, 1, LEVEL_DEV,
+           "crush subsystem log level", min=0, max=20),
+    Option("debug_osdmap", OPT_INT, 1, LEVEL_DEV,
+           "osdmap subsystem log level", min=0, max=20),
+    Option("debug_ec", OPT_INT, 1, LEVEL_DEV,
+           "erasure-code subsystem log level", min=0, max=20),
+    Option("debug_balancer", OPT_INT, 1, LEVEL_DEV,
+           "balancer subsystem log level", min=0, max=20),
+]
+
+
+class Config:
+    """Layered config: defaults < file < env < argv < runtime set."""
+
+    ENV_PREFIX = "CEPH_TPU_"
+
+    def __init__(
+        self,
+        config_file: str | None = None,
+        argv: list[str] | None = None,
+        env: dict[str, str] | None = None,
+        schema: list[Option] | None = None,
+    ):
+        self.schema = {o.name: o for o in (schema or SCHEMA)}
+        self._values: dict[str, Any] = {}
+        self._source: dict[str, str] = {}
+        self._observers: list[Callable[[str, Any], None]] = []
+        if config_file and os.path.exists(config_file):
+            with open(config_file) as f:
+                for k, v in json.load(f).items():
+                    self._set(k, v, "file")
+        env = dict(os.environ if env is None else env)
+        for k, v in env.items():
+            if k.startswith(self.ENV_PREFIX):
+                name = k[len(self.ENV_PREFIX):].lower()
+                if name in self.schema:
+                    self._set(name, v, "env")
+        for arg in argv or []:
+            if arg.startswith("--") and "=" in arg:
+                name, v = arg[2:].split("=", 1)
+                name = name.replace("-", "_")
+                if name in self.schema:
+                    self._set(name, v, "argv")
+
+    def _set(self, name: str, value: Any, source: str) -> None:
+        if name not in self.schema:
+            raise KeyError(f"unknown option {name!r}")
+        value = self.schema[name].validate(value)
+        old = self._values.get(name)
+        self._values[name] = value
+        self._source[name] = source
+        if old != value:
+            for obs in self._observers:
+                obs(name, value)
+
+    def get(self, name: str) -> Any:
+        if name in self._values:
+            return self._values[name]
+        return self.schema[name].default
+
+    def __getitem__(self, name: str) -> Any:
+        return self.get(name)
+
+    def set(self, name: str, value: Any) -> None:
+        """Runtime override (the ``config set`` / admin-socket path)."""
+        self._set(name, value, "override")
+
+    def rm(self, name: str) -> None:
+        self._values.pop(name, None)
+        self._source.pop(name, None)
+
+    def source(self, name: str) -> str:
+        return self._source.get(name, "default")
+
+    def add_observer(self, fn: Callable[[str, Any], None]) -> None:
+        self._observers.append(fn)
+
+    def show(self, level: str | None = None) -> dict[str, dict]:
+        out = {}
+        for name, opt in sorted(self.schema.items()):
+            if level and opt.level != level:
+                continue
+            out[name] = {
+                "value": self.get(name),
+                "default": opt.default,
+                "source": self.source(name),
+                "level": opt.level,
+                "desc": opt.desc,
+            }
+        return out
+
+
+_global: Config | None = None
+
+
+def global_config() -> Config:
+    global _global
+    if _global is None:
+        _global = Config()
+    return _global

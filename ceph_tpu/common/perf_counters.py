@@ -1,0 +1,143 @@
+"""Per-component metrics registry.
+
+Parity with the reference's ``src/common/perf_counters.{h,cc}``
+(``PerfCountersBuilder``, u64 counters / gauges / time-averages,
+``perf dump`` JSON via the admin socket, mgr aggregation): counters are
+built per component, updated lock-free from the hot path (the GIL is
+our lock), and dumped as JSON for scraping (the prometheus-module
+analog is a textfile emitter).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+
+TYPE_U64 = "u64"
+TYPE_GAUGE = "gauge"
+TYPE_TIME_AVG = "time_avg"
+
+
+@dataclass
+class _Counter:
+    name: str
+    type: str
+    desc: str = ""
+    value: float = 0
+    # time_avg: accumulating sum + count
+    total: float = 0.0
+    count: int = 0
+
+
+class PerfCounters:
+    def __init__(self, name: str):
+        self.name = name
+        self._counters: dict[str, _Counter] = {}
+        self._lock = threading.Lock()
+
+    def _add(self, name: str, type_: str, desc: str) -> None:
+        self._counters[name] = _Counter(name, type_, desc)
+
+    def inc(self, name: str, amount: int = 1) -> None:
+        self._counters[name].value += amount
+
+    def dec(self, name: str, amount: int = 1) -> None:
+        c = self._counters[name]
+        assert c.type == TYPE_GAUGE
+        c.value -= amount
+
+    def set(self, name: str, value: float) -> None:
+        self._counters[name].value = value
+
+    def tinc(self, name: str, seconds: float) -> None:
+        c = self._counters[name]
+        assert c.type == TYPE_TIME_AVG
+        with self._lock:
+            c.total += seconds
+            c.count += 1
+
+    def time(self, name: str):
+        """Context manager: times the block into a time_avg counter."""
+        pc = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                pc.tinc(name, time.perf_counter() - self.t0)
+                return False
+
+        return _Timer()
+
+    def dump(self) -> dict:
+        out: dict = {}
+        for c in self._counters.values():
+            if c.type == TYPE_TIME_AVG:
+                out[c.name] = {
+                    "avgcount": c.count,
+                    "sum": round(c.total, 9),
+                    "avgtime": round(c.total / c.count, 9) if c.count else 0.0,
+                }
+            else:
+                out[c.name] = c.value
+        return {self.name: out}
+
+    def dump_json(self) -> str:
+        return json.dumps(self.dump(), sort_keys=True)
+
+
+class PerfCountersBuilder:
+    """Fluent builder (reference ``PerfCountersBuilder`` pattern)."""
+
+    def __init__(self, name: str):
+        self._pc = PerfCounters(name)
+
+    def add_u64_counter(self, name: str, desc: str = "") -> "PerfCountersBuilder":
+        self._pc._add(name, TYPE_U64, desc)
+        return self
+
+    def add_gauge(self, name: str, desc: str = "") -> "PerfCountersBuilder":
+        self._pc._add(name, TYPE_GAUGE, desc)
+        return self
+
+    def add_time_avg(self, name: str, desc: str = "") -> "PerfCountersBuilder":
+        self._pc._add(name, TYPE_TIME_AVG, desc)
+        return self
+
+    def create_perf_counters(self) -> PerfCounters:
+        pc = self._pc
+        _registry.register(pc)
+        return pc
+
+
+class _Registry:
+    """Process-wide collection (the admin socket dumps all of these)."""
+
+    def __init__(self):
+        self._all: dict[str, PerfCounters] = {}
+        self._lock = threading.Lock()
+
+    def register(self, pc: PerfCounters) -> None:
+        with self._lock:
+            self._all[pc.name] = pc
+
+    def dump(self) -> dict:
+        out: dict = {}
+        with self._lock:
+            for pc in self._all.values():
+                out.update(pc.dump())
+        return out
+
+    def get(self, name: str) -> PerfCounters | None:
+        return self._all.get(name)
+
+
+_registry = _Registry()
+
+
+def registry() -> _Registry:
+    return _registry

@@ -1,0 +1,94 @@
+"""Non-regression archives: placements and EC encodings are ABI.
+
+The reference pins bit-exact behavior with archived golden outputs
+(``src/test/cli/crushtool/*.t`` recorded mappings and
+``ceph_erasure_code_non_regression`` chunk archives): if an edit
+changes any mapping or encoding, user data moves or becomes
+unreadable.  Here the archive is a checked-in JSON of SHA-256 digests:
+CRUSH mapping tables per (map shape, rule, tunables) and EC chunks per
+(plugin, technique, k, m, packetsize), over fixed seeds.
+
+Regenerate (only when a change is INTENTIONALLY breaking placement):
+    python -m ceph_tpu.testing.nonregression > tests/golden/archive.json
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+import numpy as np
+
+
+def _digest(arr: np.ndarray) -> str:
+    return hashlib.sha256(np.ascontiguousarray(arr).tobytes()).hexdigest()
+
+
+def crush_cases() -> dict[str, dict]:
+    from ..models.clusters import build_flat, build_hierarchy
+    from ..testing import cppref
+
+    cases = {}
+    specs = {
+        "flat_16": build_flat(16),
+        "flat_7_weighted": _weighted_flat(),
+        "rack_host_osd": build_hierarchy([("rack", 2), ("host", 4)], 4),
+    }
+    for name, m in specs.items():
+        rule = m.rule_by_name("replicated_rule")
+        dense = m.to_dense()
+        steps = [(s.op, s.arg1, s.arg2) for s in rule.steps]
+        xs = np.arange(2048, dtype=np.uint32)
+        w = np.full(dense.max_devices, 0x10000, np.uint32)
+        res, lens = cppref.do_rule_batch(dense, steps, xs, w, 3)
+        cases[name] = {
+            "mappings_sha256": _digest(res),
+            "lens_sha256": _digest(lens),
+        }
+    return cases
+
+
+def _weighted_flat():
+    from ..models.clusters import build_flat
+
+    m = build_flat(7)
+    root = m.bucket_by_name("default")
+    for i, osd in enumerate(root.items):
+        m.adjust_item_weight(root.id, osd, 0x8000 + i * 0x4000)
+    return m
+
+
+def ec_cases() -> dict[str, dict]:
+    from ..ec import create
+
+    rng = np.random.default_rng(0xCE9)
+    obj = rng.integers(0, 256, 40_000, dtype=np.uint8)
+    profiles = {
+        "jerasure_rs_4_2": {"plugin": "jerasure", "technique": "reed_sol_van", "k": "4", "m": "2"},
+        "jerasure_rs_8_3": {"plugin": "jerasure", "technique": "reed_sol_van", "k": "8", "m": "3"},
+        "jerasure_r6_4_2": {"plugin": "jerasure", "technique": "reed_sol_r6_op", "k": "4", "m": "2"},
+        "jerasure_cauchy_4_2_p8": {"plugin": "jerasure", "technique": "cauchy_good", "k": "4", "m": "2", "packetsize": "8"},
+        "lrc_4_2_3": {"plugin": "lrc", "k": "4", "m": "2", "l": "3"},
+        "shec_4_3_2": {"plugin": "shec", "k": "4", "m": "3", "c": "2"},
+        "clay_4_2": {"plugin": "clay", "k": "4", "m": "2"},
+    }
+    out = {}
+    for name, profile in profiles.items():
+        ec = create(profile)
+        n = ec.get_chunk_count()
+        enc = ec.encode(set(range(n)), obj)
+        out[name] = {
+            "chunk_size": len(enc[0]),
+            "chunks_sha256": {
+                str(i): _digest(enc[i]) for i in sorted(enc)
+            },
+        }
+    return out
+
+
+def generate() -> dict:
+    return {"version": 1, "crush": crush_cases(), "ec": ec_cases()}
+
+
+if __name__ == "__main__":
+    print(json.dumps(generate(), indent=1, sort_keys=True))

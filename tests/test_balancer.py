@@ -86,6 +86,34 @@ def test_upmap_moves_land():
     assert changed >= max(1, len(inc.new_pg_upmap_items) // 2)
 
 
+def test_plan_matches_trial_state():
+    """The committed epoch must equal what the optimizer validated:
+    applying the plan reproduces exactly the trial upmap table, even
+    when moves collapse (a->b then b->a) or chain (a->b then b->c)."""
+    m = build_osdmap(24, pg_num=128)
+    for o in range(6):
+        m.osd_weight[o] = 0x6000
+    # pre-existing upmap entry the optimizer may modify or remove
+    pre_pg = PGId(1, 5)
+    up0 = OSDMapMapping(m)
+    up0.update()
+    row = up0.get(pre_pg)[0]
+    other = next(o for o in range(24) if o not in row)
+    m.pg_upmap_items[pre_pg] = ((row[0], other),)
+
+    snapshot = dict(m.pg_upmap_items)
+    inc = calc_pg_upmaps(m, max_deviation=0.5, max_entries=60)
+    # the optimizer must not have mutated the live map
+    assert m.pg_upmap_items == snapshot
+    m.apply_incremental(inc)
+    # no pg should appear in both new and old lists
+    assert not (set(inc.new_pg_upmap_items) & set(inc.old_pg_upmap_items))
+    # items never contain no-op pairs or empty tuples
+    for pg, items in m.pg_upmap_items.items():
+        assert items, pg
+        assert all(f != t for f, t in items), (pg, items)
+
+
 def test_balanced_map_yields_empty_plan():
     m = build_osdmap(8, pg_num=8)
     b = Balancer(m, max_deviation=3.0)

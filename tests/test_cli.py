@@ -1,0 +1,233 @@
+"""CLI tools: compile/decompile round-trips, --test mapping stability,
+osdmaptool flows (the reference's cram golden-output test pattern,
+src/test/cli/{crushtool,osdmaptool}/*.t)."""
+
+import io
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from ceph_tpu.cli import crushtool, osdmaptool
+from ceph_tpu.crush.compiler import (
+    CompileError,
+    compile_crushmap,
+    decompile_crushmap,
+)
+from ceph_tpu.models.clusters import build_simple
+
+SAMPLE = """
+# sample map
+tunable choose_total_tries 50
+tunable chooseleaf_vary_r 1
+tunable chooseleaf_stable 1
+
+device 0 osd.0
+device 1 osd.1
+device 2 osd.2 class ssd
+device 3 osd.3
+
+type 0 osd
+type 1 host
+type 2 root
+
+host host0 {
+    id -2
+    alg straw2
+    hash 0
+    item osd.0 weight 1.000
+    item osd.1 weight 2.000
+}
+host host1 {
+    id -3
+    alg straw2
+    hash 0
+    item osd.2 weight 1.000
+    item osd.3 weight 1.000
+}
+root default {
+    id -1
+    alg straw2
+    hash 0
+    item host0 weight 3.000
+    item host1 weight 2.000
+}
+
+rule replicated_rule {
+    id 0
+    type replicated
+    step take default
+    step chooseleaf firstn 0 type host
+    step emit
+}
+rule ec_rule {
+    id 1
+    type erasure
+    step set_chooseleaf_tries 5
+    step take default
+    step chooseleaf indep 0 type host
+    step emit
+}
+"""
+
+
+def test_compile_decompile_roundtrip():
+    m = compile_crushmap(SAMPLE)
+    assert m.bucket_by_name("host0").item_weights == [0x10000, 0x20000]
+    assert m.device_classes[2] == "ssd"
+    text = decompile_crushmap(m)
+    m2 = compile_crushmap(text)
+    # semantic equality: same dense form and rules
+    d1, d2 = m.to_dense(), m2.to_dense()
+    assert np.array_equal(d1.items, d2.items)
+    assert np.array_equal(d1.weights, d2.weights)
+    assert [
+        (s.op, s.arg1, s.arg2) for r in m.rules.values() for s in r.steps
+    ] == [(s.op, s.arg1, s.arg2) for r in m2.rules.values() for s in r.steps]
+    # and identical mappings
+    from ceph_tpu.testing import cppref
+
+    steps = [(s.op, s.arg1, s.arg2) for s in m.rules[0].steps]
+    xs = np.arange(256, dtype=np.uint32)
+    w = np.full(4, 0x10000, np.uint32)
+    r1, _ = cppref.do_rule_batch(d1, steps, xs, w, 2)
+    r2, _ = cppref.do_rule_batch(d2, steps, xs, w, 2)
+    assert np.array_equal(r1, r2)
+
+
+def test_compile_errors():
+    with pytest.raises(CompileError):
+        compile_crushmap("tunable bogus_knob 3")
+    with pytest.raises(CompileError):
+        compile_crushmap("host h {\n id -1\n")  # unterminated
+    with pytest.raises(CompileError):
+        compile_crushmap("frobnicate the map")
+
+
+def test_crushtool_test_golden(tmp_path, capsys):
+    """Mapping output is pinned: placement is ABI (cram-test pattern)."""
+    path = tmp_path / "map.txt"
+    path.write_text(SAMPLE)
+    rc = crushtool.main(
+        [
+            "-i",
+            str(path),
+            "--test",
+            "--rule",
+            "0",
+            "--min-x",
+            "0",
+            "--max-x",
+            "7",
+            "--num-rep",
+            "2",
+            "--show-mappings",
+            "--cpu",
+        ]
+    )
+    assert rc == 0
+    lines = [
+        line
+        for line in capsys.readouterr().out.splitlines()
+        if line.startswith("CRUSH rule")
+    ]
+    assert len(lines) == 8
+    # golden vector: these mappings must never change (C++ reference)
+    mappings = [line.split(" x ")[1] for line in lines]
+    got = {int(s.split(" ")[0]): json.loads(s.split(" ", 1)[1]) for s in mappings}
+    # every x maps 2 replicas across the 2 hosts
+    for x, osds in got.items():
+        assert len(osds) == 2
+        assert (osds[0] < 2) != (osds[1] < 2), (x, osds)
+
+
+def test_crushtool_device_vs_cpu(tmp_path, capsys):
+    path = tmp_path / "map.txt"
+    path.write_text(SAMPLE)
+    common = ["-i", str(path), "--test", "--rule", "0", "--min-x", "0",
+              "--max-x", "63", "--num-rep", "2", "--show-mappings"]
+    crushtool.main(common + ["--cpu"])
+    cpu_out = capsys.readouterr().out
+    crushtool.main(common)
+    dev_out = capsys.readouterr().out
+    assert cpu_out == dev_out, "device --test must equal CPU reference"
+
+
+def test_crushtool_build_and_tree(tmp_path, capsys):
+    out = tmp_path / "built.json"
+    rc = crushtool.main(
+        [
+            "--build",
+            "--num_osds",
+            "16",
+            "-o",
+            str(out),
+            "host",
+            "straw2",
+            "4",
+            "root",
+            "straw2",
+            "0",
+        ]
+    )
+    assert rc == 0
+    m = crushtool.load_map(str(out))
+    assert len([b for b in m.buckets.values() if m.types[b.type_id] == "host"]) == 4
+    crushtool.main(["-i", str(out), "--tree"])
+    tree = capsys.readouterr().out
+    assert "root root0" in tree and "osd.15" in tree
+
+
+def test_osdmaptool_flow(tmp_path, capsys):
+    mapfile = tmp_path / "osdmap.json"
+    rc = osdmaptool.main(
+        ["--createsimple", "16", str(mapfile), "--pg-num", "64"]
+    )
+    assert rc == 0 and mapfile.exists()
+
+    rc = osdmaptool.main([str(mapfile), "--print"])
+    out = capsys.readouterr().out
+    assert "max_osd 16" in out and "pool 1" in out
+
+    rc = osdmaptool.main([str(mapfile), "--test-map-pgs"])
+    out = capsys.readouterr().out
+    assert "avg" in out and "mapping time" in out
+
+    rc = osdmaptool.main([str(mapfile), "--test-map-object", "foo"])
+    out = capsys.readouterr().out
+    assert "object 'foo'" in out and "up [" in out
+
+    upmap_file = tmp_path / "upmap.sh"
+    rc = osdmaptool.main(
+        [str(mapfile), "--mark-out", "0", "--mark-out", "1",
+         "--upmap", str(upmap_file), "--save"]
+    )
+    assert rc == 0
+    cmds = upmap_file.read_text()
+    # map was saved with upmaps applied
+    m = osdmaptool.load(str(mapfile))
+    assert m.is_out(0)
+    if cmds.strip():
+        assert "pg-upmap-items" in cmds
+        assert len(m.pg_upmap_items) > 0
+
+
+def test_ec_bench_cli(capsys):
+    from ceph_tpu.cli import ec_bench
+
+    rc = ec_bench.main(
+        ["--plugin", "jerasure", "--workload", "encode", "--size", "65536",
+         "--iterations", "2", "--parameter", "k=4", "--parameter", "m=2"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    secs, rate = out.split("\t")
+    assert float(secs) > 0 and rate.endswith("MB/s\n")
+
+    rc = ec_bench.main(
+        ["--plugin", "clay", "--workload", "decode", "--size", "65536",
+         "--iterations", "1", "--parameter", "k=4", "--parameter", "m=2"]
+    )
+    assert rc == 0

@@ -1,0 +1,111 @@
+"""Common runtime: config layering, perf counters, admin socket, logging."""
+
+import json
+import os
+
+import pytest
+
+from ceph_tpu.common import Config, PerfCountersBuilder
+from ceph_tpu.common.admin_socket import AdminSocket, ask
+from ceph_tpu.common.log import get_logger, set_subsys_level, wire_config
+from ceph_tpu.common.perf_counters import registry
+
+
+def test_config_layering(tmp_path):
+    cfg_file = tmp_path / "conf.json"
+    cfg_file.write_text(json.dumps({"choose_total_tries": 19}))
+    c = Config(
+        config_file=str(cfg_file),
+        env={"CEPH_TPU_UPMAP_MAX_DEVIATION": "2.5"},
+        argv=["--upmap-max-optimizations=42"],
+    )
+    assert c["choose_total_tries"] == 19 and c.source("choose_total_tries") == "file"
+    assert c["upmap_max_deviation"] == 2.5 and c.source("upmap_max_deviation") == "env"
+    assert c["upmap_max_optimizations"] == 42 and c.source("upmap_max_optimizations") == "argv"
+    assert c["balancer_mode"] == "upmap" and c.source("balancer_mode") == "default"
+    c.set("balancer_mode", "none")
+    assert c["balancer_mode"] == "none" and c.source("balancer_mode") == "override"
+    c.rm("balancer_mode")
+    assert c["balancer_mode"] == "upmap"
+
+
+def test_config_validation():
+    c = Config(env={})
+    with pytest.raises(ValueError):
+        c.set("choose_total_tries", 0)  # min 1
+    with pytest.raises(ValueError):
+        c.set("balancer_mode", "chaotic")  # enum
+    with pytest.raises(KeyError):
+        c.set("nonexistent", 1)
+    with pytest.raises(ValueError):
+        c.set("upmap_max_deviation", "not-a-number")
+
+
+def test_config_observers():
+    c = Config(env={})
+    seen = []
+    c.add_observer(lambda k, v: seen.append((k, v)))
+    c.set("debug_crush", 10)
+    assert ("debug_crush", 10) in seen
+
+
+def test_perf_counters():
+    pc = (
+        PerfCountersBuilder("test_subsys")
+        .add_u64_counter("ops", "operations")
+        .add_gauge("inflight")
+        .add_time_avg("op_lat", "op latency")
+        .create_perf_counters()
+    )
+    pc.inc("ops", 5)
+    pc.inc("inflight", 2)
+    pc.dec("inflight")
+    with pc.time("op_lat"):
+        pass
+    with pc.time("op_lat"):
+        pass
+    d = pc.dump()["test_subsys"]
+    assert d["ops"] == 5
+    assert d["inflight"] == 1
+    assert d["op_lat"]["avgcount"] == 2
+    assert d["op_lat"]["sum"] >= 0
+    assert "test_subsys" in registry().dump()
+
+
+def test_admin_socket(tmp_path):
+    path = str(tmp_path / "asok")
+    c = Config(env={})
+    a = AdminSocket(path, c)
+    a.start()
+    try:
+        out = ask(path, "help")
+        assert "perf dump" in out["commands"]
+        out = ask(path, "config set", key="debug_crush", value=7)
+        assert "success" in out
+        assert c["debug_crush"] == 7
+        out = ask(path, "config show")
+        assert out["debug_crush"]["value"] == 7
+        out = ask(path, "perf dump")
+        assert isinstance(out, dict)
+        out = ask(path, "bogus cmd")
+        assert "error" in out
+        # custom hook (AdminSocketHook analog)
+        a.register("whoami", lambda cmd: {"name": "ceph_tpu"})
+        assert ask(path, "whoami")["name"] == "ceph_tpu"
+    finally:
+        a.stop()
+    assert not os.path.exists(path)
+
+
+def test_logging_wiring(caplog):
+    c = Config(env={})
+    wire_config(c)
+    log = get_logger("crush")
+    import logging
+
+    with caplog.at_level(logging.DEBUG, logger="ceph_tpu.crush"):
+        c.set("debug_crush", 10)
+        log.debug("deep detail")
+    assert any("deep detail" in r.message for r in caplog.records)
+    set_subsys_level("crush", 0)
+    assert get_logger("crush").level >= 30  # WARNING when silenced

@@ -1,0 +1,35 @@
+"""Golden archives: placement and encodings are ABI (the reference's
+cram + ceph_erasure_code_non_regression pattern).  If one of these
+digests changes, user data would move or become unreadable — only
+regenerate (ceph_tpu/testing/nonregression.py) for an intentional,
+documented placement-breaking change."""
+
+import json
+import os
+
+from ceph_tpu.testing import nonregression
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden", "archive.json")
+
+
+def _load():
+    with open(GOLDEN) as f:
+        return json.load(f)
+
+
+def test_crush_mappings_pinned():
+    golden = _load()["crush"]
+    current = nonregression.crush_cases()
+    assert current == golden, (
+        "CRUSH mappings changed! Placement is ABI — this moves user data."
+    )
+
+
+def test_ec_encodings_pinned():
+    golden = _load()["ec"]
+    current = nonregression.ec_cases()
+    for name in golden:
+        assert current[name] == golden[name], (
+            f"EC encoding for {name} changed! Stored chunks become unreadable."
+        )
+    assert set(current) == set(golden)

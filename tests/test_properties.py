@@ -1,0 +1,149 @@
+"""Statistical & stability properties + the map-mutation thrasher.
+
+The reference validates these through qa thrashers
+(``qa/tasks/ceph_manager.py``: kill/revive OSDs, out/in, random upmaps
+during I/O) and statistical checks in ``CrushTester``.  Here:
+- distribution ∝ weight (chi-squared bound),
+- straw2 minimal-remap property under weight change,
+- a randomized thrasher that mutates an OSDMap across epochs and
+  checks placement invariants + host/device agreement each step.
+"""
+
+import random
+
+import numpy as np
+
+from ceph_tpu.crush.interp import StaticCrushMap, batch_do_rule
+from ceph_tpu.crush.map import ITEM_NONE
+from ceph_tpu.models.clusters import build_flat, build_osdmap
+from ceph_tpu.osdmap.map import PGId
+from ceph_tpu.osdmap.mapping import OSDMapMapping
+
+W1 = 0x10000
+
+
+def _run(m, rule_name, xs, weights, nrep):
+    rule = m.rule_by_name(rule_name)
+    smap = StaticCrushMap(m.to_dense())
+    res, lens = batch_do_rule(smap, rule, xs, weights, nrep)
+    return np.asarray(res), np.asarray(lens)
+
+
+def test_distribution_proportional_to_weight():
+    """P(osd) ∝ weight: chi-squared over a 2:1 weighted flat map."""
+    m = build_flat(8)
+    root = m.bucket_by_name("default")
+    for osd in range(4):
+        m.adjust_item_weight(root.id, osd, 2 * W1)
+    n = 60_000
+    xs = np.arange(n, dtype=np.uint32)
+    w = np.full(8, W1, np.uint32)
+    res, _ = _run(m, "replicated_rule", xs, w, 1)
+    counts = np.bincount(res[:, 0], minlength=8)
+    expected = np.array([2] * 4 + [1] * 4, np.float64) * n / 12
+    chi2 = ((counts - expected) ** 2 / expected).sum()
+    # 7 dof; p=0.001 critical value ~24.3
+    assert chi2 < 24.3, (chi2, counts)
+
+
+def test_straw2_minimal_remap():
+    """Changing one item's weight only remaps inputs into/out of it."""
+    m = build_flat(10)
+    n = 20_000
+    xs = np.arange(n, dtype=np.uint32)
+    w = np.full(10, W1, np.uint32)
+    before, _ = _run(m, "replicated_rule", xs, w, 1)
+    root = m.bucket_by_name("default")
+    m.adjust_item_weight(root.id, 3, W1 // 2)
+    after, _ = _run(m, "replicated_rule", xs, w, 1)
+    moved = before[:, 0] != after[:, 0]
+    # every move must involve osd 3 (straw independence property)
+    involved = (before[:, 0] == 3) | (after[:, 0] == 3)
+    assert np.all(~moved | involved)
+    # and the moved fraction ~ Δw/W = 0.5/10 = 5%
+    frac = moved.mean()
+    assert 0.02 < frac < 0.09, frac
+
+
+def test_adding_device_minimal_remap():
+    m = build_flat(9)
+    n = 20_000
+    xs = np.arange(n, dtype=np.uint32)
+    w = np.full(10, W1, np.uint32)
+    before, _ = _run(m, "replicated_rule", xs, w, 1)
+    root = m.bucket_by_name("default")
+    m.insert_item(root.id, 9, W1)
+    after, _ = _run(m, "replicated_rule", xs, w, 1)
+    moved = before[:, 0] != after[:, 0]
+    # only moves INTO the new device; expected fraction 1/10
+    assert np.all(after[moved, 0] == 9)
+    assert 0.06 < moved.mean() < 0.14
+
+
+class Thrasher:
+    """Randomized map mutator (qa thrasher analog)."""
+
+    def __init__(self, m, seed=0):
+        self.m = m
+        self.rng = random.Random(seed)
+
+    def step(self):
+        op = self.rng.randrange(6)
+        osd = self.rng.randrange(self.m.max_osd)
+        if op == 0:
+            self.m.mark_down(osd)
+        elif op == 1:
+            self.m.mark_up(osd)
+        elif op == 2:
+            self.m.mark_out(osd)
+        elif op == 3:
+            self.m.mark_in(osd, self.rng.choice([0x8000, W1]))
+        elif op == 4:
+            pool = self.rng.choice(sorted(self.m.pools))
+            ps = self.rng.randrange(self.m.pools[pool].pg_num)
+            frm = osd
+            to = self.rng.randrange(self.m.max_osd)
+            if frm != to:
+                self.m.pg_upmap_items[PGId(pool, ps)] = ((frm, to),)
+        else:
+            pool = self.rng.choice(sorted(self.m.pools))
+            ps = self.rng.randrange(self.m.pools[pool].pg_num)
+            self.m.pg_upmap_items.pop(PGId(pool, ps), None)
+
+
+def test_thrasher_invariants():
+    m = build_osdmap(24, pg_num=48)
+    th = Thrasher(m, seed=42)
+    for epoch in range(12):
+        th.step()
+        mapping = OSDMapMapping(m)
+        mapping.update()
+        pool = m.pools[1]
+        for ps in range(0, pool.pg_num, 7):
+            up, upp, acting, actp = mapping.get(PGId(1, ps))
+            # invariant: no duplicate osds in a pg
+            assert len(up) == len(set(up)), (epoch, ps, up)
+            # invariant: all up osds are alive
+            for o in up:
+                assert m.is_up(o), (epoch, ps, o)
+            # invariant: primary is a member (or -1 when empty)
+            if up:
+                assert upp in up
+            else:
+                assert upp == -1
+            # device agrees with the exact host pipeline
+            host = m.pg_to_up_acting_osds(PGId(1, ps))
+            assert (up, upp) == (host[0], host[1]), (epoch, ps)
+
+
+def test_thrasher_ec_pool_invariants():
+    m = build_osdmap(16, pg_num=16, size=4, pool_kind="erasure")
+    th = Thrasher(m, seed=7)
+    for epoch in range(8):
+        th.step()
+        pool = m.pools[1]
+        for ps in range(0, 16, 3):
+            up, upp, acting, actp = m.pg_to_up_acting_osds(PGId(1, ps))
+            assert len(up) == pool.size  # positional: size preserved
+            live = [o for o in up if o != ITEM_NONE]
+            assert len(live) == len(set(live))
