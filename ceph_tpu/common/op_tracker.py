@@ -1,0 +1,110 @@
+"""Per-operation event timelines (TrackedOp/OpTracker analog).
+
+Parity with the reference's ``src/common/TrackedOp.{h,cc}``: each
+tracked op records named lifecycle events with timestamps; the tracker
+keeps in-flight ops, a bounded history of completed ops, flags slow
+ops, and answers the admin-socket queries ``dump_ops_in_flight`` /
+``dump_historic_ops`` / ``dump_historic_slow_ops``.
+
+For device work, an op's events typically bracket trace/compile/
+execute/transfer stages; pair with ``jax.profiler`` for in-kernel
+detail (the LTTng/Jaeger analog is :func:`ceph_tpu.common.tracing.
+trace_annotation`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TrackedOp:
+    tracker: "OpTracker"
+    description: str
+    start: float = field(default_factory=time.perf_counter)
+    events: list[tuple[float, str]] = field(default_factory=list)
+    done: float | None = None
+
+    def mark_event(self, name: str) -> None:
+        self.events.append((time.perf_counter(), name))
+
+    def finish(self) -> None:
+        self.done = time.perf_counter()
+        self.tracker._finish(self)
+
+    def __enter__(self) -> "TrackedOp":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.mark_event("error" if exc[0] else "done")
+        self.finish()
+        return False
+
+    @property
+    def duration(self) -> float:
+        return (self.done or time.perf_counter()) - self.start
+
+    def dump(self) -> dict:
+        return {
+            "description": self.description,
+            "duration": round(self.duration, 6),
+            "age": round(time.perf_counter() - self.start, 6),
+            "events": [
+                {"time": round(t - self.start, 6), "event": e}
+                for t, e in self.events
+            ],
+        }
+
+
+class OpTracker:
+    def __init__(
+        self,
+        history_size: int = 20,
+        slow_op_threshold: float = 1.0,
+    ):
+        self.history_size = history_size
+        self.slow_op_threshold = slow_op_threshold
+        self._lock = threading.Lock()
+        self._in_flight: dict[int, TrackedOp] = {}
+        self._history: deque[TrackedOp] = deque(maxlen=history_size)
+        self._slow: deque[TrackedOp] = deque(maxlen=history_size)
+        self.num_slow = 0
+
+    def create_op(self, description: str) -> TrackedOp:
+        op = TrackedOp(self, description)
+        with self._lock:
+            self._in_flight[id(op)] = op
+        return op
+
+    def _finish(self, op: TrackedOp) -> None:
+        with self._lock:
+            self._in_flight.pop(id(op), None)
+            self._history.append(op)
+            if op.duration >= self.slow_op_threshold:
+                self._slow.append(op)
+                self.num_slow += 1
+
+    def dump_ops_in_flight(self) -> dict:
+        with self._lock:
+            ops = [op.dump() for op in self._in_flight.values()]
+        return {"num_ops": len(ops), "ops": ops}
+
+    def dump_historic_ops(self) -> dict:
+        with self._lock:
+            ops = [op.dump() for op in self._history]
+        return {"num_ops": len(ops), "ops": ops}
+
+    def dump_historic_slow_ops(self) -> dict:
+        with self._lock:
+            ops = [op.dump() for op in self._slow]
+        return {"num_slow_ops_found": self.num_slow, "ops": ops}
+
+    def register_admin_hooks(self, admin) -> None:
+        admin.register("dump_ops_in_flight", lambda c: self.dump_ops_in_flight())
+        admin.register("dump_historic_ops", lambda c: self.dump_historic_ops())
+        admin.register(
+            "dump_historic_slow_ops", lambda c: self.dump_historic_slow_ops()
+        )

@@ -1,0 +1,55 @@
+"""Prometheus exposition from the perf-counter registry.
+
+The reference exports daemon perf counters through the mgr prometheus
+module (``src/pybind/mgr/prometheus/module.py``).  Here the registry
+renders to the text exposition format, either to a textfile (node-
+exporter textfile-collector pattern) or over an admin-socket hook.
+"""
+
+from __future__ import annotations
+
+import re
+
+from .perf_counters import registry
+
+
+def _sanitize(name: str) -> str:
+    return re.sub(r"[^a-zA-Z0-9_]", "_", name)
+
+
+def render() -> str:
+    """Current registry state in Prometheus text format."""
+    lines: list[str] = []
+    for component, counters in sorted(registry().dump().items()):
+        comp = _sanitize(component)
+        for cname, value in sorted(counters.items()):
+            metric = f"ceph_tpu_{comp}_{_sanitize(cname)}"
+            if isinstance(value, dict):  # time_avg
+                lines.append(f"# TYPE {metric}_sum counter")
+                lines.append(f"{metric}_sum {value['sum']}")
+                lines.append(f"# TYPE {metric}_count counter")
+                lines.append(f"{metric}_count {value['avgcount']}")
+            else:
+                lines.append(f"# TYPE {metric} gauge")
+                lines.append(f"{metric} {value}")
+    return "\n".join(lines) + "\n"
+
+
+def write_textfile(path: str) -> None:
+    """Atomic write for the node-exporter textfile collector."""
+    import os
+    import tempfile
+
+    d = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".prom.tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(render())
+        os.replace(tmp, path)
+    except BaseException:
+        os.unlink(tmp)
+        raise
+
+
+def register_admin_hook(admin) -> None:
+    admin.register("prometheus", lambda cmd: {"text": render()})

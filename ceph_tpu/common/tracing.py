@@ -1,0 +1,49 @@
+"""Tracing/profiling helpers (LTTng-tracepoint / Jaeger-span analog).
+
+The reference compiles in LTTng tracepoints and optional
+OpenTelemetry spans (``src/tracing/*.tp``, ``src/common/tracer.cc``).
+The TPU-native equivalents:
+
+- :func:`trace_annotation` — named span visible in ``jax.profiler``
+  traces (Perfetto), usable around host-side stages; inside jit use
+  ``jax.named_scope``.
+- :func:`profile_to` — capture a profiler trace directory for an
+  arbitrary block (the ``WITH_JAEGER`` run-mode analog).
+- :func:`timed_block` — lightweight wall-clock span feeding a
+  perf-counter time_avg, for always-on op accounting.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+
+@contextlib.contextmanager
+def trace_annotation(name: str):
+    """Named span in profiler timelines (no-op cost when not tracing)."""
+    import jax.profiler
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def profile_to(log_dir: str):
+    """Capture a jax.profiler trace (view in Perfetto/TensorBoard)."""
+    import jax.profiler
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def timed_block(perf_counters, counter: str):
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        perf_counters.tinc(counter, time.perf_counter() - t0)

@@ -128,6 +128,71 @@ def straw2_negdraw(x, item_id, r, weight):
     return jnp.where(_u32(weight) == 0, U64_MAX, nd)
 
 
+def mulhi64(a, b):
+    """High 64 bits of the 128-bit product of two uint64 arrays.
+
+    Decomposed into 32-bit partial products (XLA emulates u64 on TPU
+    with 32-bit pairs anyway; this keeps everything in plain muls/adds
+    instead of a 128-bit path that doesn't exist)."""
+    a = jnp.asarray(a, jnp.uint64)
+    b = jnp.asarray(b, jnp.uint64)
+    m32 = np.uint64(0xFFFFFFFF)
+    s32 = np.uint64(32)
+    a0 = a & m32
+    a1 = a >> s32
+    b0 = b & m32
+    b1 = b >> s32
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    p11 = a1 * b1
+    mid = p01 + (p00 >> s32)  # <= (2^32-1)^2 + 2^32-1 < 2^64: no carry
+    mid2 = mid + (p10 & m32)  # may carry
+    carry = (mid2 < mid).astype(jnp.uint64)
+    return p11 + (p10 >> s32) + (mid2 >> s32) + (carry << s32)
+
+
+def magic_reciprocal(weight: np.ndarray) -> np.ndarray:
+    """Host-precomputed M = floor((2^64-1)/w) per 16.16 weight (u64).
+
+    Zero weights use the w=1 reciprocal (their lanes are masked to
+    U64_MAX by the caller anyway).  Computed ONCE per map on the host
+    so the straw2 hot loop never divides on device (TPU u64 division
+    is an expensive emulation).
+    """
+    w = np.asarray(weight, np.uint64)
+    w_safe = np.maximum(w, 1)
+    return ((np.uint64(0xFFFFFFFFFFFFFFFF)) // w_safe).astype(np.uint64)
+
+
+def div_by_magic(a, magic, w):
+    """Exact floor(a / w) via the precomputed reciprocal.
+
+    Valid for a < 2^50 (straw2's ln_neg <= 2^48): the mulhi estimate
+    undershoots by < 3, fixed with three correction steps.  Bit-exact
+    against the plain ``//`` path (differentially tested).
+    """
+    a = jnp.asarray(a, jnp.uint64)
+    w = jnp.asarray(w, jnp.uint64)
+    q = mulhi64(a, magic)
+    rem = a - q * w
+    for _ in range(3):
+        over = rem >= w
+        q = q + over.astype(jnp.uint64)
+        rem = jnp.where(over, rem - w, rem)
+    return q
+
+
+def straw2_negdraw_magic(x, item_id, r, weight, magic):
+    """straw2_negdraw with the division replaced by the hoisted magic
+    reciprocal (bit-exact, device-division-free)."""
+    u = crush_hash32_3(x, item_id, r) & np.uint32(0xFFFF)
+    ln_neg = (np.uint64(1) << np.uint64(48)) - crush_ln(u)
+    w = jnp.maximum(_u32(weight), np.uint32(1)).astype(jnp.uint64)
+    nd = div_by_magic(ln_neg, jnp.asarray(magic, jnp.uint64), w)
+    return jnp.where(_u32(weight) == 0, U64_MAX, nd)
+
+
 def is_out(weight_osd, item, x):
     """Vectorized reweight rejection (True = rejected)."""
     w = _u32(weight_osd)

@@ -164,6 +164,9 @@ def _item_id(m: CrushMap, name: str) -> int:
 
 def _parse_step(m: CrushMap, t: list[str]) -> Step:
     if t[0] == "take":
+        if len(t) >= 4 and t[2] == "class":
+            root = m.bucket_by_name(t[1]).id
+            return Step(OP_TAKE, m.class_shadow_root(root, t[3]))
         return Step(OP_TAKE, m.bucket_by_name(t[1]).id)
     if t[0] == "emit":
         return Step(OP_EMIT)
@@ -208,8 +211,8 @@ def decompile_crushmap(m: CrushMap) -> str:
     emitted: set[int] = set()
 
     def emit_bucket(bid: int) -> None:
-        if bid in emitted:
-            return
+        if bid in emitted or m.shadow_origin(bid) is not None:
+            return  # shadow trees are derived, not authored
         b = m.buckets[bid]
         for item in b.items:
             if item < 0:
@@ -241,6 +244,10 @@ def decompile_crushmap(m: CrushMap) -> str:
 
 def _step_text(m: CrushMap, s: Step) -> str:
     if s.op == OP_TAKE:
+        origin = m.shadow_origin(s.arg1)
+        if origin is not None:
+            orig_id, cls = origin
+            return f"take {m.buckets[orig_id].name} class {cls}"
         return f"take {m.buckets[s.arg1].name}"
     if s.op == OP_EMIT:
         return "emit"

@@ -101,9 +101,18 @@ class StaticCrushMap:
         self.size = jnp.asarray(dense.size, I32)
         self.items = jnp.asarray(dense.items, I32)
         self.weights = jnp.asarray(dense.weights, U32)
+        # hoisted straw2 reciprocals: device never divides in the hot loop
+        self.magic = jnp.asarray(hashes.magic_reciprocal(dense.weights))
 
     def tree_flatten(self):
-        arrays = (self.alg, self.btype, self.size, self.items, self.weights)
+        arrays = (
+            self.alg,
+            self.btype,
+            self.size,
+            self.items,
+            self.weights,
+            self.magic,
+        )
         static = (
             self.n_buckets,
             self.max_fanout,
@@ -125,7 +134,14 @@ class StaticCrushMap:
             obj.tunables,
             obj.algs,
         ) = static
-        obj.alg, obj.btype, obj.size, obj.items, obj.weights = arrays
+        (
+            obj.alg,
+            obj.btype,
+            obj.size,
+            obj.items,
+            obj.weights,
+            obj.magic,
+        ) = arrays
         return obj
 
 
@@ -142,11 +158,12 @@ def _straw2_choose(smap: StaticCrushMap, bidx, x, r):
     ws = smap.weights[bidx]  # [F] u32
     valid = jnp.arange(smap.max_fanout) < smap.size[bidx]
     ws = jnp.where(valid, ws, np.uint32(0))
-    nd = hashes.straw2_negdraw(
+    nd = hashes.straw2_negdraw_magic(
         jnp.full((smap.max_fanout,), x, U32),
         ids.astype(U32),
         jnp.full((smap.max_fanout,), r, U32).astype(U32),
         ws,
+        smap.magic[bidx],
     )
     # All-zero weights: argmin picks index 0 = first real item, matching
     # the reference's scan initialization (size > 0 ensured by callers).
@@ -344,48 +361,81 @@ def _choose_firstn(
     out2 = jnp.full((cap,), ITEM_NONE, I32)
     outpos = jnp.asarray(0, I32)
 
+    # Speculative retry blocks: the reference's retry ladder for one
+    # replica slot visits r = rep, rep+1, rep+2, ... (ftotal increments
+    # by one per failure), so a block of R consecutive r values can be
+    # evaluated in parallel and the FIRST acceptable one selected --
+    # identical accept/reject semantics, ~R x fewer serial while-loop
+    # rounds (under vmap every lane pays the slowest lane's rounds, so
+    # this is the difference between ~1-2 rounds and ~tries rounds).
+    R = int(min(tries, 8))
+
     for rep in range(numrep):
 
-        def cond(st):
-            ftotal, done, skip, item, leaf = st
-            return (~done) & (~skip) & (ftotal < tries)
-
-        def body(st, _rep=rep):
-            ftotal, _, _, item, leaf = st
-            r = _rep + ftotal
-            cand, ok, hard, _ = _descend(
-                smap, x, take_bucket_idx, target_type, lambda _b: jnp.asarray(r, I32)
-            )
-            collide = ok & jnp.any((jnp.arange(cap) < outpos) & (out == cand))
-            reject = FALSE()
-            new_leaf = leaf
-            if recurse_to_leaf:
-                is_bucket = cand < 0
-                sub_r = jnp.asarray(r >> (vary_r - 1) if vary_r else 0, I32)
-                lf, lok = _leaf_descend_firstn(
-                    smap,
-                    osd_weight,
-                    x,
-                    jnp.where(is_bucket, cand, -1),
-                    sub_r,
-                    recurse_tries,
-                    out2,
-                    outpos,
-                    stable,
+        def block(base, _rep=rep, _out=None, _out2=None, _outpos=None):
+            ftotals = base + jnp.arange(R, dtype=I32)  # [R]
+            rs = _rep + ftotals  # reference: r = rep + ftotal
+            cands, oks, hards, _ = jax.vmap(
+                lambda rr: _descend(
+                    smap, x, take_bucket_idx, target_type, lambda _b: rr
                 )
+            )(rs)
+            in_budget = ftotals < tries
+            collides = oks & jax.vmap(
+                lambda c: jnp.any((jnp.arange(cap) < _outpos) & (_out == c))
+            )(cands)
+            rejects = jnp.zeros((R,), bool)
+            leafs = jnp.full((R,), ITEM_NONE, I32)
+            if recurse_to_leaf:
+                is_bucket = cands < 0
+                sub_rs = (rs >> (vary_r - 1)) if vary_r else jnp.zeros((R,), I32)
+                lf, lok = jax.vmap(
+                    lambda c, sr: _leaf_descend_firstn(
+                        smap,
+                        osd_weight,
+                        x,
+                        jnp.where(c < 0, c, -1),
+                        sr,
+                        recurse_tries,
+                        _out2,
+                        _outpos,
+                        stable,
+                    )
+                )(cands, sub_rs)
                 leaf_ok = jnp.where(is_bucket, lok, True)
-                cand_leaf = jnp.where(is_bucket, lf, cand)
-                reject = reject | (ok & ~collide & ~leaf_ok)
-                new_leaf = jnp.where(ok & ~collide & leaf_ok, cand_leaf, leaf)
+                cand_leaf = jnp.where(is_bucket, lf, cands)
+                rejects = rejects | (oks & ~collides & ~leaf_ok)
+                leafs = jnp.where(oks & ~collides & leaf_ok, cand_leaf, leafs)
             if target_type == 0:
-                reject = reject | (ok & ~collide & _is_out(osd_weight, cand, x))
-            good = ok & ~collide & ~reject
+                rejects = rejects | (
+                    oks & ~collides & jax.vmap(
+                        lambda c: _is_out(osd_weight, c, x)
+                    )(cands)
+                )
+            goods = oks & ~collides & ~rejects & in_budget
+            hard_stops = hards & in_budget
+            stops = goods | hard_stops
+            idx = jnp.argmax(stops)
+            any_stop = jnp.any(stops)
+            is_good = any_stop & goods[idx]
+            is_hard = any_stop & ~goods[idx]
+            return is_good, is_hard, cands[idx], leafs[idx]
+
+        def cond(st):
+            base, done, skip, item, leaf = st
+            return (~done) & (~skip) & (base < tries)
+
+        def body(st, _block=block):
+            base, _, _, item, leaf = st
+            good, hard, cand, lf = _block(
+                base, _out=out, _out2=out2, _outpos=outpos
+            )
             return (
-                ftotal + 1,
+                base + R,
                 good,
                 hard,  # skip_rep: abandon this slot entirely
                 jnp.where(good, cand, item),
-                new_leaf,
+                jnp.where(good, lf, leaf),
             )
 
         init = (

@@ -126,11 +126,16 @@ class CrushMap:
         # mutate through the API.
         self.uid = next(CrushMap._uid_counter)
         self.version = 0
-        self._dense_cache: tuple[int, "DenseCrushMap"] | None = None
+        self._dense_cache: tuple = ()  # keyed (version, choose_args name)
+        # per-pool alternate weight sets (reference crush_choose_arg /
+        # CrushWrapper::choose_args, the crush-compat balancer's lever):
+        # name -> {bucket_id -> [alt item weights]}
+        self.choose_args: dict[str, dict[int, list[int]]] = {}
+        self._shadow_of: dict[int, tuple[int, str]] = {}
 
     def _mutated(self) -> None:
         self.version += 1
-        self._dense_cache = None
+        self._dense_cache = ()
 
     def set_tunables(self, tunables: Tunables | str) -> None:
         """Switch tunables (profile name or explicit Tunables); the API
@@ -142,7 +147,7 @@ class CrushMap:
 
     def __getstate__(self):
         d = self.__dict__.copy()
-        d["_dense_cache"] = None  # not worth copying/pickling
+        d["_dense_cache"] = ()  # not worth copying/pickling
         return d
 
     def __deepcopy__(self, memo):
@@ -270,15 +275,27 @@ class CrushMap:
                 return r
         raise KeyError(name)
 
-    def make_replicated_rule(self, name: str, root: str, failure_domain: str) -> Rule:
-        """`take root; chooseleaf firstn 0 type fd; emit` (the common rule)."""
-        root_id = self.bucket_by_name(root).id
+    def make_replicated_rule(
+        self,
+        name: str,
+        root: str,
+        failure_domain: str,
+        device_class: str | None = None,
+    ) -> Rule:
+        """`take root [class X]; chooseleaf firstn 0 type fd; emit`."""
+        root_id = self._resolve_take(root, device_class)
         fd = self.type_id(failure_domain)
         steps = [Step(OP_TAKE, root_id), Step(OP_CHOOSELEAF_FIRSTN, 0, fd), Step(OP_EMIT)]
         return self.add_rule(name, steps)
 
-    def make_erasure_rule(self, name: str, root: str, failure_domain: str) -> Rule:
-        root_id = self.bucket_by_name(root).id
+    def make_erasure_rule(
+        self,
+        name: str,
+        root: str,
+        failure_domain: str,
+        device_class: str | None = None,
+    ) -> Rule:
+        root_id = self._resolve_take(root, device_class)
         fd = self.type_id(failure_domain)
         steps = [
             Step(OP_SET_CHOOSELEAF_TRIES, 5),
@@ -287,6 +304,73 @@ class CrushMap:
             Step(OP_EMIT),
         ]
         return self.add_rule(name, steps, kind="erasure")
+
+    def _resolve_take(self, root: str, device_class: str | None) -> int:
+        if device_class is None:
+            return self.bucket_by_name(root).id
+        return self.class_shadow_root(
+            self.bucket_by_name(root).id, device_class
+        )
+
+    # ---- device-class shadow trees ----
+    #
+    # Reference semantics (CrushWrapper::populate_classes /
+    # device_class_clone): a rule's `take <root> class <c>` resolves to
+    # a per-class clone of the subtree containing only the devices of
+    # that class, buckets named `<name>~<c>`, with weights re-summed.
+    # Shadow trees are rebuilt on demand and tracked so decompile can
+    # print the class form.
+
+    def class_shadow_root(self, root_id: int, device_class: str) -> int:
+        shadow = self._build_class_shadow(root_id, device_class)
+        if shadow is None:
+            raise ValueError(
+                f"no devices of class {device_class!r} under "
+                f"{self.buckets[root_id].name}"
+            )
+        return shadow
+
+    def shadow_origin(self, bucket_id: int) -> tuple[int, str] | None:
+        """(original bucket id, class) if bucket_id is a shadow."""
+        return getattr(self, "_shadow_of", {}).get(bucket_id)
+
+    def _build_class_shadow(self, bid: int, cls: str) -> int | None:
+        if not hasattr(self, "_shadow_of"):
+            self._shadow_of: dict[int, tuple[int, str]] = {}
+        b = self.buckets[bid]
+        shadow_name = f"{b.name}~{cls}"
+        keep_id = None
+        try:
+            existing = self.bucket_by_name(shadow_name)
+            # rebuild in place (weights may have changed), keeping the
+            # id stable so rules referencing the shadow stay valid
+            keep_id = existing.id
+            del self.buckets[existing.id]
+            self._shadow_of.pop(existing.id, None)
+            self._mutated()
+        except KeyError:
+            pass
+        items: list[int] = []
+        weights: list[int] = []
+        for item, w in zip(b.items, b.item_weights):
+            if item >= 0:
+                if self.device_classes.get(item) == cls:
+                    items.append(item)
+                    weights.append(w)
+            else:
+                sub = self._build_class_shadow(item, cls)
+                if sub is not None:
+                    items.append(sub)
+                    weights.append(self.buckets[sub].weight)
+        if not items:
+            return None
+        sb = self.add_bucket(
+            shadow_name, self.types[b.type_id], alg=b.alg, bucket_id=keep_id
+        )
+        for item, w in zip(items, weights):
+            self.insert_item(sb.id, item, w)
+        self._shadow_of[sb.id] = (bid, cls)
+        return sb.id
 
     # ---- hierarchy queries ----
 
@@ -330,6 +414,14 @@ class CrushMap:
                 }
                 for r in self.rules.values()
             ],
+            "choose_args": {
+                name: {str(bid): w for bid, w in per.items()}
+                for name, per in self.choose_args.items()
+            },
+            "shadow_of": {
+                str(sid): [orig, cls]
+                for sid, (orig, cls) in self._shadow_of.items()
+            },
         }
 
     def encode(self) -> bytes:
@@ -358,26 +450,56 @@ class CrushMap:
                 kind=ro["kind"],
                 steps=[Step(*s) for s in ro["steps"]],
             )
+        m.choose_args = {
+            name: {int(bid): list(w) for bid, w in per.items()}
+            for name, per in obj.get("choose_args", {}).items()
+        }
+        m._shadow_of = {
+            int(sid): (orig, cls)
+            for sid, (orig, cls) in obj.get("shadow_of", {}).items()
+        }
+        m._mutated()
         return m
 
     @staticmethod
     def decode(data: bytes) -> "CrushMap":
         return CrushMap.from_obj(json.loads(data.decode()))
 
+    # ---- choose_args (alternate weight sets) ----
+
+    def create_choose_args(self, name: str) -> dict[int, list[int]]:
+        """New weight-set initialized from the current bucket weights."""
+        per = {bid: list(b.item_weights) for bid, b in self.buckets.items()}
+        self.choose_args[name] = per
+        self._mutated()
+        return per
+
+    def rm_choose_args(self, name: str) -> None:
+        self.choose_args.pop(name, None)
+        self._mutated()
+
+    def choose_args_adjust_item_weight(
+        self, name: str, bucket_id: int, item: int, weight: int
+    ) -> None:
+        b = self.buckets[bucket_id]
+        self.choose_args[name][bucket_id][b.items.index(item)] = int(weight)
+        self._mutated()
+
     # ---- dense packing ----
 
-    def to_dense(self) -> "DenseCrushMap":
+    def to_dense(self, choose_args: str | None = None) -> "DenseCrushMap":
         cached = self._dense_cache
-        if cached is not None and cached[0] == self.version:
+        if cached and cached[0] == (self.version, choose_args):
             return cached[1]
-        dense = self._to_dense()
-        self._dense_cache = (self.version, dense)
+        dense = self._to_dense(choose_args)
+        self._dense_cache = ((self.version, choose_args), dense)
         return dense
 
-    def _to_dense(self) -> "DenseCrushMap":
+    def _to_dense(self, choose_args: str | None = None) -> "DenseCrushMap":
         n_buckets = max((-bid for bid in self.buckets), default=0)
         max_fanout = max((len(b.items) for b in self.buckets.values()), default=1)
         max_fanout = max(max_fanout, 1)
+        override = self.choose_args.get(choose_args, {}) if choose_args else {}
         alg = np.zeros(n_buckets, np.int32)
         btype = np.zeros(n_buckets, np.int32)
         size = np.zeros(n_buckets, np.int32)
@@ -389,7 +511,10 @@ class CrushMap:
             btype[i] = b.type_id
             size[i] = len(b.items)
             items[i, : len(b.items)] = b.items
-            weights[i, : len(b.items)] = b.item_weights
+            w = override.get(bid, b.item_weights)
+            if len(w) != len(b.items):  # stale weight-set row: fall back
+                w = b.item_weights
+            weights[i, : len(b.items)] = w
         return DenseCrushMap(
             n_buckets=n_buckets,
             max_fanout=max_fanout,

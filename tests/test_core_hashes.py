@@ -140,3 +140,38 @@ def test_is_out_matches_oracle():
         [ref.is_out(int(w), int(i), int(x)) for w, i, x in zip(ws, items, xs)]
     )
     np.testing.assert_array_equal(got, want)
+
+
+def test_div_by_magic_exact():
+    """Magic-reciprocal division must equal `//` bit-for-bit over the
+    straw2 domain (a <= 2^48, w = any u32) including adversarial edges."""
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 1 << 48, 200_000, dtype=np.uint64)
+    w = rng.integers(1, 1 << 32, 200_000, dtype=np.uint64)
+    edge_a = np.array([0, 1, (1 << 48), (1 << 48) - 1, (1 << 47) + 1], np.uint64)
+    edge_w = np.array([1, 2, 3, 0xFFFF, 0x10000, 0xFFFFFFFF], np.uint64)
+    ea, ew = np.meshgrid(edge_a, edge_w)
+    a = np.concatenate(
+        [a, ea.ravel(), (ew.ravel() * np.uint64(12345) + np.uint64(7)) & np.uint64((1 << 48) - 1)]
+    )
+    w = np.concatenate([w, ew.ravel(), ew.ravel()])
+    magic = hashes.magic_reciprocal(w)
+    got = np.asarray(
+        hashes.div_by_magic(jnp.asarray(a), jnp.asarray(magic), jnp.asarray(w))
+    )
+    assert np.array_equal(got, a // w)
+
+
+def test_negdraw_magic_equals_plain():
+    rng = np.random.default_rng(1)
+    n = 50_000
+    x = jnp.asarray(rng.integers(0, 1 << 32, n, dtype=np.uint32))
+    ids = jnp.asarray(rng.integers(0, 1 << 32, n, dtype=np.uint32))
+    r = jnp.asarray(rng.integers(0, 64, n, dtype=np.uint32))
+    wnp = rng.integers(0, 1 << 20, n, dtype=np.uint32)
+    wnp[:100] = 0  # zero-weight lanes
+    w = jnp.asarray(wnp)
+    magic = jnp.asarray(hashes.magic_reciprocal(wnp))
+    plain = np.asarray(hashes.straw2_negdraw(x, ids, r, w))
+    fast = np.asarray(hashes.straw2_negdraw_magic(x, ids, r, w, magic))
+    assert np.array_equal(plain, fast)

@@ -1,0 +1,118 @@
+"""Device classes (shadow trees) and choose_args weight sets."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush.compiler import compile_crushmap, decompile_crushmap
+from ceph_tpu.crush.interp import StaticCrushMap, batch_do_rule
+from ceph_tpu.crush.map import ITEM_NONE, CrushMap
+from ceph_tpu.models.clusters import build_simple
+
+W1 = 0x10000
+
+
+def _mixed_class_map():
+    m = build_simple(16, osds_per_host=4, hosts_per_rack=2)
+    for o in range(16):
+        m.device_classes[o] = "ssd" if o % 4 < 2 else "hdd"
+    return m
+
+
+def test_class_shadow_placement_only_hits_class():
+    m = _mixed_class_map()
+    rule = m.make_replicated_rule("ssd_rule", "default", "host", device_class="ssd")
+    smap = StaticCrushMap(m.to_dense())
+    xs = np.arange(2000, dtype=np.uint32)
+    w = np.full(smap.max_devices, W1, np.uint32)
+    res, lens = batch_do_rule(smap, rule, xs, w, 3)
+    res = np.asarray(res)
+    chosen = res[res != ITEM_NONE]
+    assert len(chosen) > 0
+    assert all(m.device_classes[int(o)] == "ssd" for o in np.unique(chosen))
+    # all ssd devices get used
+    assert set(np.unique(chosen)) == {o for o in range(16) if o % 4 < 2}
+
+
+def test_class_shadow_matches_cpu_reference():
+    from ceph_tpu.testing import cppref
+
+    m = _mixed_class_map()
+    rule = m.make_replicated_rule("hdd_rule", "default", "host", device_class="hdd")
+    dense = m.to_dense()
+    smap = StaticCrushMap(dense)
+    xs = np.arange(1000, dtype=np.uint32)
+    w = np.full(smap.max_devices, W1, np.uint32)
+    dev, dlens = batch_do_rule(smap, rule, xs, w, 3)
+    steps = [(s.op, s.arg1, s.arg2) for s in rule.steps]
+    cpu, clens = cppref.do_rule_batch(dense, steps, xs, w, 3)
+    assert np.array_equal(np.asarray(dev), cpu)
+    assert np.array_equal(np.asarray(dlens), clens)
+
+
+def test_class_take_compile_decompile():
+    m = _mixed_class_map()
+    m.make_replicated_rule("ssd_rule", "default", "host", device_class="ssd")
+    text = decompile_crushmap(m)
+    assert "take default class ssd" in text
+    assert "~ssd" not in text  # shadow trees are hidden
+    m2 = compile_crushmap(text)
+    r2 = m2.rule_by_name("ssd_rule")
+    take = r2.steps[0]
+    assert m2.shadow_origin(take.arg1) is not None
+    # placements agree through the round-trip
+    smap1 = StaticCrushMap(m.to_dense())
+    smap2 = StaticCrushMap(m2.to_dense())
+    xs = np.arange(500, dtype=np.uint32)
+    w1 = np.full(smap1.max_devices, W1, np.uint32)
+    w2 = np.full(smap2.max_devices, W1, np.uint32)
+    r1, _ = batch_do_rule(smap1, m.rule_by_name("ssd_rule"), xs, w1, 2)
+    r2b, _ = batch_do_rule(smap2, r2, xs, w2, 2)
+    assert np.array_equal(np.asarray(r1), np.asarray(r2b))
+
+
+def test_shadow_rebuild_keeps_id():
+    m = _mixed_class_map()
+    root = m.bucket_by_name("default").id
+    s1 = m.class_shadow_root(root, "ssd")
+    m.adjust_item_weight(m.parent_of(0), 0, 2 * W1)
+    s2 = m.class_shadow_root(root, "ssd")
+    assert s1 == s2  # rules referencing the shadow stay valid
+
+
+def test_no_devices_of_class_raises():
+    m = build_simple(8)
+    with pytest.raises(ValueError, match="no devices of class"):
+        m.make_replicated_rule("x", "default", "host", device_class="nvme")
+
+
+def test_choose_args_weight_override():
+    m = build_simple(8, osds_per_host=8, hosts_per_rack=1)
+    host = m.bucket_by_name("host0_0")
+    m.create_choose_args("pool7")
+    # zero osd 0's weight only in the weight set
+    m.choose_args_adjust_item_weight("pool7", host.id, 0, 0)
+    xs = np.arange(4000, dtype=np.uint32)
+
+    base = StaticCrushMap(m.to_dense())
+    w = np.full(base.max_devices, W1, np.uint32)
+    rule = m.rule_by_name("replicated_rule")
+    r_base, _ = batch_do_rule(base, rule, xs, w, 1)
+    assert 0 in np.unique(np.asarray(r_base))
+
+    alt = StaticCrushMap(m.to_dense(choose_args="pool7"))
+    r_alt, _ = batch_do_rule(alt, rule, xs, w, 1)
+    assert 0 not in np.unique(np.asarray(r_alt))
+    # real weights untouched
+    assert m.bucket_by_name("host0_0").item_weights[0] == W1
+
+
+def test_choose_args_serialization():
+    m = build_simple(8)
+    m.create_choose_args("ca")
+    host = m.bucket_by_name("host0_0")
+    m.choose_args_adjust_item_weight("ca", host.id, 0, 1234)
+    m2 = CrushMap.decode(m.encode())
+    assert m2.choose_args["ca"][host.id][0] == 1234
+    d1 = m.to_dense(choose_args="ca")
+    d2 = m2.to_dense(choose_args="ca")
+    assert np.array_equal(d1.weights, d2.weights)

@@ -1,0 +1,76 @@
+"""Op tracker, tracing spans, prometheus exposition."""
+
+import time
+
+from ceph_tpu.common import PerfCountersBuilder
+from ceph_tpu.common.op_tracker import OpTracker
+from ceph_tpu.common.prometheus import render
+from ceph_tpu.common.tracing import timed_block
+
+
+def test_op_tracker_lifecycle():
+    t = OpTracker(history_size=4, slow_op_threshold=0.05)
+    with t.create_op("fast_op") as op:
+        op.mark_event("queued")
+        op.mark_event("executed")
+    assert t.dump_ops_in_flight()["num_ops"] == 0
+    hist = t.dump_historic_ops()
+    assert hist["num_ops"] == 1
+    ev = [e["event"] for e in hist["ops"][0]["events"]]
+    assert ev == ["queued", "executed", "done"]
+
+    with t.create_op("slow_op") as op:
+        time.sleep(0.06)
+    slow = t.dump_historic_slow_ops()
+    assert slow["num_slow_ops_found"] == 1
+    assert slow["ops"][0]["description"] == "slow_op"
+
+
+def test_op_tracker_in_flight_and_history_bound():
+    t = OpTracker(history_size=2)
+    op = t.create_op("pending")
+    assert t.dump_ops_in_flight()["num_ops"] == 1
+    op.finish()
+    for i in range(5):
+        t.create_op(f"op{i}").finish()
+    assert t.dump_historic_ops()["num_ops"] == 2  # bounded deque
+
+
+def test_op_tracker_admin_hooks(tmp_path):
+    from ceph_tpu.common.admin_socket import AdminSocket, ask
+    from ceph_tpu.common.config import Config
+
+    t = OpTracker()
+    a = AdminSocket(str(tmp_path / "asok"), Config(env={}))
+    t.register_admin_hooks(a)
+    a.start()
+    try:
+        t.create_op("x").finish()
+        out = ask(str(tmp_path / "asok"), "dump_historic_ops")
+        assert out["num_ops"] == 1
+    finally:
+        a.stop()
+
+
+def test_prometheus_render():
+    pc = (
+        PerfCountersBuilder("prom_test")
+        .add_u64_counter("widgets")
+        .add_time_avg("lat")
+        .create_perf_counters()
+    )
+    pc.inc("widgets", 3)
+    with timed_block(pc, "lat"):
+        pass
+    text = render()
+    assert "ceph_tpu_prom_test_widgets 3" in text
+    assert "ceph_tpu_prom_test_lat_count 1" in text
+    assert "# TYPE ceph_tpu_prom_test_widgets gauge" in text
+
+
+def test_prometheus_textfile(tmp_path):
+    from ceph_tpu.common.prometheus import write_textfile
+
+    path = tmp_path / "metrics.prom"
+    write_textfile(str(path))
+    assert path.exists() and path.read_text().endswith("\n")

@@ -1,0 +1,56 @@
+"""pg_autoscaler sizing policy."""
+
+from ceph_tpu.balancer.pg_autoscaler import PgAutoscaler, _nearest_power_of_two
+from ceph_tpu.models.clusters import build_osdmap
+from ceph_tpu.osdmap.map import Pool
+
+
+def test_nearest_power_of_two():
+    assert _nearest_power_of_two(1) == 1
+    assert _nearest_power_of_two(3) == 4
+    assert _nearest_power_of_two(5.9) == 4
+    assert _nearest_power_of_two(6) == 8
+    assert _nearest_power_of_two(1024) == 1024
+
+
+def test_single_pool_sizing():
+    m = build_osdmap(30, pg_num=8)  # deliberately undersized
+    a = PgAutoscaler(m, target_pgs_per_osd=100)
+    (rec,) = a.recommend()
+    # 100 * 30 / 3 = 1000 -> 1024
+    assert rec.target_pg_num == 1024
+    assert rec.would_adjust  # 8 * 3 < 1024
+    assert a.apply()
+    assert m.pools[1].pg_num == 1024 and m.epoch == 2
+
+
+def test_within_threshold_no_churn():
+    m = build_osdmap(30, pg_num=512)
+    a = PgAutoscaler(m, target_pgs_per_osd=100)
+    (rec,) = a.recommend()
+    assert rec.target_pg_num == 1024
+    assert not rec.would_adjust  # 512*3 >= 1024: leave it alone
+    assert not a.apply()
+    assert m.epoch == 1
+
+
+def test_target_size_ratio_split():
+    m = build_osdmap(30, pg_num=64)
+    m.add_pool(Pool(id=2, name="big", size=3, pg_num=64, pgp_num=64,
+                    crush_rule=m.pools[1].crush_rule))
+    a = PgAutoscaler(m, target_pgs_per_osd=100)
+    a.set_target_size_ratio(2, 0.75)
+    recs = {r.pool_id: r for r in a.recommend()}
+    assert recs[2].target_pg_num > recs[1].target_pg_num
+    assert abs(recs[2].capacity_ratio - 0.75) < 1e-9
+    assert abs(recs[1].capacity_ratio - 0.25) < 1e-9
+
+
+def test_out_osds_shrink_target():
+    m = build_osdmap(30, pg_num=8)
+    for o in range(15):
+        m.mark_out(o)
+    a = PgAutoscaler(m, target_pgs_per_osd=100)
+    (rec,) = a.recommend()
+    # 100 * 15 / 3 = 500 -> 512
+    assert rec.target_pg_num == 512
