@@ -6,83 +6,194 @@ single-core C++ CPU reference as baseline (the stand-in for the
 reference's serial `crushtool --test` loop, upstream
 ``src/crush/CrushTester.cc``).
 
-Prints exactly one JSON line:
+Robustness contract (this is the driver's one scored artifact): this
+script ALWAYS prints exactly one JSON line
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+and exits 0, no matter what the TPU tunnel does.  The device
+measurement runs in a child process with a hard timeout; on
+failure/timeout we retry once, then fall back to measuring the same
+jitted program on the host CPU backend (also in a bounded child), and
+the JSON carries an "error" field plus whichever rate was measured.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
+_REPO = os.path.dirname(os.path.abspath(__file__))
 
 N_OBJECTS = 1_000_000
 CPU_SAMPLE = 50_000
 N_OSDS = 1024
 REPLICAS = 3
 
+ATTACH_TIMEOUT_S = int(os.environ.get("CEPH_TPU_BENCH_TIMEOUT", "420"))
 
-def main() -> None:
-    import jax
-    import jax.numpy as jnp
 
-    from ceph_tpu.crush.interp import StaticCrushMap, compile_rule
+def _cpu_baseline() -> float:
+    """Single-core C++ reference rate (placements/s) — never touches jax."""
     from ceph_tpu.models.clusters import build_simple
     from ceph_tpu.testing import cppref
 
     m = build_simple(N_OSDS)
     rule = m.rule_by_name("replicated_rule")
     dense = m.to_dense()
-    smap = StaticCrushMap(dense)
-    osd_weight_np = np.full(smap.max_devices, 0x10000, np.uint32)
-
-    # --- CPU baseline (single core, C++ reference) ---
     steps = [(s.op, s.arg1, s.arg2) for s in rule.steps]
+    osd_weight_np = np.full(dense.max_devices, 0x10000, np.uint32)
     xs_cpu = np.arange(CPU_SAMPLE, dtype=np.uint32)
     t0 = time.perf_counter()
     cppref.do_rule_batch(dense, steps, xs_cpu, osd_weight_np, REPLICAS)
-    cpu_rate = CPU_SAMPLE / (time.perf_counter() - t0)
+    return CPU_SAMPLE / (time.perf_counter() - t0)
 
-    # --- TPU path ---
-    # Resilient sizing: the tunnel-attached chip has faulted on very
-    # large programs before; fall back through smaller batch sizes (and
-    # report honestly) rather than crash the driver's bench run.
-    run = compile_rule(smap, rule, REPLICAS)
 
-    @jax.jit
-    def batch(osd_weight, xs):
-        return jax.vmap(lambda x: run(smap, osd_weight, x))(xs)
+def _device_measure() -> None:
+    """Child-process body: measure batch placement rate on whatever
+    backend jax initializes to, print one JSON line with the result."""
+    from ceph_tpu.common.compile_cache import enable_persistent_cache
 
-    osd_weight = jnp.asarray(osd_weight_np)
-    tpu_rate = 0.0
-    for n in (N_OBJECTS, N_OBJECTS // 4, N_OBJECTS // 16, N_OBJECTS // 64):
+    enable_persistent_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.crush.interp import StaticCrushMap, batch_runner
+    from ceph_tpu.models.clusters import build_simple
+
+    m = build_simple(N_OSDS)
+    rule = m.rule_by_name("replicated_rule")
+    smap = StaticCrushMap(m.to_dense())
+    osd_weight = jnp.full((smap.max_devices,), 0x10000, jnp.uint32)
+    batch = batch_runner(smap, rule, REPLICAS)
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        # XLA:CPU runs this integer-heavy program ~3k placements/s on
+        # one core — a 1M batch would blow any sane timeout.  The CPU
+        # fallback exists to prove the program and give an honest
+        # number, not to win.
+        sizes, iters = (20_000, 5_000), 1
+    else:
+        sizes, iters = (N_OBJECTS, N_OBJECTS // 4, N_OBJECTS // 16), 3
+    rate = 0.0
+    err = None
+    # Fall back through smaller batches rather than die on a flaky chip.
+    for n in sizes:
         try:
             xs = jnp.arange(n, dtype=jnp.uint32)
-            jax.block_until_ready(batch(osd_weight, xs))  # compile + warm
-            iters = 3
+            jax.block_until_ready(batch(smap, osd_weight, xs))  # compile+warm
             t0 = time.perf_counter()
             for i in range(iters):
-                jax.block_until_ready(batch(osd_weight, xs + np.uint32(i + 1)))
+                jax.block_until_ready(
+                    batch(smap, osd_weight, xs + np.uint32(i + 1))
+                )
             dt = (time.perf_counter() - t0) / iters
-            tpu_rate = n / dt
+            rate = n / dt
+            err = None
             break
-        except Exception as e:  # noqa: BLE001 — report what we measured
-            print(f"bench: batch {n} failed ({e}); retrying smaller",
-                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            err = f"batch {n}: {type(e).__name__}: {e}"
+            print(f"bench child: {err}; retrying smaller", file=sys.stderr)
+    out = {"rate": rate, "platform": platform}
+    if err is not None:
+        out["error"] = err
+    print("BENCH_CHILD_RESULT " + json.dumps(out), flush=True)
 
-    print(
-        json.dumps(
-            {
-                "metric": "crush_placements_per_sec",
-                "value": round(tpu_rate),
-                "unit": "placements/s",
-                "vs_baseline": round(tpu_rate / cpu_rate, 2),
-            }
+
+def _run_child(env: dict, timeout_s: int) -> dict | None:
+    """Run the device measurement in a child; return its result dict."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            env=env,
+            cwd=_REPO,
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
         )
-    )
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {timeout_s}s"}
+    for line in proc.stdout.splitlines():
+        if line.startswith("BENCH_CHILD_RESULT "):
+            return json.loads(line[len("BENCH_CHILD_RESULT "):])
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-3:]
+    return {"error": f"rc={proc.returncode}: " + " | ".join(tail)}
+
+
+def main() -> int:
+    if "--child" in sys.argv:
+        _device_measure()
+        return 0
+    try:
+        return _main_guarded()
+    except BaseException as e:  # noqa: BLE001 — the JSON line is sacred
+        print(
+            json.dumps(
+                {
+                    "metric": "crush_placements_per_sec",
+                    "value": 0,
+                    "unit": "placements/s",
+                    "vs_baseline": 0.0,
+                    "error": f"bench driver crashed: {type(e).__name__}: {e}",
+                }
+            ),
+            flush=True,
+        )
+        return 0
+
+
+def _main_guarded() -> int:
+    try:
+        cpu_rate = _cpu_baseline()
+    except Exception as e:  # noqa: BLE001 — even this must not kill the JSON
+        print(f"bench: CPU baseline failed: {e}", file=sys.stderr)
+        cpu_rate = 0.0
+
+    # Attempt 1 + retry: real device (inherit env — axon TPU plugin).
+    # A timed-out attach is not retried — the tunnel won't recover in
+    # seconds, and the driver's own timeout budget is finite.
+    result = None
+    errors = []
+    for attempt in range(2):
+        r = _run_child(dict(os.environ), ATTACH_TIMEOUT_S)
+        if r and r.get("rate"):
+            result = r
+            break
+        err = (r or {}).get("error") or ""
+        errors.append(f"tpu attempt {attempt + 1}: {err}")
+        if "timeout" in err:
+            break
+
+    # Fallback: same jitted program on host CPU in a scrubbed child.
+    if result is None:
+        from ceph_tpu.common.hermetic import scrubbed_env
+
+        r = _run_child(scrubbed_env(_REPO), ATTACH_TIMEOUT_S)
+        if r and r.get("rate"):
+            result = r
+        else:
+            errors.append(f"cpu fallback: {(r or {}).get('error')}")
+
+    out = {
+        "metric": "crush_placements_per_sec",
+        "value": round(result["rate"]) if result else 0,
+        "unit": "placements/s",
+        "vs_baseline": (
+            round(result["rate"] / cpu_rate, 2) if result and cpu_rate else 0.0
+        ),
+    }
+    if result and result.get("platform"):
+        out["platform"] = result["platform"]
+    out["cpu_ref_placements_per_sec"] = round(cpu_rate)
+    if errors:
+        out["error"] = "; ".join(e for e in errors if e)
+    print(json.dumps(out), flush=True)
+    return 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,44 @@
+"""On-disk compiled-artifact cache (SURVEY §5 checkpoint/resume).
+
+The reference's durable truth is Paxos-committed map epochs; its
+restart path replays them.  Our equivalent concern is XLA compilation:
+every placement/EC program is deterministic in (map shapes, rule, code
+version), so compiled executables are content-addressed by HLO hash and
+persisted, making a restart re-JIT nothing that was compiled before.
+
+This wires JAX's persistent compilation cache with framework defaults:
+``enable_persistent_cache()`` is idempotent, safe to call from tests,
+benches and CLIs alike.  Cache location precedence: explicit argument >
+``CEPH_TPU_CACHE_DIR`` env > ``~/.cache/ceph_tpu/xla``.
+"""
+
+from __future__ import annotations
+
+import os
+
+_enabled: str | None = None
+
+
+def cache_dir() -> str:
+    return os.environ.get(
+        "CEPH_TPU_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "ceph_tpu", "xla"),
+    )
+
+
+def enable_persistent_cache(directory: str | None = None) -> str:
+    """Turn on the on-disk XLA executable cache; returns the directory."""
+    global _enabled
+    directory = directory or cache_dir()
+    if _enabled == directory:
+        return directory
+    os.makedirs(directory, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", directory)
+    # cache everything: placement programs are small but expensive to
+    # build (deep while_loops), so no minimum size / compile-time gate
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    _enabled = directory
+    return directory

@@ -1,0 +1,57 @@
+"""Hermetic child-process environments for CPU-only JAX work.
+
+This machine injects a TPU-tunnel JAX plugin via a ``sitecustomize`` on
+PYTHONPATH (``.axon_site``) that force-initializes the single-tenant,
+slow-to-attach TPU client even under ``JAX_PLATFORMS=cpu``.  Anything
+that must never block on that attach (tests, dry runs, CPU fallbacks)
+re-runs itself in a child with this scrubbed environment.
+
+One definition, used by tests/conftest.py, __graft_entry__.py and
+bench.py alike, so hermeticity fixes land in one place.
+"""
+
+from __future__ import annotations
+
+import os
+
+# env markers meaning "the TPU plugin will grab the process"
+AXON_MARKERS = ("_AXON_REGISTERED",)
+AXON_SITE_FRAGMENT = ".axon_site"
+
+
+def env_is_dirty(environ: dict | None = None) -> bool:
+    env = os.environ if environ is None else environ
+    if any(env.get(m) is not None for m in AXON_MARKERS):
+        return True
+    if any(
+        AXON_SITE_FRAGMENT in p
+        for p in env.get("PYTHONPATH", "").split(os.pathsep)
+    ):
+        return True
+    return env.get("JAX_PLATFORMS", "cpu").lower() != "cpu"
+
+
+def scrubbed_env(
+    repo_dir: str, n_devices: int | None = None, **extra: str
+) -> dict:
+    """Child env: CPU platform, axon site off PYTHONPATH, quiet XLA logs.
+
+    ``n_devices`` forces a virtual CPU device count (replacing any stale
+    ``xla_force_host_platform_device_count`` already in XLA_FLAGS).
+    """
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_dir
+    env["JAX_PLATFORMS"] = "cpu"
+    for m in AXON_MARKERS:
+        env.pop(m, None)
+    env.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+    if n_devices is not None:
+        flags = [
+            f
+            for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
+    env.update(extra)
+    return env

@@ -43,8 +43,6 @@ tunables (argonaut profile) are CPU-reference-only.
 
 from __future__ import annotations
 
-from functools import partial
-
 import numpy as np
 
 import jax
@@ -711,15 +709,58 @@ def compile_rule(smap: StaticCrushMap, rule: Rule, result_max: int):
     return run
 
 
+def smap_signature(smap: StaticCrushMap) -> tuple:
+    """Hashable static signature: two maps with equal signatures trace to
+    the same program (arrays are traced arguments, not constants)."""
+    return (
+        smap.n_buckets,
+        smap.max_fanout,
+        smap.max_devices,
+        smap.max_depth,
+        smap.tunables,
+        tuple(sorted(smap.algs)),
+    )
+
+
+def rule_signature(rule: Rule) -> tuple:
+    return tuple((s.op, s.arg1, s.arg2) for s in rule.steps)
+
+
+_BATCH_CACHE: dict = {}
+_MEMO_CAP = 64  # evict oldest beyond this (maps evolve in long processes)
+
+
+def _memo_put(cache: dict, key, value) -> None:
+    if len(cache) >= _MEMO_CAP:
+        cache.pop(next(iter(cache)))
+    cache[key] = value
+
+
+def batch_runner(smap: StaticCrushMap, rule: Rule, result_max: int):
+    """Cached jitted ``f(smap, osd_weight, xs) -> (results, lens)``.
+
+    Tracing a placement program costs seconds (deep masked loops); the
+    program depends only on static shape/tunables/rule structure, so it
+    is memoized process-wide by signature.  The persistent XLA cache
+    (ceph_tpu.common.compile_cache) extends this across processes.
+    """
+    key = (smap_signature(smap), rule_signature(rule), result_max)
+    fn = _BATCH_CACHE.get(key)
+    if fn is None:
+        run = compile_rule(smap, rule, result_max)
+
+        @jax.jit
+        def fn(smap_, wgt, xs_):
+            return jax.vmap(lambda x: run(smap_, wgt, x))(xs_)
+
+        _memo_put(_BATCH_CACHE, key, fn)
+    return fn
+
+
 def batch_do_rule(smap: StaticCrushMap, rule: Rule, xs, osd_weight, result_max: int):
     """vmapped rule execution over a batch of x seeds (jit-compiled).
 
     Returns (results [n, result_max] int32, lens [n] int32).
     """
-    run = compile_rule(smap, rule, result_max)
-
-    @partial(jax.jit, static_argnames=())
-    def go(smap_, wgt, xs_):
-        return jax.vmap(lambda x: run(smap_, wgt, x))(xs_)
-
+    go = batch_runner(smap, rule, result_max)
     return go(smap, jnp.asarray(osd_weight, U32), jnp.asarray(xs, U32))

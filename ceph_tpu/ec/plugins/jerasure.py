@@ -67,8 +67,10 @@ class ErasureCodeJerasure(ErasureCode):
 
     def get_alignment(self) -> int:
         if self.technique in BITMATRIX_TECHNIQUES:
-            # chunk must split into w*packetsize groups
-            return self.k * self.w * self.packetsize
+            # reference ErasureCodeJerasureCauchy::get_alignment is
+            # k * w * packetsize * sizeof(int) — the extra sizeof(int)
+            # factor matters for on-disk chunk-size parity
+            return self.k * self.w * self.packetsize * SIZEOF_INT
         return self.k * self.w * SIZEOF_INT
 
     def encode_chunks(self, chunks: dict[int, np.ndarray]) -> None:

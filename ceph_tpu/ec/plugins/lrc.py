@@ -100,26 +100,33 @@ class ErasureCodeLrc(ErasureCode):
 
     @staticmethod
     def _generate(k: int, m: int, l: int):
-        """k/m/l layout: k data, m global RS, (k+m)/l local XOR parities."""
+        """k/m/l layout: k data, m global RS, (k+m)/l local XOR parities.
+
+        Matches the reference's generated layout (parities at the START
+        of each group): each group of l+1 positions is [local parity,
+        global parities..., data...], e.g. k=4 m=2 l=3 -> mapping
+        ``__DD__DD``, layers ``_cDD_cDD`` / ``cDDD____`` / ``____cDDD``
+        (upstream ``src/erasure-code/lrc/ErasureCodeLrc.cc`` parse_kml,
+        doc/rados/operations/erasure-code-lrc.rst example).
+        """
         if (k + m) % l:
             raise ErasureCodeError(f"k+m={k + m} must be divisible by l={l}")
         groups = (k + m) // l
-        # global positions: per group of l data/global chunks, the group
-        # followed by its local parity
+        # distribute the m global parities over groups, earliest first
+        per = [m // groups + (1 if g < m % groups else 0) for g in range(groups)]
+        n = k + m + groups
         mapping = ""
         global_desc = ""
-        seq = "D" * k + "c" * m  # the global layer's view
-        pos = 0
         local_descs = []
         for g in range(groups):
-            chunk = seq[g * l : (g + 1) * l]
-            mapping += "".join("D" if c == "D" else "_" for c in chunk) + "_"
-            global_desc += "".join("D" if c == "D" else "c" for c in chunk) + "_"
-            local = ["_"] * (k + m + groups)
+            ncod = per[g]
+            mapping += "_" + "_" * ncod + "D" * (l - ncod)
+            global_desc += "_" + "c" * ncod + "D" * (l - ncod)
+            local = ["_"] * n
             base = g * (l + 1)
-            for i in range(l):
+            local[base] = "c"
+            for i in range(1, l + 1):
                 local[base + i] = "D"
-            local[base + l] = "c"
             local_descs.append("".join(local))
         layers = [[global_desc, {"plugin": "jerasure", "technique": "reed_sol_van"}]]
         for d in local_descs:

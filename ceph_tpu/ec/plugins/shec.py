@@ -1,4 +1,11 @@
-"""Shingled erasure code (SHEC plugin parity).
+"""Shingled erasure code (SHEC — structural semantics only).
+
+Parity scope: this plugin reproduces the reference's *structural*
+semantics (shingle geometry, non-MDS recoverability, windowed repair),
+NOT bit-compatible encodings — the parity coefficients below use an
+``alpha^((i+1)(j+1))`` pattern rather than the reference shec plugin's
+exact matrix construction, so encoded parity bytes differ from upstream
+while remaining self-consistent and recoverable.
 
 Semantics per the reference's ``src/erasure-code/shec`` (Miyamae et
 al., "SHEC"): SHEC(k, m, c) places m parities, each covering a

@@ -214,18 +214,30 @@ class OSDMap:
         if um:
             for osd in um:
                 if self._upmap_target_out(osd):
-                    return raw  # any out target voids the whole override
-            return list(um)
+                    # any out target rejects the explicit mapping outright
+                    # (items are NOT applied either — reference returns here)
+                    return raw
+            raw = list(um)
+            # fall through: pg_upmap_items still apply on top of pg_upmap
         items = self.pg_upmap_items.get(pg)
         if items:
             raw = list(raw)
             for frm, to in items:
                 if self._upmap_target_out(to):
                     continue
+                # reference guard: never rewrite when the replacement
+                # target already appears in the raw set (would place two
+                # replicas of the PG on one OSD)
+                pos = -1
+                exists = False
                 for i, osd in enumerate(raw):
-                    if osd == frm:
-                        raw[i] = to
+                    if osd == to:
+                        exists = True
                         break
+                    if pos < 0 and osd == frm:
+                        pos = i
+                if not exists and pos >= 0:
+                    raw[pos] = to
         return raw
 
     def _raw_to_up_osds(self, pool: Pool, raw: list[int]) -> list[int]:

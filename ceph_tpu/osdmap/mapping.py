@@ -20,7 +20,13 @@ import jax
 import jax.numpy as jnp
 
 from ..core.hashes import ceph_stable_mod, crush_hash32_2
-from ..crush.interp import StaticCrushMap, compile_rule
+from ..crush.interp import (
+    StaticCrushMap,
+    _memo_put,
+    compile_rule,
+    rule_signature,
+    smap_signature,
+)
 from ..crush.map import ITEM_NONE
 from .map import (
     DEFAULT_PRIMARY_AFFINITY,
@@ -157,15 +163,37 @@ def _compact_left(row, valid):
     return jnp.where(slot < count, shifted, ITEM_NONE), count
 
 
+_POOL_FN_CACHE: dict = {}
+
+
 def compile_pool_mapping(smap: StaticCrushMap, pool: Pool, rule):
-    """Build ``fn(state, pg_indices) -> (up, up_primary, acting, acting_primary)``.
+    """Build ``fn(smap, state, pg_indices) -> (up, up_primary, acting,
+    acting_primary)``.
 
     ``pg_indices`` are folded PG seeds (0..pg_num-1); outputs are
     [n, size] i32 (ITEM_NONE padded) and [n] i32 primaries.  Covers the
     reference pipeline ``_pg_to_raw_osds -> _apply_upmap ->
     _raw_to_up_osds -> _pick_primary -> _apply_primary_affinity ->
     _get_temp_osds`` (upstream ``src/osd/OSDMap.cc``).
+
+    The program depends only on static structure (map shapes, tunables,
+    rule steps, pool constants); map/state arrays are traced arguments.
+    Compiled programs are memoized process-wide — tracing these deep
+    masked loops costs seconds, so equal-signature calls must not
+    re-trace.
     """
+    key = (
+        smap_signature(smap),
+        rule_signature(rule),
+        pool.id,
+        pool.size,
+        pool.pgp_num,
+        pool.hashpspool,
+        pool.can_shift_osds(),
+    )
+    cached = _POOL_FN_CACHE.get(key)
+    if cached is not None:
+        return cached
     size = pool.size
     run = compile_rule(smap, rule, size)
     pool_id = np.uint32(pool.id)
@@ -176,7 +204,7 @@ def compile_pool_mapping(smap: StaticCrushMap, pool: Pool, rule):
     def in_range(o, n_osd):
         return (o >= 0) & (o < n_osd)
 
-    def map_one(state: PoolMapState, ps):
+    def map_one(smap, state: PoolMapState, ps):
         n_osd = state.osd_weight.shape[0]
         ps = jnp.asarray(ps, U32)
         folded = ceph_stable_mod(ps, pgp_num, pgp_mask)
@@ -209,8 +237,20 @@ def compile_pool_mapping(smap: StaticCrushMap, pool: Pool, rule):
             )
             hit = r == frm
             first = jnp.argmax(hit)
-            # a full pg_upmap entry (applied or voided) shadows items
-            do = (j < n_it) & jnp.any(hit) & ~to_out & ~has_full
+            # reference guard: skip the rewrite when the replacement
+            # target already appears anywhere in the raw set (two
+            # replicas of the PG on one OSD otherwise)
+            exists = jnp.any(r == to)
+            # a voided full pg_upmap returns early in the reference, so
+            # items are blocked only in that case; an *applied* full
+            # upmap falls through and items apply on top of it
+            do = (
+                (j < n_it)
+                & jnp.any(hit)
+                & ~to_out
+                & ~exists
+                & ~(has_full & um_void)
+            )
             return jnp.where(
                 do & (jnp.arange(size) == first), to, r
             )
@@ -266,9 +306,10 @@ def compile_pool_mapping(smap: StaticCrushMap, pool: Pool, rule):
         return up, up_primary, acting, acting_primary
 
     @jax.jit
-    def fn(state: PoolMapState, pg_indices):
-        return jax.vmap(lambda ps: map_one(state, ps))(pg_indices)
+    def fn(smap, state: PoolMapState, pg_indices):
+        return jax.vmap(lambda ps: map_one(smap, state, ps))(pg_indices)
 
+    _memo_put(_POOL_FN_CACHE, key, fn)
     return fn
 
 
@@ -313,10 +354,10 @@ class OSDMapMapping:
             else list(self.osdmap.pools.values())
         )
         for pool in pools:
-            _smap, fn = self._fn_for(pool)
+            smap, fn = self._fn_for(pool)
             state = build_pool_state(self.osdmap, pool, self.max_items)
             pgs = jnp.arange(pool.pg_num, dtype=jnp.uint32)
-            up, upp, acting, actp = jax.block_until_ready(fn(state, pgs))
+            up, upp, acting, actp = jax.block_until_ready(fn(smap, state, pgs))
             self._results[pool.id] = (
                 np.asarray(up),
                 np.asarray(upp),

@@ -26,30 +26,33 @@ if _REPO not in sys.path:
 def _needs_reexec() -> bool:
     if os.environ.get("CEPH_TPU_TEST_REEXEC") == "1":
         return False
-    return os.environ.get("_AXON_REGISTERED") is not None or any(
-        ".axon_site" in p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
-    )
+    from ceph_tpu.common.hermetic import env_is_dirty
+
+    return env_is_dirty()
 
 
 def pytest_configure(config):
     if not _needs_reexec():
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        # silence XLA:CPU AOT-cache machine-feature warnings (spurious
+        # prefer-no-scatter/gather pseudo-feature mismatch, E-level)
+        os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (
                 flags + " --xla_force_host_platform_device_count=8"
             ).strip()
+        # persistent XLA executable cache: repeat runs skip all re-JITs
+        from ceph_tpu.common.compile_cache import enable_persistent_cache
+
+        enable_persistent_cache()
         return
 
     import subprocess
 
-    env = dict(os.environ)
-    env["CEPH_TPU_TEST_REEXEC"] = "1"
-    env["PYTHONPATH"] = _REPO
-    env["JAX_PLATFORMS"] = "cpu"
-    flags = env.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    from ceph_tpu.common.hermetic import scrubbed_env
+
+    env = scrubbed_env(_REPO, n_devices=8, CEPH_TPU_TEST_REEXEC="1")
 
     cmd = [sys.executable, "-m", "pytest", *config.invocation_params.args]
     capman = config.pluginmanager.getplugin("capturemanager")

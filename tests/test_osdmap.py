@@ -31,7 +31,7 @@ def _device_all(m: OSDMap, pool: Pool):
     fn = compile_pool_mapping(smap, pool, rule)
     state = build_pool_state(m, pool)
     pgs = jnp.arange(pool.pg_num, dtype=jnp.uint32)
-    up, upp, acting, actp = fn(state, pgs)
+    up, upp, acting, actp = fn(smap, state, pgs)
     return np.asarray(up), np.asarray(upp), np.asarray(acting), np.asarray(actp)
 
 
@@ -117,6 +117,51 @@ def test_upmaps_and_temps():
     m.mark_out(3)
     m.mark_out(17)
     m.mark_down(9)
+    _assert_pool_agrees(m, pool)
+
+
+def test_upmap_item_target_already_in_set():
+    """Reference ``_apply_upmap`` guard: a pg_upmap_items rewrite whose
+    replacement target already appears in the raw set must be skipped
+    (it would place two replicas of the PG on one OSD)."""
+    m = build_osdmap(24, pg_num=32)
+    pool = m.pools[1]
+    hit = 0
+    for ps in range(32):
+        up, _, _, _ = m.pg_to_up_acting_osds(PGId(1, ps))
+        if len(up) >= 2:
+            # frm -> to where `to` is already another member of the set
+            m.pg_upmap_items[PGId(1, ps)] = ((up[0], up[1]),)
+            hit += 1
+    assert hit > 0
+    # host path: no duplicates, item not applied
+    for pg, items in m.pg_upmap_items.items():
+        up, _, _, _ = m.pg_to_up_acting_osds(pg)
+        assert len(set(up)) == len(up), f"duplicate replica in {up}"
+        (frm, to) = items[0]
+        assert frm in up and up.count(to) == 1
+    _assert_pool_agrees(m, pool)
+
+
+def test_upmap_full_then_items_falls_through():
+    """An *applied* full pg_upmap falls through to pg_upmap_items (the
+    reference only returns early when the full override is voided)."""
+    m = build_osdmap(24, pg_num=32)
+    pool = m.pools[1]
+    m.pg_upmap[PGId(1, 4)] = (1, 2, 3)
+    m.pg_upmap_items[PGId(1, 4)] = ((2, 9),)
+    up, _, _, _ = m.pg_to_up_acting_osds(PGId(1, 4))
+    assert up == [1, 9, 3]
+    # voided full override: raw mapping preserved, items NOT applied
+    m.mark_out(14)
+    m.pg_upmap[PGId(1, 5)] = (13, 14, 15)
+    raw_before = m.pg_to_up_acting_osds(PGId(1, 5))[0]
+    to = next(o for o in range(24) if o not in raw_before and not m.is_out(o))
+    m.pg_upmap_items[PGId(1, 5)] = (
+        ((raw_before[0], to),) if raw_before else ((0, to),)
+    )
+    up5, _, _, _ = m.pg_to_up_acting_osds(PGId(1, 5))
+    assert to not in up5
     _assert_pool_agrees(m, pool)
 
 
