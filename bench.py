@@ -117,7 +117,7 @@ def _run_child(env: dict, timeout_s: int) -> dict | None:
             text=True,
         )
     except subprocess.TimeoutExpired:
-        return {"error": f"timeout after {timeout_s}s"}
+        return {"error": f"timeout after {timeout_s}s", "timed_out": True}
     for line in proc.stdout.splitlines():
         if line.startswith("BENCH_CHILD_RESULT "):
             return json.loads(line[len("BENCH_CHILD_RESULT "):])
@@ -164,9 +164,8 @@ def _main_guarded() -> int:
         if r and r.get("rate"):
             result = r
             break
-        err = (r or {}).get("error") or ""
-        errors.append(f"tpu attempt {attempt + 1}: {err}")
-        if "timeout" in err:
+        errors.append(f"tpu attempt {attempt + 1}: {(r or {}).get('error')}")
+        if r and r.get("timed_out"):
             break
 
     # Fallback: same jitted program on host CPU in a scrubbed child.

@@ -2,14 +2,21 @@
 
 Simulates a failure-driven rebalance the way the reference recovers —
 placement-driven: place a 100M-object stream before and after marking
-OSDs out, count moved objects, on a straw2 rack/host/osd map.  Objects
-are sharded across every available chip (``shard_map``; degrades to the
-single local chip) and streamed in batches so the object space never
-materializes in HBM.  Emits one JSON line (placements/s across the
-whole sim, counting both epochs).
+OSDs out, count moved objects, on a straw2 rack/host/osd map (the
+reference's recovery is `peering -> re-place everything CRUSH moved`,
+upstream ``src/osd/PeeringState.cc``; here failure = weight edit).
+
+The timed loop IS the sharded path: one jitted step per slice of the
+object space, sharded over every available chip (``shard_map``;
+degrades to the single local chip), with a ``lax.scan`` inside each
+shard streaming chunks so the object space never materializes in HBM
+and seeds are generated on device (zero host->device traffic).
+Emits one JSON line (placements/s across the whole sim, counting both
+epochs, with the device count in the JSON).
 """
 
 import json
+import os
 import sys
 import time
 
@@ -17,62 +24,64 @@ import numpy as np
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-N_OSDS = 10_000
-N_OBJECTS = 100_000_000
-BATCH = 4_000_000
+N_OSDS = int(os.environ.get("CEPH_TPU_BENCH_OSDS", 10_000))
+N_OBJECTS = int(os.environ.get("CEPH_TPU_BENCH_OBJECTS", 100_000_000))
+CHUNK = int(os.environ.get("CEPH_TPU_BENCH_CHUNK", 1_048_576))
 REPLICAS = 3
-FAILED_OSDS = 100
+FAILED_OSDS = max(1, N_OSDS // 100)
 
 
 def main() -> None:
-    import jax
-    import jax.numpy as jnp
+    from ceph_tpu.common.compile_cache import enable_persistent_cache
 
-    from ceph_tpu.crush.interp import StaticCrushMap, compile_rule
+    enable_persistent_cache()
+
+    import jax
+
+    from ceph_tpu.crush.interp import StaticCrushMap
     from ceph_tpu.models.clusters import build_simple
-    from ceph_tpu.parallel.placement import make_mesh, sharded_placement_step
+    from ceph_tpu.parallel.placement import make_mesh, sharded_rebalance_sim
 
     m = build_simple(N_OSDS, osds_per_host=8, hosts_per_rack=16)
     rule = m.rule_by_name("replicated_rule")
     smap = StaticCrushMap(m.to_dense())
     mesh = make_mesh()
     ndev = len(mesh.devices.reshape(-1))
-    step = sharded_placement_step(mesh, smap, rule, REPLICAS)
+
+    # one launch covers ndev * chunk * n_chunks objects; outer python
+    # loop walks slices of the 100M space (re-dispatches pipeline, so
+    # device stays busy while the host bookkeeps)
+    chunks_per_launch = 8
+    per_launch = ndev * CHUNK * chunks_per_launch
+    step = sharded_rebalance_sim(
+        mesh, smap, rule, REPLICAS, CHUNK, chunks_per_launch
+    )
 
     w_before = np.full(smap.max_devices, 0x10000, np.uint32)
     w_after = w_before.copy()
     failed = np.random.default_rng(0).choice(N_OSDS, FAILED_OSDS, replace=False)
     w_after[failed] = 0
 
-    run = compile_rule(smap, rule, REPLICAS)
+    # warm with the SAME scalar dtype the timed loop uses (a python int
+    # would trace a second jit signature and recompile inside the timing)
+    jax.block_until_ready(step(w_before, w_after, np.uint32(0)))
 
-    @jax.jit
-    def moved_batch(wb, wa, xs):
-        rb, _ = jax.vmap(lambda x: run(smap, wb, x))(xs)
-        ra, _ = jax.vmap(lambda x: run(smap, wa, x))(xs)
-        return jnp.sum(jnp.any(rb != ra, axis=1).astype(jnp.int64))
-
-    batch = BATCH - BATCH % ndev
-    xs0 = jnp.arange(batch, dtype=jnp.uint32)
-    wb = jnp.asarray(w_before)
-    wa = jnp.asarray(w_after)
-    jax.block_until_ready(moved_batch(wb, wa, xs0))  # compile
-    jax.block_until_ready(step(wb, xs0))
-
+    n_launches = max(1, N_OBJECTS // per_launch)
+    covered = n_launches * per_launch
     moved = 0
+    pending = []
     t0 = time.perf_counter()
-    done = 0
-    while done < N_OBJECTS:
-        n = min(batch, N_OBJECTS - done)
-        xs = xs0[:n] + np.uint32(done)
-        moved += int(moved_batch(wb, wa, xs))
-        done += n
+    for i in range(n_launches):
+        pending.append(step(w_before, w_after, np.uint32(i * per_launch)))
+        if len(pending) > 2:  # keep 2 launches in flight
+            moved += int(pending.pop(0))
+    moved += sum(int(p) for p in pending)
     dt = time.perf_counter() - t0
-    rate = 2 * N_OBJECTS / dt  # two placements per object per epoch pair
+    rate = 2 * covered / dt  # two placements per object (before/after)
 
-    frac = moved / N_OBJECTS
+    frac = moved / covered
     print(
-        f"rebalance sim: {N_OBJECTS/1e6:.0f}M objects, {FAILED_OSDS} OSDs out -> "
+        f"rebalance sim: {covered/1e6:.0f}M objects, {FAILED_OSDS} OSDs out -> "
         f"{frac:.4%} objects moved (ideal ~{FAILED_OSDS * REPLICAS / N_OSDS:.4%}), "
         f"{dt:.1f} s on {ndev} device(s)",
         file=sys.stderr,
@@ -82,6 +91,8 @@ def main() -> None:
         "value": round(rate),
         "unit": "placements/s",
         "vs_baseline": round(frac, 5),
+        "devices": ndev,
+        "objects": covered,
     }))
 
 

@@ -9,10 +9,13 @@ deviation is within ``max_deviation`` or no further progress.
 
 TPU-native structure: the full-map remap (the expensive part the
 reference runs on the ``ParallelPGMapper`` threadpool) is one device
-batch launch (:mod:`ceph_tpu.osdmap.mapping`), re-run per round with the
-trial upmap tables as *traced inputs* (no recompile); candidate scoring
-is vectorized on host numpy over all (pg, from, to) moves at once
-rather than the reference's per-candidate trial loop.
+batch launch (:mod:`ceph_tpu.osdmap.mapping`), re-run once per round
+with the trial upmap tables as *traced inputs* (no recompile).  Within
+a round, candidate scoring really is vectorized: every (pg, from, to)
+move out of every overfull OSD is scored as numpy array ops
+(:func:`_score_candidate_moves`), then a whole batch of compatible
+moves is accepted greedily against a simulated deviation vector, so
+one device launch validates many moves instead of one launch per move.
 """
 
 from __future__ import annotations
@@ -102,12 +105,84 @@ def expected_pg_share(m: OSDMap, pool: Pool, n_osd: int) -> np.ndarray | None:
     return pool.pg_num * pool.size * cw / total
 
 
+def _score_candidate_moves(
+    up_all: np.ndarray,
+    deviation: np.ndarray,
+    dom: np.ndarray,
+    underfull: np.ndarray,
+    max_deviation: float,
+    n_osd: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Vectorized scoring of every (pg, from, to) candidate move.
+
+    For each PG row the ``from`` is its most-overfull member (the
+    reference empties the most-overfull OSD first); ``to`` ranges over
+    all underfull OSDs.  Returns flat arrays (gain, pg, frm, to) of
+    admissible candidates, unsorted; a candidate is admissible when
+
+    - the move strictly improves balance (gain = dev[frm]-dev[to] > 1),
+    - it addresses an actual violation: frm above +max_deviation or
+      to below -max_deviation (both sides count — an OSD stuck 4 PGs
+      under its share is as unbalanced as one 4 over),
+    - ``to`` is not already in the row, and
+    - ``to``'s failure domain differs from ``frm``'s only if it is not
+      already used by another member (the reference's domain guard).
+    """
+    valid = (up_all != ITEM_NONE) & (up_all >= 0) & (up_all < n_osd)
+    up_c = np.clip(up_all, 0, n_osd - 1)
+    dev_row = np.where(valid, deviation[up_c], -np.inf)  # [P, S]
+    frm_slot = dev_row.argmax(axis=1)  # [P]
+    rows = np.arange(up_all.shape[0])
+    frm = up_c[rows, frm_slot]  # [P]
+    frm_dev = dev_row[rows, frm_slot]  # [P]
+    r_sel = np.nonzero(frm_dev > 0.0)[0]
+    if len(r_sel) == 0 or len(underfull) == 0:
+        empty = np.empty(0, np.int64)
+        return empty.astype(np.float64), empty, empty, empty
+    # bound the [R, S, U] broadcasts below (at 10k-OSD/10k-PG scale an
+    # unbounded R*S*U bool blows past 1 GB): keep the worst rows and the
+    # most-underfull targets — exactly the moves a round would accept
+    MAX_ROWS, MAX_UNDER = 8192, 256
+    if len(r_sel) > MAX_ROWS:
+        worst = np.argsort(-frm_dev[r_sel], kind="stable")[:MAX_ROWS]
+        r_sel = r_sel[worst]
+    if len(underfull) > MAX_UNDER:
+        neediest = np.argsort(deviation[underfull], kind="stable")[:MAX_UNDER]
+        underfull = underfull[neediest]
+    sub_up = up_c[r_sel]  # [R, S]
+    sub_valid = valid[r_sel]
+    sub_frm = frm[r_sel]  # [R]
+    # to already in the row?
+    in_row = (
+        (sub_up[:, :, None] == underfull[None, None, :]) & sub_valid[:, :, None]
+    ).any(axis=1)  # [R, U]
+    # failure-domain guard
+    row_doms = np.where(sub_valid, dom[sub_up], np.int64(-(2**31)))  # [R, S]
+    to_dom = dom[underfull]  # [U]
+    dom_used = (row_doms[:, :, None] == to_dom[None, None, :]).any(axis=1)
+    dom_conflict = dom_used & (to_dom[None, :] != dom[sub_frm][:, None])
+    to_dev = deviation[underfull]  # [U]
+    gain = frm_dev[r_sel][:, None] - to_dev[None, :]  # [R, U]
+    violates = (frm_dev[r_sel][:, None] > max_deviation) | (
+        to_dev[None, :] < -max_deviation
+    )
+    ok = ~in_row & ~dom_conflict & (gain > 1.0) & violates
+    ri, ui = np.nonzero(ok)
+    return (
+        gain[ri, ui],
+        r_sel[ri].astype(np.int64),
+        sub_frm[ri].astype(np.int64),
+        underfull[ui].astype(np.int64),
+    )
+
+
 def calc_pg_upmaps(
     m: OSDMap,
     max_deviation: float = 1.0,
     max_entries: int = 100,
     pools: list[int] | None = None,
     mapping: OSDMapMapping | None = None,
+    max_rounds: int = 16,
 ) -> Incremental:
     """Compute pg_upmap_items moves; returns an Incremental (possibly
     empty).  ``max_deviation`` is in PGs, like the reference's
@@ -141,64 +216,84 @@ def calc_pg_upmaps(
         pool_entries = 0
         trial_items = dict(original_items)
         m.pg_upmap_items = trial_items  # staged; restored below
+        up_vec = np.fromiter(
+            (m.is_up(o) for o in range(n_osd)), bool, count=n_osd
+        )
         try:
-            for _round in range(max_entries):
+            for _round in range(max_rounds):
                 if entries + pool_entries >= max_entries:
                     break
+                # ONE device launch per round re-maps the whole pool
+                # with the trial upmap tables as inputs
                 mapping.update(pool_id)
                 up_all, _, _, _ = mapping._results[pool_id]
                 counts = mapping.pg_counts_by_osd(pool_id, acting=False)
                 deviation = counts - expect
-                if deviation.max() <= max_deviation:
+                # balanced means NO osd beyond +-max_deviation (weightless
+                # devices excluded: they cannot receive PGs)
+                weighted = cw > 0
+                worst = max(
+                    float(deviation[weighted].max(initial=0.0)),
+                    float(-deviation[weighted & up_vec].min(initial=0.0)),
+                )
+                if worst <= max_deviation:
                     break
-                # candidate moves: every pg replica on the most-overfull
-                # osd, to every underfull osd in a compatible domain
-                over = int(np.argmax(deviation))
-                under = np.nonzero((deviation < -1e-9) & (cw > 0))[0]
+                under = np.nonzero((deviation < -1e-9) & (cw > 0) & up_vec)[0]
                 if len(under) == 0:
                     under = np.nonzero(
-                        (deviation < deviation.max() - 1) & (cw > 0)
+                        (deviation < deviation.max() - 1) & (cw > 0) & up_vec
                     )[0]
                 if len(under) == 0:
                     break
-                pgs_on_over = np.nonzero((up_all == over).any(axis=1))[0]
-                best = None  # (gain, pg, frm, to)
-                for ps in pgs_on_over:
-                    row = up_all[ps]
-                    row_valid = row[row != ITEM_NONE]
-                    used_doms = {int(dom[o]) for o in row_valid if o < n_osd}
-                    frm_dom = int(dom[over])
-                    existing = trial_items.get(PGId(pool_id, int(ps)), ())
-                    if len(existing) >= 4:  # keep per-pg item lists short
-                        continue
-                    for to in under:
-                        to = int(to)
-                        if to in row_valid or not m.is_up(to):
-                            continue
-                        to_dom = int(dom[to])
-                        if to_dom != frm_dom and to_dom in used_doms:
-                            continue  # would double up a failure domain
-                        gain = deviation[over] - deviation[to]
-                        if best is None or gain > best[0]:
-                            best = (float(gain), int(ps), over, to)
-                if best is None:
+                gains, pgs, frms, tos = _score_candidate_moves(
+                    up_all, deviation, dom, under, max_deviation, n_osd
+                )
+                if len(gains) == 0:
                     break
-                _, ps, frm, to = best
-                pg = PGId(pool_id, ps)
-                items = list(trial_items.get(pg, ()))
-                # collapse chains: a->b then b->c becomes a->c
-                for idx, (f0, t0) in enumerate(items):
-                    if t0 == frm:
-                        items[idx] = (f0, to)
+                # Greedy batched acceptance against a simulated deviation
+                # vector: each accepted move shifts one PG replica, so
+                # dev[frm] -= 1 and dev[to] += 1.  One move per PG per
+                # round; a move must still help at acceptance time.
+                order = np.argsort(-gains, kind="stable")
+                dev_sim = deviation.copy()
+                pg_touched: set[int] = set()
+                accepted = 0
+                for ci in order:
+                    if entries + pool_entries >= max_entries:
                         break
-                else:
-                    items.append((frm, to))
-                items = [(f, t) for f, t in items if f != t]
-                if items:
-                    trial_items[pg] = tuple(items)
-                else:
-                    trial_items.pop(pg, None)
-                pool_entries += 1
+                    ps, frm, to = int(pgs[ci]), int(frms[ci]), int(tos[ci])
+                    if ps in pg_touched:
+                        continue
+                    if dev_sim[frm] - dev_sim[to] <= 1.0:
+                        continue  # move no longer helps
+                    if (
+                        dev_sim[frm] <= max_deviation
+                        and dev_sim[to] >= -max_deviation
+                    ):
+                        continue  # neither side still violates
+                    pg = PGId(pool_id, ps)
+                    items = list(trial_items.get(pg, ()))
+                    if len(items) >= 4:  # keep per-pg item lists short
+                        continue
+                    # collapse chains: a->b then b->c becomes a->c
+                    for idx, (f0, t0) in enumerate(items):
+                        if t0 == frm:
+                            items[idx] = (f0, to)
+                            break
+                    else:
+                        items.append((frm, to))
+                    items = [(f, t) for f, t in items if f != t]
+                    if items:
+                        trial_items[pg] = tuple(items)
+                    else:
+                        trial_items.pop(pg, None)
+                    pg_touched.add(ps)
+                    dev_sim[frm] -= 1.0
+                    dev_sim[to] += 1.0
+                    pool_entries += 1
+                    accepted += 1
+                if accepted == 0:
+                    break
 
             # validation: trial deviation must not be worse than base
             mapping.update(pool_id)

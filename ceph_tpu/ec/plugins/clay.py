@@ -39,6 +39,9 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax
+import jax.numpy as jnp
+
 from .. import gf
 from ..backend import MatrixCodec
 from ..interface import ErasureCode, ErasureCodeError, Profile
@@ -174,8 +177,30 @@ class ErasureCodeClay(ErasureCode):
             C[i] = np.asarray(buf, np.uint8).reshape(self.sub_chunk_no, sub)
         return C
 
+    def _geometry(self):
+        """Vectorized plane geometry, computed once per codec instance.
+
+        Returns (digits [Z,t], x [n], y [n], partner [n,Z], zpair [n,Z],
+        diag [n,Z], pw [t]) where partner/zpair/diag encode, for every
+        (node, plane), the coupled-pair structure the scalar reference
+        walks one plane at a time.
+        """
+        if not hasattr(self, "_geom"):
+            q, t, n, Z = self.q, self.t, self.n, self.sub_chunk_no
+            pw = q ** (t - 1 - np.arange(t))  # [t]
+            z = np.arange(Z)
+            digits = (z[:, None] // pw[None, :]) % q  # [Z, t]
+            x = np.arange(n) % q
+            y = np.arange(n) // q
+            zy = digits[:, y].T  # [n, Z] — the node-row digit per plane
+            partner = y[:, None] * q + zy  # [n, Z]
+            zpair = z[None, :] + (x[:, None] - zy) * pw[y][:, None]  # [n, Z]
+            diag = zy == x[:, None]  # [n, Z]
+            self._geom = (digits, x, y, partner, zpair, diag, pw)
+        return self._geom
+
     def _pair_invert(self, c1, c2):
-        """(C1, C2) -> (U1, U2) through [[1,g],[g,1]]^-1."""
+        """(C1, C2) -> (U1, U2) through [[1,g],[g,1]]^-1 (vectorized)."""
         g, di = GAMMA, self._det_inv
         mt = gf.mul_table()
         u1 = mt[di][c1 ^ mt[g][c2]]
@@ -185,64 +210,60 @@ class ErasureCodeClay(ErasureCode):
     def _decode_layered(
         self, C: np.ndarray, erased: set[int], sub: int
     ) -> None:
-        """Recover C at erased nodes in place (<= m erasures)."""
-        q, t, n = self.q, self.t, self.n
+        """Recover C at erased nodes in place (<= m erasures).
+
+        Planes are processed in batches by *intersection score*: a
+        plane's erased-partner lookups only ever reference planes of
+        strictly lower score, so all planes of one score class are
+        independent and run as single array ops — the per-class MDS
+        solve is ONE device decode over the [planes*sub] stripe instead
+        of the reference's per-plane scalar loop
+        (``ErasureCodeClay.cc :: decode_layered``).
+        """
+        n = self.n
         mt = gf.mul_table()
+        g, di = GAMMA, self._det_inv
+        digits, _x, _y, partner, zpair, diag, _pw = self._geometry()
+        er = np.zeros(n, bool)
+        er[list(erased)] = True
+        # score[z] = number of grid rows whose dot node is erased
+        node_ids = digits + (np.arange(self.t)[None, :] * self.q)
+        score = er[node_ids].sum(axis=1)  # [Z]
         U = np.zeros_like(C)
-        have_u = np.zeros((n, self.sub_chunk_no), bool)
 
-        def score(z: int) -> int:
-            return sum(
-                1
-                for y in range(t)
-                if self._node(self._digit(z, y), y) in erased
-            )
-
-        order = sorted(range(self.sub_chunk_no), key=score)
-        for z in order:
-            # 1) U at surviving nodes
+        for s in sorted(set(score.tolist())):
+            P = np.nonzero(score == s)[0]
+            # 1) U at surviving nodes, all planes of the class at once
             for node in range(n):
-                if node in erased:
+                if er[node]:
                     continue
-                x, y = self._xy(node)
-                zy = self._digit(z, y)
-                if x == zy:
-                    U[node, z] = C[node, z]
-                    have_u[node, z] = True
-                    continue
-                partner = self._node(zy, y)
-                zpair = self._replace(z, y, x)
-                if partner not in erased:
-                    u1, _ = self._pair_invert(C[node, z], C[partner, zpair])
-                    U[node, z] = u1
-                else:
-                    # partner plane has lower score: its U is complete
-                    assert have_u[partner, zpair]
-                    U[node, z] = C[node, z] ^ mt[GAMMA][U[partner, zpair]]
-                have_u[node, z] = True
-            # 2) MDS-decode the plane's erased U symbols
+                d = diag[node, P][:, None]  # [P, 1]
+                pa = partner[node, P]  # [P]
+                zp = zpair[node, P]  # [P]
+                pe = er[pa][:, None]  # partner-erased mask
+                cn = C[node, P]  # [P, sub]
+                cpart = C[pa, zp]  # garbage rows where partner erased
+                u_pair = mt[di][cn ^ mt[g][cpart]]
+                # partner plane has strictly lower score: U complete
+                u_pe = cn ^ mt[g][U[pa, zp]]
+                U[node, P] = np.where(d, cn, np.where(pe, u_pe, u_pair))
+            # 2) one batched MDS solve for the whole class
             if erased:
                 avail = {
-                    self._base_id(node): U[node, z]
+                    self._base_id(node): U[node, P].reshape(-1)
                     for node in range(n)
-                    if node not in erased
+                    if not er[node]
                 }
                 want = {self._base_id(node) for node in erased}
                 out = self.base.decode(avail, want)
                 for node in erased:
-                    U[node, z] = out[self._base_id(node)]
-                    have_u[node, z] = True
-        # 3) U -> C at erased nodes
+                    U[node, P] = out[self._base_id(node)].reshape(len(P), sub)
+        # 3) U -> C at erased nodes (all planes at once)
         for node in erased:
-            x, y = self._xy(node)
-            for z in range(self.sub_chunk_no):
-                zy = self._digit(z, y)
-                if x == zy:
-                    C[node, z] = U[node, z]
-                else:
-                    partner = self._node(zy, y)
-                    zpair = self._replace(z, y, x)
-                    C[node, z] = U[node, z] ^ mt[GAMMA][U[partner, zpair]]
+            d = diag[node][:, None]
+            pa = partner[node]
+            zp = zpair[node]
+            C[node] = np.where(d, U[node], U[node] ^ mt[g][U[pa, zp]])
 
     # ---- repair-optimal single-node recovery ----
 
@@ -257,12 +278,11 @@ class ErasureCodeClay(ErasureCode):
         ``helper_subchunks[i][z]`` = helper i's sub-chunk for plane z.
         Returns the full reconstructed chunk (q^t sub-chunks).
         """
-        q, t, n = self.q, self.t, self.n
-        mt = gf.mul_table()
+        n = self.n
         x0, y0 = self._xy(lost)
-        planes = [
-            z for z in range(self.sub_chunk_no) if self._digit(z, y0) == x0
-        ]
+        digits, xv, yv, _partner, _zpair, _diag, _pw = self._geometry()
+        planes = np.nonzero(digits[:, y0] == x0)[0]  # [P] repair planes
+        npl = len(planes)
         real = set(range(self.k + self.m))
         helpers = set(helper_subchunks)
         if helpers != real - {lost}:
@@ -272,53 +292,92 @@ class ErasureCodeClay(ErasureCode):
             )
         sub = len(next(iter(helper_subchunks[next(iter(helpers))].values())))
 
-        def cval(node: int, z: int) -> np.ndarray:
-            if node >= self.k + self.m:  # virtual: zero
-                return np.zeros(sub, np.uint8)
-            return helper_subchunks[node][z]
+        # helper sub-chunks on the repair planes; virtual nodes are zero
+        Cp = np.zeros((n, npl, sub), np.uint8)
+        for i in helpers:
+            Cp[i] = np.stack([helper_subchunks[i][int(z)] for z in planes])
 
-        # U on the repair planes
-        U = {}
-        for z in planes:
-            unknowns = set()
-            for node in range(n):
-                x, y = self._xy(node)
-                if node == lost or (y == y0 and x != x0):
-                    unknowns.add(node)
-                    continue
-                zy = self._digit(z, y)
-                if x == zy:
-                    U[(node, z)] = cval(node, z)
-                else:
-                    partner = self._node(zy, y)
-                    zpair = self._replace(z, y, x)
-                    # partner is never the lost node (y != y0 here) and
-                    # zpair stays in the repair set (y0 digit unchanged)
-                    u1, _ = self._pair_invert(cval(node, z), cval(partner, zpair))
-                    U[(node, z)] = u1
-            avail = {
-                self._base_id(node): U[(node, z)]
-                for node in range(n)
-                if node not in unknowns
-            }
-            want = {self._base_id(node) for node in unknowns}
-            out = self.base.decode(avail, want)
-            for node in unknowns:
-                U[(node, z)] = out[self._base_id(node)]
+        # unknown nodes: the whole grid row y0 (incl. virtual columns)
+        unknown = np.zeros(n, bool)
+        unknown[lost] = True
+        unknown[(yv == y0) & (xv != x0)] = True
+        known = np.nonzero(~unknown)[0]
 
-        # reconstruct the lost chunk
-        out = np.zeros((self.sub_chunk_no, sub), np.uint8)
-        for z in range(self.sub_chunk_no):
-            zy0 = self._digit(z, y0)
-            if zy0 == x0:
-                out[z] = U[(lost, z)]
-            else:
-                xp = zy0  # partner column
-                partner = self._node(xp, y0)
-                zpair = self._replace(z, y0, x0)  # in the repair set
-                # partner's pair equation at plane zpair reveals U(lost, z)
-                u_lost = mt[self._ginv][
-                    cval(partner, zpair) ^ U[(partner, zpair)]
-                ]
-                out[z] = u_lost ^ mt[GAMMA][U[(partner, zpair)]]
-        return out.reshape(-1)
+        u_known_fn, rebuild_fn = self._repair_kernels(lost)
+
+        # U at known nodes, all repair planes in one device op; the
+        # partner of a known node is never in row y0 (y != y0 there) and
+        # its pair plane keeps the y0 digit, so it stays in the repair set
+        U = np.zeros((n, npl, sub), np.uint8)
+        U[known] = np.asarray(u_known_fn(jnp.asarray(Cp)))
+
+        # one batched MDS solve over all repair planes
+        avail = {
+            self._base_id(node): U[node].reshape(-1)
+            for node in known
+        }
+        want = {self._base_id(node) for node in np.nonzero(unknown)[0]}
+        solved = self.base.decode(avail, want)
+        for node in np.nonzero(unknown)[0]:
+            U[node] = solved[self._base_id(node)].reshape(npl, sub)
+
+        # reconstruct the lost chunk over the full plane space (device)
+        out = np.asarray(rebuild_fn(jnp.asarray(Cp), jnp.asarray(U)))
+        return np.ascontiguousarray(out.reshape(-1))
+
+    def _repair_kernels(self, lost: int):
+        """Jitted device kernels for the repair hot path, cached per
+        lost node: (u_known [n,P,sub]<-Cp, rebuild [Z,sub]<-(Cp,U))."""
+        if not hasattr(self, "_repair_fns"):
+            self._repair_fns = {}
+        if lost in self._repair_fns:
+            return self._repair_fns[lost]
+        n, Z = self.n, self.sub_chunk_no
+        mt = gf.mul_table()
+        x0, y0 = self._xy(lost)
+        digits, xv, yv, partner, zpair, diag, pw = self._geometry()
+        planes = np.nonzero(digits[:, y0] == x0)[0]
+        pos = np.full(Z, -1)
+        pos[planes] = np.arange(len(planes))
+        unknown = np.zeros(n, bool)
+        unknown[lost] = True
+        unknown[(yv == y0) & (xv != x0)] = True
+        known = np.nonzero(~unknown)[0]
+
+        tab_g = jnp.asarray(mt[GAMMA])
+        tab_di = jnp.asarray(mt[self._det_inv])
+        tab_gi = jnp.asarray(mt[self._ginv])
+        d_mask = jnp.asarray(diag[known][:, planes][..., None])
+        pa = jnp.asarray(partner[known][:, planes])
+        pz = jnp.asarray(pos[zpair[known][:, planes]])
+        known_j = jnp.asarray(known)
+
+        @jax.jit
+        def u_known_fn(Cp):
+            cn = Cp[known_j]  # [K, P, sub]
+            cpart = Cp[pa, pz]  # [K, P, sub]
+            i32 = jnp.int32
+            u_pair = jnp.take(
+                tab_di, (cn ^ jnp.take(tab_g, cpart.astype(i32))).astype(i32)
+            )
+            return jnp.where(d_mask, cn, u_pair)
+
+        zy0 = digits[:, y0]
+        partner0 = jnp.asarray(y0 * self.q + zy0)
+        pidx = jnp.asarray(pos[np.arange(Z) + (x0 - zy0) * pw[y0]])
+        on_diag_idx = jnp.asarray(np.maximum(pos, 0))
+        diag_mask = jnp.asarray((zy0 == x0)[:, None])
+
+        @jax.jit
+        def rebuild_fn(Cp, U):
+            i32 = jnp.int32
+            u_pz = U[partner0, pidx]  # [Z, sub]
+            c_pz = Cp[partner0, pidx]
+            # partner's pair equation at plane zpair reveals U(lost, z)
+            u_lost = jnp.take(tab_gi, (c_pz ^ u_pz).astype(i32))
+            off_diag = u_lost ^ jnp.take(tab_g, u_pz.astype(i32))
+            on_diag = U[lost, on_diag_idx]
+            return jnp.where(diag_mask, on_diag, off_diag)
+
+        self._repair_fns[lost] = (u_known_fn, rebuild_fn)
+        return self._repair_fns[lost]

@@ -17,7 +17,28 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+
+try:  # jax >= 0.8 top-level API; experimental path for older jax
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+import inspect
+
+# the replication/vma checker rejects our kernels (they mix unvarying
+# loop constants with psum-reduced outputs); its kwarg name differs
+# across jax versions, so probe the signature once at import
+_CHECK_KW = (
+    "check_vma"
+    if "check_vma" in inspect.signature(_shard_map).parameters
+    else "check_rep"
+)
+
+
+def shard_map(f, **kw):
+    """shard_map with replication/vma checking off."""
+    return _shard_map(f, **{_CHECK_KW: False}, **kw)
 
 from ceph_tpu.crush.interp import StaticCrushMap, compile_rule
 from ceph_tpu.crush.map import ITEM_NONE, Rule
@@ -59,11 +80,70 @@ def sharded_placement_step(
         mesh=mesh,
         in_specs=(P(), P(), P(axis)),
         out_specs=(P(axis), P(axis), P()),
-        check_rep=False,
     )
 
     @jax.jit
     def step(osd_weight, xs):
         return sharded(smap, jnp.asarray(osd_weight, jnp.uint32), jnp.asarray(xs, jnp.uint32))
+
+    return step
+
+
+def sharded_rebalance_sim(
+    mesh: Mesh,
+    smap: StaticCrushMap,
+    rule: Rule,
+    result_max: int,
+    chunk: int,
+    n_chunks: int,
+    axis: str = "objects",
+):
+    """Build the fused rebalance-sim step: one launch streams the whole
+    object space (BASELINE config 5).
+
+    Each device scans ``n_chunks`` chunks of ``chunk`` synthetic object
+    seeds (``lax.scan`` keeps HBM flat: only the running moved-count
+    survives a chunk), places each seed under the before- and after-
+    failure weight vectors, and the global moved total is psum-reduced
+    over the mesh.  Covers ``n_devices * chunk * n_chunks`` objects with
+    zero host->device traffic — the seeds are generated on device.
+
+    Returns jitted ``f(w_before, w_after, start) -> moved`` (global).
+    """
+    run = compile_rule(smap, rule, result_max)
+
+    def local(smap_, wb, wa, start):
+        dev = jax.lax.axis_index(axis).astype(jnp.uint32)
+        base = start + dev * np.uint32(chunk * n_chunks)
+
+        def body(moved, k):
+            xs = base + k.astype(jnp.uint32) * np.uint32(chunk) + jax.lax.iota(
+                jnp.uint32, chunk
+            )
+            rb, _ = jax.vmap(lambda x: run(smap_, wb, x))(xs)
+            ra, _ = jax.vmap(lambda x: run(smap_, wa, x))(xs)
+            moved += jnp.sum(jnp.any(rb != ra, axis=1).astype(jnp.int64))
+            return moved, None
+
+        moved, _ = jax.lax.scan(
+            body, jnp.asarray(0, jnp.int64), jnp.arange(n_chunks)
+        )
+        return jax.lax.psum(moved, axis)
+
+    sharded = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P()),
+        out_specs=P(),
+    )
+
+    @jax.jit
+    def step(w_before, w_after, start):
+        return sharded(
+            smap,
+            jnp.asarray(w_before, jnp.uint32),
+            jnp.asarray(w_after, jnp.uint32),
+            jnp.asarray(start, jnp.uint32),
+        )
 
     return step
