@@ -62,14 +62,14 @@ def _device_measure() -> None:
     import jax
     import jax.numpy as jnp
 
-    from ceph_tpu.crush.interp import StaticCrushMap, batch_runner
+    from ceph_tpu.crush.engine import make_batch_runner
     from ceph_tpu.models.clusters import build_simple
 
     m = build_simple(N_OSDS)
     rule = m.rule_by_name("replicated_rule")
-    smap = StaticCrushMap(m.to_dense())
-    osd_weight = jnp.full((smap.max_devices,), 0x10000, jnp.uint32)
-    batch = batch_runner(smap, rule, REPLICAS)
+    dense = m.to_dense()
+    osd_weight = jnp.full((dense.max_devices,), 0x10000, jnp.uint32)
+    crush_arg, batch = make_batch_runner(dense, rule, REPLICAS)
 
     platform = jax.devices()[0].platform
     if platform == "cpu":
@@ -86,11 +86,11 @@ def _device_measure() -> None:
     for n in sizes:
         try:
             xs = jnp.arange(n, dtype=jnp.uint32)
-            jax.block_until_ready(batch(smap, osd_weight, xs))  # compile+warm
+            jax.block_until_ready(batch(crush_arg, osd_weight, xs))  # compile+warm
             t0 = time.perf_counter()
             for i in range(iters):
                 jax.block_until_ready(
-                    batch(smap, osd_weight, xs + np.uint32(i + 1))
+                    batch(crush_arg, osd_weight, xs + np.uint32(i + 1))
                 )
             dt = (time.perf_counter() - t0) / iters
             rate = n / dt

@@ -23,15 +23,14 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    from ceph_tpu.crush.interp import StaticCrushMap, compile_rule
+    from ceph_tpu.crush.engine import make_batch_runner
     from ceph_tpu.models.clusters import build_simple
     from ceph_tpu.testing import cppref
 
     m = build_simple(N_OSDS)
     rule = m.rule_by_name("replicated_rule")
     dense = m.to_dense()
-    smap = StaticCrushMap(dense)
-    osd_weight_np = np.full(smap.max_devices, 0x10000, np.uint32)
+    osd_weight_np = np.full(dense.max_devices, 0x10000, np.uint32)
 
     steps = [(s.op, s.arg1, s.arg2) for s in rule.steps]
     xs_cpu = np.arange(CPU_SAMPLE, dtype=np.uint32)
@@ -39,11 +38,10 @@ def main() -> None:
     cppref.do_rule_batch(dense, steps, xs_cpu, osd_weight_np, REPLICAS)
     cpu_rate = CPU_SAMPLE / (time.perf_counter() - t0)
 
-    run = compile_rule(smap, rule, REPLICAS)
+    crush_arg, run = make_batch_runner(dense, rule, REPLICAS)
 
-    @jax.jit
     def batch(osd_weight, xs):
-        return jax.vmap(lambda x: run(smap, osd_weight, x))(xs)
+        return run(crush_arg, osd_weight, xs)
 
     osd_weight = jnp.asarray(osd_weight_np)
     xs = jnp.arange(N_OBJECTS, dtype=jnp.uint32)

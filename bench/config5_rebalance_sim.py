@@ -38,13 +38,12 @@ def main() -> None:
 
     import jax
 
-    from ceph_tpu.crush.interp import StaticCrushMap
     from ceph_tpu.models.clusters import build_simple
     from ceph_tpu.parallel.placement import make_mesh, sharded_rebalance_sim
 
     m = build_simple(N_OSDS, osds_per_host=8, hosts_per_rack=16)
     rule = m.rule_by_name("replicated_rule")
-    smap = StaticCrushMap(m.to_dense())
+    dense = m.to_dense()
     mesh = make_mesh()
     ndev = len(mesh.devices.reshape(-1))
 
@@ -54,10 +53,10 @@ def main() -> None:
     chunks_per_launch = 8
     per_launch = ndev * CHUNK * chunks_per_launch
     step = sharded_rebalance_sim(
-        mesh, smap, rule, REPLICAS, CHUNK, chunks_per_launch
+        mesh, dense, rule, REPLICAS, CHUNK, chunks_per_launch
     )
 
-    w_before = np.full(smap.max_devices, 0x10000, np.uint32)
+    w_before = np.full(dense.max_devices, 0x10000, np.uint32)
     w_after = w_before.copy()
     failed = np.random.default_rng(0).choice(N_OSDS, FAILED_OSDS, replace=False)
     w_after[failed] = 0

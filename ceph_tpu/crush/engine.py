@@ -1,0 +1,53 @@
+"""Engine dispatch: pick the fastest CRUSH batch executor for a map+rule.
+
+Two device engines implement identical placement semantics (upstream
+``src/crush/mapper.c :: crush_do_rule``):
+
+- :mod:`ceph_tpu.crush.interp_batch` — level-synchronous, one-hot-MXU
+  engine (the fast path; straw2 maps with modern tunables), and
+- :mod:`ceph_tpu.crush.interp` — the general ``vmap`` engine (uniform
+  buckets, legacy shapes).
+
+Callers that just want "run this rule for a batch of x" should go
+through :func:`make_batch_runner` / :func:`run_batch` so they get the
+fast path whenever the map qualifies.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import interp, interp_batch
+from .map import DenseCrushMap, Rule
+
+
+def make_batch_runner(dense: DenseCrushMap, rule: Rule, result_max: int):
+    """Return ``(crush_arg, fn)`` with ``fn(crush_arg, osd_weight, xs)
+    -> (results [n, result_max] i32, lens [n] i32)``.
+
+    ``crush_arg`` is a pytree of device arrays (per-level packs for the
+    fast engine, the dense map for the general one); it is a traced
+    argument of ``fn``, so maps sharing topology shape reuse compiled
+    programs.
+    """
+    if interp_batch.supports(dense, rule):
+        return interp_batch.fast_runner(dense, rule, result_max)
+    smap = interp.StaticCrushMap(dense)
+    return smap, interp.batch_runner(smap, rule, result_max)
+
+
+def runner_signature(dense: DenseCrushMap, rule: Rule, result_max: int) -> tuple:
+    """Hashable static signature of the program make_batch_runner would
+    build — equal signatures share one compiled executable."""
+    if interp_batch.supports(dense, rule):
+        return ("fast",) + interp_batch.fast_signature(dense, rule, result_max)
+    smap = interp.StaticCrushMap(dense)
+    return ("vmap", interp.smap_signature(smap),
+            interp.rule_signature(rule), result_max)
+
+
+def run_batch(dense: DenseCrushMap, rule: Rule, xs, osd_weight, result_max: int):
+    """One-shot batched rule execution on the best engine."""
+    crush_arg, fn = make_batch_runner(dense, rule, result_max)
+    return fn(crush_arg, jnp.asarray(osd_weight, jnp.uint32),
+              jnp.asarray(xs, jnp.uint32))

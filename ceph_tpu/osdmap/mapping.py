@@ -20,13 +20,8 @@ import jax
 import jax.numpy as jnp
 
 from ..core.hashes import ceph_stable_mod, crush_hash32_2
-from ..crush.interp import (
-    StaticCrushMap,
-    _memo_put,
-    compile_rule,
-    rule_signature,
-    smap_signature,
-)
+from ..crush.engine import make_batch_runner, runner_signature
+from ..crush.interp import _memo_put
 from ..crush.map import ITEM_NONE
 from .map import (
     DEFAULT_PRIMARY_AFFINITY,
@@ -166,8 +161,9 @@ def _compact_left(row, valid):
 _POOL_FN_CACHE: dict = {}
 
 
-def compile_pool_mapping(smap: StaticCrushMap, pool: Pool, rule):
-    """Build ``fn(smap, state, pg_indices) -> (up, up_primary, acting,
+def compile_pool_mapping(dense, pool: Pool, rule):
+    """Build the pool mapping program; returns ``(crush_arg, fn)`` with
+    ``fn(crush_arg, state, pg_indices) -> (up, up_primary, acting,
     acting_primary)``.
 
     ``pg_indices`` are folded PG seeds (0..pg_num-1); outputs are
@@ -176,26 +172,28 @@ def compile_pool_mapping(smap: StaticCrushMap, pool: Pool, rule):
     _raw_to_up_osds -> _pick_primary -> _apply_primary_affinity ->
     _get_temp_osds`` (upstream ``src/osd/OSDMap.cc``).
 
-    The program depends only on static structure (map shapes, tunables,
-    rule steps, pool constants); map/state arrays are traced arguments.
-    Compiled programs are memoized process-wide — tracing these deep
-    masked loops costs seconds, so equal-signature calls must not
-    re-trace.
+    The CRUSH stage runs whole-batch on the best available engine
+    (:func:`ceph_tpu.crush.engine.make_batch_runner` — the one-hot-MXU
+    level-synchronous path for straw2 maps); the per-PG post-processing
+    is vmapped over the batch.  The program depends only on static
+    structure (map shapes, tunables, rule steps, pool constants);
+    map/state arrays are traced arguments.  Compiled programs are
+    memoized process-wide — tracing costs seconds, so equal-signature
+    calls must not re-trace.
     """
     key = (
-        smap_signature(smap),
-        rule_signature(rule),
+        runner_signature(dense, rule, pool.size),
         pool.id,
         pool.size,
         pool.pgp_num,
         pool.hashpspool,
         pool.can_shift_osds(),
     )
+    crush_arg, crush_fn = make_batch_runner(dense, rule, pool.size)
     cached = _POOL_FN_CACHE.get(key)
     if cached is not None:
-        return cached
+        return crush_arg, cached
     size = pool.size
-    run = compile_rule(smap, rule, size)
     pool_id = np.uint32(pool.id)
     pgp_num = np.uint32(pool.pgp_num)
     pgp_mask = np.uint32(pool.pgp_num_mask)
@@ -204,15 +202,9 @@ def compile_pool_mapping(smap: StaticCrushMap, pool: Pool, rule):
     def in_range(o, n_osd):
         return (o >= 0) & (o < n_osd)
 
-    def map_one(smap, state: PoolMapState, ps):
+    def post_one(state: PoolMapState, ps, pps, raw):
+        """Everything after the CRUSH stage, for one PG row."""
         n_osd = state.osd_weight.shape[0]
-        ps = jnp.asarray(ps, U32)
-        folded = ceph_stable_mod(ps, pgp_num, pgp_mask)
-        if pool.hashpspool:
-            pps = crush_hash32_2(folded, pool_id)
-        else:
-            pps = folded + pool_id
-        raw, _raw_len = run(smap, state.osd_weight, pps)
 
         # ---- _apply_upmap ----
         psi = ps.astype(I32)
@@ -306,11 +298,20 @@ def compile_pool_mapping(smap: StaticCrushMap, pool: Pool, rule):
         return up, up_primary, acting, acting_primary
 
     @jax.jit
-    def fn(smap, state: PoolMapState, pg_indices):
-        return jax.vmap(lambda ps: map_one(smap, state, ps))(pg_indices)
+    def fn(crush_arg, state: PoolMapState, pg_indices):
+        ps = jnp.asarray(pg_indices, U32)
+        folded = ceph_stable_mod(ps, pgp_num, pgp_mask)
+        if pool.hashpspool:
+            pps = crush_hash32_2(folded, pool_id)
+        else:
+            pps = folded + pool_id
+        raw, _raw_len = crush_fn(crush_arg, state.osd_weight, pps)
+        return jax.vmap(
+            lambda ps_, pps_, raw_: post_one(state, ps_, pps_, raw_)
+        )(ps, pps, raw)
 
     _memo_put(_POOL_FN_CACHE, key, fn)
-    return fn
+    return crush_arg, fn
 
 
 class OSDMapMapping:
@@ -340,9 +341,10 @@ class OSDMapMapping:
         )
         cached = self._fns.get(pool.id)
         if cached is None or cached[0] != fp:
-            smap = StaticCrushMap(self.osdmap.crush.to_dense())
+            dense = self.osdmap.crush.to_dense()
             rule = self.osdmap.crush.rules[pool.crush_rule]
-            cached = (fp, smap, compile_pool_mapping(smap, pool, rule))
+            crush_arg, fn = compile_pool_mapping(dense, pool, rule)
+            cached = (fp, crush_arg, fn)
             self._fns[pool.id] = cached
         return cached[1], cached[2]
 
@@ -354,10 +356,12 @@ class OSDMapMapping:
             else list(self.osdmap.pools.values())
         )
         for pool in pools:
-            smap, fn = self._fn_for(pool)
+            crush_arg, fn = self._fn_for(pool)
             state = build_pool_state(self.osdmap, pool, self.max_items)
             pgs = jnp.arange(pool.pg_num, dtype=jnp.uint32)
-            up, upp, acting, actp = jax.block_until_ready(fn(smap, state, pgs))
+            up, upp, acting, actp = jax.block_until_ready(
+                fn(crush_arg, state, pgs)
+            )
             self._results[pool.id] = (
                 np.asarray(up),
                 np.asarray(upp),

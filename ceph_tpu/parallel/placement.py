@@ -40,8 +40,8 @@ def shard_map(f, **kw):
     """shard_map with replication/vma checking off."""
     return _shard_map(f, **{_CHECK_KW: False}, **kw)
 
-from ceph_tpu.crush.interp import StaticCrushMap, compile_rule
-from ceph_tpu.crush.map import ITEM_NONE, Rule
+from ceph_tpu.crush.engine import make_batch_runner
+from ceph_tpu.crush.map import DenseCrushMap, ITEM_NONE, Rule
 
 
 def make_mesh(n_devices: int | None = None, axis: str = "objects") -> Mesh:
@@ -54,7 +54,7 @@ def make_mesh(n_devices: int | None = None, axis: str = "objects") -> Mesh:
 
 def sharded_placement_step(
     mesh: Mesh,
-    smap: StaticCrushMap,
+    dense: DenseCrushMap,
     rule: Rule,
     result_max: int,
     axis: str = "objects",
@@ -64,12 +64,14 @@ def sharded_placement_step(
     ``xs`` is the global object-seed batch, sharded along the mesh;
     results come back with the same sharding; the per-OSD histogram is
     psum-reduced across chips so every chip holds the global tally.
+    The CRUSH stage uses the best engine for the map (one-hot-MXU
+    level-synchronous path for straw2 maps).
     """
-    run = compile_rule(smap, rule, result_max)
-    n_osds = smap.max_devices
+    crush_arg, run = make_batch_runner(dense, rule, result_max)
+    n_osds = dense.max_devices
 
-    def local_step(smap_, osd_weight, xs):
-        results, lens = jax.vmap(lambda x: run(smap_, osd_weight, x))(xs)
+    def local_step(crush_, osd_weight, xs):
+        results, lens = run(crush_, osd_weight, xs)
         chosen = jnp.where(results == ITEM_NONE, n_osds, results)
         hist = jnp.zeros((n_osds + 1,), jnp.int32).at[chosen.reshape(-1)].add(1)
         hist = jax.lax.psum(hist, axis)
@@ -84,14 +86,18 @@ def sharded_placement_step(
 
     @jax.jit
     def step(osd_weight, xs):
-        return sharded(smap, jnp.asarray(osd_weight, jnp.uint32), jnp.asarray(xs, jnp.uint32))
+        return sharded(
+            crush_arg,
+            jnp.asarray(osd_weight, jnp.uint32),
+            jnp.asarray(xs, jnp.uint32),
+        )
 
     return step
 
 
 def sharded_rebalance_sim(
     mesh: Mesh,
-    smap: StaticCrushMap,
+    dense: DenseCrushMap,
     rule: Rule,
     result_max: int,
     chunk: int,
@@ -110,9 +116,9 @@ def sharded_rebalance_sim(
 
     Returns jitted ``f(w_before, w_after, start) -> moved`` (global).
     """
-    run = compile_rule(smap, rule, result_max)
+    crush_arg, run = make_batch_runner(dense, rule, result_max)
 
-    def local(smap_, wb, wa, start):
+    def local(crush_, wb, wa, start):
         dev = jax.lax.axis_index(axis).astype(jnp.uint32)
         base = start + dev * np.uint32(chunk * n_chunks)
 
@@ -120,8 +126,8 @@ def sharded_rebalance_sim(
             xs = base + k.astype(jnp.uint32) * np.uint32(chunk) + jax.lax.iota(
                 jnp.uint32, chunk
             )
-            rb, _ = jax.vmap(lambda x: run(smap_, wb, x))(xs)
-            ra, _ = jax.vmap(lambda x: run(smap_, wa, x))(xs)
+            rb, _ = run(crush_, wb, xs)
+            ra, _ = run(crush_, wa, xs)
             moved += jnp.sum(jnp.any(rb != ra, axis=1).astype(jnp.int64))
             return moved, None
 
@@ -140,7 +146,7 @@ def sharded_rebalance_sim(
     @jax.jit
     def step(w_before, w_after, start):
         return sharded(
-            smap,
+            crush_arg,
             jnp.asarray(w_before, jnp.uint32),
             jnp.asarray(w_after, jnp.uint32),
             jnp.asarray(start, jnp.uint32),

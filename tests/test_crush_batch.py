@@ -1,0 +1,316 @@
+"""Differential tests for the level-synchronous batch CRUSH engine.
+
+The fast engine (``crush.interp_batch``) must be lane-for-lane identical
+to the vmap engine (``crush.interp``, itself pinned to the C++ reference
+by test_crush_differential) on every supported map/rule, and identical
+to the C++ reference directly on the rule shapes only the fast engine
+runs on device (multi-TAKE chains, chained chooses — upstream
+``src/crush/mapper.c :: crush_do_rule`` working-vector loop).
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush import interp_batch
+from ceph_tpu.crush.engine import make_batch_runner, run_batch
+from ceph_tpu.crush.interp import StaticCrushMap, batch_do_rule
+from ceph_tpu.crush.interp_batch import batch_do_rule_fast, supports
+from ceph_tpu.crush.map import (
+    ALG_STRAW2,
+    CrushMap,
+    OP_CHOOSE_FIRSTN,
+    OP_CHOOSELEAF_FIRSTN,
+    OP_CHOOSE_INDEP,
+    OP_EMIT,
+    OP_TAKE,
+    Step,
+)
+from ceph_tpu.models.clusters import build_flat, build_hierarchy, build_simple
+from ceph_tpu.testing import cppref
+
+RNG = np.random.default_rng(1234)
+N = 2048
+
+
+def _assert_match_vmap(m, rule_name, result_max, osd_weight=None, n=N):
+    rule = m.rule_by_name(rule_name)
+    dense = m.to_dense()
+    assert supports(dense, rule)
+    if osd_weight is None:
+        osd_weight = np.full(dense.max_devices, 0x10000, np.uint32)
+    xs = RNG.integers(0, 1 << 32, n, dtype=np.uint32)
+    r_old, l_old = batch_do_rule(
+        StaticCrushMap(dense), rule, xs, osd_weight, result_max
+    )
+    r_new, l_new = batch_do_rule_fast(dense, rule, xs, osd_weight, result_max)
+    np.testing.assert_array_equal(np.asarray(r_old), np.asarray(r_new))
+    np.testing.assert_array_equal(np.asarray(l_old), np.asarray(l_new))
+
+
+def _assert_match_cpp(m, rule, result_max, osd_weight=None, n=N):
+    dense = m.to_dense()
+    assert supports(dense, rule)
+    if osd_weight is None:
+        osd_weight = np.full(dense.max_devices, 0x10000, np.uint32)
+    xs = RNG.integers(0, 1 << 32, n, dtype=np.uint32)
+    steps = [(s.op, s.arg1, s.arg2) for s in rule.steps]
+    r_ref, l_ref = cppref.do_rule_batch(dense, steps, xs, osd_weight, result_max)
+    r_new, l_new = batch_do_rule_fast(dense, rule, xs, osd_weight, result_max)
+    np.testing.assert_array_equal(r_ref, np.asarray(r_new))
+    np.testing.assert_array_equal(l_ref, np.asarray(l_new))
+
+
+def test_simple_replicated():
+    _assert_match_vmap(build_simple(64), "replicated_rule", 3)
+
+
+def test_flat_choose_osd():
+    _assert_match_vmap(build_flat(32), "replicated_rule", 3)
+
+
+def test_hierarchy_replicated():
+    m = build_hierarchy([("rack", 3), ("host", 4)], 4)
+    _assert_match_vmap(m, "replicated_rule", 3)
+
+
+def test_erasure_indep():
+    m = build_simple(48)
+    m.make_erasure_rule("erasure_rule", "default", "host")
+    _assert_match_vmap(m, "erasure_rule", 6)
+
+
+def test_skewed_weights():
+    m = build_simple(64)
+    for bid, b in list(m.buckets.items()):
+        if b.type_id == 3:  # host rows
+            for item in list(b.items):
+                if RNG.random() < 0.4:
+                    m.adjust_item_weight(
+                        bid, item, int(0x4000 + RNG.integers(0, 0x30000))
+                    )
+    _assert_match_vmap(m, "replicated_rule", 3)
+
+
+def test_osd_weight_outs_and_reweights():
+    m = build_simple(64)
+    w = np.full(m.to_dense().max_devices, 0x10000, np.uint32)
+    w[RNG.integers(0, 64, 8)] = 0
+    w[RNG.integers(0, 64, 8)] = 0x8000
+    _assert_match_vmap(m, "replicated_rule", 3, osd_weight=w)
+
+
+def _two_root_map():
+    """ssd + hdd roots, separate hosts (the shadow-tree shape device
+    classes compile to)."""
+    m = CrushMap()
+    m.add_type(1, "root")
+    m.add_type(2, "host")
+    osd = 0
+    roots = {}
+    for cls in ("ssd", "hdd"):
+        root = m.add_bucket(f"{cls}root", "root", alg=ALG_STRAW2)
+        roots[cls] = root
+        for h in range(4):
+            host = m.add_bucket(f"{cls}host{h}", "host", alg=ALG_STRAW2)
+            hw = 0
+            for _ in range(2):
+                m.insert_item(host.id, osd, 0x10000)
+                hw += 0x10000
+                osd += 1
+            m.insert_item(root.id, host.id, hw)
+    return m, roots
+
+
+def test_multi_take_two_roots_vs_cpp():
+    """take ssd; chooseleaf 1 host; emit; take hdd; chooseleaf 2 host;
+    emit — the chained-TAKE ladder (VERDICT round-2 missing item)."""
+    m, roots = _two_root_map()
+    steps = [
+        Step(OP_TAKE, roots["ssd"].id),
+        Step(OP_CHOOSELEAF_FIRSTN, 1, m.type_id("host")),
+        Step(OP_EMIT),
+        Step(OP_TAKE, roots["hdd"].id),
+        Step(OP_CHOOSELEAF_FIRSTN, 2, m.type_id("host")),
+        Step(OP_EMIT),
+    ]
+    rule = m.add_rule("hybrid", steps)
+    _assert_match_cpp(m, rule, 3)
+
+
+def test_multi_take_choose_osd_vs_cpp():
+    m, roots = _two_root_map()
+    steps = [
+        Step(OP_TAKE, roots["ssd"].id),
+        Step(OP_CHOOSE_FIRSTN, 2, 0),
+        Step(OP_EMIT),
+        Step(OP_TAKE, roots["hdd"].id),
+        Step(OP_CHOOSE_FIRSTN, 1, 0),
+        Step(OP_EMIT),
+    ]
+    rule = m.add_rule("hybrid2", steps)
+    _assert_match_cpp(m, rule, 3)
+
+
+def test_chained_choose_rack_then_leaf_vs_cpp():
+    """choose 2 racks, then chooseleaf 2 hosts under each (the classic
+    wide-then-deep chained rule)."""
+    m = build_hierarchy([("rack", 4), ("host", 4)], 2)
+    root_id = m.bucket_by_name("default").id
+    steps = [
+        Step(OP_TAKE, root_id),
+        Step(OP_CHOOSE_FIRSTN, 2, m.type_id("rack")),
+        Step(OP_CHOOSELEAF_FIRSTN, 2, m.type_id("host")),
+        Step(OP_EMIT),
+    ]
+    rule = m.add_rule("wide_deep", steps)
+    _assert_match_cpp(m, rule, 4)
+
+
+def test_chained_choose_indep_vs_cpp():
+    m = build_hierarchy([("rack", 4), ("host", 4)], 2)
+    root_id = m.bucket_by_name("default").id
+    steps = [
+        Step(OP_TAKE, root_id),
+        Step(OP_CHOOSE_INDEP, 2, m.type_id("rack")),
+        Step(OP_CHOOSE_INDEP, 2, m.type_id("host")),
+        Step(OP_EMIT),
+    ]
+    rule = m.add_rule("indep_chain", steps)
+    _assert_match_cpp(m, rule, 4)
+
+
+def test_chained_choose_stable0_vs_cpp():
+    """stable=0 profiles seed the leaf recursion with the entry-LOCAL
+    outpos (reference passes outpos=0 per working entry) — regression
+    for the shared-segment bug found in review."""
+    from ceph_tpu.crush.map import Tunables
+
+    m = build_hierarchy(
+        [("rack", 4), ("host", 4)], 2, tunables=Tunables.profile("firefly")
+    )
+    root_id = m.bucket_by_name("default").id
+    steps = [
+        Step(OP_TAKE, root_id),
+        Step(OP_CHOOSE_FIRSTN, 2, m.type_id("rack")),
+        Step(OP_CHOOSELEAF_FIRSTN, 2, m.type_id("host")),
+        Step(OP_EMIT),
+    ]
+    rule = m.add_rule("wide_deep_f", steps)
+    _assert_match_cpp(m, rule, 4)
+
+
+def test_chained_indep_with_holes_vs_cpp():
+    """INDEP holes (ITEM_NONE >= 0) are skipped by the next choose and
+    later entries compact left (reference's per-entry osize bump)."""
+    m = build_hierarchy([("rack", 2), ("host", 3)], 2)
+    root_id = m.bucket_by_name("default").id
+    steps = [
+        Step(OP_TAKE, root_id),
+        # 3 rack slots over 2 racks: one positional hole guaranteed
+        Step(OP_CHOOSE_INDEP, 3, m.type_id("rack")),
+        Step(OP_CHOOSE_INDEP, 2, m.type_id("host")),
+        Step(OP_EMIT),
+    ]
+    rule = m.add_rule("holey", steps)
+    _assert_match_cpp(m, rule, 6)
+
+
+def test_compile_cache_distinguishes_same_shape_maps():
+    """Two maps with identical pack shapes but different bucket-id
+    wiring must not share a compiled program (review finding: root_ids
+    are baked constants)."""
+
+    def build(order):
+        m = CrushMap()
+        m.add_type(1, "root")
+        m.add_type(2, "rack")
+        m.add_type(3, "host")
+        root = m.add_bucket("default", "root", alg=ALG_STRAW2)
+        osd = 0
+        # racks created in different orders get different dense indices
+        racks = {}
+        for name in order:
+            racks[name] = m.add_bucket(name, "rack", alg=ALG_STRAW2)
+        for name in ("ra", "rb"):
+            rack = racks[name]
+            rw = 0
+            for h in range(2):
+                host = m.add_bucket(f"{name}h{h}", "host", alg=ALG_STRAW2)
+                hw = 0
+                for _ in range(2):
+                    m.insert_item(host.id, osd, 0x10000)
+                    hw += 0x10000
+                    osd += 1
+                m.insert_item(rack.id, host.id, hw)
+                rw += hw
+            m.insert_item(root.id, rack.id, rw)
+        steps = [
+            Step(OP_TAKE, root.id),
+            Step(OP_CHOOSE_FIRSTN, 2, m.type_id("rack")),
+            Step(OP_CHOOSELEAF_FIRSTN, 1, m.type_id("host")),
+            Step(OP_EMIT),
+        ]
+        rule = m.add_rule("chain", steps)
+        return m, rule
+
+    for order in (("ra", "rb"), ("rb", "ra")):
+        m, rule = build(order)
+        _assert_match_cpp(m, rule, 2, n=512)
+
+
+def test_unsupported_falls_back():
+    from ceph_tpu.crush.map import ALG_UNIFORM
+
+    m = build_flat(8, alg=ALG_UNIFORM)
+    rule = m.rule_by_name("replicated_rule")
+    dense = m.to_dense()
+    assert not supports(dense, rule)
+    with pytest.raises(NotImplementedError):
+        interp_batch.compile_rule_batch(dense, rule, 3)
+    # engine dispatch still runs it (vmap path)
+    w = np.full(dense.max_devices, 0x10000, np.uint32)
+    res, lens = run_batch(dense, rule, np.arange(64, dtype=np.uint32), w, 3)
+    assert np.asarray(res).shape == (64, 3)
+
+
+def test_take_rows_exactness():
+    """one-hot bf16 matmul row fetch is bit-exact for arbitrary u32/u64
+    table contents (the property the whole engine rests on)."""
+    m = build_simple(64)
+    rule = m.rule_by_name("replicated_rule")
+    dense = m.to_dense()
+    packs, _, _ = interp_batch.compile_rule_batch(dense, rule, 3)
+    pack, leaf_pack = packs[0]
+    for table in list(pack.tables) + list(leaf_pack.tables):
+        if table.nb == 1:
+            continue
+        idx = RNG.integers(0, table.nb, 4096)
+        import jax.numpy as jnp
+
+        row = interp_batch.take_rows(table, jnp.asarray(idx, jnp.int32))
+        # cross-check against the raw numpy byte table
+        tb = np.asarray(table.tb.astype(jnp.float32)).astype(np.uint64)
+        F = table.fanout
+
+        def u32_col(off):
+            cols = [tb[:, (off + i) * F:(off + i + 1) * F] for i in range(4)]
+            return (cols[0] | (cols[1] << 8) | (cols[2] << 16)
+                    | (cols[3] << 24)).astype(np.uint32)
+
+        np.testing.assert_array_equal(
+            np.asarray(row["ids"]), u32_col(0)[idx]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(row["weights"]), u32_col(4)[idx]
+        )
+        mag = (u32_col(8).astype(np.uint64)
+               | (u32_col(12).astype(np.uint64) << 32))
+        np.testing.assert_array_equal(np.asarray(row["magic"]), mag[idx])
+
+
+def test_engine_dispatch_picks_fast():
+    m = build_simple(32)
+    rule = m.rule_by_name("replicated_rule")
+    dense = m.to_dense()
+    crush_arg, _fn = make_batch_runner(dense, rule, 3)
+    assert isinstance(crush_arg, tuple)  # packs, not a StaticCrushMap

@@ -26,12 +26,12 @@ from ceph_tpu.osdmap.mapping import (
 
 
 def _device_all(m: OSDMap, pool: Pool):
-    smap = StaticCrushMap(m.crush.to_dense())
+    dense = m.crush.to_dense()
     rule = m.crush.rules[pool.crush_rule]
-    fn = compile_pool_mapping(smap, pool, rule)
+    crush_arg, fn = compile_pool_mapping(dense, pool, rule)
     state = build_pool_state(m, pool)
     pgs = jnp.arange(pool.pg_num, dtype=jnp.uint32)
-    up, upp, acting, actp = fn(smap, state, pgs)
+    up, upp, acting, actp = fn(crush_arg, state, pgs)
     return np.asarray(up), np.asarray(upp), np.asarray(acting), np.asarray(actp)
 
 

@@ -23,42 +23,43 @@ from ceph_tpu.parallel.placement import (
 def _setup(n_osds=32):
     m = build_simple(n_osds)
     rule = m.rule_by_name("replicated_rule")
-    smap = StaticCrushMap(m.to_dense())
-    return m, rule, smap
+    dense = m.to_dense()
+    return m, rule, dense
 
 
 def test_sharded_placement_matches_single_device():
-    _, rule, smap = _setup()
+    _, rule, dense = _setup()
     mesh = make_mesh(8)
-    step = sharded_placement_step(mesh, smap, rule, 3)
-    w = jnp.full((smap.max_devices,), 0x10000, jnp.uint32)
+    step = sharded_placement_step(mesh, dense, rule, 3)
+    w = jnp.full((dense.max_devices,), 0x10000, jnp.uint32)
     xs = jnp.arange(64, dtype=jnp.uint32)
     res, lens, hist = jax.block_until_ready(step(w, xs))
     ref_res, ref_lens = batch_do_rule(
-        smap, rule, np.arange(64, dtype=np.uint32), w, 3
+        StaticCrushMap(dense), rule, np.arange(64, dtype=np.uint32), w, 3
     )
     assert np.array_equal(np.asarray(res), np.asarray(ref_res))
     assert np.array_equal(np.asarray(lens), np.asarray(ref_lens))
     # psum histogram equals the serial tally
     flat = np.asarray(ref_res).reshape(-1)
     expect = np.bincount(
-        flat[flat != ITEM_NONE], minlength=smap.max_devices
+        flat[flat != ITEM_NONE], minlength=dense.max_devices
     )
     assert np.array_equal(np.asarray(hist), expect)
 
 
 def test_rebalance_sim_matches_unsharded_count():
-    _, rule, smap = _setup()
+    _, rule, dense = _setup()
     mesh = make_mesh(8)
     chunk, n_chunks = 16, 2
-    step = sharded_rebalance_sim(mesh, smap, rule, 3, chunk, n_chunks)
+    step = sharded_rebalance_sim(mesh, dense, rule, 3, chunk, n_chunks)
     n = 8 * chunk * n_chunks
-    wb = np.full(smap.max_devices, 0x10000, np.uint32)
+    wb = np.full(dense.max_devices, 0x10000, np.uint32)
     wa = wb.copy()
     wa[[3, 17]] = 0
     moved = int(jax.block_until_ready(step(wb, wa, 0)))
 
     xs = np.arange(n, dtype=np.uint32)
+    smap = StaticCrushMap(dense)
     rb, _ = batch_do_rule(smap, rule, xs, wb, 3)
     ra, _ = batch_do_rule(smap, rule, xs, wa, 3)
     expect = int(np.sum(np.any(np.asarray(rb) != np.asarray(ra), axis=1)))
@@ -67,15 +68,16 @@ def test_rebalance_sim_matches_unsharded_count():
 
 
 def test_rebalance_sim_start_offset():
-    _, rule, smap = _setup()
+    _, rule, dense = _setup()
     mesh = make_mesh(8)
-    step = sharded_rebalance_sim(mesh, smap, rule, 3, 8, 1)
-    wb = np.full(smap.max_devices, 0x10000, np.uint32)
+    step = sharded_rebalance_sim(mesh, dense, rule, 3, 8, 1)
+    wb = np.full(dense.max_devices, 0x10000, np.uint32)
     wa = wb.copy()
     wa[5] = 0
     a = int(step(wb, wa, 0))
     b = int(step(wb, wa, 64))
     xs = np.arange(128, dtype=np.uint32)
+    smap = StaticCrushMap(dense)
     rb, _ = batch_do_rule(smap, rule, xs, wb, 3)
     ra, _ = batch_do_rule(smap, rule, xs, wa, 3)
     d = np.any(np.asarray(rb) != np.asarray(ra), axis=1)
