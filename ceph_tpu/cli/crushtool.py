@@ -54,7 +54,7 @@ def cmd_tree(m: CrushMap, out) -> None:
 
 
 def run_test(m: CrushMap, args, out) -> int:
-    from ..crush.interp import StaticCrushMap, batch_do_rule
+    from ..crush.engine import run_batch
 
     rules = (
         [m.rules[args.rule]]
@@ -62,9 +62,8 @@ def run_test(m: CrushMap, args, out) -> int:
         else sorted(m.rules.values(), key=lambda r: r.id)
     )
     dense = m.to_dense()
-    smap = StaticCrushMap(dense)
     xs = np.arange(args.min_x, args.max_x + 1, dtype=np.uint32)
-    weights = np.full(max(smap.max_devices, 1), 0x10000, np.uint32)
+    weights = np.full(max(dense.max_devices, 1), 0x10000, np.uint32)
     if args.weight:
         for spec in args.weight:
             osd, w = spec.split(":")
@@ -83,7 +82,7 @@ def run_test(m: CrushMap, args, out) -> int:
                 import jax
 
                 results, lens = jax.block_until_ready(
-                    batch_do_rule(smap, rule, xs, weights, num_rep)
+                    run_batch(dense, rule, xs, weights, num_rep)
                 )
                 results = np.asarray(results)
                 lens = np.asarray(lens)

@@ -41,9 +41,21 @@ def runner_signature(dense: DenseCrushMap, rule: Rule, result_max: int) -> tuple
     build — equal signatures share one compiled executable."""
     if interp_batch.supports(dense, rule):
         return ("fast",) + interp_batch.fast_signature(dense, rule, result_max)
-    smap = interp.StaticCrushMap(dense)
-    return ("vmap", interp.smap_signature(smap),
-            interp.rule_signature(rule), result_max)
+    # smap_signature's fields, read straight off the dense map (no
+    # StaticCrushMap construction — that would upload the whole map)
+    return (
+        "vmap",
+        (
+            dense.n_buckets,
+            dense.max_fanout,
+            dense.max_devices,
+            max(dense.max_depth, 1),
+            dense.tunables,
+            frozenset(dense.algs_present()),
+        ),
+        interp.rule_signature(rule),
+        result_max,
+    )
 
 
 def run_batch(dense: DenseCrushMap, rule: Rule, xs, osd_weight, result_max: int):

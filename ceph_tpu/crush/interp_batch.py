@@ -243,6 +243,22 @@ def _bfs_levels(
     return levels
 
 
+def _stop_buckets(
+    dense: DenseCrushMap, roots: list[int], target_type: int
+) -> list[int]:
+    """Reachable target-type buckets in BFS order — build_pack's stop
+    list without constructing any tables."""
+    levels = _bfs_levels(dense, roots, target_type, dense.max_depth + 2)
+    stop: list[int] = []
+    seen: set[int] = set()
+    for lvl in levels:
+        for b in lvl:
+            if int(dense.btype[b]) == target_type and b not in seen:
+                seen.add(b)
+                stop.append(b)
+    return stop
+
+
 def build_pack(
     dense: DenseCrushMap,
     roots: list[int],
@@ -261,14 +277,7 @@ def build_pack(
         tables.append(
             _build_level_table(dense, lvl, next_map, consumer_map, target_type)
         )
-    stop: list[int] = []
-    seen: set[int] = set()
-    for lvl in levels:
-        for b in lvl:
-            if int(dense.btype[b]) == target_type and b not in seen:
-                seen.add(b)
-                stop.append(b)
-    return DescendPack(tuple(tables)), stop
+    return DescendPack(tuple(tables)), _stop_buckets(dense, roots, target_type)
 
 
 def take_rows(table: LevelTable, lidx: jnp.ndarray) -> dict[str, jnp.ndarray]:
@@ -714,7 +723,7 @@ def compile_rule_batch(dense: DenseCrushMap, rule: Rule, result_max: int):
             }
             if numrep > 0 and roots is not None:
                 if recurse:
-                    _, stop = build_pack(dense, roots, s.arg2, {})
+                    stop = _stop_buckets(dense, roots, s.arg2)
                     leaf_pack, _ = build_pack(dense, stop, 0, {})
                     leaf0_map = {b: i for i, b in enumerate(stop)}
                     pk, _ = build_pack(dense, roots, s.arg2, leaf0_map)

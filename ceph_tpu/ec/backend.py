@@ -73,31 +73,33 @@ class BitmatrixEncoder:
     """GF(2) bit-matrix x bit-sliced data as an int8 MXU matmul.
 
     Packet layout matches the host/CPU reference
-    (``gfref_bitmatrix_encode``): each chunk is groups of 8 packets of
-    ``packetsize`` bytes; row (i*8+t) of the bit-matrix XORs data
-    packets (j*8+l).  Bits within bytes are untouched SIMD lanes, so
-    unpack/pack order only needs to be self-consistent.
+    (``gfref_bitmatrix_encode``): each chunk is groups of ``w`` packets
+    of ``packetsize`` bytes; row (i*w+t) of the bit-matrix XORs data
+    packets (j*w+l).  The bit-slicing of *bytes* (always 8 lanes) is
+    independent of the code's ``w``; bits within bytes are untouched
+    SIMD lanes, so unpack/pack order only needs to be self-consistent.
     """
 
-    def __init__(self, bitmatrix: np.ndarray, packetsize: int):
+    def __init__(self, bitmatrix: np.ndarray, packetsize: int, w: int = W):
         self.bitmatrix = np.asarray(bitmatrix, np.uint8)
         self.mw, self.kw = self.bitmatrix.shape
-        self.k, self.m = self.kw // W, self.mw // W
+        self.w = w
+        self.k, self.m = self.kw // w, self.mw // w
         self.packetsize = packetsize
         self._encode = jax.jit(self._encode_impl)
 
     def _encode_impl(self, data: jnp.ndarray) -> jnp.ndarray:
-        k, m, p = self.k, self.m, self.packetsize
+        k, m, p, w = self.k, self.m, self.packetsize, self.w
         size = data.shape[1]
-        g = size // (W * p)  # groups per chunk
-        # [k, S] -> packet rows [k*8, g*p] indexed s = j*8 + l
-        d = data.reshape(k, g, W, p).transpose(0, 2, 1, 3).reshape(k * W, g * p)
-        # bit-slice bytes -> [k*8, g*p*8] in {0,1}
+        g = size // (w * p)  # groups per chunk
+        # [k, S] -> packet rows [k*w, g*p] indexed s = j*w + l
+        d = data.reshape(k, g, w, p).transpose(0, 2, 1, 3).reshape(k * w, g * p)
+        # bit-slice bytes -> [k*w, g*p*8] in {0,1}
         shifts = jnp.arange(W, dtype=jnp.uint8)
         bits = ((d[:, :, None] >> shifts) & 1).astype(jnp.int8)
-        bits = bits.reshape(k * W, g * p * W)
-        bm = jnp.asarray(self.bitmatrix, jnp.int8)  # [m*8, k*8]
-        # the MXU contraction: [m*8, k*8] @ [k*8, N] -> int32, parity = &1
+        bits = bits.reshape(k * w, g * p * W)
+        bm = jnp.asarray(self.bitmatrix, jnp.int8)  # [m*w, k*w]
+        # the MXU contraction: [m*w, k*w] @ [k*w, N] -> int32, parity = &1
         cbits = jax.lax.dot_general(
             bm,
             bits,
@@ -106,17 +108,17 @@ class BitmatrixEncoder:
         )
         cbits = (cbits & 1).astype(jnp.uint8)
         # re-pack bits -> bytes
-        cb = cbits.reshape(m * W, g * p, W)
+        cb = cbits.reshape(m * w, g * p, W)
         weights = (jnp.uint8(1) << shifts).astype(jnp.uint8)
         packed = jnp.sum(cb * weights, axis=-1, dtype=jnp.uint8)
         # packet rows -> [m, S]
         return (
-            packed.reshape(m, W, g, p).transpose(0, 2, 1, 3).reshape(m, size)
+            packed.reshape(m, w, g, p).transpose(0, 2, 1, 3).reshape(m, size)
         )
 
     def encode(self, data: np.ndarray) -> np.ndarray:
         size = data.shape[1]
-        group = W * self.packetsize
+        group = self.w * self.packetsize
         if size % group:
             raise ValueError(
                 f"chunk size {size} not a multiple of w*packetsize={group}"
@@ -124,34 +126,28 @@ class BitmatrixEncoder:
         return np.asarray(self._encode(jnp.asarray(data)))
 
 
-class MatrixCodec:
-    """Encode/decode driver for a systematic [I; M] GF(2^8) code."""
+class _SystematicCodec:
+    """Shared encode/decode driver for systematic [I; M] codes.
 
-    def __init__(self, matrix: np.ndarray, technique: str = "table",
-                 packetsize: int = 64):
-        self.matrix = np.asarray(matrix, np.uint8)
-        self.m, self.k = self.matrix.shape
-        self.technique = technique
-        self.packetsize = packetsize
-        if technique == "bitmatrix":
-            self.bitmatrix = gf.matrix_to_bitmatrix(self.matrix)
-            self.encoder = BitmatrixEncoder(self.bitmatrix, packetsize)
-        else:
-            self.encoder = TableEncoder(self.matrix)
+    Subclasses set ``self.encoder`` and implement ``_build_decoder``
+    (the reconstruction program for a given surviving-row set); the
+    decode flow — pick k survivors, cache the decoder, regenerate any
+    wanted coding chunks — is identical for the GF(2^8) matrix and the
+    GF(2) bit-matrix representations.
+    """
+
+    k: int
+    m: int
+    encoder: TableEncoder | BitmatrixEncoder
+
+    def __init__(self):
         self._decoders: dict[tuple, TableEncoder | BitmatrixEncoder] = {}
-
-    def generator(self) -> np.ndarray:
-        """(k+m) x k generator with identity top block."""
-        return np.vstack([np.eye(self.k, dtype=np.uint8), self.matrix])
 
     def encode(self, data: np.ndarray) -> np.ndarray:
         return self.encoder.encode(data)
 
-    def _decode_matrix(self, rows: tuple[int, ...]):
-        """Reconstruction matrix for data chunks from surviving rows."""
-        gen = self.generator()
-        sub = gen[list(rows)]  # k x k
-        return gf.invert_matrix(sub)
+    def _build_decoder(self, rows: tuple[int, ...]):
+        raise NotImplementedError
 
     def decode(
         self, available: dict[int, np.ndarray], want: set[int]
@@ -166,13 +162,7 @@ class MatrixCodec:
             rows = tuple(sorted(have)[: self.k])
             key = ("d", rows)
             if key not in self._decoders:
-                inv = self._decode_matrix(rows)
-                if self.technique == "bitmatrix":
-                    self._decoders[key] = BitmatrixEncoder(
-                        gf.matrix_to_bitmatrix(inv), self.packetsize
-                    )
-                else:
-                    self._decoders[key] = TableEncoder(inv)
+                self._decoders[key] = self._build_decoder(rows)
             survivors = np.stack([available[r] for r in rows])
             data = self._decoders[key].encode(survivors)
         else:
@@ -186,3 +176,68 @@ class MatrixCodec:
             for i in coding_want:
                 out[i] = np.ascontiguousarray(coding[i - self.k])
         return out
+
+
+class MatrixCodec(_SystematicCodec):
+    """Encode/decode driver for a systematic [I; M] GF(2^8) code."""
+
+    def __init__(self, matrix: np.ndarray, technique: str = "table",
+                 packetsize: int = 64):
+        super().__init__()
+        self.matrix = np.asarray(matrix, np.uint8)
+        self.m, self.k = self.matrix.shape
+        self.technique = technique
+        self.packetsize = packetsize
+        if technique == "bitmatrix":
+            self.bitmatrix = gf.matrix_to_bitmatrix(self.matrix)
+            self.encoder = BitmatrixEncoder(self.bitmatrix, packetsize)
+        else:
+            self.encoder = TableEncoder(self.matrix)
+
+    def generator(self) -> np.ndarray:
+        """(k+m) x k generator with identity top block."""
+        return np.vstack([np.eye(self.k, dtype=np.uint8), self.matrix])
+
+    def _build_decoder(self, rows: tuple[int, ...]):
+        inv = gf.invert_matrix(self.generator()[list(rows)])
+        if self.technique == "bitmatrix":
+            return BitmatrixEncoder(
+                gf.matrix_to_bitmatrix(inv), self.packetsize
+            )
+        return TableEncoder(inv)
+
+
+class BitmatrixCodec(_SystematicCodec):
+    """Encode/decode driver for codes defined natively by a GF(2)
+    bit-matrix (w>8 matrix techniques expanded host-side, and the
+    liberation / blaum_roth / liber8tion minimal-density codes, which
+    have no GF(2^w) matrix form at all).
+
+    Decode works at the bit level: select the k surviving chunks' w-row
+    blocks of the bit generator [I; B], invert the (k*w) x (k*w) GF(2)
+    matrix on host (exact), and run the same MXU bulk multiply —
+    mirroring the reference's ``jerasure_bitmatrix`` decode structure.
+    """
+
+    def __init__(self, bitmatrix: np.ndarray, w: int, packetsize: int):
+        super().__init__()
+        self.bitmatrix = np.asarray(bitmatrix, np.uint8)
+        self.w = w
+        self.mw, self.kw = self.bitmatrix.shape
+        self.k, self.m = self.kw // w, self.mw // w
+        self.packetsize = packetsize
+        self.encoder = BitmatrixEncoder(self.bitmatrix, packetsize, w)
+
+    def generator_bits(self) -> np.ndarray:
+        """((k+m)*w) x (k*w) bit generator with identity top block."""
+        return np.vstack(
+            [np.eye(self.kw, dtype=np.uint8), self.bitmatrix]
+        )
+
+    def _build_decoder(self, rows: tuple[int, ...]):
+        gen = self.generator_bits()
+        w = self.w
+        sub = np.vstack([gen[r * w:(r + 1) * w] for r in rows])
+        return BitmatrixEncoder(
+            gf.invert_bitmatrix(sub), self.packetsize, w
+        )

@@ -71,6 +71,12 @@ def ec_cases() -> dict[str, dict]:
         "lrc_4_2_3": {"plugin": "lrc", "k": "4", "m": "2", "l": "3"},
         "shec_4_3_2": {"plugin": "shec", "k": "4", "m": "3", "c": "2"},
         "clay_4_2": {"plugin": "clay", "k": "4", "m": "2"},
+        "jerasure_liberation_4_2_w7": {"plugin": "jerasure", "technique": "liberation", "k": "4", "m": "2", "w": "7", "packetsize": "8"},
+        "jerasure_blaum_roth_4_2_w6": {"plugin": "jerasure", "technique": "blaum_roth", "k": "4", "m": "2", "w": "6", "packetsize": "8"},
+        "jerasure_liber8tion_4_2": {"plugin": "jerasure", "technique": "liber8tion", "k": "4", "m": "2", "packetsize": "8"},
+        "jerasure_rs_4_2_w16": {"plugin": "jerasure", "technique": "reed_sol_van", "k": "4", "m": "2", "w": "16"},
+        "jerasure_rs_4_2_w32": {"plugin": "jerasure", "technique": "reed_sol_van", "k": "4", "m": "2", "w": "32"},
+        "jerasure_cauchy_4_2_w16_p8": {"plugin": "jerasure", "technique": "cauchy_good", "k": "4", "m": "2", "w": "16", "packetsize": "8"},
     }
     out = {}
     for name, profile in profiles.items():
