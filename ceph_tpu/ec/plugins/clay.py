@@ -215,55 +215,99 @@ class ErasureCodeClay(ErasureCode):
         Planes are processed in batches by *intersection score*: a
         plane's erased-partner lookups only ever reference planes of
         strictly lower score, so all planes of one score class are
-        independent and run as single array ops — the per-class MDS
-        solve is ONE device decode over the [planes*sub] stripe instead
-        of the reference's per-plane scalar loop
-        (``ErasureCodeClay.cc :: decode_layered``).
+        independent — per class the engine runs exactly two device
+        steps (one jitted pair-transform over every surviving node at
+        once, one batched MDS solve over the class's plane stripe),
+        versus the reference's per-plane-per-node scalar loops
+        (``ErasureCodeClay.cc :: decode_layered``).  All index arrays
+        are trace-time constants (cached per erased set, like the
+        repair kernels), so the gathers compile to static reshuffles.
         """
+        known_fns, rebuild_fn, classes = self._decode_kernels(
+            frozenset(erased)
+        )
+        U = np.zeros_like(C)
+        er = np.zeros(self.n, bool)
+        er[list(erased)] = True
+        known = np.nonzero(~er)[0]
+        C_dev = jnp.asarray(C)  # C is read-only until step 3: upload once
+        for (P, fn) in zip(classes, known_fns):
+            # 1) U at surviving nodes for the whole class: one device op
+            U[np.ix_(known, P)] = np.asarray(fn(C_dev, jnp.asarray(U)))
+            # 2) one batched MDS solve for the whole class
+            avail = {
+                self._base_id(node): U[node, P].reshape(-1)
+                for node in known
+            }
+            want = {self._base_id(node) for node in erased}
+            out = self.base.decode(avail, want)
+            for node in erased:
+                U[node, P] = out[self._base_id(node)].reshape(len(P), sub)
+        # 3) U -> C at erased nodes, all planes at once: one device op
+        er_nodes = sorted(erased)
+        C[er_nodes] = np.asarray(rebuild_fn(jnp.asarray(U)))
+
+    def _decode_kernels(self, erased_key: frozenset):
+        """Jitted device kernels for decode, cached per erased set:
+        per-score-class U-at-known transforms + the final U->C rebuild."""
+        if not hasattr(self, "_decode_fns"):
+            self._decode_fns = {}
+        if erased_key in self._decode_fns:
+            return self._decode_fns[erased_key]
         n = self.n
         mt = gf.mul_table()
-        g, di = GAMMA, self._det_inv
         digits, _x, _y, partner, zpair, diag, _pw = self._geometry()
         er = np.zeros(n, bool)
-        er[list(erased)] = True
-        # score[z] = number of grid rows whose dot node is erased
+        er[list(erased_key)] = True
         node_ids = digits + (np.arange(self.t)[None, :] * self.q)
         score = er[node_ids].sum(axis=1)  # [Z]
-        U = np.zeros_like(C)
+        known = np.nonzero(~er)[0]
+        tab_g = jnp.asarray(mt[GAMMA])
+        tab_di = jnp.asarray(mt[self._det_inv])
 
+        classes = []
+        known_fns = []
         for s in sorted(set(score.tolist())):
             P = np.nonzero(score == s)[0]
-            # 1) U at surviving nodes, all planes of the class at once
-            for node in range(n):
-                if er[node]:
-                    continue
-                d = diag[node, P][:, None]  # [P, 1]
-                pa = partner[node, P]  # [P]
-                zp = zpair[node, P]  # [P]
-                pe = er[pa][:, None]  # partner-erased mask
-                cn = C[node, P]  # [P, sub]
-                cpart = C[pa, zp]  # garbage rows where partner erased
-                u_pair = mt[di][cn ^ mt[g][cpart]]
-                # partner plane has strictly lower score: U complete
-                u_pe = cn ^ mt[g][U[pa, zp]]
-                U[node, P] = np.where(d, cn, np.where(pe, u_pe, u_pair))
-            # 2) one batched MDS solve for the whole class
-            if erased:
-                avail = {
-                    self._base_id(node): U[node, P].reshape(-1)
-                    for node in range(n)
-                    if not er[node]
-                }
-                want = {self._base_id(node) for node in erased}
-                out = self.base.decode(avail, want)
-                for node in erased:
-                    U[node, P] = out[self._base_id(node)].reshape(len(P), sub)
-        # 3) U -> C at erased nodes (all planes at once)
-        for node in erased:
-            d = diag[node][:, None]
-            pa = partner[node]
-            zp = zpair[node]
-            C[node] = np.where(d, U[node], U[node] ^ mt[g][U[pa, zp]])
+            classes.append(P)
+            kn = known[:, None]  # [K, 1]
+            d_mask = jnp.asarray(diag[kn, P[None, :]][..., None])
+            pa = jnp.asarray(partner[kn, P[None, :]])  # [K, P]
+            zp = jnp.asarray(zpair[kn, P[None, :]])
+            pe = jnp.asarray(er[partner[kn, P[None, :]]][..., None])
+            kn_j = jnp.asarray(known)
+            P_j = jnp.asarray(P)
+
+            def fn(C_j, U_j, *, d_mask=d_mask, pa=pa, zp=zp, pe=pe,
+                   kn_j=kn_j, P_j=P_j):
+                i32 = jnp.int32
+                cn = C_j[kn_j[:, None], P_j[None, :]]  # [K, P, sub]
+                cpart = C_j[pa, zp]
+                upa = U_j[pa, zp]
+                u_pair = jnp.take(
+                    tab_di,
+                    (cn ^ jnp.take(tab_g, cpart.astype(i32))).astype(i32),
+                )
+                u_pe = cn ^ jnp.take(tab_g, upa.astype(i32))
+                return jnp.where(d_mask, cn, jnp.where(pe, u_pe, u_pair))
+
+            known_fns.append(jax.jit(fn))
+
+        er_nodes = np.array(sorted(erased_key), np.int32)
+        d_e = jnp.asarray(diag[er_nodes][..., None])
+        pa_e = jnp.asarray(partner[er_nodes])  # [E, Z]
+        zp_e = jnp.asarray(zpair[er_nodes])
+        er_j = jnp.asarray(er_nodes)
+
+        @jax.jit
+        def rebuild_fn(U_j):
+            i32 = jnp.int32
+            ue = U_j[er_j]  # [E, Z, sub]
+            upz = U_j[pa_e, zp_e]
+            return jnp.where(d_e, ue, ue ^ jnp.take(tab_g, upz.astype(i32)))
+
+        self._decode_fns[erased_key] = (known_fns, rebuild_fn, classes)
+        return self._decode_fns[erased_key]
 
     # ---- repair-optimal single-node recovery ----
 

@@ -92,6 +92,11 @@ class ErasureCodeLrc(ErasureCode):
         n = len(mapping)
         self.k = sum(1 for c in mapping if c == "D")
         self.m = n - self.k
+        # base-class chunk_mapping from the 'D'/'_' string: raw chunk i
+        # (0..k-1 data, k.. coding) -> global shard position; serves
+        # get_chunk_mapping and _chunk_index
+        dp = self._data_positions()
+        self.chunk_mapping = dp + [p for p in range(n) if p not in dp]
         for layer in self.layers:
             if len(layer.descriptor) != n:
                 raise ErasureCodeError(
@@ -147,13 +152,6 @@ class ErasureCodeLrc(ErasureCode):
     def _data_positions(self) -> list[int]:
         return [i for i, c in enumerate(self.mapping) if c == "D"]
 
-    def _chunk_index(self, i: int) -> int:
-        """Object chunk i (0..k-1 data, k.. coding) -> global position."""
-        dp = self._data_positions()
-        if i < self.k:
-            return dp[i]
-        cp = [p for p in range(len(self.mapping)) if p not in dp]
-        return cp[i - self.k]
 
     def encode_prepare(self, data: np.ndarray) -> dict[int, np.ndarray]:
         blocksize = self.get_chunk_size(len(data))
