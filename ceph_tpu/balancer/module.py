@@ -15,6 +15,7 @@ import numpy as np
 
 from ..osdmap.map import Incremental, OSDMap
 from ..osdmap.mapping import OSDMapMapping
+from .crush_compat import do_crush_compat
 from .upmap import calc_pg_upmaps, crush_device_weights, expected_pg_share
 
 
@@ -42,8 +43,10 @@ class Balancer:
         max_deviation: float = 1.0,
         max_optimizations: int = 100,
     ):
-        if mode != "upmap":
-            raise ValueError(f"mode {mode!r} not supported (upmap only)")
+        if mode not in ("upmap", "crush-compat"):
+            raise ValueError(
+                f"mode {mode!r} not supported (upmap / crush-compat)"
+            )
         self.osdmap = osdmap
         self.mode = mode
         self.max_deviation = max_deviation
@@ -74,7 +77,11 @@ class Balancer:
         return ev
 
     def optimize(self, pools: list[int] | None = None) -> Incremental:
-        """One balancing step; empty Incremental means balanced."""
+        """One balancing step (upmap mode); empty Incremental means
+        balanced."""
+        if self.mode != "upmap":
+            raise ValueError("optimize() returns a plan only in upmap "
+                             "mode; use tick() for crush-compat")
         return calc_pg_upmaps(
             self.osdmap,
             max_deviation=self.max_deviation,
@@ -92,5 +99,22 @@ class Balancer:
         return True
 
     def tick(self, pools: list[int] | None = None) -> bool:
-        """One serve-loop iteration: optimize + execute."""
+        """One serve-loop iteration: optimize + execute.
+
+        upmap mode emits pg_upmap_items through an Incremental;
+        crush-compat mode descends the compat choose_args weight set
+        (placement consumes it directly) and bumps the epoch when it
+        changed — the reference commits the same two ways
+        (``do_upmap`` vs ``do_crush_compat``).
+        """
+        if self.mode == "crush-compat":
+            changed = do_crush_compat(
+                self.osdmap,
+                pools=pools,
+                max_deviation=self.max_deviation,
+                mapping=self.mapping,
+            )
+            if changed:
+                self.osdmap.epoch += 1
+            return changed
         return self.execute(self.optimize(pools))

@@ -4,7 +4,8 @@ Covers the reference's ``src/tools/osdmaptool.cc`` placement surface:
 ``--createsimple N``, ``--print``, ``--test-map-pgs`` (whole-map
 mapping + distribution statistics, the batch mapping timer),
 ``--test-map-object``, ``--upmap`` (run the optimizer, write the
-resulting commands), ``--upmap-cleanup``, ``--mark-out``.  Map files
+resulting commands), ``--upmap-cleanup``, ``--crush-compat`` (weight-set
+descent), ``--mark-out``.  Map files
 are the framework's versioned JSON OSDMap encoding.
 """
 
@@ -93,6 +94,10 @@ def main(argv=None) -> int:
     p.add_argument("--upmap-deviation", type=float, default=1.0)
     p.add_argument("--upmap-pool", action="append", type=int)
     p.add_argument("--upmap-cleanup", action="store_true")
+    p.add_argument(
+        "--crush-compat", action="store_true",
+        help="optimize the compat choose_args weight set instead of upmaps",
+    )
     p.add_argument("--save", action="store_true", help="write map changes back")
     args = p.parse_args(argv)
     out = sys.stdout
@@ -153,6 +158,22 @@ def main(argv=None) -> int:
         if cmds:
             m.apply_incremental(inc)
             dirty = True
+    if args.crush_compat:
+        from ..balancer.module import Balancer
+
+        bal = Balancer(m, mode="crush-compat",
+                       max_deviation=args.upmap_deviation)
+        before = bal.evaluate(args.upmap_pool)
+        changed = bal.tick(args.upmap_pool)  # descends + bumps epoch
+        after = bal.evaluate(args.upmap_pool)
+        print(
+            "crush-compat: "
+            f"max deviation {max(before.pool_max_deviation.values(), default=0):.2f}"
+            f" -> {max(after.pool_max_deviation.values(), default=0):.2f}"
+            f" ({'updated' if changed else 'no change'})",
+            file=out,
+        )
+        dirty = dirty or changed
     if dirty and args.save:
         save(m, args.mapfilename)
         print(f"osdmaptool: writing epoch {m.epoch} to {args.mapfilename}", file=sys.stderr)

@@ -126,7 +126,7 @@ class CrushMap:
         # mutate through the API.
         self.uid = next(CrushMap._uid_counter)
         self.version = 0
-        self._dense_cache: tuple = ()  # keyed (version, choose_args name)
+        self._dense_cache: dict = {}  # keyed (version, choose_args name)
         # per-pool alternate weight sets (reference crush_choose_arg /
         # CrushWrapper::choose_args, the crush-compat balancer's lever):
         # name -> {bucket_id -> [alt item weights]}
@@ -135,7 +135,7 @@ class CrushMap:
 
     def _mutated(self) -> None:
         self.version += 1
-        self._dense_cache = ()
+        self._dense_cache = {}
 
     def set_tunables(self, tunables: Tunables | str) -> None:
         """Switch tunables (profile name or explicit Tunables); the API
@@ -147,7 +147,7 @@ class CrushMap:
 
     def __getstate__(self):
         d = self.__dict__.copy()
-        d["_dense_cache"] = ()  # not worth copying/pickling
+        d["_dense_cache"] = {}  # not worth copying/pickling
         return d
 
     def __deepcopy__(self, memo):
@@ -478,6 +478,15 @@ class CrushMap:
         self.choose_args.pop(name, None)
         self._mutated()
 
+    def choose_args_name_for_pool(self, pool_id: int) -> str | None:
+        """Weight-set placement resolution (upstream ``do_rule`` picks
+        choose_args by pool id, falling back to the compat set)."""
+        if str(pool_id) in self.choose_args:
+            return str(pool_id)
+        if "compat" in self.choose_args:
+            return "compat"
+        return None
+
     def choose_args_adjust_item_weight(
         self, name: str, bucket_id: int, item: int, weight: int
     ) -> None:
@@ -488,11 +497,19 @@ class CrushMap:
     # ---- dense packing ----
 
     def to_dense(self, choose_args: str | None = None) -> "DenseCrushMap":
-        cached = self._dense_cache
-        if cached and cached[0] == (self.version, choose_args):
-            return cached[1]
+        # small dict, not a single slot: with per-pool weight sets the
+        # host placement path alternates choose_args names per pool and
+        # a one-entry cache would rebuild the dense map per PG lookup
+        key = (self.version, choose_args)
+        cached = self._dense_cache.get(key)
+        if cached is not None:
+            return cached
+        if len(self._dense_cache) >= 8 or (
+            self._dense_cache and next(iter(self._dense_cache))[0] != self.version
+        ):
+            self._dense_cache.clear()  # stale version or cap reached
         dense = self._to_dense(choose_args)
-        self._dense_cache = ((self.version, choose_args), dense)
+        self._dense_cache[key] = dense
         return dense
 
     def _to_dense(self, choose_args: str | None = None) -> "DenseCrushMap":

@@ -189,7 +189,9 @@ class OSDMap:
         from ..testing import cppref
 
         rule = self.crush.rules[pool.crush_rule]
-        dense = self.crush.to_dense()
+        dense = self.crush.to_dense(
+            choose_args=self.crush.choose_args_name_for_pool(pool.id)
+        )
         steps = [(s.op, s.arg1, s.arg2) for s in rule.steps]
         wfull = np.zeros(max(dense.max_devices, self.max_osd), np.uint32)
         wfull[: self.max_osd] = self.osd_weight

@@ -328,6 +328,7 @@ class OSDMapMapping:
         # compile cache keyed on everything baked in at trace time; a
         # mutated crush map or resized/renumbered pool recompiles
         # instead of silently serving stale placements
+        choose_args = self.osdmap.crush.choose_args_name_for_pool(pool.id)
         fp = (
             pool.pg_num,
             pool.pgp_num,
@@ -338,10 +339,11 @@ class OSDMapMapping:
             self.osdmap.crush.uid,  # process-unique, never reused
             self.osdmap.crush.version,
             self.osdmap.crush.tunables,
+            choose_args,
         )
         cached = self._fns.get(pool.id)
         if cached is None or cached[0] != fp:
-            dense = self.osdmap.crush.to_dense()
+            dense = self.osdmap.crush.to_dense(choose_args=choose_args)
             rule = self.osdmap.crush.rules[pool.crush_rule]
             crush_arg, fn = compile_pool_mapping(dense, pool, rule)
             cached = (fp, crush_arg, fn)

@@ -1,0 +1,90 @@
+"""crush-compat balancer mode: choose_args weight-set descent
+(reference ``src/pybind/mgr/balancer/module.py :: do_crush_compat``
+over ``CrushWrapper::choose_args``)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.balancer.crush_compat import COMPAT_WEIGHT_SET, do_crush_compat
+from ceph_tpu.balancer.module import Balancer
+from ceph_tpu.models.clusters import build_osdmap
+from ceph_tpu.osdmap.map import PGId
+from ceph_tpu.osdmap.mapping import OSDMapMapping
+
+
+def _max_dev(bal: Balancer) -> float:
+    ev = bal.evaluate()
+    return max(ev.pool_max_deviation.values(), default=0.0)
+
+
+def test_crush_compat_reduces_deviation_without_upmaps():
+    m = build_osdmap(32, pg_num=256, size=3)
+    bal = Balancer(m, mode="crush-compat", max_deviation=1.0)
+    before = _max_dev(bal)
+    assert before > 1.0  # raw CRUSH placement is statistically lumpy
+    changed = do_crush_compat(m, max_deviation=1.0, mapping=bal.mapping)
+    assert changed
+    after = _max_dev(bal)
+    assert after < before
+    assert not m.pg_upmap_items and not m.pg_upmap  # zero upmaps used
+    assert COMPAT_WEIGHT_SET in m.crush.choose_args
+
+
+def test_weight_set_respected_by_host_and_device_paths():
+    """With a compat weight set present, the scalar host path and the
+    device batch mapper must agree (both resolve choose_args)."""
+    m = build_osdmap(16, pg_num=64, size=3)
+    do_crush_compat(m, max_iterations=3, mapping=OSDMapMapping(m))
+    assert COMPAT_WEIGHT_SET in m.crush.choose_args
+    mapping = OSDMapMapping(m)
+    mapping.update()
+    for ps in range(64):
+        dev = mapping.get(PGId(1, ps))
+        host = m.pg_to_up_acting_osds(PGId(1, ps))
+        assert dev[0] == host[0] and dev[2] == host[2], (ps, dev, host)
+
+
+def test_weight_set_changes_placement():
+    m = build_osdmap(16, pg_num=64, size=3)
+    mapping = OSDMapMapping(m)
+    mapping.update(1)
+    before = np.asarray(mapping._results[1][0]).copy()
+    # a strongly skewed weight set must move some PGs
+    m.crush.create_choose_args(COMPAT_WEIGHT_SET)
+    host_bid = next(
+        bid for bid, b in m.crush.buckets.items()
+        if any(i >= 0 for i in b.items)
+    )
+    m.crush.choose_args_adjust_item_weight(
+        COMPAT_WEIGHT_SET, host_bid, m.crush.buckets[host_bid].items[0], 1
+    )
+    mapping.update(1)
+    after = np.asarray(mapping._results[1][0])
+    assert (before != after).any()
+
+
+def test_pool_specific_choose_args_beats_compat():
+    m = build_osdmap(8, pg_num=16, size=2)
+    crush = m.crush
+    crush.create_choose_args(COMPAT_WEIGHT_SET)
+    assert crush.choose_args_name_for_pool(1) == COMPAT_WEIGHT_SET
+    crush.create_choose_args("1")
+    assert crush.choose_args_name_for_pool(1) == "1"
+    assert crush.choose_args_name_for_pool(2) == COMPAT_WEIGHT_SET
+
+
+def test_balancer_tick_crush_compat_bumps_epoch():
+    m = build_osdmap(32, pg_num=128, size=3)
+    e0 = m.epoch
+    bal = Balancer(m, mode="crush-compat", max_deviation=0.5)
+    changed = bal.tick()
+    assert changed
+    assert m.epoch == e0 + 1
+    with pytest.raises(ValueError):
+        bal.optimize()
+
+
+def test_bad_mode_rejected():
+    m = build_osdmap(8, pg_num=16, size=2)
+    with pytest.raises(ValueError):
+        Balancer(m, mode="nonsense")
