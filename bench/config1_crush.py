@@ -57,6 +57,7 @@ def main() -> None:
         "value": round(tpu_rate),
         "unit": "placements/s",
         "vs_baseline": round(tpu_rate / cpu_rate, 2),
+        "platform": jax.default_backend(),
     }))
 
 

@@ -21,6 +21,7 @@ def bench_profile(k, m, chunk, batch_mb, technique="reed_sol_van", packetsize=20
 
     from ceph_tpu.ec import gf
     from ceph_tpu.ec.backend import BitmatrixEncoder, TableEncoder
+    from ceph_tpu.ec.pallas_kernels import PallasBitmatrixEncoder
     from ceph_tpu.testing import cppref
 
     rng = np.random.default_rng(0)
@@ -29,6 +30,13 @@ def bench_profile(k, m, chunk, batch_mb, technique="reed_sol_van", packetsize=20
     if technique == "reed_sol_van":
         mat = gf.vandermonde_matrix(k, m)
         enc = TableEncoder(mat)
+    elif technique == "cauchy_pallas":
+        mat = gf.cauchy_good_matrix(k, m)
+        size -= size % (8 * packetsize)
+        enc = PallasBitmatrixEncoder(
+            gf.matrix_to_bitmatrix(mat), packetsize,
+            interpret=jax.default_backend() != "tpu",
+        )
     else:
         mat = gf.cauchy_good_matrix(k, m)
         size -= size % (8 * packetsize)
@@ -43,24 +51,53 @@ def bench_profile(k, m, chunk, batch_mb, technique="reed_sol_van", packetsize=20
 
     import jax.numpy as jnp
 
-    dev = jnp.asarray(data)
-    jax.block_until_ready(enc._encode(dev))  # compile + warm
+    if isinstance(enc, PallasBitmatrixEncoder):
+        # device-only timing, same methodology as the XLA engines:
+        # pre-pack host-side once, time only the kernel on device arrays
+        from ceph_tpu.ec.pallas_kernels import LANES, W, _encode_padded, _pad_to
+
+        g = size // (W * packetsize)
+        d = np.ascontiguousarray(data).reshape(k, g, W, packetsize)
+        d = d.transpose(0, 2, 1, 3).reshape(k * W, g * packetsize)
+        d_words = d.view(np.uint32)
+        nw_pad = _pad_to(max(d_words.shape[1], LANES * 4), LANES * 4)
+        if nw_pad != d_words.shape[1]:
+            d_words = np.pad(d_words, ((0, 0), (0, nw_pad - d_words.shape[1])))
+        masks_dev = jnp.asarray(enc._masks)
+        dwords_dev = jnp.asarray(d_words)
+        run = lambda: jax.block_until_ready(  # noqa: E731
+            _encode_padded(masks_dev, dwords_dev, interpret=enc._interpret)
+        )
+    elif hasattr(enc, "_encode"):
+        dev = jnp.asarray(data)
+        run = lambda: jax.block_until_ready(enc._encode(dev))  # noqa: E731
+    else:
+        run = lambda: enc.encode(data)  # noqa: E731
+    run()  # compile + warm
     iters = 5
     t0 = time.perf_counter()
     for _ in range(iters):
-        jax.block_until_ready(enc._encode(dev))
+        run()
     dt = (time.perf_counter() - t0) / iters
     rate = k * size / dt  # data bytes encoded per second
     return rate, cpu_rate
 
 
 def main() -> None:
-    results = {}
-    for name, args in {
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    profiles = {
         "rs_4_2_table": (4, 2, 4096, 64, "reed_sol_van"),
         "rs_8_3_table": (8, 3, 4096, 128, "reed_sol_van"),
         "cauchy_8_3_mxu": (8, 3, 4096, 128, "cauchy_good"),
-    }.items():
+    }
+    if on_tpu:
+        # real Mosaic lowering only makes sense on silicon; interpret
+        # mode would just benchmark the emulator
+        profiles["cauchy_8_3_pallas"] = (8, 3, 4096, 128, "cauchy_pallas")
+    results = {}
+    for name, args in profiles.items():
         k, m, chunk, mb, tech = args
         rate, cpu = bench_profile(k, m, chunk, mb, tech)
         results[name] = (rate, cpu)
@@ -68,13 +105,22 @@ def main() -> None:
             f"{name}: {rate / 1e9:.2f} GB/s device, {cpu / 1e9:.3f} GB/s cpu-ref",
             file=sys.stderr,
         )
-    best = max(results.items(), key=lambda kv: kv[1][0])
-    rate, cpu = best[1]
+    # the headline is the BASELINE north-star shape — EC(8,3) — on the
+    # best engine for it (never a different (k,m) mislabeled as 8_3)
+    best_name, (rate, cpu) = max(
+        (kv for kv in results.items() if "8_3" in kv[0]),
+        key=lambda kv: kv[1][0],
+    )
     print(json.dumps({
         "metric": "ec_encode_8_3_bytes_per_sec",
         "value": round(rate),
         "unit": "B/s",
         "vs_baseline": round(rate / cpu, 2),
+        "engine": best_name,
+        "profiles_gbps": {
+            name: round(r / 1e9, 3) for name, (r, _) in results.items()
+        },
+        "platform": jax.default_backend(),
     }))
 
 

@@ -53,11 +53,14 @@ def main() -> None:
         file=sys.stderr,
     )
 
+    import jax
+
     print(json.dumps({
         "metric": "clay_repair_decode_bytes_per_sec",
         "value": round(rate),
         "unit": "B/s",
         "vs_baseline": round(read_frac, 3),
+        "platform": jax.default_backend(),
     }))
 
 

@@ -92,6 +92,7 @@ def main() -> None:
         "vs_baseline": round(frac, 5),
         "devices": ndev,
         "objects": covered,
+        "platform": jax.default_backend(),
     }))
 
 

@@ -1,0 +1,104 @@
+"""Run every BASELINE bench config + the TPU test tier; write
+BENCH_DETAIL_r{N}.json (one record per config, with provenance).
+
+Each config runs in its own child process with a hard timeout so one
+wedged tunnel attach cannot sink the others; failures are recorded,
+not raised.  Usage::
+
+    python bench/run_all.py [--round N] [--timeout SECONDS]
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONFIGS = [
+    ("config1_crush", "bench/config1_crush.py"),
+    ("config2_ec_encode", "bench/config2_ec_encode.py"),
+    ("config3_upmap", "bench/config3_upmap.py"),
+    ("config4_repair_decode", "bench/config4_repair_decode.py"),
+    ("config5_rebalance_sim", "bench/config5_rebalance_sim.py"),
+    ("tpu_tier", "bench/tpu_tier.py"),
+]
+
+
+def _run_one(name: str, path: str, timeout: int) -> dict:
+    full = os.path.join(_REPO, path)
+    cfg_hash = hashlib.sha256(open(full, "rb").read()).hexdigest()[:12]
+    t0 = time.perf_counter()
+    rec: dict = {"config": name, "config_hash": cfg_hash}
+    try:
+        proc = subprocess.run(
+            [sys.executable, full],
+            cwd=_REPO,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+        rec["rc"] = proc.returncode
+        # last JSON-looking stdout line is the result
+        for line in reversed(proc.stdout.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    rec["result"] = json.loads(line)
+                    break
+                except json.JSONDecodeError:
+                    continue
+        if "result" not in rec:
+            rec["error"] = (proc.stderr or proc.stdout)[-500:]
+    except subprocess.TimeoutExpired:
+        rec["rc"] = -1
+        rec["error"] = f"timeout after {timeout}s"
+    rec["seconds"] = round(time.perf_counter() - t0, 1)
+    return rec
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--round", type=int, default=3)
+    p.add_argument("--timeout", type=int, default=900)
+    p.add_argument("--only", action="append", help="config name filter")
+    args = p.parse_args()
+
+    records = []
+    for name, path in CONFIGS:
+        if args.only and name not in args.only:
+            continue
+        print(f"== {name} ==", file=sys.stderr, flush=True)
+        rec = _run_one(name, path, args.timeout)
+        print(json.dumps(rec), flush=True)
+        records.append(rec)
+
+    # device provenance comes from the child records — importing jax
+    # here could block the parent forever on a wedged tunnel attach and
+    # lose every completed record
+    platforms = {
+        r["result"]["platform"]
+        for r in records
+        if isinstance(r.get("result"), dict) and r["result"].get("platform")
+    }
+    out = {
+        "round": args.round,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "device": sorted(platforms) or ["unknown"],
+        "records": records,
+    }
+    dest = os.path.join(_REPO, f"BENCH_DETAIL_r{args.round:02d}.json")
+    with open(dest, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {dest}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

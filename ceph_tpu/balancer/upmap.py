@@ -22,9 +22,12 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..common.log import get_logger
 from ..crush.map import ITEM_NONE, CrushMap
 from ..osdmap.map import Incremental, OSDMap, PGId, Pool
 from ..osdmap.mapping import OSDMapMapping
+
+_LOG = get_logger("balancer")
 
 
 def crush_device_weights(crush: CrushMap, rule_id: int, n_osd: int) -> np.ndarray:
@@ -144,9 +147,19 @@ def _score_candidate_moves(
     # most-underfull targets — exactly the moves a round would accept
     MAX_ROWS, MAX_UNDER = 8192, 256
     if len(r_sel) > MAX_ROWS:
+        _LOG.info(
+            "candidate truncation: keeping %d of %d overfull PG rows "
+            "(worst-first); later rounds revisit the rest",
+            MAX_ROWS, len(r_sel),
+        )
         worst = np.argsort(-frm_dev[r_sel], kind="stable")[:MAX_ROWS]
         r_sel = r_sel[worst]
     if len(underfull) > MAX_UNDER:
+        _LOG.info(
+            "candidate truncation: keeping %d of %d underfull targets "
+            "(neediest-first)",
+            MAX_UNDER, len(underfull),
+        )
         neediest = np.argsort(deviation[underfull], kind="stable")[:MAX_UNDER]
         underfull = underfull[neediest]
     sub_up = up_c[r_sel]  # [R, S]

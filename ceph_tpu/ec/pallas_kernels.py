@@ -56,8 +56,13 @@ def _encode_padded(masks, d_words, interpret=False):
     mw_pad, kw = masks.shape
     nw = d_words.shape[1]
     tile = LANES * 4  # words per grid step
-    grid = (nw // tile,) if nw % tile == 0 and nw >= tile else (1,)
-    tn = tile if grid[0] > 1 or nw == tile else nw
+    if nw % tile:
+        # never collapse to one whole-array block: that blows VMEM on
+        # large chunks (round-2 review finding); callers pad (encode()
+        # always does) so this only fires on misuse
+        raise ValueError(f"word count {nw} must be a multiple of {tile}")
+    grid = (nw // tile,)
+    tn = tile
     return pl.pallas_call(
         _kernel,
         out_shape=jax.ShapeDtypeStruct((mw_pad, nw), jnp.uint32),

@@ -143,3 +143,86 @@ def build_simple(n_osds: int, osds_per_host: int = 4, hosts_per_rack: int = 8,
         m.insert_item(root.id, rack.id, rack_w)
     m.make_replicated_rule("replicated_rule", "default", "host")
     return m
+
+
+def build_skewed(
+    n_osds: int,
+    seed: int = 0,
+    tunables: Tunables | None = None,
+) -> CrushMap:
+    """Deep, heterogeneous hierarchy: root -> dcs -> racks -> hosts ->
+    osds with ragged fanouts and mixed device weights (0.5x-4x).
+
+    The uniform ``build_simple`` topology never stresses straw2 retry
+    divergence or the balancer's weight handling; this one does — use
+    it wherever "realistic cluster" matters (benches, property tests).
+    """
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    m = CrushMap(tunables)
+    m.add_type(1, "root")
+    m.add_type(2, "dc")
+    m.add_type(3, "rack")
+    m.add_type(4, "host")
+    root = m.add_bucket("default", "root")
+    osd = 0
+    dc_i = rack_i = host_i = 0
+    while osd < n_osds:
+        dc = m.add_bucket(f"dc{dc_i}", "dc")
+        dc_i += 1
+        dc_w = 0
+        for _ in range(int(rng.integers(2, 5))):
+            if osd >= n_osds:
+                break
+            rack = m.add_bucket(f"rack{rack_i}", "rack")
+            rack_i += 1
+            rack_w = 0
+            for _ in range(int(rng.integers(2, 7))):
+                if osd >= n_osds:
+                    break
+                host = m.add_bucket(f"host{host_i}", "host")
+                host_i += 1
+                host_w = 0
+                for _ in range(int(rng.integers(2, 9))):
+                    if osd >= n_osds:
+                        break
+                    w = int(rng.integers(0x8000, 0x40000))  # 0.5x-4x
+                    m.insert_item(host.id, osd, w)
+                    host_w += w
+                    osd += 1
+                m.insert_item(rack.id, host.id, host_w)
+                rack_w += host_w
+            m.insert_item(dc.id, rack.id, rack_w)
+            dc_w += rack_w
+        m.insert_item(root.id, dc.id, dc_w)
+    m.make_replicated_rule("replicated_rule", "default", "host")
+    return m
+
+
+def build_skewed_osdmap(
+    n_osds: int,
+    pg_num: int = 1024,
+    size: int = 3,
+    seed: int = 0,
+):
+    """OSDMap over :func:`build_skewed` (one replicated pool)."""
+    from ceph_tpu.osdmap.map import OSDMap, Pool
+
+    crush = build_skewed(n_osds, seed=seed)
+    m = OSDMap(crush)
+    for o in range(n_osds):
+        m.add_osd(o)
+    rule = crush.rule_by_name("replicated_rule")
+    m.add_pool(
+        Pool(
+            id=1,
+            name="pool1",
+            kind="replicated",
+            size=size,
+            pg_num=pg_num,
+            pgp_num=pg_num,
+            crush_rule=rule.id,
+        )
+    )
+    return m

@@ -147,3 +147,50 @@ def test_thrasher_ec_pool_invariants():
             assert len(up) == pool.size  # positional: size preserved
             live = [o for o in up if o != ITEM_NONE]
             assert len(live) == len(set(live))
+
+
+def test_skewed_topology_distribution_and_parity():
+    """The deep ragged ``build_skewed`` map: device placement matches
+    the C++ reference exactly, and per-OSD load tracks the skewed
+    weights (correlation, not exact chi^2 — straw2 is statistical)."""
+    from ceph_tpu.crush.engine import run_batch
+    from ceph_tpu.models.clusters import build_skewed
+    from ceph_tpu.testing import cppref
+
+    m = build_skewed(96, seed=7)
+    rule = m.rule_by_name("replicated_rule")
+    dense = m.to_dense()
+    w = np.full(dense.max_devices, W1, np.uint32)
+    n = 20_000
+    xs = np.arange(n, dtype=np.uint32)
+    steps = [(s.op, s.arg1, s.arg2) for s in rule.steps]
+    r_ref, l_ref = cppref.do_rule_batch(dense, steps, xs, w, 3)
+    r_dev, l_dev = run_batch(dense, rule, xs, w, 3)
+    np.testing.assert_array_equal(r_ref, np.asarray(r_dev))
+    np.testing.assert_array_equal(l_ref, np.asarray(l_dev))
+
+    from ceph_tpu.balancer.upmap import crush_device_weights
+
+    counts = np.bincount(
+        r_ref[r_ref != 0x7FFFFFFF].reshape(-1), minlength=96
+    ).astype(np.float64)
+    cw = crush_device_weights(m, rule.id, 96)
+    corr = np.corrcoef(counts, cw)[0, 1]
+    assert corr > 0.9, f"load/weight correlation {corr:.3f}"
+
+
+def test_skewed_topology_balancer_converges():
+    """Upmap optimizer reaches its deviation target on the skewed map
+    (the shape the uniform fixtures never stress)."""
+    from ceph_tpu.balancer import Balancer
+    from ceph_tpu.models.clusters import build_skewed_osdmap
+
+    m = build_skewed_osdmap(48, pg_num=256, seed=3)
+    bal = Balancer(m, max_deviation=1.0, max_optimizations=500)
+    before = max(bal.evaluate().pool_max_deviation.values())
+    for _ in range(8):
+        if not bal.tick():
+            break
+    after = max(bal.evaluate().pool_max_deviation.values())
+    assert after < before
+    assert after <= 2.0, f"final max deviation {after}"

@@ -1,0 +1,106 @@
+"""Real-TPU tier (SURVEY.md §7 tier 3): device correctness on silicon.
+
+The hermetic suite proves semantics on the CPU backend; nothing there
+exercises the chip's actual lowering (u64 emulation, one-hot bf16 MXU
+exactness, Mosaic/Pallas non-interpret mode).  These tests do, and are
+skipped automatically when no TPU backend is attached.
+
+Run on the chip with::
+
+    CEPH_TPU_TEST_REEXEC=1 python -m pytest tests/test_tpu_device.py -q
+
+(or ``python bench/tpu_tier.py``, which sets the environment up).
+CEPH_TPU_TEST_REEXEC=1 stops conftest from scrubbing the TPU plugin
+out of the environment; the axon JAX_PLATFORMS value is kept as-is.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+
+@pytest.fixture(scope="module")
+def tpu():
+    import jax
+
+    if jax.default_backend() == "cpu":
+        pytest.skip("no TPU backend attached (hermetic CPU run)")
+    return jax.devices()[0]
+
+
+RNG = np.random.default_rng(0x79D)
+
+
+def _diff_vs_cpp(m, rule_name, osd_weight=None, n=4096, result_max=3):
+    from ceph_tpu.crush.engine import run_batch
+    from ceph_tpu.testing import cppref
+
+    rule = m.rule_by_name(rule_name)
+    dense = m.to_dense()
+    if osd_weight is None:
+        osd_weight = np.full(dense.max_devices, 0x10000, np.uint32)
+    xs = RNG.integers(0, 1 << 32, n, dtype=np.uint32)
+    steps = [(s.op, s.arg1, s.arg2) for s in rule.steps]
+    r_ref, l_ref = cppref.do_rule_batch(dense, steps, xs, osd_weight, result_max)
+    r_dev, l_dev = run_batch(dense, rule, xs, osd_weight, result_max)
+    np.testing.assert_array_equal(r_ref, np.asarray(r_dev))
+    np.testing.assert_array_equal(l_ref, np.asarray(l_dev))
+
+
+def test_crush_uniform_topology_vs_cpp(tpu):
+    from ceph_tpu.models.clusters import build_simple
+
+    _diff_vs_cpp(build_simple(256), "replicated_rule")
+
+
+def test_crush_skewed_topology_vs_cpp(tpu):
+    from ceph_tpu.models.clusters import build_hierarchy
+
+    m = build_hierarchy([("rack", 3), ("host", 4)], 4)
+    for bid, b in list(m.buckets.items()):
+        for item in list(b.items):
+            if item >= 0 and RNG.random() < 0.5:
+                m.adjust_item_weight(
+                    bid, item, int(0x4000 + RNG.integers(0, 0x30000))
+                )
+    w = np.full(m.to_dense().max_devices, 0x10000, np.uint32)
+    w[RNG.integers(0, 48, 6)] = 0x8000  # partial reweights: is_out path
+    w[RNG.integers(0, 48, 3)] = 0  # outs
+    _diff_vs_cpp(m, "replicated_rule", osd_weight=w)
+
+
+def test_crush_erasure_indep_vs_cpp(tpu):
+    from ceph_tpu.models.clusters import build_simple
+
+    m = build_simple(48)
+    m.make_erasure_rule("erasure_rule", "default", "host")
+    _diff_vs_cpp(m, "erasure_rule", result_max=6)
+
+
+def test_pallas_bitmatrix_non_interpret(tpu):
+    """Mosaic (interpret=False) XOR kernel == XLA MXU bitmatrix path —
+    the first-ever silicon check of the Pallas lowering."""
+    from ceph_tpu.ec import gf
+    from ceph_tpu.ec.backend import BitmatrixEncoder
+    from ceph_tpu.ec.pallas_kernels import PallasBitmatrixEncoder
+
+    bm = gf.matrix_to_bitmatrix(gf.cauchy_good_matrix(8, 3))
+    p = 64
+    data = RNG.integers(0, 256, (8, 8 * p * 64), dtype=np.uint8)
+    xla = BitmatrixEncoder(bm, p).encode(data)
+    pallas = PallasBitmatrixEncoder(bm, p, interpret=False).encode(data)
+    np.testing.assert_array_equal(xla, pallas)
+
+
+def test_clay_repair_roundtrip(tpu):
+    from ceph_tpu.ec import create
+
+    ec = create({"plugin": "clay", "k": "4", "m": "2"})
+    n = ec.get_chunk_count()
+    obj = RNG.integers(0, 256, 40_000, dtype=np.uint8)
+    enc = ec.encode(set(range(n)), obj)
+    lost = 2
+    need = ec.minimum_to_decode({lost}, set(range(n)) - {lost})
+    dec = ec.decode({lost}, {i: enc[i] for i in need}, len(enc[0]))
+    np.testing.assert_array_equal(dec[lost], enc[lost])
