@@ -35,25 +35,45 @@ def _pad_to(x: int, m: int) -> int:
 
 
 def _kernel(masks_ref, d_ref, out_ref):
-    """One N-tile: out[mw, TN] = XOR_s (d[s, TN] & mask[mw, s])."""
+    """One N-tile: out[mw, TN] = XOR_s (d[s, TN] & mask[mw, s]).
+
+    ``masks_ref`` is [KW, MW, 1]: the XOR-select loop indexes the
+    *untiled* leading dim, so Mosaic never sees a dynamic lane-dim
+    offset (a [MW, KW] layout lowers ``masks[:, s]`` to a lane-strided
+    ``vector.load`` that real TPUs reject with an alignment error —
+    caught on first silicon run, round 3).  The [MW, 1] slice is
+    already sublane-oriented and broadcasts across lanes for free.
+    """
     kw = d_ref.shape[0]
     acc = jnp.zeros(out_ref.shape, jnp.uint32)
-
-    def body(s, acc):
+    # Static Python unroll (kw <= 8*k, small): no loop-carried scalars
+    # for Mosaic to legalize (x64 mode made fori_loop bounds i64, which
+    # it rejects) and every load has a static index.
+    for s in range(kw):
         row = d_ref[s, :]  # [TN] u32
-        sel = masks_ref[:, s]  # [MW] u32 (0 or 0xffffffff)
-        return acc ^ (row[None, :] & sel[:, None])
+        sel = masks_ref[s]  # [MW, 1] u32 (0 or 0xffffffff)
+        acc = acc ^ (row[None, :] & sel)
+    out_ref[:, :] = acc
 
-    out_ref[:, :] = jax.lax.fori_loop(0, kw, body, acc)
+
+def _encode_padded(masks, d_words, interpret=False):
+    """masks [KW, MWpad, 1] u32; d_words [KW, NW] u32 -> [MWpad, NW] u32.
+
+    Traced with x64 scoped off: x64 mode leaks i64 into the BlockSpec
+    index maps, which Mosaic refuses to legalize on real TPUs
+    ("func.return (i64,i64,i64)", first silicon run).  Everything here
+    is u32, so the scope changes no dtypes.
+    """
+    with jax.enable_x64(False):
+        return _encode_padded_jit(masks, d_words, interpret=interpret)
 
 
 @partial(jax.jit, static_argnames=("interpret",))
-def _encode_padded(masks, d_words, interpret=False):
-    """masks [MWpad, KW] u32; d_words [KW, NW] u32 -> [MWpad, NW] u32."""
+def _encode_padded_jit(masks, d_words, interpret=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    mw_pad, kw = masks.shape
+    kw, mw_pad, _ = masks.shape
     nw = d_words.shape[1]
     tile = LANES * 4  # words per grid step
     if nw % tile:
@@ -68,7 +88,7 @@ def _encode_padded(masks, d_words, interpret=False):
         out_shape=jax.ShapeDtypeStruct((mw_pad, nw), jnp.uint32),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((mw_pad, kw), lambda i: (0, 0),
+            pl.BlockSpec((kw, mw_pad, 1), lambda i: (0, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((kw, tn), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
@@ -92,8 +112,10 @@ class PallasBitmatrixEncoder:
         if packetsize % 4:
             raise ValueError("pallas path needs packetsize % 4 == 0")
         self.mw_pad = _pad_to(self.mw, 8)
-        masks = np.zeros((self.mw_pad, self.kw), np.uint32)
-        masks[: self.mw] = np.where(self.bitmatrix != 0, 0xFFFFFFFF, 0)
+        masks = np.zeros((self.kw, self.mw_pad, 1), np.uint32)
+        masks[:, : self.mw, 0] = np.where(
+            self.bitmatrix != 0, 0xFFFFFFFF, 0
+        ).T
         self._masks = masks
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
