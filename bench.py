@@ -79,20 +79,27 @@ def _device_measure() -> None:
         # number, not to win.
         sizes, iters = (20_000, 5_000), 1
     else:
-        sizes, iters = (N_OBJECTS, N_OBJECTS // 4, N_OBJECTS // 16), 3
+        sizes, iters = (N_OBJECTS, N_OBJECTS // 4, N_OBJECTS // 16), 5
     rate = 0.0
     err = None
+
+    sys.path.insert(0, os.path.join(_REPO, "bench"))
+    from _timing import chained_rate
+
     # Fall back through smaller batches rather than die on a flaky chip.
     for n in sizes:
         try:
-            xs = jnp.arange(n, dtype=jnp.uint32)
-            jax.block_until_ready(batch(crush_arg, osd_weight, xs))  # compile+warm
-            t0 = time.perf_counter()
-            for i in range(iters):
-                jax.block_until_ready(
-                    batch(crush_arg, osd_weight, xs + np.uint32(i + 1))
-                )
-            dt = (time.perf_counter() - t0) / iters
+            xs0 = jnp.arange(n, dtype=jnp.uint32)
+
+            def step(xs):
+                # next batch's seeds depend on this batch's results: a
+                # real data dependency the tunnel cannot elide (see
+                # bench/_timing.py for why block_until_ready is not
+                # enough on this machine)
+                res, lens = batch(crush_arg, osd_weight, xs)
+                return xs + lens.astype(jnp.uint32) + jnp.uint32(1)
+
+            dt, _ = chained_rate(step, xs0, iters=iters, reps=3)
             rate = n / dt
             err = None
             break

@@ -1,0 +1,52 @@
+"""Honest device timing for all bench configs.
+
+Round-3 finding (silicon): repeated dispatch of the same computation
+through this machine's TPU tunnel is elided somewhere below JAX —
+``block_until_ready`` returns without real execution, and even chains
+of data-dependent dispatches complete "faster" than the chip's HBM
+bandwidth allows (a [8192]^2 matmul chain "ran" at 49 PFLOP/s).  The
+only timing that matches physics is: chain data-dependent steps AND
+force a host readback of a value derived from the final state, then
+amortize over the chain length.
+
+Every config times through :func:`chained_rate` so the methodology is
+uniform and auditable.  ``step`` must return a state whose value feeds
+the next iteration (a genuine data dependency), and the final state is
+reduced to a Python float — that readback is what forces the chain.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def chained_rate(step, state0, *, iters: int = 10, reps: int = 3):
+    """Best seconds/iteration over ``reps`` chains of ``iters`` steps.
+
+    ``step(state) -> state'`` where state is a pytree of device arrays
+    and state' depends on state's *values*.  Compiles/warms once, then
+    for each rep: re-chain from state0 and read back one scalar.
+    Returns (best_seconds_per_iter, checksum_float).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def _readback(st):
+        leaf = jax.tree_util.tree_leaves(st)[0]
+        return float(jnp.sum(leaf.astype(jnp.float32)))
+
+    st = step(state0)
+    _readback(st)  # compile + warm + prove execution
+    best = float("inf")
+    checksum = 0.0
+    # One continuous chain across reps — never reset to state0, so no
+    # rep ever re-issues a dispatch with previously-seen input values
+    # (a reset chain is byte-identical to the prior rep and the elision
+    # layer could serve it from cache, handing min() a fake time).
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            st = step(st)
+        checksum = _readback(st)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best, checksum

@@ -12,6 +12,7 @@ import time
 import numpy as np
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
 
 N_OBJECTS = 1_000_000
 CPU_SAMPLE = 50_000
@@ -40,17 +41,17 @@ def main() -> None:
 
     crush_arg, run = make_batch_runner(dense, rule, REPLICAS)
 
-    def batch(osd_weight, xs):
-        return run(crush_arg, osd_weight, xs)
-
     osd_weight = jnp.asarray(osd_weight_np)
-    xs = jnp.arange(N_OBJECTS, dtype=jnp.uint32)
-    jax.block_until_ready(batch(osd_weight, xs))
-    iters = 3
-    t0 = time.perf_counter()
-    for i in range(iters):
-        jax.block_until_ready(batch(osd_weight, xs + np.uint32(i)))
-    tpu_rate = N_OBJECTS * iters / (time.perf_counter() - t0)
+    xs0 = jnp.arange(N_OBJECTS, dtype=jnp.uint32)
+
+    from _timing import chained_rate
+
+    def step(xs):
+        res, lens = run(crush_arg, osd_weight, xs)
+        return xs + lens.astype(jnp.uint32) + jnp.uint32(1)
+
+    dt, _ = chained_rate(step, xs0, iters=5, reps=3)
+    tpu_rate = N_OBJECTS / dt
 
     print(json.dumps({
         "metric": "crush_placements_per_sec",

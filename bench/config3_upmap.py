@@ -45,12 +45,13 @@ def main() -> None:
     removals = 0
     rounds = 0
     t0 = time.perf_counter()
-    for rounds in range(1, 33):
+    for _ in range(32):
         plan = b.optimize()
         n_new = len(plan.new_pg_upmap_items)
         n_old = len(plan.old_pg_upmap_items)
         if not b.execute(plan):
-            break
+            break  # empty plan: converged, not a completed round
+        rounds += 1
         entries += n_new
         removals += n_old
     opt_s = time.perf_counter() - t0

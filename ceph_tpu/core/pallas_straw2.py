@@ -1,0 +1,454 @@
+"""Fused Pallas TPU kernel for the straw2 negdraw (the CRUSH hot op).
+
+Computes, per lane, the exact :func:`ceph_tpu.core.hashes.straw2_negdraw_magic`
+pipeline — rjenkins hash -> ``crush_ln`` LUT walk -> magic-reciprocal
+division (upstream ``src/crush/mapper.c :: bucket_straw2_choose`` +
+``crush_ln`` + ``src/crush/hash.c``) — entirely inside VMEM.
+
+Why (round-3 silicon profiling): the XLA path spends ~300 ms per
+[1M, 8] straw2 call in ``crush_ln``'s three per-lane LUT gathers; the
+chip lowers any HBM-level gather at ~10 ns/lane regardless of table
+size, while every other part of straw2 costs ~4 ms.  The fix is the
+TPU's native in-register table unit: ``tpu.dynamic_gather`` handles a
+128-wide lane-resident LUT in one op, but only via Pallas (XLA never
+emits it for these shapes).
+
+Kernel facts:
+
+- All arithmetic is u32; the u64 quantities (crush_ln's 48-bit fixed
+  point, the 64-bit magic reciprocal, the 128-bit mulhi) are carried
+  as 16-bit limbs with explicit carries — Mosaic has no 64-bit ints.
+- The 256/129-entry LUTs are split into 128-entry lane-resident
+  halves and read with ``jnp.take_along_axis(..., axis=1)`` (lowers
+  to one ``tpu.dynamic_gather`` each); the single boundary entry
+  (``xs == 0x10000``) is a constant select.
+- ``31 - clz(x)`` is a sum of 16 compares (no clz in Mosaic).
+- Traced with x64 scoped off (i64 in index maps breaks Mosaic; see
+  pallas_kernels.py).
+
+Bit-exactness is enforced by tests/test_pallas_straw2.py (interpret
+mode vs the jnp path over random draws incl. boundary cases) and on
+silicon by the TPU tier.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import hashes
+
+U32 = jnp.uint32
+I32 = jnp.int32
+
+SUBLANES = 256          # tile = [SUBLANES, 128]
+TILE = SUBLANES * 128   # elements per grid step
+
+_M16 = np.uint32(0xFFFF)
+_U32MAX = np.uint32(0xFFFFFFFF)
+
+
+def _build_tables() -> tuple[np.ndarray, np.uint32, np.uint32, np.uint32, np.uint32]:
+    """Pack the crush_ln LUTs into one [8, 128] u32 array.
+
+    Rows: rh_lo, rh_hi, lh_lo, lh_hi, ll0_lo, ll0_hi, ll1_lo, ll1_hi
+    (lo/hi = 32-bit halves of the <2^48 u64 entries; rh/lh indexed by
+    ``k = (xs >> 8) - 128`` in [0, 128); ll0/ll1 = LL table halves for
+    index2 < 128 / >= 128).  Returns the boundary entries (k == 128,
+    i.e. xs == 0x10000) separately as scalars.
+    """
+    rh_lh = hashes._RH_LH_NP
+    ll = hashes._LL_NP
+    assert rh_lh.shape[0] >= 258 and ll.shape[0] >= 256
+    rh = rh_lh[0:256:2]      # k = 0..127
+    lh = rh_lh[1:256:2]
+    t = np.zeros((8, 128), np.uint32)
+    t[0] = (rh & 0xFFFFFFFF).astype(np.uint32)
+    t[1] = (rh >> np.uint64(32)).astype(np.uint32)
+    t[2] = (lh & 0xFFFFFFFF).astype(np.uint32)
+    t[3] = (lh >> np.uint64(32)).astype(np.uint32)
+    t[4] = (ll[:128] & 0xFFFFFFFF).astype(np.uint32)
+    t[5] = (ll[:128] >> np.uint64(32)).astype(np.uint32)
+    t[6] = (ll[128:256] & 0xFFFFFFFF).astype(np.uint32)
+    t[7] = (ll[128:256] >> np.uint64(32)).astype(np.uint32)
+    rb, lb = rh_lh[256], rh_lh[257]
+    return (
+        t,
+        np.uint32(rb & 0xFFFFFFFF), np.uint32(rb >> np.uint64(32)),
+        np.uint32(lb & 0xFFFFFFFF), np.uint32(lb >> np.uint64(32)),
+    )
+
+
+_TBL, _RH_B_LO, _RH_B_HI, _LH_B_LO, _LH_B_HI = _build_tables()
+
+
+def _lut(tbl, row: int, idx):
+    """128-entry lane-resident lookup: T[row][idx] via dynamic_gather."""
+    t = jnp.broadcast_to(tbl[row:row + 1, :], idx.shape)
+    return jnp.take_along_axis(t, idx, axis=1)
+
+
+def _mulhi_3x4(a0, a1, a2, m0, m1, m2, m3):
+    """bits 64..111 of (a2:a1:a0 16-bit limbs) * (m3:m2:m1:m0), as two
+    u32 digits (lo32, hi16).  a2 may be up to 0x10000 (17 bits): every
+    partial product still fits u32 (0x10000 * 0xFFFF < 2^32)."""
+    ps = {}
+    for i, av in enumerate((a0, a1, a2)):
+        for j, mv in enumerate((m0, m1, m2, m3)):
+            ps[i, j] = av * mv
+    # column digit sums, split into lo/hi 16 first so no sum overflows
+    g = [None] * 7  # g[k] multiplies 2^(16k); g6 collects col5's hi
+    for k in range(6):
+        lo = jnp.zeros_like(a0)
+        hi = jnp.zeros_like(a0)
+        for i in range(3):
+            j = k - i
+            if 0 <= j < 4:
+                lo = lo + (ps[i, j] & _M16)
+                hi = hi + (ps[i, j] >> 16)
+        g[k] = lo if g[k] is None else g[k] + lo
+        nxt = g[k + 1] if k + 1 < 7 and g[k + 1] is not None else None
+        g[k + 1] = hi if nxt is None else nxt + hi
+    carry = jnp.zeros_like(a0)
+    digits = []
+    for k in range(7):
+        t = g[k] + carry
+        digits.append(t & _M16)
+        carry = t >> 16
+    q_lo = digits[4] | (digits[5] << 16)
+    q_hi = digits[6] | (carry << 16)
+    return q_lo, q_hi
+
+
+def _mullo_3x2(q0, q1, q2, w0, w1):
+    """low 64 bits of (q2:q1:q0) * (w1:w0) as (lo32, hi32)."""
+    p00 = q0 * w0
+    p01 = q0 * w1
+    p10 = q1 * w0
+    p11 = q1 * w1
+    p20 = q2 * w0
+    p21 = q2 * w1
+    g0 = p00 & _M16
+    g1 = (p00 >> 16) + (p01 & _M16) + (p10 & _M16)
+    g2 = (p01 >> 16) + (p10 >> 16) + (p11 & _M16) + (p20 & _M16)
+    g3 = (p11 >> 16) + (p20 >> 16) + (p21 & _M16)
+    c = g0 >> 16
+    d0 = g0 & _M16
+    t = g1 + c
+    d1 = t & _M16
+    c = t >> 16
+    t = g2 + c
+    d2 = t & _M16
+    c = t >> 16
+    d3 = (g3 + c) & _M16
+    return d0 | (d1 << 16), d2 | (d3 << 16)
+
+
+def _straw2_math(x, item, r, w, mlo, mhi, tbl):
+    """Per-lane straw2 negdraw as u32 ops (the kernel body; shapes all
+    [S, 128]).  Returns (nd_lo, nd_hi) with w == 0 -> U64MAX."""
+    # ---- rjenkins hash (hashes.crush_hash32_3, inlined u32 ops) ----
+    a, b, c = x, item, r
+    h = hashes.CRUSH_HASH_SEED ^ a ^ b ^ c
+    hx = jnp.full_like(a, 231232)
+    hy = jnp.full_like(a, 1232)
+    a, b, h = hashes.hashmix(a, b, h)
+    c, hx, h = hashes.hashmix(c, hx, h)
+    hy, a, h = hashes.hashmix(hy, a, h)
+    b, hx, h = hashes.hashmix(b, hx, h)
+    hy, c, h = hashes.hashmix(hy, c, h)
+    u = h & _M16
+
+    # ---- crush_ln (hashes.crush_ln, LUTs via dynamic_gather) ----
+    xv = u + np.uint32(1)                      # [1, 0x10000]
+    p = jnp.zeros_like(xv)
+    for k in range(1, 17):                     # p = 31 - clz(xv)
+        p = p + (xv >= np.uint32(1 << k)).astype(U32)
+    need = p < np.uint32(15)
+    shift = jnp.where(need, np.uint32(15) - p, np.uint32(0))
+    xs = xv << shift                           # [0x8000, 0x10000]
+    iexpon = jnp.where(need, p, np.uint32(15))
+    kidx = (xs >> 8) - np.uint32(128)          # [0, 128]
+    bound = kidx == np.uint32(128)
+    # minui doesn't legalize in Mosaic; kidx <= 128 so signed min is safe
+    li = jnp.minimum(kidx.astype(I32), np.int32(127))
+    rh_lo = jnp.where(bound, _RH_B_LO, _lut(tbl, 0, li))
+    rh_hi = jnp.where(bound, _RH_B_HI, _lut(tbl, 1, li))
+    lh_lo = jnp.where(bound, _LH_B_LO, _lut(tbl, 2, li))
+    lh_hi = jnp.where(bound, _LH_B_HI, _lut(tbl, 3, li))
+
+    # index2 = ((xs * rh) >> 48) & 0xff ; xs <= 2^16, rh < 2^48
+    pa = xs * (rh_lo & _M16)
+    pb = xs * (rh_lo >> 16)
+    pc = xs * rh_hi                            # rh_hi < 2^16
+    s = (pa >> 16) + pb
+    hi32t = pc + (s >> 16)
+    idx2 = (hi32t >> 16) & np.uint32(0xFF)
+    half = idx2 >= np.uint32(128)
+    l2 = (idx2 & np.uint32(127)).astype(I32)
+    ll_lo = jnp.where(half, _lut(tbl, 6, l2), _lut(tbl, 4, l2))
+    ll_hi = jnp.where(half, _lut(tbl, 7, l2), _lut(tbl, 5, l2))
+
+    # ln = (iexpon << 44) + ((lh + ll) >> 4)   (< 2^48, as hi16:lo32)
+    sum_lo = lh_lo + ll_lo
+    carry = (sum_lo < lh_lo).astype(U32)
+    sum_hi = lh_hi + ll_hi + carry
+    ln_lo = (sum_lo >> 4) | (sum_hi << 28)
+    ln_hi = (sum_hi >> 4) + (iexpon << 12)
+
+    # ln_neg = 2^48 - ln
+    neg_lo = np.uint32(0) - ln_lo
+    borrow = (ln_lo != np.uint32(0)).astype(U32)
+    neg_hi = np.uint32(0x10000) - ln_hi - borrow
+
+    # ---- q = floor(ln_neg / w) via magic (hashes.div_by_magic) ----
+    a0 = neg_lo & _M16
+    a1 = neg_lo >> 16
+    a2 = neg_hi                                # <= 0x10000
+    m0 = mlo & _M16
+    m1 = mlo >> 16
+    m2 = mhi & _M16
+    m3 = mhi >> 16
+    q_lo, q_hi = _mulhi_3x4(a0, a1, a2, m0, m1, m2, m3)
+
+    wsafe = jnp.where(w == np.uint32(0), np.uint32(1), w)  # maxui: no Mosaic
+    w0 = wsafe & _M16
+    w1 = wsafe >> 16
+    for _ in range(3):                         # same 3 corrections
+        qw_lo, qw_hi = _mullo_3x2(q_lo & _M16, q_lo >> 16, q_hi & _M16,
+                                  w0, w1)
+        rem_lo = neg_lo - qw_lo
+        rb = (neg_lo < qw_lo).astype(U32)
+        rem_hi = neg_hi - qw_hi - rb
+        over = (rem_hi != np.uint32(0)) | (rem_lo >= wsafe)
+        inc = over.astype(U32)
+        nq_lo = q_lo + inc
+        q_hi = q_hi + ((nq_lo == 0) & over).astype(U32)
+        q_lo = nq_lo
+
+    zero = w == np.uint32(0)
+    return jnp.where(zero, _U32MAX, q_lo), jnp.where(zero, _U32MAX, q_hi)
+
+
+def _kernel(x_ref, id_ref, r_ref, w_ref, mlo_ref, mhi_ref, tbl_ref,
+            lo_ref, hi_ref):
+    lo, hi = _straw2_math(
+        x_ref[:, :], id_ref[:, :], r_ref[:, :], w_ref[:, :],
+        mlo_ref[:, :], mhi_ref[:, :], tbl_ref[:, :],
+    )
+    lo_ref[:, :] = lo
+    hi_ref[:, :] = hi
+
+
+def _negdraw_call(xf, idf, rf, wf, mlo, mhi, interpret: bool):
+    with jax.enable_x64(False):
+        return _negdraw_jit(xf, idf, rf, wf, mlo, mhi, interpret)
+
+
+@partial(jax.jit, static_argnums=(6,))
+def _negdraw_jit(xf, idf, rf, wf, mlo, mhi, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = xf.shape[0]
+    rows = n // 128
+    grid = (rows // SUBLANES,)
+    bs = lambda: pl.BlockSpec((SUBLANES, 128), lambda i: (i, 0),
+                              memory_space=pltpu.VMEM)
+    args = [v.reshape(rows, 128) for v in (xf, idf, rf, wf, mlo, mhi)]
+    out = pl.pallas_call(
+        _kernel,
+        out_shape=(jax.ShapeDtypeStruct((rows, 128), jnp.uint32),
+                   jax.ShapeDtypeStruct((rows, 128), jnp.uint32)),
+        grid=grid,
+        in_specs=[bs() for _ in range(6)] + [
+            pl.BlockSpec((8, 128), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM)],
+        out_specs=(bs(), bs()),
+        interpret=interpret,
+    )(*args, jnp.asarray(_TBL))
+    return out[0].reshape(n), out[1].reshape(n)
+
+
+# ---------------------------------------------------------------------------
+# Level-descent kernel: one whole straw2 choose (row fetch + F-way draw +
+# first-wins argmin + winner field select) per call.  Removes the XLA-side
+# one-hot row matmul, the [B, F] HBM intermediates and the u64 argmin —
+# per-level HBM traffic drops to ~7 words/lane.
+# ---------------------------------------------------------------------------
+
+MAX_HALVES = 4   # level tables up to 4*128 buckets ride the kernel
+MAX_FANOUT = 32  # per-child straw2 unroll bound (compile time/VMEM)
+
+
+def _bucket_field(tbl_ref, field: int, f: int, halves: int, lidx, li):
+    """Per-lane bucket-table read: tbl[field, f, lidx] where the level
+    table is packed as [NF, F, H, 128] lane vectors.  ``li`` is
+    ``lidx & 127``; lanes pick their 128-half by ``lidx >> 7``."""
+    v = jnp.take_along_axis(
+        jnp.broadcast_to(tbl_ref[field, f, 0:1, :], li.shape), li, axis=1)
+    for h in range(1, halves):
+        vh = jnp.take_along_axis(
+            jnp.broadcast_to(tbl_ref[field, f, h:h + 1, :], li.shape),
+            li, axis=1)
+        v = jnp.where((lidx >> 7) == np.uint32(h), vh, v)
+    return v
+
+
+def _make_level_kernel(fanout: int, halves: int):
+    def kern(x_ref, r_ref, lidx_ref, tbl_ref, lut_ref,
+             item_ref, ctnl_ref, size_ref):
+        x = x_ref[:, :]
+        r = r_ref[:, :]
+        lidx = lidx_ref[:, :]
+        lut = lut_ref[:, :]
+        li = (lidx & np.uint32(127)).astype(I32)
+
+        # bucket size (per lidx, field 5 holds it at f=0)
+        size = _bucket_field(tbl_ref, 5, 0, halves, lidx, li)
+
+        best_lo = best_hi = None
+        chosen = ctnl = None
+        for f in range(fanout):
+            idf = _bucket_field(tbl_ref, 0, f, halves, lidx, li)
+            wf = _bucket_field(tbl_ref, 1, f, halves, lidx, li)
+            mlo = _bucket_field(tbl_ref, 2, f, halves, lidx, li)
+            mhi = _bucket_field(tbl_ref, 3, f, halves, lidx, li)
+            ctnlf = _bucket_field(tbl_ref, 4, f, halves, lidx, li)
+            nd_lo, nd_hi = _straw2_math(x, idf, r, wf, mlo, mhi, lut)
+            if f == 0:
+                best_lo, best_hi = nd_lo, nd_hi
+                chosen, ctnl = idf, ctnlf
+            else:
+                # strict less-than keeps first-index tie semantics
+                upd = (nd_hi < best_hi) | (
+                    (nd_hi == best_hi) & (nd_lo < best_lo))
+                best_lo = jnp.where(upd, nd_lo, best_lo)
+                best_hi = jnp.where(upd, nd_hi, best_hi)
+                chosen = jnp.where(upd, idf, chosen)
+                ctnl = jnp.where(upd, ctnlf, ctnl)
+
+        item_ref[:, :] = chosen
+        ctnl_ref[:, :] = ctnl
+        size_ref[:, :] = size
+    return kern
+
+
+def _level_call(xf, rf, lidxf, tbl, interpret: bool):
+    with jax.enable_x64(False):
+        return _level_jit(xf, rf, lidxf, tbl, interpret)
+
+
+@partial(jax.jit, static_argnums=(4,))
+def _level_jit(xf, rf, lidxf, tbl, interpret):
+    """Inputs are FLAT [N] u32 arrays, N a multiple of TILE."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nf, fanout, halves, _ = tbl.shape
+    n = xf.shape[0]
+    rows = n // 128
+    grid = (rows // SUBLANES,)
+    bs = lambda: pl.BlockSpec((SUBLANES, 128), lambda i: (i, 0),
+                              memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        _make_level_kernel(fanout, halves),
+        out_shape=(jax.ShapeDtypeStruct((rows, 128), jnp.uint32),) * 3,
+        grid=grid,
+        in_specs=[bs(), bs(), bs(),
+                  pl.BlockSpec((nf, fanout, halves, 128),
+                               lambda i: (0, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((8, 128), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=(bs(), bs(), bs()),
+        interpret=interpret,
+    )(xf.reshape(rows, 128), rf.reshape(rows, 128),
+      lidxf.reshape(rows, 128), tbl, jnp.asarray(_TBL))
+    return out
+
+
+def pack_level_table(ids: np.ndarray, weights: np.ndarray,
+                     magic: np.ndarray, ctype: np.ndarray,
+                     nlidx: np.ndarray, sizes: np.ndarray) -> np.ndarray | None:
+    """Host-side pack of one BFS level into the kernel's [6, F, H, 128]
+    u32 layout (fields: id, w, magic_lo, magic_hi, ctype<<16|nlidx,
+    size).  Returns None when the level exceeds the kernel's bounds."""
+    nb, fanout = ids.shape
+    halves = (max(nb, 1) + 127) // 128
+    if halves > MAX_HALVES or not 1 <= fanout <= MAX_FANOUT:
+        # wide flat buckets would unroll one full _straw2_math per
+        # child into a single Mosaic kernel (compile-time/VMEM blowup);
+        # the XLA [B, F] path handles any fanout
+        return None
+    if nlidx.max(initial=0) > 0xFFFF or ctype.max(initial=0) > 0xFF:
+        return None
+    t = np.zeros((6, fanout, halves, 128), np.uint32)
+    pad = halves * 128
+    for f in range(fanout):
+        for field, arr in ((0, ids[:, f]), (1, weights[:, f]),
+                           (2, (magic[:, f] & 0xFFFFFFFF).astype(np.uint32)),
+                           (3, (magic[:, f] >> np.uint64(32)).astype(np.uint32)),
+                           (4, (ctype[:, f].astype(np.uint32) << 16)
+                               | nlidx[:, f].astype(np.uint32))):
+            a = np.zeros((pad,), np.uint32)
+            a[:nb] = arr.astype(np.uint32)
+            t[field, f] = a.reshape(halves, 128)
+    a = np.zeros((pad,), np.uint32)
+    a[:nb] = sizes.astype(np.uint32)
+    t[5, :] = np.broadcast_to(a.reshape(halves, 128), (fanout, halves, 128))
+    return t
+
+
+def level_choose(x, r, lidx, tbl, interpret: bool | None = None):
+    """One straw2 level choose for a [B] batch.
+
+    Returns (item u32, ctype i32, nlidx i32, size i32), all [B].
+    ``tbl`` is the pack_level_table output as a device array."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n = x.shape[0]
+    npad = (n + TILE - 1) // TILE * TILE
+    u32 = lambda v: jnp.asarray(v).astype(U32)
+    xf, rf, lf = u32(x), u32(r), u32(lidx)
+    if npad != n:
+        pad = lambda v: jnp.pad(v, (0, npad - n))
+        xf, rf, lf = pad(xf), pad(rf), pad(lf)
+    item, ctnl, size = _level_call(xf, rf, lf, tbl, interpret)
+    item = item.reshape(-1)[:n]
+    ctnl = ctnl.reshape(-1)[:n]
+    size = size.reshape(-1)[:n]
+    return (item, (ctnl >> 16).astype(jnp.int32),
+            (ctnl & jnp.uint32(0xFFFF)).astype(jnp.int32),
+            size.astype(jnp.int32))
+
+
+def straw2_negdraw_fused(x, item_id, r, weight, magic,
+                         interpret: bool | None = None):
+    """Drop-in replacement for :func:`hashes.straw2_negdraw_magic`
+    (same broadcastable [.., F] args, same u64 result), computed by the
+    fused Pallas kernel.  Pads the flattened batch to the tile size;
+    padding lanes compute garbage that is sliced off."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    shape = jnp.broadcast_shapes(
+        jnp.shape(x), jnp.shape(item_id), jnp.shape(r),
+        jnp.shape(weight), jnp.shape(magic))
+    u32 = lambda v: jnp.broadcast_to(
+        jnp.asarray(v).astype(U32), shape).reshape(-1)
+    mg = jnp.broadcast_to(jnp.asarray(magic, jnp.uint64), shape).reshape(-1)
+    xf, idf, rf, wf = u32(x), u32(item_id), u32(r), u32(weight)
+    mlo = mg.astype(U32)
+    mhi = (mg >> jnp.uint64(32)).astype(U32)
+    n = xf.shape[0]
+    npad = (n + TILE - 1) // TILE * TILE
+    if npad != n:
+        pad = lambda v: jnp.pad(v, (0, npad - n))
+        xf, idf, rf, wf, mlo, mhi = map(pad, (xf, idf, rf, wf, mlo, mhi))
+    lo, hi = _negdraw_call(xf, idf, rf, wf, mlo, mhi, interpret)
+    nd = lo[:n].astype(jnp.uint64) | (hi[:n].astype(jnp.uint64) << jnp.uint64(32))
+    return nd.reshape(shape)

@@ -48,13 +48,15 @@ rather than capping each inner choose by per-lane remaining space.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ceph_tpu.core import hashes
+from ceph_tpu.core import hashes, pallas_straw2
 from .interp import _memo_put, rule_signature  # shared memo policy
 from .map import (
     ALG_STRAW2,
@@ -104,19 +106,30 @@ _CTYPE_DANGLING = 255
 
 
 class LevelTable:
-    """One BFS level of a descent pack (pytree)."""
+    """One BFS level of a descent pack (pytree).
 
-    def __init__(self, tb: jnp.ndarray, nb: int, fanout: int):
+    Carries two device encodings of the same level: ``tb`` (byte-split
+    bf16 for the XLA one-hot matmul path) and, when the level fits the
+    Pallas level kernel's bounds, ``lane_tb`` ([6, F, H, 128] u32 lane
+    vectors for in-VMEM dynamic_gather row fetch)."""
+
+    def __init__(self, tb: jnp.ndarray, nb: int, fanout: int,
+                 lane_tb: jnp.ndarray | None = None):
         self.tb = tb  # [NB, 19*F + 2] bfloat16 byte-split table
         self.nb = nb
         self.fanout = fanout
+        self.lane_tb = lane_tb
 
     def tree_flatten(self):
-        return (self.tb,), (self.nb, self.fanout)
+        if self.lane_tb is None:
+            return (self.tb,), (self.nb, self.fanout, False)
+        return (self.tb, self.lane_tb), (self.nb, self.fanout, True)
 
     @classmethod
     def tree_unflatten(cls, static, arrays):
-        return cls(arrays[0], *static)
+        nb, fanout, has_lane = static
+        return cls(arrays[0], nb, fanout,
+                   arrays[1] if has_lane else None)
 
 
 jax.tree_util.register_pytree_node(
@@ -210,7 +223,12 @@ def _build_level_table(
     tb = np.concatenate(
         col_list + [c[:, None] for c in _byte_cols(sizes, 2)], axis=1
     )
-    return LevelTable(jnp.asarray(tb, jnp.bfloat16), nb, fanout)
+    lane_tb = None
+    if _want_lane_tables():
+        lane_np = pallas_straw2.pack_level_table(
+            ids, ws, magic, ctype, nlidx, sizes)
+        lane_tb = None if lane_np is None else jnp.asarray(lane_np)
+    return LevelTable(jnp.asarray(tb, jnp.bfloat16), nb, fanout, lane_tb)
 
 
 def _bfs_levels(
@@ -336,6 +354,47 @@ def _select_col(vals: jnp.ndarray, col: jnp.ndarray) -> jnp.ndarray:
     )
 
 
+def _negdraw(x2, ids, r2, w, magic):
+    """straw2 negdraw dispatch: fused Pallas kernel on the chip (the
+    jnp path's crush_ln LUT gathers cost ~10 ns/lane there — silicon
+    profiling, round 3), plain jnp elsewhere.  Both are bit-exact
+    (tests/test_pallas_straw2.py); CEPH_TPU_FUSED_STRAW2=0/1 forces a
+    path (tests use 1 with interpret to cover the kernel on CPU)."""
+    if _fused_straw2():
+        return pallas_straw2.straw2_negdraw_fused(x2, ids, r2, w, magic)
+    return hashes.straw2_negdraw_magic(x2, ids, r2, w, magic)
+
+
+def _fused_straw2() -> bool:
+    mode = os.environ.get("CEPH_TPU_FUSED_STRAW2", "auto")
+    return mode == "1" or (mode == "auto" and jax.default_backend() == "tpu")
+
+
+def _kernel_mode() -> str:
+    """'1' forces the Pallas level kernel (interpret off-TPU), '0'
+    forces the XLA matmul path, 'auto' = kernel on the chip only."""
+    return os.environ.get("CEPH_TPU_LEVEL_KERNEL", "auto")
+
+
+def _want_lane_tables() -> bool:
+    """Whether pack builds should spend host time + device memory on
+    the level kernel's lane encoding at all (it is dead weight when the
+    dispatch can never select the kernel).
+
+    CEPH_TPU_FUSED_STRAW2=0 also disables the level kernel: it embeds
+    the same Pallas straw2 math, so "force the jnp path" must win over
+    the level dispatch or the escape hatch is a lie."""
+    mode = _kernel_mode()
+    fused_mode = os.environ.get("CEPH_TPU_FUSED_STRAW2", "auto")
+    if mode == "0" or fused_mode == "0":
+        return False
+    return mode == "1" or jax.default_backend() == "tpu"
+
+
+def _use_level_kernel(table: LevelTable) -> bool:
+    return table.lane_tb is not None and _want_lane_tables()
+
+
 def descend(
     pack: DescendPack,
     x: jnp.ndarray,       # [B] u32
@@ -361,17 +420,24 @@ def descend(
     lidx = lidx0
 
     for table in pack.tables:
-        row = take_rows(table, jnp.where(done, 0, lidx))
-        nd = hashes.straw2_negdraw_magic(
-            x[:, None], row["ids"], r[:, None].astype(U32),
-            row["weights"], row["magic"],
-        )  # [B, F] u64
-        amin = jnp.argmin(nd, axis=1).astype(I32)  # first-index ties
-        chosen = lax.bitcast_convert_type(_select_col(row["ids"], amin), I32)
-        ctype = _select_col(row["ctype"], amin)
-        nlidx = _select_col(row["nlidx"], amin)
+        if _use_level_kernel(table):
+            item_u, ctype, nlidx, size = pallas_straw2.level_choose(
+                x, r.astype(U32), jnp.where(done, 0, lidx), table.lane_tb)
+            chosen = lax.bitcast_convert_type(item_u, I32)
+        else:
+            row = take_rows(table, jnp.where(done, 0, lidx))
+            nd = _negdraw(
+                x[:, None], row["ids"], r[:, None].astype(U32),
+                row["weights"], row["magic"],
+            )  # [B, F] u64
+            amin = jnp.argmin(nd, axis=1).astype(I32)  # first-index ties
+            chosen = lax.bitcast_convert_type(
+                _select_col(row["ids"], amin), I32)
+            ctype = _select_col(row["ctype"], amin)
+            nlidx = _select_col(row["nlidx"], amin)
+            size = row["size"]
 
-        empty = row["size"] == 0
+        empty = size == 0
         is_bucket = chosen < 0
         reached = (ctype == target_type) if target_type != 0 else ~is_bucket
         wrong_dev = (~is_bucket) & (~reached)
@@ -879,15 +945,26 @@ _FAST_CACHE: dict = {}
 _PACK_CACHE: dict = {}
 
 
+def _dispatch_sig() -> tuple:
+    """Trace-time dispatch state that changes the compiled program —
+    the RESOLVED booleans, not the raw env strings, so equivalent
+    modes ('1' vs 'auto' on TPU) share one compiled executable."""
+    return (_fused_straw2(), _want_lane_tables())
+
+
 def fast_signature(dense: DenseCrushMap, rule: Rule, result_max: int) -> tuple:
     """Full compile-cache key for (dense, rule, result_max) — includes
     every map-derived constant baked into the traced program."""
     packs, run, program_sig = _packs_for(dense, rule, result_max)
-    return (program_sig, dense.tunables, result_max, dense.max_devices)
+    return (program_sig, dense.tunables, result_max, dense.max_devices,
+            _dispatch_sig())
 
 
 def _packs_for(dense: DenseCrushMap, rule: Rule, result_max: int):
-    pkey = (id(dense), rule_signature(rule), result_max)
+    # lane tables are built conditionally on the dispatch mode, so the
+    # pack cache must not serve a build made under a different mode
+    pkey = (id(dense), rule_signature(rule), result_max,
+            _want_lane_tables())
     hit = _PACK_CACHE.get(pkey)
     if hit is not None and hit[0] is dense:
         return hit[1], hit[2], hit[3]
@@ -906,7 +983,8 @@ def fast_runner(dense: DenseCrushMap, rule: Rule, result_max: int):
     repeated calls with the same map skip the host-side rebuild.
     """
     packs, run, program_sig = _packs_for(dense, rule, result_max)
-    key = (program_sig, dense.tunables, result_max, dense.max_devices)
+    key = (program_sig, dense.tunables, result_max, dense.max_devices,
+           _dispatch_sig())
     fn = _FAST_CACHE.get(key)
     if fn is None:
         fn = jax.jit(run)

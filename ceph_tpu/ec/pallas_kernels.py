@@ -121,9 +121,12 @@ class PallasBitmatrixEncoder:
             interpret = jax.default_backend() != "tpu"
         self._interpret = interpret
 
-    def encode(self, data: np.ndarray) -> np.ndarray:
-        """data [k, S] u8 -> coding [m, S] u8 (packet-interleaved)."""
-        k, m, p = self.k, self.m, self.packetsize
+    def _pack_words(self, data: np.ndarray) -> tuple[np.ndarray, int]:
+        """Packet-interleave [k, S] u8 into the kernel's padded
+        [KW, NWpad] u32 layout; returns (words, unpadded word count).
+        The single source of the kernel's input contract — benches
+        must use this, not a re-implementation."""
+        k, p = self.k, self.packetsize
         size = data.shape[1]
         group = W * p
         if size % group:
@@ -136,6 +139,14 @@ class PallasBitmatrixEncoder:
         nw_pad = _pad_to(max(nw, LANES * 4), LANES * 4)
         if nw_pad != nw:
             d_words = np.pad(d_words, ((0, 0), (0, nw_pad - nw)))
+        return d_words, nw
+
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        """data [k, S] u8 -> coding [m, S] u8 (packet-interleaved)."""
+        k, m, p = self.k, self.m, self.packetsize
+        size = data.shape[1]
+        g = size // (W * p)
+        d_words, nw = self._pack_words(data)
         out = np.asarray(
             _encode_padded(
                 jnp.asarray(self._masks), jnp.asarray(d_words),

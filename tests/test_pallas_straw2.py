@@ -1,0 +1,106 @@
+"""Fused Pallas straw2 kernel vs the jnp path (bit-exact, interpret)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from ceph_tpu.core import hashes
+from ceph_tpu.core.pallas_straw2 import straw2_negdraw_fused
+
+
+def _compare(x, ids, r, w):
+    magic = hashes.magic_reciprocal(w)
+    want = np.asarray(hashes.straw2_negdraw_magic(
+        jnp.asarray(x), jnp.asarray(ids), jnp.asarray(r),
+        jnp.asarray(w), jnp.asarray(magic)))
+    got = np.asarray(straw2_negdraw_fused(
+        jnp.asarray(x), jnp.asarray(ids), jnp.asarray(r),
+        jnp.asarray(w), jnp.asarray(magic), interpret=True))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_random_draws():
+    rng = np.random.default_rng(42)
+    B, F = 1024, 8
+    x = rng.integers(0, 2**32, (B, 1), dtype=np.uint32)
+    ids = rng.integers(0, 2**31, (B, F), dtype=np.uint32)
+    r = rng.integers(0, 64, (B, 1), dtype=np.uint32)
+    w = rng.integers(0, 0x200000, (B, F), dtype=np.uint32)
+    _compare(x, ids, r, w)
+
+
+def test_edge_weights_and_boundary():
+    # weights: zero (masked to U64MAX), one, huge; plus enough draws to
+    # hit the crush_ln boundary (u == 0xffff -> xs == 0x10000) and the
+    # ll table's upper half
+    B, F = 512, 4
+    rng = np.random.default_rng(7)
+    x = rng.integers(0, 2**32, (B, 1), dtype=np.uint32)
+    ids = rng.integers(0, 2**31, (B, F), dtype=np.uint32)
+    r = rng.integers(0, 50, (B, 1), dtype=np.uint32)
+    w = np.zeros((B, F), np.uint32)
+    w[:, 0] = 0
+    w[:, 1] = 1
+    w[:, 2] = 0xFFFFFFFF
+    w[:, 3] = 0x10000
+    _compare(x, ids, r, w)
+
+
+def test_nonaligned_batch_padding():
+    # N not a multiple of the tile: padding lanes must not leak
+    rng = np.random.default_rng(3)
+    B, F = 333, 3
+    x = rng.integers(0, 2**32, (B, 1), dtype=np.uint32)
+    ids = rng.integers(0, 2**31, (B, F), dtype=np.uint32)
+    r = rng.integers(0, 8, (B, 1), dtype=np.uint32)
+    w = rng.integers(1, 0x40000, (B, F), dtype=np.uint32)
+    _compare(x, ids, r, w)
+
+
+def test_engine_with_fused_path_matches(monkeypatch):
+    """Whole batch engine with the fused straw2 forced (interpret on
+    CPU) must match the default jnp path placement-for-placement."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.crush.engine import make_batch_runner
+    from ceph_tpu.models.clusters import build_simple
+
+    m = build_simple(64)
+    rule = m.rule_by_name("replicated_rule")
+    dense = m.to_dense()
+    osd_w = jnp.asarray(np.full(dense.max_devices, 0x10000, np.uint32))
+    xs = jnp.arange(192, dtype=jnp.uint32)
+
+    crush_arg, run = make_batch_runner(dense, rule, 3)
+    want_res, want_len = run(crush_arg, osd_w, xs)
+
+    monkeypatch.setenv("CEPH_TPU_FUSED_STRAW2", "1")
+    crush_arg2, run2 = make_batch_runner(dense, rule, 3)
+    got_res, got_len = run2(crush_arg2, osd_w, xs)
+    np.testing.assert_array_equal(np.asarray(got_res), np.asarray(want_res))
+    np.testing.assert_array_equal(np.asarray(got_len), np.asarray(want_len))
+
+
+def test_engine_with_level_kernel_matches(monkeypatch):
+    """Whole batch engine with the Pallas level-descent kernel forced
+    (interpret on CPU) must match the XLA matmul path exactly."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.crush.engine import make_batch_runner
+    from ceph_tpu.models.clusters import build_simple, build_skewed
+
+    for m in (build_simple(64), build_skewed(48)):
+        rule = m.rule_by_name("replicated_rule")
+        dense = m.to_dense()
+        osd_w = jnp.asarray(np.full(dense.max_devices, 0x10000, np.uint32))
+        osd_w = osd_w.at[3].set(0x8000).at[7].set(0)  # reweights + out
+        xs = jnp.arange(160, dtype=jnp.uint32)
+
+        monkeypatch.delenv("CEPH_TPU_LEVEL_KERNEL", raising=False)
+        crush_arg, run = make_batch_runner(dense, rule, 3)
+        want_res, want_len = run(crush_arg, osd_w, xs)
+
+        monkeypatch.setenv("CEPH_TPU_LEVEL_KERNEL", "1")
+        crush_arg2, run2 = make_batch_runner(dense, rule, 3)
+        got_res, got_len = run2(crush_arg2, osd_w, xs)
+        np.testing.assert_array_equal(np.asarray(got_res), np.asarray(want_res))
+        np.testing.assert_array_equal(np.asarray(got_len), np.asarray(want_len))
