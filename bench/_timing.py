@@ -21,12 +21,15 @@ import time
 
 
 def chained_rate(step, state0, *, iters: int = 10, reps: int = 3):
-    """Best seconds/iteration over ``reps`` chains of ``iters`` steps.
+    """Best seconds/iteration over ``reps`` segments of one continuous
+    ``iters``-step chain.
 
     ``step(state) -> state'`` where state is a pytree of device arrays
     and state' depends on state's *values*.  Compiles/warms once, then
-    for each rep: re-chain from state0 and read back one scalar.
-    Returns (best_seconds_per_iter, checksum_float).
+    keeps extending the SAME chain — reps are consecutive segments, so
+    no dispatch ever repeats previously-seen input values — reading
+    back one scalar per segment.  Returns (best_seconds_per_iter,
+    last_checksum).
     """
     import jax
     import jax.numpy as jnp

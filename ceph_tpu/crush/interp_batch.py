@@ -366,6 +366,10 @@ def _negdraw(x2, ids, r2, w, magic):
 
 
 def _fused_straw2() -> bool:
+    # default_backend() reports "tpu" through this machine's tunnel
+    # plugin when properly attached (verified on silicon); "axon" only
+    # appears when the env scrub is wrong, and then no device path
+    # works anyway
     mode = os.environ.get("CEPH_TPU_FUSED_STRAW2", "auto")
     return mode == "1" or (mode == "auto" and jax.default_backend() == "tpu")
 

@@ -37,7 +37,13 @@ W = 8
 
 
 class TableEncoder:
-    """GF(2^8) matrix x data on device via per-coefficient LUT gathers."""
+    """GF(2^8) matrix x data on device via per-coefficient LUTs.
+
+    On the chip the lookups run through the fused Pallas byte-table
+    kernel (:func:`ceph_tpu.ec.pallas_gf.matrix_encode`) — XLA's
+    per-lane gathers cost ~10 ns/lane there (round-3 silicon
+    profiling); elsewhere the jnp gather path is used.  Both are
+    bit-identical (tests/test_pallas_gf.py)."""
 
     def __init__(self, matrix: np.ndarray):
         self.matrix = np.asarray(matrix, np.uint8)
@@ -46,11 +52,16 @@ class TableEncoder:
         self.luts = gf.mul_table()[self.matrix]
         m, k = self.m, self.k
         luts_np = self.luts
+        matrix_np = self.matrix
 
         # per-instance jit (not a static-self method): the compiled
         # executable's lifetime is tied to this encoder, so dropped
         # encoders don't pin cache entries forever
         def _encode(data: jnp.ndarray) -> jnp.ndarray:
+            if jax.default_backend() == "tpu":
+                from .pallas_gf import matrix_encode
+
+                return matrix_encode(matrix_np, data, interpret=False)
             luts = jnp.asarray(luts_np)
             idx = data.astype(jnp.int32)  # [k, S]
 

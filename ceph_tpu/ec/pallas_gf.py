@@ -1,0 +1,208 @@
+"""Pallas TPU kernels for GF(2^8) byte-table operations.
+
+Round-3 silicon profiling: XLA lowers per-lane table gathers at
+~10 ns/lane on the chip regardless of table size, which makes every
+``jnp.take``-based GF(2^8) path (TableEncoder's log-table multiply,
+CLAY's coupled-pair transforms) gather-bound by 2-3 orders of
+magnitude.  The cure is the TPU's in-register table unit
+(``tpu.dynamic_gather``), reachable only through Pallas and only for
+128-wide lane-resident tables — so 256-entry GF tables are split into
+two 128-entry halves and selected (see pallas_straw2.py for the same
+trick on crush_ln's LUTs).
+
+Two primitives:
+
+- :func:`byte_lut` — ``table[x]`` for u8 arrays, any shape.
+- :func:`matrix_encode` — ``coding[j] = XOR_i mul_table[M[j,i]][data[i]]``,
+  the whole GF matrix-vector product over a chunk batch in one kernel
+  (TableEncoder's inner loop with the m*k byte lookups fused).
+
+Both fall back to the jnp gather path off-TPU (tests force the kernels
+through interpret mode); results are bit-identical by construction and
+test-enforced.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+SUBLANES = 256
+TILE = SUBLANES * 128  # u32 words per grid step
+
+
+def _pad_words(x32, tile):
+    n = x32.shape[0]
+    npad = (n + tile - 1) // tile * tile
+    if npad != n:
+        x32 = jnp.pad(x32, (0, npad - n))
+    return x32, n
+
+
+def _tbl_lanes(table: np.ndarray) -> np.ndarray:
+    """[256] u8 -> [2, 128] u32 lane-resident halves."""
+    t = np.asarray(table, np.uint8).astype(np.uint32)
+    return t.reshape(2, 128)
+
+
+def _lut256(tbl_ref, row0: int, idx):
+    """table[idx] for idx in [0,256): two 128-lane gathers + select.
+    ``tbl_ref`` rows [row0, row0+1] hold the table halves."""
+    hi = idx >= np.uint32(128)
+    li = (idx & np.uint32(127)).astype(jnp.int32)
+    lo_v = jnp.take_along_axis(
+        jnp.broadcast_to(tbl_ref[row0:row0 + 1, :], li.shape), li, axis=1)
+    hi_v = jnp.take_along_axis(
+        jnp.broadcast_to(tbl_ref[row0 + 1:row0 + 2, :], li.shape), li, axis=1)
+    return jnp.where(hi, hi_v, lo_v)
+
+
+def _word_lut(tbl_ref, row0: int, w):
+    """Apply a 256-entry byte table to all 4 bytes of u32 words."""
+    out = jnp.zeros_like(w)
+    for b in range(4):
+        idx = (w >> np.uint32(8 * b)) & np.uint32(0xFF)
+        out = out | (_lut256(tbl_ref, row0, idx) << np.uint32(8 * b))
+    return out
+
+
+def _byte_lut_kernel(x_ref, tbl_ref, o_ref):
+    o_ref[:, :] = _word_lut(tbl_ref, 0, x_ref[:, :])
+
+
+def _byte_lut_call(x32, tbl, interpret: bool):
+    with jax.enable_x64(False):
+        return _byte_lut_jit(x32, tbl, interpret)
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _byte_lut_jit(x32, tbl, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = x32.shape[0]
+    rows = n // 128
+    sub = min(SUBLANES, rows)  # small inputs: shrink the tile
+    bs = pl.BlockSpec((sub, 128), lambda i: (i, 0),
+                      memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _byte_lut_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, 128), jnp.uint32),
+        grid=(rows // sub,),
+        in_specs=[bs, pl.BlockSpec((2, 128), lambda i: (0, 0),
+                                   memory_space=pltpu.VMEM)],
+        out_specs=bs,
+        interpret=interpret,
+    )(x32.reshape(rows, 128), tbl).reshape(n)
+
+
+def byte_lut(x, table, interpret: bool | None = None):
+    """``table[x]`` for a u8 array of any shape (device-fast on TPU).
+
+    ``table``: 256-entry u8 (numpy or device).  Bit-identical to
+    ``jnp.take(table, x)``; pads internally to the tile size.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    x = jnp.asarray(x, jnp.uint8)
+    shape = x.shape
+    flat = x.reshape(-1)
+    n8 = flat.shape[0]
+    # pack to u32 words (4 bytes/lane); pad bytes to word multiple
+    if n8 % 4:
+        flat = jnp.pad(flat, (0, 4 - n8 % 4))
+    words = jax.lax.bitcast_convert_type(
+        flat.reshape(-1, 4), jnp.uint32).reshape(-1)
+    rows_needed = (words.shape[0] + 127) // 128
+    gran = min(SUBLANES, rows_needed) * 128
+    words, nw = _pad_words(words, gran)
+    tbl = jnp.asarray(_tbl_lanes(np.asarray(table)))
+    out = _byte_lut_call(words, tbl, interpret)[:nw]
+    ob = jax.lax.bitcast_convert_type(
+        out.reshape(-1, 1), jnp.uint8).reshape(-1)[:n8]
+    return ob.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# Fused GF matrix encode: coding[j] = XOR_i mul(M[j,i], data[i])
+# ---------------------------------------------------------------------------
+
+
+def _make_matrix_kernel(m: int, k: int):
+    def kern(d_ref, tbl_ref, o_ref):
+        for j in range(m):
+            acc = jnp.zeros_like(d_ref[0])
+            for i in range(k):
+                acc = acc ^ _word_lut(tbl_ref, 2 * (j * k + i), d_ref[i])
+            o_ref[j] = acc
+    return kern
+
+
+def _matrix_call(d32, tbl, m: int, interpret: bool):
+    with jax.enable_x64(False):
+        return _matrix_jit(d32, tbl, m, interpret)
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def _matrix_jit(d32, tbl, m, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    k, n = d32.shape
+    rows = n // 128
+    sub = min(SUBLANES, rows)
+    return pl.pallas_call(
+        _make_matrix_kernel(m, k),
+        out_shape=jax.ShapeDtypeStruct((m, rows, 128), jnp.uint32),
+        grid=(rows // sub,),
+        in_specs=[
+            pl.BlockSpec((k, sub, 128), lambda i: (0, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(tbl.shape, lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((m, sub, 128), lambda i: (0, i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(d32.reshape(k, rows, 128), tbl).reshape(m, n)
+
+
+def matrix_encode(matrix, data, interpret: bool | None = None):
+    """GF(2^8) ``[m, k] x [k, S] -> [m, S]`` via fused byte-table kernel.
+
+    Bit-identical to the log-table path (``gf.matrix_encode``); ``S``
+    padded internally.  Used by TableEncoder's device path on TPU.
+    """
+    from . import gf
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    M = np.asarray(matrix, np.uint8)
+    m, k = M.shape
+    mt = gf.mul_table()
+    tbl = np.concatenate(
+        [_tbl_lanes(mt[M[j, i]]) for j in range(m) for i in range(k)], axis=0
+    )  # [2*m*k, 128]
+    d = jnp.asarray(data, jnp.uint8)
+    S = d.shape[1]
+    pad8 = (4 - S % 4) % 4
+    if pad8:
+        d = jnp.pad(d, ((0, 0), (0, pad8)))
+    words = jax.lax.bitcast_convert_type(
+        d.reshape(k, -1, 4), jnp.uint32)  # [k, S/4]
+    nw = words.shape[1]
+    npad = (nw + TILE - 1) // TILE * TILE
+    # small inputs: shrink the tile rather than pad 32x
+    if npad != nw:
+        rows_needed = (nw + 127) // 128
+        sub = min(SUBLANES, rows_needed)
+        npad = (nw + sub * 128 - 1) // (sub * 128) * (sub * 128)
+        words = jnp.pad(words, ((0, 0), (0, npad - nw)))
+    out = _matrix_call(words, jnp.asarray(tbl), m, interpret)[:, :nw]
+    ob = jax.lax.bitcast_convert_type(
+        out.reshape(m, -1, 1), jnp.uint8).reshape(m, -1)
+    return ob[:, :S]

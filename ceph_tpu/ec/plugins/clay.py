@@ -49,6 +49,18 @@ from ..interface import ErasureCode, ErasureCodeError, Profile
 GAMMA = 2  # alpha; any g not in {0, 1} works (det 1 + g^2 != 0)
 
 
+def _gf_lut(table_np: np.ndarray, x):
+    """``table[x]`` inside the device kernels: the Pallas byte-table
+    kernel on the chip (XLA per-lane gathers run ~10 ns/lane there —
+    round-3 silicon profiling), plain jnp gather elsewhere.  The table
+    is a host constant (a mul_table row)."""
+    if jax.default_backend() == "tpu":
+        from ..pallas_gf import byte_lut
+
+        return byte_lut(x, table_np, interpret=False)
+    return jnp.take(jnp.asarray(table_np), jnp.asarray(x).astype(jnp.int32))
+
+
 class ErasureCodeClay(ErasureCode):
     def init(self, profile: Profile) -> None:
         self.profile = profile
@@ -262,8 +274,8 @@ class ErasureCodeClay(ErasureCode):
         node_ids = digits + (np.arange(self.t)[None, :] * self.q)
         score = er[node_ids].sum(axis=1)  # [Z]
         known = np.nonzero(~er)[0]
-        tab_g = jnp.asarray(mt[GAMMA])
-        tab_di = jnp.asarray(mt[self._det_inv])
+        tab_g = mt[GAMMA]
+        tab_di = mt[self._det_inv]
 
         classes = []
         known_fns = []
@@ -280,15 +292,11 @@ class ErasureCodeClay(ErasureCode):
 
             def fn(C_j, U_j, *, d_mask=d_mask, pa=pa, zp=zp, pe=pe,
                    kn_j=kn_j, P_j=P_j):
-                i32 = jnp.int32
                 cn = C_j[kn_j[:, None], P_j[None, :]]  # [K, P, sub]
                 cpart = C_j[pa, zp]
                 upa = U_j[pa, zp]
-                u_pair = jnp.take(
-                    tab_di,
-                    (cn ^ jnp.take(tab_g, cpart.astype(i32))).astype(i32),
-                )
-                u_pe = cn ^ jnp.take(tab_g, upa.astype(i32))
+                u_pair = _gf_lut(tab_di, cn ^ _gf_lut(tab_g, cpart))
+                u_pe = cn ^ _gf_lut(tab_g, upa)
                 return jnp.where(d_mask, cn, jnp.where(pe, u_pe, u_pair))
 
             known_fns.append(jax.jit(fn))
@@ -301,10 +309,9 @@ class ErasureCodeClay(ErasureCode):
 
         @jax.jit
         def rebuild_fn(U_j):
-            i32 = jnp.int32
             ue = U_j[er_j]  # [E, Z, sub]
             upz = U_j[pa_e, zp_e]
-            return jnp.where(d_e, ue, ue ^ jnp.take(tab_g, upz.astype(i32)))
+            return jnp.where(d_e, ue, ue ^ _gf_lut(tab_g, upz))
 
         self._decode_fns[erased_key] = (known_fns, rebuild_fn, classes)
         return self._decode_fns[erased_key]
@@ -388,9 +395,9 @@ class ErasureCodeClay(ErasureCode):
         unknown[(yv == y0) & (xv != x0)] = True
         known = np.nonzero(~unknown)[0]
 
-        tab_g = jnp.asarray(mt[GAMMA])
-        tab_di = jnp.asarray(mt[self._det_inv])
-        tab_gi = jnp.asarray(mt[self._ginv])
+        tab_g = mt[GAMMA]
+        tab_di = mt[self._det_inv]
+        tab_gi = mt[self._ginv]
         d_mask = jnp.asarray(diag[known][:, planes][..., None])
         pa = jnp.asarray(partner[known][:, planes])
         pz = jnp.asarray(pos[zpair[known][:, planes]])
@@ -400,10 +407,7 @@ class ErasureCodeClay(ErasureCode):
         def u_known_fn(Cp):
             cn = Cp[known_j]  # [K, P, sub]
             cpart = Cp[pa, pz]  # [K, P, sub]
-            i32 = jnp.int32
-            u_pair = jnp.take(
-                tab_di, (cn ^ jnp.take(tab_g, cpart.astype(i32))).astype(i32)
-            )
+            u_pair = _gf_lut(tab_di, cn ^ _gf_lut(tab_g, cpart))
             return jnp.where(d_mask, cn, u_pair)
 
         zy0 = digits[:, y0]
@@ -414,12 +418,11 @@ class ErasureCodeClay(ErasureCode):
 
         @jax.jit
         def rebuild_fn(Cp, U):
-            i32 = jnp.int32
             u_pz = U[partner0, pidx]  # [Z, sub]
             c_pz = Cp[partner0, pidx]
             # partner's pair equation at plane zpair reveals U(lost, z)
-            u_lost = jnp.take(tab_gi, (c_pz ^ u_pz).astype(i32))
-            off_diag = u_lost ^ jnp.take(tab_g, u_pz.astype(i32))
+            u_lost = _gf_lut(tab_gi, c_pz ^ u_pz)
+            off_diag = u_lost ^ _gf_lut(tab_g, u_pz)
             on_diag = U[lost, on_diag_idx]
             return jnp.where(diag_mask, on_diag, off_diag)
 

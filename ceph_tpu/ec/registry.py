@@ -23,12 +23,14 @@ def register_plugin(name: str, loader: Callable[[], type]) -> None:
 
 
 def _builtin(name: str):
-    if name in ("jerasure", "jax", "isa"):
-        # "isa" maps onto the same RS math (the reference's ISA-L plugin
-        # is an alternate CPU backend for identical codes)
+    if name in ("jerasure", "jax"):
         from .plugins.jerasure import ErasureCodeJerasure
 
         return ErasureCodeJerasure
+    if name == "isa":
+        from .plugins.isa import ErasureCodeIsa
+
+        return ErasureCodeIsa
     if name == "lrc":
         from .plugins.lrc import ErasureCodeLrc
 

@@ -1,0 +1,35 @@
+"""Pallas GF byte-table kernels vs the jnp/numpy paths (bit-exact)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import gf
+from ceph_tpu.ec.pallas_gf import byte_lut, matrix_encode
+
+
+def test_byte_lut_matches_take():
+    rng = np.random.default_rng(5)
+    table = rng.integers(0, 256, 256, dtype=np.uint8)
+    for shape in ((7,), (3, 1000), (2, 5, 33)):
+        x = rng.integers(0, 256, shape, dtype=np.uint8)
+        got = np.asarray(byte_lut(x, table, interpret=True))
+        np.testing.assert_array_equal(got, table[x])
+
+
+def test_byte_lut_gf_tables():
+    mt = gf.mul_table()
+    rng = np.random.default_rng(6)
+    x = rng.integers(0, 256, 4096, dtype=np.uint8)
+    for c in (1, 2, 0x1D, 255):
+        got = np.asarray(byte_lut(x, mt[c], interpret=True))
+        np.testing.assert_array_equal(got, mt[c][x])
+
+
+@pytest.mark.parametrize("k,m,size", [(4, 2, 4096), (8, 3, 1024), (5, 1, 131)])
+def test_matrix_encode_matches_gf(k, m, size):
+    rng = np.random.default_rng(k * 7 + m)
+    M = gf.vandermonde_matrix(k, m)
+    data = rng.integers(0, 256, (k, size), dtype=np.uint8)
+    got = np.asarray(matrix_encode(M, data, interpret=True))
+    want = gf.matrix_encode(M, data)
+    np.testing.assert_array_equal(got, want)
