@@ -29,10 +29,16 @@ def main() -> None:
         i: {z: enc[i][z * sub_size : (z + 1) * sub_size] for z in planes}
         for i in helpers
     }
-    clay.repair(0, hs)  # warm (compile decode matrices)
+    out = clay.repair(0, hs)  # warm (compile decode matrices)
+    # chain: fold the previous output into one helper plane so every
+    # timed call has fresh input values — repeated identical dispatches
+    # are elided below JAX on this machine (see bench/_timing.py)
+    h0 = min(helpers)
+    z0 = int(planes[0])
     iters = 3
     t0 = time.perf_counter()
     for _ in range(iters):
+        hs[h0][z0] = hs[h0][z0] ^ out[:sub_size]
         out = clay.repair(0, hs)
     dt = (time.perf_counter() - t0) / iters
     rate = len(enc[0]) / dt
@@ -43,7 +49,10 @@ def main() -> None:
     cs = len(enc2[0])
     need = lrc.minimum_to_decode({0}, set(range(8)) - {0})
     avail = {i: enc2[i] for i in need}
-    lrc.decode({0}, avail, cs)
+    prev = lrc.decode({0}, avail, cs)
+    # fresh input values for the timed call (elision defense, as above)
+    first = min(avail)
+    avail[first] = avail[first] ^ prev[0]
     t0 = time.perf_counter()
     lrc.decode({0}, avail, cs)
     lrc_rate = cs / (time.perf_counter() - t0)

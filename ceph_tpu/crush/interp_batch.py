@@ -376,8 +376,13 @@ def _fused_straw2() -> bool:
 
 def _kernel_mode() -> str:
     """'1' forces the Pallas level kernel (interpret off-TPU), '0'
-    forces the XLA matmul path, 'auto' = kernel on the chip only."""
-    return os.environ.get("CEPH_TPU_LEVEL_KERNEL", "auto")
+    forces the XLA matmul path.  Default is OFF (opt-in): the level
+    kernel is bit-exact in tests but its one silicon compile attempt
+    hung >20 min before the TPU tunnel wedged (round 3) — until a
+    bounded compile is demonstrated on the chip, auto-enabling it
+    would put the driver's whole bench run at risk.  The flat fused
+    straw2 kernel (CEPH_TPU_FUSED_STRAW2, auto-on) is the proven path."""
+    return os.environ.get("CEPH_TPU_LEVEL_KERNEL", "0")
 
 
 def _want_lane_tables() -> bool:
@@ -390,9 +395,12 @@ def _want_lane_tables() -> bool:
     the level dispatch or the escape hatch is a lie."""
     mode = _kernel_mode()
     fused_mode = os.environ.get("CEPH_TPU_FUSED_STRAW2", "auto")
-    if mode == "0" or fused_mode == "0":
+    if fused_mode == "0":
         return False
-    return mode == "1" or jax.default_backend() == "tpu"
+    # strictly opt-in: ONLY the literal '1' enables the kernel (a
+    # legacy 'auto' value must not re-enable the unproven silicon
+    # compile the default exists to fence off)
+    return mode == "1"
 
 
 def _use_level_kernel(table: LevelTable) -> bool:

@@ -112,6 +112,8 @@ def byte_lut(x, table, interpret: bool | None = None):
     shape = x.shape
     flat = x.reshape(-1)
     n8 = flat.shape[0]
+    if n8 == 0:
+        return x
     # pack to u32 words (4 bytes/lane); pad bytes to word multiple
     if n8 % 4:
         flat = jnp.pad(flat, (0, 4 - n8 % 4))
@@ -189,6 +191,8 @@ def matrix_encode(matrix, data, interpret: bool | None = None):
     )  # [2*m*k, 128]
     d = jnp.asarray(data, jnp.uint8)
     S = d.shape[1]
+    if S == 0:
+        return jnp.zeros((m, 0), jnp.uint8)
     pad8 = (4 - S % 4) % 4
     if pad8:
         d = jnp.pad(d, ((0, 0), (0, pad8)))

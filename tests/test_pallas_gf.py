@@ -33,3 +33,13 @@ def test_matrix_encode_matches_gf(k, m, size):
     got = np.asarray(matrix_encode(M, data, interpret=True))
     want = gf.matrix_encode(M, data)
     np.testing.assert_array_equal(got, want)
+
+
+def test_empty_inputs():
+    table = np.arange(256, dtype=np.uint8)
+    out = np.asarray(byte_lut(np.empty(0, np.uint8), table, interpret=True))
+    assert out.shape == (0,)
+    M = gf.vandermonde_matrix(3, 2)
+    out = np.asarray(matrix_encode(M, np.empty((3, 0), np.uint8),
+                                   interpret=True))
+    assert out.shape == (2, 0)
