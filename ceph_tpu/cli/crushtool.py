@@ -56,11 +56,19 @@ def cmd_tree(m: CrushMap, out) -> None:
 def run_test(m: CrushMap, args, out) -> int:
     from ..crush.engine import run_batch
 
+    if args.rule is not None and args.rule not in m.rules:
+        print(f"rule {args.rule} not in map (rules: "
+              f"{sorted(m.rules)})", file=sys.stderr)
+        return 1
     rules = (
         [m.rules[args.rule]]
         if args.rule is not None
         else sorted(m.rules.values(), key=lambda r: r.id)
     )
+    if not rules:
+        print("map has no rules (--build maps need a rule added "
+              "via the text compiler)", file=sys.stderr)
+        return 1
     dense = m.to_dense()
     xs = np.arange(args.min_x, args.max_x + 1, dtype=np.uint32)
     weights = np.full(max(dense.max_devices, 1), 0x10000, np.uint32)
@@ -179,6 +187,17 @@ def main(argv=None) -> int:
     p.add_argument("--show-bad-mappings", action="store_true")
     p.add_argument("--weight", action="append", metavar="OSD:W")
     p.add_argument("--cpu", action="store_true", help="use the C++ CPU reference")
+    # map mutation (reference crushtool --add-item/--remove-item/
+    # --reweight-item; weights are decimal, 1.0 = 0x10000)
+    p.add_argument("--add-item", nargs=3, metavar=("ID", "WEIGHT", "NAME"),
+                   help="add device ID with WEIGHT as NAME (needs --loc)")
+    p.add_argument("--loc", nargs=2, action="append",
+                   metavar=("TYPE", "NAME"), default=None,
+                   help="bucket location for --add-item")
+    p.add_argument("--remove-item", metavar="NAME",
+                   help="remove a device by name from every bucket")
+    p.add_argument("--reweight-item", nargs=2, metavar=("NAME", "WEIGHT"),
+                   help="set a device's weight everywhere it appears")
     args = p.parse_args(argv)
     if args.num_rep is not None:
         args.min_rep = args.max_rep = args.num_rep
@@ -213,6 +232,73 @@ def main(argv=None) -> int:
     if not args.infn:
         p.error("need -i/--infn (or -c/-d/--build)")
     m = load_map(args.infn)
+
+    def _device_id(name: str) -> int:
+        for osd, nm in m.device_names.items():
+            if nm == name:
+                return osd
+        p.error(f"unknown device {name!r}")
+
+    def _repropagate() -> None:
+        # reference CrushWrapper mutations update every ancestor's
+        # recorded weight for the child; recompute all roots
+        child_ids = {i for b in m.buckets.values() for i in b.items}
+        for b in list(m.buckets.values()):
+            if b.id not in child_ids:
+                m.adjust_subtree_weights(b.id)
+
+    mutated = False
+    if args.add_item:
+        osd_s, weight, name = args.add_item
+        osd, w = int(osd_s), int(float(weight) * 0x10000)
+        if not args.loc:
+            p.error("--add-item needs at least one --loc TYPE NAME")
+        # the reference treats --loc pairs as an unordered location
+        # map and inserts at the innermost (lowest type id) bucket
+        type_ids = {tname: tid for tid, tname in m.types.items()}
+        locs = []
+        for tname, bname in args.loc:
+            if tname not in type_ids:
+                p.error(f"unknown type {tname!r}")
+            try:
+                bucket = m.bucket_by_name(bname)
+            except (KeyError, ValueError):
+                p.error(f"unknown bucket {bname!r}")
+            if m.types[bucket.type_id] != tname:
+                p.error(f"bucket {bname!r} is not a {tname}")
+            locs.append((type_ids[tname], bucket))
+        bucket = min(locs)[1]
+        if osd in m.device_names and m.device_names[osd] != name:
+            p.error(f"device id {osd} already exists as "
+                    f"{m.device_names[osd]!r}")
+        if osd in bucket.items:
+            p.error(f"device {osd} already in bucket {bucket.name!r}")
+        m.add_device(osd, name)
+        m.insert_item(bucket.id, osd, w)
+        mutated = True
+    if args.remove_item:
+        osd = _device_id(args.remove_item)
+        for b in list(m.buckets.values()):
+            if osd in b.items:
+                m.remove_item(b.id, osd)
+        m.device_names.pop(osd, None)  # reference removes the device too
+        mutated = True
+    if args.reweight_item:
+        name, weight = args.reweight_item
+        osd, w = _device_id(name), int(float(weight) * 0x10000)
+        for b in m.buckets.values():
+            if osd in b.items:
+                m.adjust_item_weight(b.id, osd, w)
+        mutated = True
+    if mutated:
+        _repropagate()
+        dest = args.outfn or args.infn
+        with open(dest, "wb") as f:
+            f.write(m.encode())
+        print(f"wrote crush map to {dest}", file=sys.stderr)
+        if not (args.test or args.tree):
+            return 0
+
     if args.tree:
         cmd_tree(m, out)
         return 0

@@ -107,6 +107,30 @@ def ceph_str_hash_rjenkins(data: bytes) -> int:
     return c
 
 
+def ceph_str_hash_linux(data: bytes) -> int:
+    """Linux dcache string hash (reference ``ceph_str_hash_linux``,
+    ``src/common/ceph_hash.cc``): the alternate ``object_hash``
+    selectable per pool (CEPH_STR_HASH_LINUX)."""
+    h = 0
+    for byte in data:
+        h = (h + (byte << 4) + (byte >> 4)) * 11 & M32
+    return h
+
+
+# reference src/include/rados.h values — LINUX is 0x1, RJENKINS 0x2
+CEPH_STR_HASH_LINUX = 1
+CEPH_STR_HASH_RJENKINS = 2
+
+
+def ceph_str_hash(alg: int, data: bytes) -> int:
+    """Dispatch by pool ``object_hash`` id (reference ``ceph_str_hash``)."""
+    if alg == CEPH_STR_HASH_LINUX:
+        return ceph_str_hash_linux(data)
+    if alg == CEPH_STR_HASH_RJENKINS:
+        return ceph_str_hash_rjenkins(data)
+    raise ValueError(f"unknown object_hash {alg}")
+
+
 def ceph_stable_mod(x: int, b: int, bmask: int) -> int:
     """Split-friendly bucketing for non-power-of-two moduli."""
     if (x & bmask) < b:

@@ -63,6 +63,9 @@ class Pool:
     pgp_num: int = 32
     crush_rule: int = 0
     hashpspool: bool = True
+    # reference pg_pool_t::object_hash (CEPH_STR_HASH_RJENKINS = 0x2
+    # default; CEPH_STR_HASH_LINUX = 0x1 selectable)
+    object_hash: int = 2
     # erasure pools carry their profile name (see ceph_tpu.ec.registry)
     erasure_code_profile: str = ""
 
@@ -167,10 +170,13 @@ class OSDMap:
 
     def object_locator_to_pg(self, name: str | bytes, pool_id: int) -> PGId:
         """Object name -> raw PG (pre-fold).  Reference
-        ``OSDMap::object_locator_to_pg`` with rjenkins object_hash."""
+        ``OSDMap::object_locator_to_pg``; hashes with the pool's
+        ``object_hash`` algorithm (rjenkins default, linux)."""
         if isinstance(name, str):
             name = name.encode()
-        ps = ref.ceph_str_hash_rjenkins(name)
+        pool = self.pools.get(pool_id)
+        alg = pool.object_hash if pool is not None else ref.CEPH_STR_HASH_RJENKINS
+        ps = ref.ceph_str_hash(alg, name)
         return PGId(pool_id, ps)
 
     def raw_pg_to_pg(self, pgid: PGId) -> PGId:

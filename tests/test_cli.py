@@ -231,3 +231,85 @@ def test_ec_bench_cli(capsys):
          "--iterations", "1", "--parameter", "k=4", "--parameter", "m=2"]
     )
     assert rc == 0
+
+
+def test_crushtool_mutation_flags(tmp_path):
+    """--add-item/--reweight-item/--remove-item (reference crushtool
+    mutation surface) round-trip through the on-disk map."""
+    from ceph_tpu.cli import crushtool
+
+    mapfile = str(tmp_path / "m.json")
+    rc = crushtool.main(
+        ["--build", "--num_osds", "8", "-o", mapfile,
+         "host", "straw2", "4", "root", "straw2", "0"]
+    )
+    assert rc == 0
+
+    rc = crushtool.main(
+        ["-i", mapfile, "--add-item", "8", "2.5", "osd.8",
+         "--loc", "host", "host0"]
+    )
+    assert rc == 0
+    from ceph_tpu.cli.crushtool import load_map
+
+    m = load_map(mapfile)
+    h0 = m.bucket_by_name("host0")
+    assert 8 in h0.items
+    assert h0.item_weights[h0.items.index(8)] == int(2.5 * 0x10000)
+
+    rc = crushtool.main(["-i", mapfile, "--reweight-item", "osd.8", "1.25"])
+    assert rc == 0
+    m = load_map(mapfile)
+    h0 = m.bucket_by_name("host0")
+    assert h0.item_weights[h0.items.index(8)] == int(1.25 * 0x10000)
+
+    rc = crushtool.main(["-i", mapfile, "--remove-item", "osd.8"])
+    assert rc == 0
+    m = load_map(mapfile)
+    assert all(8 not in b.items for b in m.buckets.values())
+
+
+def test_crushtool_mutation_propagates_and_validates(tmp_path):
+    """Ancestor weights must follow mutations (reference CrushWrapper
+    recursive weight update), --loc resolves innermost-by-type
+    regardless of flag order, and bad inputs fail cleanly."""
+    import pytest
+
+    from ceph_tpu.cli import crushtool
+    from ceph_tpu.cli.crushtool import load_map
+
+    mapfile = str(tmp_path / "m.json")
+    assert crushtool.main(
+        ["--build", "--num_osds", "8", "-o", mapfile,
+         "host", "straw2", "4", "root", "straw2", "0"]) == 0
+
+    # --loc order must not matter: root listed AFTER host still inserts
+    # into the host (innermost type)
+    assert crushtool.main(
+        ["-i", mapfile, "--add-item", "8", "2.0", "osd.8",
+         "--loc", "host", "host0", "--loc", "root", "root0"]) == 0
+    m = load_map(mapfile)
+    assert 8 in m.bucket_by_name("host0").items
+    root = [b for b in m.buckets.values()
+            if m.types[b.type_id] == "root"][0]
+    h0 = m.bucket_by_name("host0")
+    # root's recorded weight for host0 == sum of host0's items
+    assert root.item_weights[root.items.index(h0.id)] == \
+        sum(h0.item_weights)
+
+    # clean errors, map untouched
+    before = open(mapfile, "rb").read()
+    with pytest.raises(SystemExit):
+        crushtool.main(["-i", mapfile, "--add-item", "8", "1.0", "osd.8x",
+                        "--loc", "host", "host0"])  # id exists
+    with pytest.raises(SystemExit):
+        crushtool.main(["-i", mapfile, "--add-item", "9", "1.0", "osd.9",
+                        "--loc", "host", "nope"])  # unknown bucket
+    with pytest.raises(SystemExit):
+        crushtool.main(["-i", mapfile, "--remove-item", "osd.99"])
+    assert open(mapfile, "rb").read() == before
+
+    # remove deletes the device registration too
+    assert crushtool.main(["-i", mapfile, "--remove-item", "osd.8"]) == 0
+    m = load_map(mapfile)
+    assert 8 not in m.device_names

@@ -175,3 +175,41 @@ def test_negdraw_magic_equals_plain():
     plain = np.asarray(hashes.straw2_negdraw(x, ids, r, w))
     fast = np.asarray(hashes.straw2_negdraw_magic(x, ids, r, w, magic))
     assert np.array_equal(plain, fast)
+
+
+def test_str_hash_linux_and_dispatch():
+    """ceph_str_hash_linux (dcache hash) + per-pool object_hash
+    dispatch (reference src/common/ceph_hash.cc, pg_pool_t)."""
+    from ceph_tpu.core import ref
+
+    # dcache recurrence, hand-computed for short strings
+    def dcache(bs):
+        h = 0
+        for c in bs:
+            h = (h + (c << 4) + (c >> 4)) * 11 & 0xFFFFFFFF
+        return h
+
+    for s in (b"", b"a", b"rbd_data.1234", b"\xff" * 7):
+        assert ref.ceph_str_hash_linux(s) == dcache(s)
+        assert ref.ceph_str_hash(ref.CEPH_STR_HASH_LINUX, s) == dcache(s)
+        assert ref.ceph_str_hash(ref.CEPH_STR_HASH_RJENKINS, s) == \
+            ref.ceph_str_hash_rjenkins(s)
+
+    import pytest
+    with pytest.raises(ValueError):
+        ref.ceph_str_hash(99, b"x")
+
+
+def test_pool_object_hash_selects_algorithm():
+    from ceph_tpu.core import ref
+    from ceph_tpu.models.clusters import build_osdmap
+
+    m = build_osdmap(16, pg_num=32)
+    pool = m.pools[1]
+    name = b"obj-42"
+    assert m.object_locator_to_pg(name, 1).ps == \
+        ref.ceph_str_hash_rjenkins(name)
+    pool.object_hash = ref.CEPH_STR_HASH_LINUX
+    assert m.object_locator_to_pg(name, 1).ps == \
+        ref.ceph_str_hash_linux(name)
+    pool.object_hash = ref.CEPH_STR_HASH_RJENKINS
