@@ -33,7 +33,15 @@ def main() -> None:
 
     iters = 5
     t0 = time.perf_counter()
-    for _ in range(iters):
+    for i in range(iters):
+        # perturb one reweight per iteration: every update recomputes a
+        # genuinely different map (elision defense, see bench/_timing.py;
+        # also the reference's actual workload — remap after map change).
+        # Toggle against the stored value so EVERY iteration changes
+        # the map (writing the default back would be a no-op dispatch).
+        m.osd_weight[i % N_OSDS] = (
+            0xFFFF if m.osd_weight[i % N_OSDS] == 0x10000 else 0x10000
+        )
         mapping.update()
     per_update = (time.perf_counter() - t0) / iters
     rate = PG_NUM / per_update

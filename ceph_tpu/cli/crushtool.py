@@ -251,6 +251,8 @@ def main(argv=None) -> int:
     if args.add_item:
         osd_s, weight, name = args.add_item
         osd, w = int(osd_s), int(float(weight) * 0x10000)
+        if osd < 0:
+            p.error("--add-item id must be a device id (>= 0)")
         if not args.loc:
             p.error("--add-item needs at least one --loc TYPE NAME")
         # the reference treats --loc pairs as an unordered location
@@ -267,12 +269,15 @@ def main(argv=None) -> int:
             if m.types[bucket.type_id] != tname:
                 p.error(f"bucket {bname!r} is not a {tname}")
             locs.append((type_ids[tname], bucket))
-        bucket = min(locs)[1]
+        bucket = min(locs, key=lambda t: t[0])[1]
         if osd in m.device_names and m.device_names[osd] != name:
             p.error(f"device id {osd} already exists as "
                     f"{m.device_names[osd]!r}")
-        if osd in bucket.items:
-            p.error(f"device {osd} already in bucket {bucket.name!r}")
+        # reference crushtool: "specified item already exists" — a
+        # device may live in at most one bucket
+        for b in m.buckets.values():
+            if osd in b.items:
+                p.error(f"device {osd} already in bucket {b.name!r}")
         m.add_device(osd, name)
         m.insert_item(bucket.id, osd, w)
         mutated = True

@@ -338,6 +338,16 @@ def _make_level_kernel(fanout: int, halves: int):
     return kern
 
 
+def _level_sublanes(fanout: int) -> int:
+    """Tile height for the level kernel: the F-unrolled straw2 keeps
+    ~10 live [sub, 128] u32 temporaries per child, and the whole
+    working set must fit the chip's 16 MB scoped VMEM (F=16 at
+    sub=256 OOMs at 19.5 MB — found by local chipless AOT compile).
+    Budget ~6 MB: sub = 1536/F clamped to [8, 256], multiple of 8."""
+    sub = max(8, min(SUBLANES, (1536 // max(fanout, 1)) // 8 * 8))
+    return sub
+
+
 def _level_call(xf, rf, lidxf, tbl, interpret: bool):
     with jax.enable_x64(False):
         return _level_jit(xf, rf, lidxf, tbl, interpret)
@@ -345,15 +355,17 @@ def _level_call(xf, rf, lidxf, tbl, interpret: bool):
 
 @partial(jax.jit, static_argnums=(4,))
 def _level_jit(xf, rf, lidxf, tbl, interpret):
-    """Inputs are FLAT [N] u32 arrays, N a multiple of TILE."""
+    """Inputs are FLAT [N] u32 arrays, N a multiple of
+    ``_level_sublanes(fanout) * 128``."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     nf, fanout, halves, _ = tbl.shape
     n = xf.shape[0]
     rows = n // 128
-    grid = (rows // SUBLANES,)
-    bs = lambda: pl.BlockSpec((SUBLANES, 128), lambda i: (i, 0),
+    sub = _level_sublanes(fanout)
+    grid = (rows // sub,)
+    bs = lambda: pl.BlockSpec((sub, 128), lambda i: (i, 0),
                               memory_space=pltpu.VMEM)
     out = pl.pallas_call(
         _make_level_kernel(fanout, halves),
@@ -412,7 +424,8 @@ def level_choose(x, r, lidx, tbl, interpret: bool | None = None):
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     n = x.shape[0]
-    npad = (n + TILE - 1) // TILE * TILE
+    gran = _level_sublanes(int(tbl.shape[1])) * 128
+    npad = (n + gran - 1) // gran * gran
     u32 = lambda v: jnp.asarray(v).astype(U32)
     xf, rf, lf = u32(x), u32(r), u32(lidx)
     if npad != n:

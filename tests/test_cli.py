@@ -313,3 +313,28 @@ def test_crushtool_mutation_propagates_and_validates(tmp_path):
     assert crushtool.main(["-i", mapfile, "--remove-item", "osd.8"]) == 0
     m = load_map(mapfile)
     assert 8 not in m.device_names
+
+
+def test_crushtool_add_item_rejections(tmp_path):
+    import pytest
+
+    from ceph_tpu.cli import crushtool
+
+    mapfile = str(tmp_path / "m.json")
+    assert crushtool.main(
+        ["--build", "--num_osds", "8", "-o", mapfile,
+         "host", "straw2", "4", "root", "straw2", "0"]) == 0
+    before = open(mapfile, "rb").read()
+    # device already placed (in ANY bucket) -> clean error, map untouched
+    with pytest.raises(SystemExit):
+        crushtool.main(["-i", mapfile, "--add-item", "3", "1.0", "osd.3",
+                        "--loc", "host", "host1"])
+    # negative id -> clean error
+    with pytest.raises(SystemExit):
+        crushtool.main(["-i", mapfile, "--add-item", "-99", "1.0", "osd.x",
+                        "--loc", "host", "host0"])
+    # duplicate --loc types must not crash on tie-break
+    with pytest.raises(SystemExit):
+        crushtool.main(["-i", mapfile, "--add-item", "3", "1.0", "osd.3",
+                        "--loc", "host", "host0", "--loc", "host", "host1"])
+    assert open(mapfile, "rb").read() == before
