@@ -258,7 +258,10 @@ def main(argv=None) -> int:
         # the reference treats --loc pairs as an unordered location
         # map and inserts at the innermost (lowest type id) bucket
         type_ids = {tname: tid for tid, tname in m.types.items()}
-        locs = []
+        # the reference parses --loc pairs into a map keyed by type
+        # (later pair for the same type wins), then inserts at the
+        # innermost (lowest type id) location
+        locmap: dict[int, "object"] = {}
         for tname, bname in args.loc:
             if tname not in type_ids:
                 p.error(f"unknown type {tname!r}")
@@ -268,8 +271,8 @@ def main(argv=None) -> int:
                 p.error(f"unknown bucket {bname!r}")
             if m.types[bucket.type_id] != tname:
                 p.error(f"bucket {bname!r} is not a {tname}")
-            locs.append((type_ids[tname], bucket))
-        bucket = min(locs, key=lambda t: t[0])[1]
+            locmap[type_ids[tname]] = bucket
+        bucket = locmap[min(locmap)]
         if osd in m.device_names and m.device_names[osd] != name:
             p.error(f"device id {osd} already exists as "
                     f"{m.device_names[osd]!r}")

@@ -558,20 +558,21 @@ def _choose_firstn_batch(
     segment.  Returns (out [B, cap], out2 [B, cap], outpos [B]).
     """
     B = x.shape[0]
-    out = jnp.full((B, cap), ITEM_NONE, I32)
-    out2 = jnp.full((B, cap), ITEM_NONE, I32)
-    outpos = jnp.zeros((B,), I32)
 
-    for rep in range(numrep):
+    def rep_step(carry, rep):
+        # one replica slot; ``rep`` is a traced scalar so the whole
+        # numrep loop is a lax.scan — the program is traced/compiled
+        # once instead of numrep times (compile time and suite speed)
+        out, out2, outpos = carry
 
-        def body(st, _rep=rep, _out=out, _out2=out2, _outpos=outpos):
+        def body(st):
             ftotal, settled, item_acc, leaf_acc, placed = st
             active = start_active & ~settled & (ftotal < tries)
-            rB = jnp.broadcast_to(jnp.asarray(_rep, I32), (B,)) + ftotal
+            rB = jnp.broadcast_to(rep, (B,)) + ftotal
             item, ok, hard, nlidx = descend(
                 pack, x, lidx0, rB, target_type, False, active, max_devices
             )
-            collide = ok & _collides(_out, _outpos, item)
+            collide = ok & _collides(out, outpos, item)
             reject = jnp.zeros((B,), bool)
             leaf = item
             if leaf_pack is not None:
@@ -582,7 +583,7 @@ def _choose_firstn_batch(
                 lf, lok = _leaf_firstn(
                     leaf_pack, osd_weight, x, nlidx,
                     active & ok & ~collide & is_bucket,
-                    sub_r, recurse_tries, _out2, _outpos, stable, max_devices,
+                    sub_r, recurse_tries, out2, outpos, stable, max_devices,
                 )
                 leaf_ok = jnp.where(is_bucket, lok, True)
                 leaf = jnp.where(is_bucket, lf, item)
@@ -616,7 +617,16 @@ def _choose_firstn_batch(
         if leaf_pack is not None:
             out2 = jnp.where(col & place[:, None], leaf[:, None], out2)
         outpos = outpos + place.astype(I32)
+        return (out, out2, outpos), None
 
+    init_carry = (
+        jnp.full((B, cap), ITEM_NONE, I32),
+        jnp.full((B, cap), ITEM_NONE, I32),
+        jnp.zeros((B,), I32),
+    )
+    (out, out2, outpos), _ = lax.scan(
+        rep_step, init_carry, jnp.arange(numrep, dtype=I32)
+    )
     return out, out2, outpos
 
 
@@ -673,10 +683,21 @@ def _choose_indep_batch(
 
     def round_body(st):
         ftotal, out, out2 = st
-        for rep in range(out_size):
-            undef = out[:, rep] == ITEM_UNDEF
+
+        def slot_step(carry, rep):
+            # rep is traced: the out_size slot loop is a lax.scan so
+            # the descend program is traced/compiled once per round,
+            # not out_size times (EC rules have out_size = k+m)
+            out, out2 = carry
+            # rep is a traced scalar: column reads/writes lower to
+            # dynamic_slice / dynamic_update_slice (not lane gathers)
+            col = lambda a: lax.dynamic_index_in_dim(
+                a, rep, axis=1, keepdims=False)
+            setcol = lambda a, v: lax.dynamic_update_index_in_dim(
+                a, v, rep, axis=1)
+            undef = col(out) == ITEM_UNDEF
             active = start_active & undef
-            rB = jnp.broadcast_to(jnp.asarray(rep, I32), (B,)) + numrep * ftotal
+            rB = jnp.broadcast_to(rep, (B,)) + numrep * ftotal
             item, ok, hard, nlidx = descend(
                 pack, x, lidx0, rB, target_type, True, active, max_devices
             )
@@ -699,14 +720,19 @@ def _choose_indep_batch(
             write_none = active & hard
             newv = jnp.where(
                 write_item, item,
-                jnp.where(write_none, ITEM_NONE, out[:, rep]),
+                jnp.where(write_none, ITEM_NONE, col(out)),
             )
-            out = out.at[:, rep].set(newv)
+            out = setcol(out, newv)
             newl = jnp.where(
                 write_item, leaf,
-                jnp.where(write_none, ITEM_NONE, out2[:, rep]),
+                jnp.where(write_none, ITEM_NONE, col(out2)),
             )
-            out2 = out2.at[:, rep].set(newl)
+            out2 = setcol(out2, newl)
+            return (out, out2), None
+
+        (out, out2), _ = lax.scan(
+            slot_step, (out, out2), jnp.arange(out_size, dtype=I32)
+        )
         return (ftotal + 1, out, out2)
 
     _, out, out2 = lax.while_loop(

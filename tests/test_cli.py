@@ -338,3 +338,21 @@ def test_crushtool_add_item_rejections(tmp_path):
         crushtool.main(["-i", mapfile, "--add-item", "3", "1.0", "osd.3",
                         "--loc", "host", "host0", "--loc", "host", "host1"])
     assert open(mapfile, "rb").read() == before
+
+
+def test_crushtool_loc_last_same_type_wins(tmp_path):
+    """Duplicate --loc pairs for one type: the LAST wins (reference
+    parses --loc into a map keyed by type)."""
+    from ceph_tpu.cli import crushtool
+    from ceph_tpu.cli.crushtool import load_map
+
+    mapfile = str(tmp_path / "m.json")
+    assert crushtool.main(
+        ["--build", "--num_osds", "8", "-o", mapfile,
+         "host", "straw2", "4", "root", "straw2", "0"]) == 0
+    assert crushtool.main(
+        ["-i", mapfile, "--add-item", "100", "1.0", "osd.100",
+         "--loc", "host", "host0", "--loc", "host", "host1"]) == 0
+    m = load_map(mapfile)
+    assert 100 in m.bucket_by_name("host1").items
+    assert 100 not in m.bucket_by_name("host0").items
