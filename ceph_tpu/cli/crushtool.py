@@ -255,8 +255,6 @@ def main(argv=None) -> int:
             p.error("--add-item id must be a device id (>= 0)")
         if not args.loc:
             p.error("--add-item needs at least one --loc TYPE NAME")
-        # the reference treats --loc pairs as an unordered location
-        # map and inserts at the innermost (lowest type id) bucket
         type_ids = {tname: tid for tid, tname in m.types.items()}
         # the reference parses --loc pairs into a map keyed by type
         # (later pair for the same type wins), then inserts at the

@@ -109,3 +109,75 @@ def test_clay_repair_roundtrip(tpu):
     need = ec.minimum_to_decode({lost}, set(range(n)) - {lost})
     dec = ec.decode({lost}, {i: enc[i] for i in need}, len(enc[0]))
     np.testing.assert_array_equal(dec[lost], enc[lost])
+
+
+def test_fused_straw2_kernel_on_silicon(tpu):
+    """Pallas straw2 negdraw (non-interpret) == jnp path on device."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.core import hashes
+    from ceph_tpu.core.pallas_straw2 import straw2_negdraw_fused
+
+    rng = _rng(0x57A2)
+    B, F = 20_000, 8
+    x = rng.integers(0, 2**32, (B, 1), dtype=np.uint32)
+    ids = rng.integers(0, 2**31, (B, F), dtype=np.uint32)
+    r = rng.integers(0, 64, (B, 1), dtype=np.uint32)
+    w = rng.integers(0, 0x200000, (B, F), dtype=np.uint32)
+    magic = hashes.magic_reciprocal(w)
+    want = np.asarray(hashes.straw2_negdraw_magic(
+        *map(jnp.asarray, (x, ids, r, w, magic))))
+    got = np.asarray(straw2_negdraw_fused(
+        *map(jnp.asarray, (x, ids, r, w, magic)), interpret=False))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_level_kernel_on_silicon(tpu):
+    """Pallas level-descent kernel (non-interpret) == jnp argmin path,
+    incl. the F=16 shape that used to exhaust scoped VMEM."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.core import hashes
+    from ceph_tpu.core import pallas_straw2 as ps
+
+    for F in (4, 16):
+        rng = _rng(0x1E + F)
+        nb = 24
+        ids = rng.integers(0, 2**31, (nb, F), dtype=np.uint32)
+        ws = rng.integers(1, 0x40000, (nb, F), dtype=np.uint32)
+        magic = hashes.magic_reciprocal(ws)
+        ct = np.zeros((nb, F), np.uint32)
+        nl = np.zeros((nb, F), np.uint32)
+        tbl = ps.pack_level_table(
+            ids, ws, magic, ct, nl, np.full(nb, F, np.uint32))
+        B = 30_000
+        x = jnp.arange(B, dtype=jnp.uint32)
+        z = jnp.zeros(B, jnp.uint32)
+        lidx = jnp.asarray(rng.integers(0, nb, B, dtype=np.uint32))
+        it, _, _, sz = ps.level_choose(
+            x, z, lidx, jnp.asarray(tbl), interpret=False)
+        nd = hashes.straw2_negdraw_magic(
+            x[:, None], jnp.asarray(ids)[lidx], z[:, None],
+            jnp.asarray(ws)[lidx], jnp.asarray(magic)[lidx])
+        am = np.asarray(jnp.argmin(nd, axis=1))
+        want = ids[np.asarray(lidx), am]
+        np.testing.assert_array_equal(np.asarray(it), want)
+        np.testing.assert_array_equal(np.asarray(sz), np.full(B, F))
+
+
+def test_gf_kernels_on_silicon(tpu):
+    """Pallas byte-LUT + fused GF matrix kernels (non-interpret) vs
+    the host GF algebra."""
+    from ceph_tpu.ec import gf
+    from ceph_tpu.ec.pallas_gf import byte_lut, matrix_encode
+
+    rng = _rng(0x6F)
+    mt = gf.mul_table()
+    x = rng.integers(0, 256, 100_000, dtype=np.uint8)
+    got = np.asarray(byte_lut(x, mt[0x1D], interpret=False))
+    np.testing.assert_array_equal(got, mt[0x1D][x])
+
+    M = gf.vandermonde_matrix(8, 3)
+    data = rng.integers(0, 256, (8, 1 << 20), dtype=np.uint8)
+    got = np.asarray(matrix_encode(M, data, interpret=False))
+    np.testing.assert_array_equal(got, gf.matrix_encode(M, data))
