@@ -285,18 +285,22 @@ MAX_HALVES = 4   # level tables up to 4*128 buckets ride the kernel
 MAX_FANOUT = 32  # per-child straw2 unroll bound (compile time/VMEM)
 
 
-def _bucket_field(tbl_ref, field: int, f: int, halves: int, lidx, li):
-    """Per-lane bucket-table read: tbl[field, f, lidx] where the level
-    table is packed as [NF, F, H, 128] lane vectors.  ``li`` is
-    ``lidx & 127``; lanes pick their 128-half by ``lidx >> 7``."""
-    v = jnp.take_along_axis(
-        jnp.broadcast_to(tbl_ref[field, f, 0:1, :], li.shape), li, axis=1)
+def _gather_halves(row_fn, halves: int, lidx, li):
+    """Per-lane bucket-table read from 128-lane halves: ``row_fn(h)``
+    returns the [1, 128] lane vector for half ``h``; ``li`` is
+    ``lidx & 127`` and lanes pick their half by ``lidx >> 7``."""
+    v = jnp.take_along_axis(jnp.broadcast_to(row_fn(0), li.shape), li, axis=1)
     for h in range(1, halves):
         vh = jnp.take_along_axis(
-            jnp.broadcast_to(tbl_ref[field, f, h:h + 1, :], li.shape),
-            li, axis=1)
+            jnp.broadcast_to(row_fn(h), li.shape), li, axis=1)
         v = jnp.where((lidx >> 7) == np.uint32(h), vh, v)
     return v
+
+
+def _bucket_field(tbl_ref, field: int, f: int, halves: int, lidx, li):
+    """tbl[field, f, lidx] for a [NF, F, H, 128] level table."""
+    return _gather_halves(
+        lambda h: tbl_ref[field, f, h:h + 1, :], halves, lidx, li)
 
 
 def _make_level_kernel(fanout: int, halves: int):
@@ -438,6 +442,197 @@ def level_choose(x, r, lidx, tbl, interpret: bool | None = None):
     return (item, (ctnl >> 16).astype(jnp.int32),
             (ctnl & jnp.uint32(0xFFFF)).astype(jnp.int32),
             size.astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Whole-descent kernel: ALL hierarchy levels of one descent in a single
+# Pallas call.  The per-level kernel already removed the HBM row fetch;
+# this removes the per-level kernel-call boundary too, so an engine
+# program embeds one Mosaic kernel per descend site instead of one per
+# (site x level) — the compile-time blowup that kept the kernel path
+# opt-in (round 3).
+# ---------------------------------------------------------------------------
+
+ITEM_NONE_U32 = np.uint32(0x7FFFFFFF)
+_CT_DANGLING = np.uint32(255)
+
+
+MAX_DESC_TABLE_BYTES = 4 << 20  # stacked-table VMEM budget
+
+
+def pack_descend_tables(levels_packed) -> tuple[np.ndarray, tuple] | None:
+    """Stack per-level lane tables (pack_level_table outputs) into one
+    [L, 6, Fmax, Hmax, 128] u32 array.  Returns (stacked, meta) with
+    meta = ((F0, H0), ...), or None if any level failed the per-level
+    bounds or the padded stack would exceed the kernel's VMEM budget
+    (the whole table is resident; per-level bounds alone don't cap a
+    deep hierarchy)."""
+    if any(t is None for t in levels_packed):
+        return None
+    meta = [(t.shape[1], t.shape[2]) for t in levels_packed]
+    fmax = max(f for f, _ in meta)
+    hmax = max(h for _, h in meta)
+    nbytes = len(levels_packed) * 6 * fmax * hmax * 128 * 4
+    if nbytes > MAX_DESC_TABLE_BYTES:
+        return None
+    out = np.zeros((len(levels_packed), 6, fmax, hmax, 128), np.uint32)
+    for i, t in enumerate(levels_packed):
+        out[i, :, : t.shape[1], : t.shape[2], :] = t
+    return out, tuple(meta)
+
+
+def _make_descend_kernel(meta: tuple, target_type: int,
+                         empty_is_hard: bool, max_devices: int):
+    def kern(x_ref, r_ref, lidx_ref, act_ref, tbl_ref, lut_ref,
+             item_ref, aux_ref):
+        x = x_ref[:, :]
+        r = r_ref[:, :]
+        lut = lut_ref[:, :]
+        active = act_ref[:, :] != np.uint32(0)
+
+        done = ~active
+        ok = jnp.zeros_like(done)
+        hard = jnp.zeros_like(done)
+        item = jnp.full_like(x, ITEM_NONE_U32)
+        nlidx_out = jnp.zeros_like(x)
+        lidx = lidx_ref[:, :]
+
+        for lv, (fanout, halves) in enumerate(meta):
+            li = (lidx & np.uint32(127)).astype(I32)
+
+            def bf(field, f):
+                # f may be a traced i32 (fori_loop index): dynamic
+                # indexing is on untiled leading dims only
+                return _gather_halves(
+                    lambda h: tbl_ref[lv, field, f, h:h + 1, :],
+                    halves, lidx, li)
+
+            size = bf(5, 0)
+
+            def draw(f):
+                idf = bf(0, f)
+                ctnlf = bf(4, f)
+                nd_lo, nd_hi = _straw2_math(
+                    x, idf, r, bf(1, f), bf(2, f), bf(3, f), lut)
+                return nd_lo, nd_hi, idf, ctnlf
+
+            best_lo, best_hi, chosen, ctnl = draw(0)
+
+            def fbody(f, st):
+                # straw2 is traced ONCE per level (Mosaic compile time
+                # is superlinear in kernel size; a fanout-unrolled body
+                # took >17 min to compile at 3 levels x F=16)
+                b_lo, b_hi, ch, ct = st
+                nd_lo, nd_hi, idf, ctnlf = draw(f)
+                upd = (nd_hi < b_hi) | ((nd_hi == b_hi) & (nd_lo < b_lo))
+                return (jnp.where(upd, nd_lo, b_lo),
+                        jnp.where(upd, nd_hi, b_hi),
+                        jnp.where(upd, idf, ch),
+                        jnp.where(upd, ctnlf, ct))
+
+            if fanout > 1:
+                best_lo, best_hi, chosen, ctnl = jax.lax.fori_loop(
+                    1, fanout, fbody, (best_lo, best_hi, chosen, ctnl))
+
+            ctype = ctnl >> 16
+            nlidx = ctnl & np.uint32(0xFFFF)
+            # mirror interp_batch.descend's per-level status block
+            empty = size == np.uint32(0)
+            is_bucket = chosen >= np.uint32(0x80000000)
+            if target_type != 0:
+                reached = ctype == np.uint32(target_type)
+            else:
+                reached = ~is_bucket
+            wrong_dev = (~is_bucket) & (~reached)
+            bad_dev = (~is_bucket) & (chosen >= np.uint32(max_devices))
+            bad_bucket = is_bucket & (ctype == _CT_DANGLING)
+            if empty_is_hard:
+                hard_now = empty | wrong_dev | bad_dev | bad_bucket
+                soft_now = jnp.zeros_like(empty)
+            else:
+                hard_now = (~empty) & (wrong_dev | bad_dev | bad_bucket)
+                soft_now = empty
+            new_done = done | hard_now | soft_now | reached
+            ok = jnp.where(done, ok, reached & ~hard_now & ~soft_now)
+            hard = jnp.where(done, hard, hard_now)
+            item = jnp.where(done, item, chosen)
+            nlidx_out = jnp.where(done, nlidx_out, nlidx)
+            lidx = jnp.where(new_done, lidx, nlidx)
+            done = new_done
+
+        item_ref[:, :] = item
+        aux_ref[:, :] = (nlidx_out
+                         | (ok.astype(U32) << 16)
+                         | (hard.astype(U32) << 17))
+    return kern
+
+
+def _descend_call(xf, rf, lidxf, actf, tbl, meta, target_type,
+                  empty_is_hard, max_devices, interpret):
+    with jax.enable_x64(False):
+        return _descend_jit(xf, rf, lidxf, actf, tbl, meta, target_type,
+                            empty_is_hard, max_devices, interpret)
+
+
+@partial(jax.jit, static_argnums=(5, 6, 7, 8, 9))
+def _descend_jit(xf, rf, lidxf, actf, tbl, meta, target_type,
+                 empty_is_hard, max_devices, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = xf.shape[0]
+    rows = n // 128
+    fmax = max(f for f, _ in meta)
+    sub = _level_sublanes(fmax)
+    bs = lambda: pl.BlockSpec((sub, 128), lambda i: (i, 0),
+                              memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        _make_descend_kernel(meta, target_type, empty_is_hard, max_devices),
+        out_shape=(jax.ShapeDtypeStruct((rows, 128), jnp.uint32),) * 2,
+        grid=(rows // sub,),
+        in_specs=[bs(), bs(), bs(), bs(),
+                  pl.BlockSpec(tbl.shape, lambda i: (0,) * 5,
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((8, 128), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=(bs(), bs()),
+        interpret=interpret,
+    )(xf.reshape(rows, 128), rf.reshape(rows, 128),
+      lidxf.reshape(rows, 128), actf.reshape(rows, 128),
+      tbl, jnp.asarray(_TBL))
+    return out
+
+
+def descend_fused(x, r, lidx, active, tbl, meta, target_type: int,
+                  empty_is_hard: bool, max_devices: int,
+                  interpret: bool | None = None):
+    """Whole descent for a [B] batch in one kernel call.
+
+    Returns (item i32, ok bool, hard bool, nlidx i32) — the contract of
+    ``interp_batch.descend``'s level loop."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n = x.shape[0]
+    fmax = max(f for f, _ in meta)
+    gran = _level_sublanes(fmax) * 128
+    npad = (n + gran - 1) // gran * gran
+    u32 = lambda v: jnp.asarray(v).astype(U32)
+    xf, rf, lf = u32(x), u32(r), u32(lidx)
+    af = jnp.asarray(active).astype(U32)
+    if npad != n:
+        pad = lambda v: jnp.pad(v, (0, npad - n))
+        xf, rf, lf, af = pad(xf), pad(rf), pad(lf), pad(af)
+    item_u, aux = _descend_call(xf, rf, lf, af, tbl, meta, target_type,
+                                empty_is_hard, max_devices, interpret)
+    item_u = item_u.reshape(-1)[:n]
+    aux = aux.reshape(-1)[:n]
+    import jax.lax as lax
+
+    item = lax.bitcast_convert_type(item_u, jnp.int32)
+    ok = (aux >> 16) & jnp.uint32(1)
+    hard = (aux >> 17) & jnp.uint32(1)
+    nlidx = (aux & jnp.uint32(0xFFFF)).astype(jnp.int32)
+    return item, ok != 0, hard != 0, nlidx
 
 
 def straw2_negdraw_fused(x, item_id, r, weight, magic,

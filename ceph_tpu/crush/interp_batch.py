@@ -104,6 +104,11 @@ _OFF_NLIDX = 17
 # range); real type ids are capped below this by supports()
 _CTYPE_DANGLING = 255
 
+# the fused descend kernel re-declares these sentinels (importing this
+# module from core/ would cycle); keep them coupled
+assert int(pallas_straw2.ITEM_NONE_U32) == ITEM_NONE
+assert int(pallas_straw2._CT_DANGLING) == _CTYPE_DANGLING
+
 
 class LevelTable:
     """One BFS level of a descent pack (pytree).
@@ -138,21 +143,36 @@ jax.tree_util.register_pytree_node(
 
 
 class DescendPack:
-    """Per-level tables for one descent, as a pytree of LevelTables."""
+    """Per-level tables for one descent, as a pytree of LevelTables.
 
-    def __init__(self, tables: tuple[LevelTable, ...]):
+    When every level fits the Pallas bounds, also carries the stacked
+    whole-descent table (``desc_tb`` [L, 6, Fmax, Hmax, 128] u32 +
+    static ``desc_meta``) for the single-kernel descent path."""
+
+    def __init__(self, tables: tuple[LevelTable, ...],
+                 desc_tb: jnp.ndarray | None = None,
+                 desc_meta: tuple | None = None):
         self.tables = tuple(tables)
+        self.desc_tb = desc_tb
+        self.desc_meta = desc_meta
 
     def tree_flatten(self):
-        return tuple(self.tables), len(self.tables)
+        if self.desc_tb is None:
+            return tuple(self.tables), (len(self.tables), None)
+        return tuple(self.tables) + (self.desc_tb,), (
+            len(self.tables), self.desc_meta)
 
     @classmethod
-    def tree_unflatten(cls, n, tables):
-        return cls(tuple(tables))
+    def tree_unflatten(cls, static, arrays):
+        n, desc_meta = static
+        if desc_meta is None:
+            return cls(tuple(arrays))
+        return cls(tuple(arrays[:n]), arrays[n], desc_meta)
 
     @property
     def signature(self) -> tuple:
-        return tuple((t.nb, t.fanout) for t in self.tables)
+        return (tuple((t.nb, t.fanout) for t in self.tables),
+                self.desc_meta)
 
 
 jax.tree_util.register_pytree_node(
@@ -223,12 +243,14 @@ def _build_level_table(
     tb = np.concatenate(
         col_list + [c[:, None] for c in _byte_cols(sizes, 2)], axis=1
     )
-    lane_tb = None
+    lane_np = None
     if _want_lane_tables():
         lane_np = pallas_straw2.pack_level_table(
             ids, ws, magic, ctype, nlidx, sizes)
-        lane_tb = None if lane_np is None else jnp.asarray(lane_np)
-    return LevelTable(jnp.asarray(tb, jnp.bfloat16), nb, fanout, lane_tb)
+    # lane_tb attachment is decided by build_pack: when the fused
+    # whole-descent table is built, per-level device uploads are dead
+    lt = LevelTable(jnp.asarray(tb, jnp.bfloat16), nb, fanout, None)
+    return lt, lane_np
 
 
 def _bfs_levels(
@@ -290,12 +312,28 @@ def build_pack(
     levels = _bfs_levels(dense, roots, target_type, dense.max_depth + 2)
     maps = [{b: i for i, b in enumerate(lvl)} for lvl in levels]
     tables = []
+    lane_nps = []
     for li, lvl in enumerate(levels):
         next_map = maps[li + 1] if li + 1 < len(levels) else {}
-        tables.append(
-            _build_level_table(dense, lvl, next_map, consumer_map, target_type)
-        )
-    return DescendPack(tuple(tables)), _stop_buckets(dense, roots, target_type)
+        lt, lane_np = _build_level_table(
+            dense, lvl, next_map, consumer_map, target_type)
+        tables.append(lt)
+        lane_nps.append(lane_np)
+    desc_tb = desc_meta = None
+    if _want_lane_tables():
+        packed = pallas_straw2.pack_descend_tables(lane_nps)
+        if packed is not None:
+            desc_tb, desc_meta = jnp.asarray(packed[0]), packed[1]
+        else:
+            # fused table unavailable: fall back to per-level kernels
+            # where the individual level fits
+            tables = [
+                LevelTable(t.tb, t.nb, t.fanout,
+                           None if ln is None else jnp.asarray(ln))
+                for t, ln in zip(tables, lane_nps)
+            ]
+    return (DescendPack(tuple(tables), desc_tb, desc_meta),
+            _stop_buckets(dense, roots, target_type))
 
 
 def take_rows(table: LevelTable, lidx: jnp.ndarray) -> dict[str, jnp.ndarray]:
@@ -375,13 +413,14 @@ def _fused_straw2() -> bool:
 
 
 def _kernel_mode() -> str:
-    """'1' forces the Pallas level kernel (interpret off-TPU), '0'
-    forces the XLA matmul path.  Default is OFF (opt-in): the level
-    kernel is bit-exact in tests but its one silicon compile attempt
-    hung >20 min before the TPU tunnel wedged (round 3) — until a
-    bounded compile is demonstrated on the chip, auto-enabling it
-    would put the driver's whole bench run at risk.  The flat fused
-    straw2 kernel (CEPH_TPU_FUSED_STRAW2, auto-on) is the proven path."""
+    """'1' forces the Pallas level/descent kernels (interpret off-TPU),
+    '0' forces the XLA matmul path.  Default is OFF (opt-in): the
+    kernels are bit-exact in tests, but whole-descent Mosaic compiles
+    exceeded 20 min in local chipless AOT (superlinear in kernel size
+    even with the fanout fori_loop) and were never demonstrated bounded
+    on silicon before the round-3 tunnel wedge — auto-enabling would
+    put the driver's whole bench run at risk.  The flat fused straw2
+    kernel (CEPH_TPU_FUSED_STRAW2, auto-on) is the proven path."""
     return os.environ.get("CEPH_TPU_LEVEL_KERNEL", "0")
 
 
@@ -424,6 +463,13 @@ def descend(
     the item is a target-type bucket.
     """
     B = x.shape[0]
+
+    if pack.desc_tb is not None and _want_lane_tables():
+        # whole descent in one Pallas call (all levels fused)
+        return pallas_straw2.descend_fused(
+            x, r.astype(U32), lidx0, active, pack.desc_tb, pack.desc_meta,
+            target_type, empty_is_hard, max_devices)
+
     item = jnp.full((B,), ITEM_NONE, I32)
     ok = jnp.zeros((B,), bool)
     hard = jnp.zeros((B,), bool)

@@ -124,3 +124,30 @@ def test_crush_ln_boundary_u_ffff():
     w = np.array([[0x10000, 1], [0xFFFFFFFF, 0x8000], [3, 0x25000]],
                  np.uint32)
     _compare(x, ids, r, w)
+
+
+def test_engine_level_kernel_indep_rule(monkeypatch):
+    """Fused whole-descent path under the EC indep rule (positional
+    NONE holes, empty_is_hard branch) must match the XLA path."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.crush.engine import make_batch_runner
+    from ceph_tpu.models.clusters import build_simple
+
+    m = build_simple(48)
+    m.make_erasure_rule("ec", "default", "host")
+    rule = m.rule_by_name("ec")
+    dense = m.to_dense()
+    osd_w = jnp.asarray(np.full(dense.max_devices, 0x10000, np.uint32))
+    osd_w = osd_w.at[2].set(0)
+    xs = jnp.arange(128, dtype=jnp.uint32)
+
+    monkeypatch.delenv("CEPH_TPU_LEVEL_KERNEL", raising=False)
+    ca, run = make_batch_runner(dense, rule, 6)
+    want_r, want_l = run(ca, osd_w, xs)
+
+    monkeypatch.setenv("CEPH_TPU_LEVEL_KERNEL", "1")
+    ca2, run2 = make_batch_runner(dense, rule, 6)
+    got_r, got_l = run2(ca2, osd_w, xs)
+    np.testing.assert_array_equal(np.asarray(got_r), np.asarray(want_r))
+    np.testing.assert_array_equal(np.asarray(got_l), np.asarray(want_l))
