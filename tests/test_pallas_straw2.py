@@ -151,3 +151,28 @@ def test_engine_level_kernel_indep_rule(monkeypatch):
     got_r, got_l = run2(ca2, osd_w, xs)
     np.testing.assert_array_equal(np.asarray(got_r), np.asarray(want_r))
     np.testing.assert_array_equal(np.asarray(got_l), np.asarray(want_l))
+
+
+def test_pack_descend_tables_bounds():
+    """Aggregate VMEM bound: levels that each fit can still overflow
+    the stacked table; packing must refuse, not OOM at compile."""
+    from ceph_tpu.core import hashes
+    from ceph_tpu.core import pallas_straw2 as ps
+
+    def lvl(nb, F):
+        ids = np.ones((nb, F), np.uint32)
+        ws = np.ones((nb, F), np.uint32)
+        return ps.pack_level_table(
+            ids, ws, hashes.magic_reciprocal(ws),
+            np.zeros((nb, F), np.uint32), np.zeros((nb, F), np.uint32),
+            np.full(nb, F, np.uint32))
+
+    ok = ps.pack_descend_tables([lvl(8, 4), lvl(64, 4)])
+    assert ok is not None and ok[1] == ((4, 1), (4, 1))
+
+    # 30 levels at Fmax=32, Hmax=4 -> ~11.8 MB padded stack > 4 MB budget
+    big = [lvl(512, 32)] * 30
+    assert ps.pack_descend_tables(big) is None
+
+    # any level over per-level bounds poisons the stack
+    assert ps.pack_descend_tables([lvl(8, 4), None]) is None
