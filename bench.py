@@ -139,18 +139,8 @@ def main() -> int:
     try:
         return _main_guarded()
     except BaseException as e:  # noqa: BLE001 — the JSON line is sacred
-        print(
-            json.dumps(
-                {
-                    "metric": "crush_placements_per_sec",
-                    "value": 0,
-                    "unit": "placements/s",
-                    "vs_baseline": 0.0,
-                    "error": f"bench driver crashed: {type(e).__name__}: {e}",
-                }
-            ),
-            flush=True,
-        )
+        err = f"bench driver crashed: {type(e).__name__}: {e}"
+        print(json.dumps(format_result(None, 0.0, [err])), flush=True)
         return 0
 
 
@@ -185,21 +175,46 @@ def _main_guarded() -> int:
         else:
             errors.append(f"cpu fallback: {(r or {}).get('error')}")
 
-    out = {
-        "metric": "crush_placements_per_sec",
-        "value": round(result["rate"]) if result else 0,
-        "unit": "placements/s",
-        "vs_baseline": (
-            round(result["rate"] / cpu_rate, 2) if result and cpu_rate else 0.0
-        ),
-    }
-    if result and result.get("platform"):
-        out["platform"] = result["platform"]
+    print(json.dumps(format_result(result, cpu_rate, errors)), flush=True)
+    return 0
+
+
+def format_result(result: dict | None, cpu_rate: float, errors: list) -> dict:
+    """Build the one scored JSON line.
+
+    A non-TPU measurement is NOT reported under the headline metric: the
+    metric name gains a ``_cpu_fallback`` suffix and the headline fields
+    are zeroed, so a reader scanning only ``value``/``vs_baseline`` can
+    never mistake a host-backend fallback for a device result (round-3
+    verdict, weakness 5).
+    """
+    platform = (result or {}).get("platform")
+    on_device = result is not None and platform not in (None, "cpu")
+    if on_device:
+        out = {
+            "metric": "crush_placements_per_sec",
+            "value": round(result["rate"]),
+            "unit": "placements/s",
+            "vs_baseline": round(result["rate"] / cpu_rate, 2) if cpu_rate else 0.0,
+        }
+    else:
+        out = {
+            "metric": "crush_placements_per_sec_cpu_fallback",
+            "value": 0,
+            "unit": "placements/s",
+            "vs_baseline": 0.0,
+        }
+        if result:
+            out["cpu_fallback_rate"] = round(result["rate"])
+            out["cpu_fallback_vs_baseline"] = (
+                round(result["rate"] / cpu_rate, 2) if cpu_rate else 0.0
+            )
+    if platform:
+        out["platform"] = platform
     out["cpu_ref_placements_per_sec"] = round(cpu_rate)
     if errors:
         out["error"] = "; ".join(e for e in errors if e)
-    print(json.dumps(out), flush=True)
-    return 0
+    return out
 
 
 if __name__ == "__main__":

@@ -231,6 +231,12 @@ def main(argv=None) -> int:
         return 0
     if not args.infn:
         p.error("need -i/--infn (or -c/-d/--build)")
+    if (args.add_item or args.remove_item or args.reweight_item) \
+            and not args.outfn:
+        # reference crushtool refuses to mutate without an explicit
+        # output file; never silently clobber the -i input map
+        p.error("mutation flags (--add-item/--remove-item/"
+                "--reweight-item) require -o OUTFN")
     m = load_map(args.infn)
 
     def _device_id(name: str) -> int:
@@ -298,7 +304,7 @@ def main(argv=None) -> int:
         mutated = True
     if mutated:
         _repropagate()
-        dest = args.outfn or args.infn
+        dest = args.outfn
         with open(dest, "wb") as f:
             f.write(m.encode())
         print(f"wrote crush map to {dest}", file=sys.stderr)

@@ -124,18 +124,26 @@ def _mulhi_3x4(a0, a1, a2, m0, m1, m2, m3):
     return q_lo, q_hi
 
 
-def _mullo_3x2(q0, q1, q2, w0, w1):
-    """low 64 bits of (q2:q1:q0) * (w1:w0) as (lo32, hi32)."""
+def _mullo_3x2(q0, q1, q2, q3, w0, w1):
+    """low 64 bits of (q3:q2:q1:q0) * (w1:w0) as (lo32, hi32).
+
+    q3 (bits 48..63 of q) matters exactly when the quotient is 2^48 —
+    reachable at u==0 with weight 1 — where dropping it wrapped the
+    correction product and broke bit-exactness (round-3 advisor).  Only
+    q3*w0's low 16 bits can land in digit 3; higher partials overflow
+    bit 63 and are discarded.
+    """
     p00 = q0 * w0
     p01 = q0 * w1
     p10 = q1 * w0
     p11 = q1 * w1
     p20 = q2 * w0
     p21 = q2 * w1
+    p30 = q3 * w0
     g0 = p00 & _M16
     g1 = (p00 >> 16) + (p01 & _M16) + (p10 & _M16)
     g2 = (p01 >> 16) + (p10 >> 16) + (p11 & _M16) + (p20 & _M16)
-    g3 = (p11 >> 16) + (p20 >> 16) + (p21 & _M16)
+    g3 = (p11 >> 16) + (p20 >> 16) + (p21 & _M16) + (p30 & _M16)
     c = g0 >> 16
     d0 = g0 & _M16
     t = g1 + c
@@ -220,7 +228,7 @@ def _straw2_math(x, item, r, w, mlo, mhi, tbl):
     w1 = wsafe >> 16
     for _ in range(3):                         # same 3 corrections
         qw_lo, qw_hi = _mullo_3x2(q_lo & _M16, q_lo >> 16, q_hi & _M16,
-                                  w0, w1)
+                                  q_hi >> 16, w0, w1)
         rem_lo = neg_lo - qw_lo
         rb = (neg_lo < qw_lo).astype(U32)
         rem_hi = neg_hi - qw_hi - rb

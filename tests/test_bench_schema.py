@@ -1,0 +1,47 @@
+"""The scored bench JSON must never let a CPU fallback pass as a device run.
+
+Round-3 verdict weakness 5: on TPU timeout, bench.py used to report the
+host-backend rate under the headline metric name, distinguishable only by
+the ``platform`` field.  ``bench.format_result`` now renames the metric and
+zeroes the headline fields for any non-TPU measurement.
+"""
+
+import importlib.util
+import os
+
+_BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py")
+_spec = importlib.util.spec_from_file_location("bench_headline", _BENCH)
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+
+def test_device_result_uses_headline_metric():
+    out = bench.format_result({"rate": 2_000_000.0, "platform": "tpu"}, 200_000.0, [])
+    assert out["metric"] == "crush_placements_per_sec"
+    assert out["value"] == 2_000_000
+    assert out["vs_baseline"] == 10.0
+    assert out["platform"] == "tpu"
+    assert "error" not in out
+
+
+def test_cpu_fallback_is_unmistakable():
+    out = bench.format_result(
+        {"rate": 50_000.0, "platform": "cpu"}, 200_000.0, ["tpu attempt 1: timeout after 420s"]
+    )
+    assert out["metric"] == "crush_placements_per_sec_cpu_fallback"
+    # headline fields zeroed: a platform-blind reader sees no device rate
+    assert out["value"] == 0
+    assert out["vs_baseline"] == 0.0
+    # the honest CPU measurement lives in clearly-named side fields
+    assert out["cpu_fallback_rate"] == 50_000
+    assert out["cpu_fallback_vs_baseline"] == 0.25
+    assert "error" in out
+
+
+def test_total_failure_still_emits_schema():
+    out = bench.format_result(None, 0.0, ["tpu attempt 1: boom", "cpu fallback: boom"])
+    assert out["metric"] == "crush_placements_per_sec_cpu_fallback"
+    assert out["value"] == 0
+    assert out["vs_baseline"] == 0.0
+    assert "cpu_fallback_rate" not in out
+    assert "error" in out

@@ -246,7 +246,7 @@ def test_crushtool_mutation_flags(tmp_path):
     assert rc == 0
 
     rc = crushtool.main(
-        ["-i", mapfile, "--add-item", "8", "2.5", "osd.8",
+        ["-i", mapfile, "-o", mapfile, "--add-item", "8", "2.5", "osd.8",
          "--loc", "host", "host0"]
     )
     assert rc == 0
@@ -257,13 +257,15 @@ def test_crushtool_mutation_flags(tmp_path):
     assert 8 in h0.items
     assert h0.item_weights[h0.items.index(8)] == int(2.5 * 0x10000)
 
-    rc = crushtool.main(["-i", mapfile, "--reweight-item", "osd.8", "1.25"])
+    rc = crushtool.main(["-i", mapfile, "-o", mapfile,
+                         "--reweight-item", "osd.8", "1.25"])
     assert rc == 0
     m = load_map(mapfile)
     h0 = m.bucket_by_name("host0")
     assert h0.item_weights[h0.items.index(8)] == int(1.25 * 0x10000)
 
-    rc = crushtool.main(["-i", mapfile, "--remove-item", "osd.8"])
+    rc = crushtool.main(["-i", mapfile, "-o", mapfile,
+                         "--remove-item", "osd.8"])
     assert rc == 0
     m = load_map(mapfile)
     assert all(8 not in b.items for b in m.buckets.values())
@@ -286,7 +288,7 @@ def test_crushtool_mutation_propagates_and_validates(tmp_path):
     # --loc order must not matter: root listed AFTER host still inserts
     # into the host (innermost type)
     assert crushtool.main(
-        ["-i", mapfile, "--add-item", "8", "2.0", "osd.8",
+        ["-i", mapfile, "-o", mapfile, "--add-item", "8", "2.0", "osd.8",
          "--loc", "host", "host0", "--loc", "root", "root0"]) == 0
     m = load_map(mapfile)
     assert 8 in m.bucket_by_name("host0").items
@@ -310,9 +312,27 @@ def test_crushtool_mutation_propagates_and_validates(tmp_path):
     assert open(mapfile, "rb").read() == before
 
     # remove deletes the device registration too
-    assert crushtool.main(["-i", mapfile, "--remove-item", "osd.8"]) == 0
+    assert crushtool.main(["-i", mapfile, "-o", mapfile,
+                           "--remove-item", "osd.8"]) == 0
     m = load_map(mapfile)
     assert 8 not in m.device_names
+
+
+def test_crushtool_mutation_requires_output(tmp_path):
+    """Mutation flags without -o must refuse and leave the input map
+    untouched (reference crushtool never silently clobbers -i)."""
+    import pytest
+
+    from ceph_tpu.cli import crushtool
+
+    mapfile = str(tmp_path / "m.json")
+    assert crushtool.main(
+        ["--build", "--num_osds", "8", "-o", mapfile,
+         "host", "straw2", "4", "root", "straw2", "0"]) == 0
+    before = open(mapfile, "rb").read()
+    with pytest.raises(SystemExit):
+        crushtool.main(["-i", mapfile, "--reweight-item", "osd.3", "2.0"])
+    assert open(mapfile, "rb").read() == before
 
 
 def test_crushtool_add_item_rejections(tmp_path):
@@ -351,8 +371,9 @@ def test_crushtool_loc_last_same_type_wins(tmp_path):
         ["--build", "--num_osds", "8", "-o", mapfile,
          "host", "straw2", "4", "root", "straw2", "0"]) == 0
     assert crushtool.main(
-        ["-i", mapfile, "--add-item", "100", "1.0", "osd.100",
-         "--loc", "host", "host0", "--loc", "host", "host1"]) == 0
+        ["-i", mapfile, "-o", mapfile, "--add-item", "100", "1.0",
+         "osd.100", "--loc", "host", "host0",
+         "--loc", "host", "host1"]) == 0
     m = load_map(mapfile)
     assert 100 in m.bucket_by_name("host1").items
     assert 100 not in m.bucket_by_name("host0").items

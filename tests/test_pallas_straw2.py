@@ -45,6 +45,37 @@ def test_edge_weights_and_boundary():
     _compare(x, ids, r, w)
 
 
+def test_quotient_exactly_2_pow_48():
+    """u==0 draws with weight 1 make the division quotient exactly 2^48.
+
+    The correction loop's recomputed q*w used to truncate q to 48 bits,
+    wrapping the product and returning 2^48+2 instead of 2^48 (round-3
+    advisor).  Pin (x, id) pairs whose rjenkins hash has u == 0."""
+    pairs = []
+    xs_all = jnp.arange(200_000, dtype=jnp.uint32)
+    for item in range(4):
+        h = np.asarray(hashes.crush_hash32_3(
+            xs_all, jnp.full_like(xs_all, item), jnp.zeros_like(xs_all)))
+        hits = np.nonzero((h & 0xFFFF) == 0)[0]
+        assert hits.size, "u==0 preimage search failed"
+        pairs.append((int(hits[0]), item))
+    B = len(pairs)
+    x = np.array([[p[0]] for p in pairs], np.uint32)
+    ids = np.array([[p[1], p[1] + 100] for p in pairs], np.uint32)
+    r = np.zeros((B, 1), np.uint32)
+    w = np.ones((B, 2), np.uint32)          # weight 1 -> q == ln_neg
+    magic = hashes.magic_reciprocal(w)
+    want = np.asarray(hashes.straw2_negdraw_magic(
+        jnp.asarray(x), jnp.asarray(ids), jnp.asarray(r),
+        jnp.asarray(w), jnp.asarray(magic)))
+    # lane 0 of each row really is the 2^48 case
+    assert (want[:, 0] == np.uint64(1) << np.uint64(48)).all()
+    got = np.asarray(straw2_negdraw_fused(
+        jnp.asarray(x), jnp.asarray(ids), jnp.asarray(r),
+        jnp.asarray(w), jnp.asarray(magic), interpret=True))
+    np.testing.assert_array_equal(got, want)
+
+
 def test_nonaligned_batch_padding():
     # N not a multiple of the tile: padding lanes must not leak
     rng = np.random.default_rng(3)
