@@ -66,9 +66,16 @@ def main() -> None:
     ev = b.evaluate()
     final_dev = max(ev.pool_max_deviation.values(), default=0.0)
 
+    # entry economy: the mon-map state the optimizer leaves behind.
+    # (Summing per-round news double-counts PGs re-planned later — the
+    # round-3 record's 12k "entries" was that artifact.)
+    final_pgs = len(ms.pg_upmap_items)
+    final_pairs = sum(len(v) for v in ms.pg_upmap_items.values())
+
     print(
         f"bulk remap: {per_update * 1e3:.1f} ms / {PG_NUM} PGs; optimizer: "
-        f"{rounds} rounds, {entries} upmap entries (+{removals} removals), "
+        f"{rounds} rounds, {final_pgs} upmap pgs / {final_pairs} pairs "
+        f"({entries} per-round news, +{removals} removals), "
         f"{opt_s:.1f} s, "
         f"final max deviation {final_dev:.2f} (target {MAX_DEVIATION})",
         file=sys.stderr,
@@ -87,6 +94,8 @@ def main() -> None:
             "rounds": rounds,
             "entries": entries,
             "removals": removals,
+            "final_upmap_pgs": final_pgs,
+            "final_upmap_pairs": final_pairs,
             "seconds": round(opt_s, 1),
             "final_max_deviation": round(final_dev, 2),
             "target_max_deviation": MAX_DEVIATION,

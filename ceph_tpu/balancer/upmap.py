@@ -251,17 +251,68 @@ def calc_pg_upmaps(
                 )
                 if worst <= max_deviation:
                     break
+                # --- entry GC first: reverse existing trial entries
+                # whose removal now helps balance.  Upmap entries are
+                # mon-map state the reference treats as precious
+                # (OSDMap::calc_pg_upmaps considers existing items for
+                # removal before adding new ones); each reversal here is
+                # a free rebalancing move that SHRINKS the table.
+                pg_touched: set[int] = set()
+                gc_removed = 0
+                for pg in list(trial_items):
+                    if pg.pool != pool_id or pg.ps in pg_touched:
+                        continue
+                    row = up_all[pg.ps]
+                    rowv = row[(row != ITEM_NONE) & (row >= 0) & (row < n_osd)]
+                    items = list(trial_items[pg])
+                    changed = False
+                    for idx in range(len(items) - 1, -1, -1):
+                        f, t2 = items[idx]
+                        if not (0 <= f < n_osd and 0 <= t2 < n_osd):
+                            continue
+                        if t2 not in rowv:
+                            # entry not observably in effect (e.g. its
+                            # `from` left the raw set): reversing it
+                            # would shift deviation for a no-op move
+                            continue
+                        # reversal moves one replica t2 -> f
+                        if deviation[t2] - deviation[f] <= 1.0:
+                            continue
+                        if (
+                            deviation[t2] <= max_deviation
+                            and deviation[f] >= -max_deviation
+                        ):
+                            continue
+                        if not (up_vec[f] and cw[f] > 0):
+                            continue
+                        if f in rowv:
+                            continue
+                        others = rowv[rowv != t2]
+                        if dom[f] != -1 and (dom[others] == dom[f]).any():
+                            continue
+                        del items[idx]
+                        deviation[t2] -= 1.0
+                        deviation[f] += 1.0
+                        gc_removed += 1
+                        changed = True
+                    if changed:
+                        if items:
+                            trial_items[pg] = tuple(items)
+                        else:
+                            trial_items.pop(pg, None)
+                        pg_touched.add(pg.ps)
+
                 under = np.nonzero((deviation < -1e-9) & (cw > 0) & up_vec)[0]
                 if len(under) == 0:
                     under = np.nonzero(
                         (deviation < deviation.max() - 1) & (cw > 0) & up_vec
                     )[0]
-                if len(under) == 0:
+                if len(under) == 0 and gc_removed == 0:
                     break
                 gains, pgs, frms, tos = _score_candidate_moves(
                     up_all, deviation, dom, under, max_deviation, n_osd
                 )
-                if len(gains) == 0:
+                if len(gains) == 0 and gc_removed == 0:
                     break
                 # Greedy batched acceptance against a simulated deviation
                 # vector: each accepted move shifts one PG replica, so
@@ -269,8 +320,7 @@ def calc_pg_upmaps(
                 # round; a move must still help at acceptance time.
                 order = np.argsort(-gains, kind="stable")
                 dev_sim = deviation.copy()
-                pg_touched: set[int] = set()
-                accepted = 0
+                accepted = gc_removed
                 for ci in order:
                     if entries + pool_entries >= max_entries:
                         break

@@ -6,19 +6,107 @@ Two device engines implement identical placement semantics (upstream
 - :mod:`ceph_tpu.crush.interp_batch` — level-synchronous, one-hot-MXU
   engine (the fast path; straw2 maps with modern tunables), and
 - :mod:`ceph_tpu.crush.interp` — the general ``vmap`` engine (uniform
-  buckets, legacy shapes).
+  buckets, legacy shapes; single choose step per take).
+
+A third tier guarantees reference semantics for every remaining shape:
+the in-repo C++ reference (:mod:`ceph_tpu.testing.cppref`, a native
+implementation of the upstream working-vector loop).  Rules land there
+only when no device engine is exact — today that is chained choose
+steps whose per-step fan-out overflows ``result_max`` (where the
+reference caps each inner choose by the lane's remaining space,
+dynamically) and chained chooses on maps the fast engine rejects.
 
 Callers that just want "run this rule for a batch of x" should go
-through :func:`make_batch_runner` / :func:`run_batch` so they get the
-fast path whenever the map qualifies.
+through :func:`make_batch_runner` / :func:`run_batch` so they always
+get reference semantics at the fastest qualifying tier.
 """
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax.numpy as jnp
 
 from . import interp, interp_batch
-from .map import DenseCrushMap, Rule
+from .map import (
+    DenseCrushMap,
+    OP_CHOOSE_FIRSTN,
+    OP_CHOOSE_INDEP,
+    OP_CHOOSELEAF_FIRSTN,
+    OP_CHOOSELEAF_INDEP,
+    OP_EMIT,
+    OP_TAKE,
+    Rule,
+)
+
+_CHOOSE_OPS = (
+    OP_CHOOSE_FIRSTN,
+    OP_CHOOSE_INDEP,
+    OP_CHOOSELEAF_FIRSTN,
+    OP_CHOOSELEAF_INDEP,
+)
+
+
+def _chain_overflows(rule: Rule, result_max: int) -> bool:
+    """Static check: does any chained choose's fan-out exceed
+    ``result_max``?  In that regime the reference caps each inner choose
+    by the lane's *dynamic* remaining space (``result_max - osize``,
+    mapper.c crush_do_rule), which the batch engine cannot express with
+    static shapes — it raises instead of deviating."""
+    width = 0
+    for s in rule.steps:
+        if s.op == OP_TAKE:
+            width = 1
+        elif s.op in _CHOOSE_OPS:
+            numrep = s.arg1 if s.arg1 > 0 else s.arg1 + result_max
+            if numrep <= 0:
+                continue
+            if width > 1 and width * numrep > result_max:
+                return True
+            width = min(width * numrep, result_max)
+        elif s.op == OP_EMIT:
+            width = 0
+    return False
+
+
+def _interp_supports(rule: Rule) -> bool:
+    """The vmap engine runs single-choose-per-take programs only
+    (its working vector holds one pending take, not a chain)."""
+    pending = False  # an un-consumed choose result in the working vector
+    for s in rule.steps:
+        if s.op == OP_TAKE:
+            pending = False
+        elif s.op in _CHOOSE_OPS:
+            if pending:
+                return False
+            pending = True
+        elif s.op == OP_EMIT:
+            pending = False
+    return True
+
+
+def _host_runner(dense: DenseCrushMap, rule: Rule, result_max: int):
+    """Exact-semantics native fallback on the C++ reference tier.
+
+    The map travels through ``crush_arg`` (here the DenseCrushMap
+    itself), NOT a closure: signature-keyed fn caches reuse ``fn``
+    across maps sharing a signature, so baking the map in would serve
+    stale placements (see test_compile_cache_distinguishes_same_shape_maps).
+    """
+    from ceph_tpu.testing import cppref
+
+    steps = [(s.op, s.arg1, s.arg2) for s in rule.steps]
+
+    def fn(dense_arg, osd_weight, xs):
+        res, lens = cppref.do_rule_batch(
+            dense_arg, steps,
+            np.asarray(xs, np.uint32),
+            np.asarray(osd_weight, np.uint32),
+            result_max,
+        )
+        return jnp.asarray(res), jnp.asarray(lens)
+
+    return dense, fn
 
 
 def make_batch_runner(dense: DenseCrushMap, rule: Rule, result_max: int):
@@ -30,17 +118,25 @@ def make_batch_runner(dense: DenseCrushMap, rule: Rule, result_max: int):
     argument of ``fn``, so maps sharing topology shape reuse compiled
     programs.
     """
-    if interp_batch.supports(dense, rule):
+    if interp_batch.supports(dense, rule) and not _chain_overflows(
+        rule, result_max
+    ):
         return interp_batch.fast_runner(dense, rule, result_max)
-    smap = interp.StaticCrushMap(dense)
-    return smap, interp.batch_runner(smap, rule, result_max)
+    if _interp_supports(rule):
+        smap = interp.StaticCrushMap(dense)
+        return smap, interp.batch_runner(smap, rule, result_max)
+    return _host_runner(dense, rule, result_max)
 
 
 def runner_signature(dense: DenseCrushMap, rule: Rule, result_max: int) -> tuple:
     """Hashable static signature of the program make_batch_runner would
     build — equal signatures share one compiled executable."""
-    if interp_batch.supports(dense, rule):
+    if interp_batch.supports(dense, rule) and not _chain_overflows(
+        rule, result_max
+    ):
         return ("fast",) + interp_batch.fast_signature(dense, rule, result_max)
+    if not _interp_supports(rule):
+        return ("host", interp.rule_signature(rule), result_max)
     # smap_signature's fields, read straight off the dense map (no
     # StaticCrushMap construction — that would upload the whole map)
     return (

@@ -40,10 +40,15 @@ Design:
 
 Scope (checked by :func:`supports`): straw2 buckets only (uniform/list/
 tree maps fall back to ``interp.batch_do_rule``), bobtail+ tunables (no
-legacy local retries), take targets must be buckets.  One deliberate
-deviation from upstream in exotic chains: when multiple EMITs overflow
-``result_max``, surplus entries are dropped at emit (masked writes)
-rather than capping each inner choose by per-lane remaining space.
+legacy local retries), take targets must be buckets.  Multi-EMIT
+programs that overflow ``result_max`` drop surplus at emit via masked
+writes — the same cap the reference's EMIT applies (``result_len <
+result_max``), differentially pinned in ``tests/test_crush_batch.py``.
+Chained chooses whose fan-out exceeds ``result_max`` would need the
+reference's *dynamic* per-lane inner-choose cap (``result_max - osize``)
+which static shapes cannot express: compile raises, and
+``engine.make_batch_runner`` detects the shape statically and routes to
+the exact C++ tier instead.
 """
 
 from __future__ import annotations

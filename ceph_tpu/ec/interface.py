@@ -168,6 +168,40 @@ class ErasureCode(ErasureCodeInterface):
         # default: cost-blind (reference base class does the same)
         return self.minimum_to_decode(want_to_read, set(available))
 
+    # ---- create_rule (reference ErasureCode::create_rule) ----
+    #
+    # The bridge that makes an EC profile self-contained: the profile's
+    # ``crush-root`` / ``crush-failure-domain`` / ``crush-device-class``
+    # keys describe the CRUSH rule the pool needs, and the plugin builds
+    # it on the map (upstream src/erasure-code/ErasureCode.cc ::
+    # create_rule, defaults from ErasureCode::parse).
+
+    DEFAULT_RULE_ROOT = "default"
+    DEFAULT_RULE_FAILURE_DOMAIN = "host"
+
+    def _rule_profile(self) -> tuple[str, str, str | None]:
+        """(root, failure_domain, device_class|None) from the profile
+        this plugin was init()ed with."""
+        profile = getattr(self, "profile", None) or Profile()
+        root = profile.get("crush-root", self.DEFAULT_RULE_ROOT)
+        fd = profile.get(
+            "crush-failure-domain", self.DEFAULT_RULE_FAILURE_DOMAIN
+        )
+        dc = profile.get("crush-device-class", "") or None
+        return root, fd, dc
+
+    def create_rule(self, name: str, crush_map):
+        """Build this profile's erasure rule on ``crush_map`` and
+        return it.  Raises ErasureCodeError on unknown root/type/class
+        (upstream returns -ENOENT with an error stream)."""
+        root, fd, dc = self._rule_profile()
+        try:
+            return crush_map.make_erasure_rule(name, root, fd, dc)
+        except (KeyError, ValueError) as e:
+            raise ErasureCodeError(
+                f"create_rule {name!r}: {e}"
+            ) from e
+
     # ---- encode: pad -> split -> encode_chunks ----
 
     def encode_prepare(self, data: np.ndarray) -> dict[int, np.ndarray]:

@@ -30,9 +30,12 @@ planes by increasing *intersection score* (count of y whose dot node
 already fully U-decoded), then MDS-decode each plane's <= m unknown U
 symbols; finally map U back to C at the erased nodes.
 
-Single-node repair reads only planes with z_{y0} = x0 and is
-implemented for the default d = k+m-1 (all surviving real nodes are
-helpers), matching the reference's default profile.
+Single-node repair reads only planes with z_{y0} = x0, for any
+k <= d <= k+m-1 (upstream ErasureCodeClay::parse bounds).  At the
+default d = k+m-1 every surviving real node helps; for smaller d the
+k+m-1-d aloof survivors are carried as extra MDS erasures and repair
+planes are processed by aloof-intersection score, mirroring upstream
+repair_one_lost_chunk's order classes.
 """
 
 from __future__ import annotations
@@ -67,11 +70,12 @@ class ErasureCodeClay(ErasureCode):
         self.k = profile.get_int("k", 4)
         self.m = profile.get_int("m", 2)
         self.d = profile.get_int("d", self.k + self.m - 1)
-        if self.d != self.k + self.m - 1:
+        if not self.k <= self.d <= self.k + self.m - 1:
             raise ErasureCodeError(
-                "only d = k+m-1 (the reference default) is supported"
+                f"d={self.d} must satisfy k <= d <= k+m-1 "
+                f"(k={self.k}, m={self.m}; upstream ErasureCodeClay::parse)"
             )
-        self.q = self.d - self.k + 1  # == m
+        self.q = self.d - self.k + 1  # == m only at the default d
         km = self.k + self.m
         self.nu = (self.q - km % self.q) % self.q  # virtual chunks
         self.t = (km + self.nu) // self.q
@@ -155,15 +159,44 @@ class ErasureCodeClay(ErasureCode):
             i: np.ascontiguousarray(C[i].reshape(-1)) for i in want_to_read
         }
 
+    def _repair_helpers(self, lost: int, available: set[int]) -> set[int] | None:
+        """Pick the d helper chunks for single-node repair, or None if
+        the repair-optimal path is not possible.
+
+        Every surviving real node in the lost node's grid row must help:
+        their stored repair-plane bytes appear irreplaceably in the
+        rebuild pair equations (upstream is_repair refuses otherwise and
+        falls back to conventional decode).  The rest are filled in node
+        order, as upstream minimum_to_repair does.
+        """
+        if len(available) < self.d:
+            return None
+        x0, y0 = self._xy(lost)
+        real = set(range(self.k + self.m))
+        row = ({self._node(x, y0) for x in range(self.q)} & real) - {lost}
+        if not row <= available:
+            return None
+        helpers = set(row)
+        for c in sorted(available):
+            if len(helpers) == self.d:
+                break
+            helpers.add(c)
+        return helpers if len(helpers) == self.d else None
+
     def minimum_to_decode(
         self, want_to_read: set[int], available: set[int]
     ) -> set[int]:
         if want_to_read <= available:
             return set(want_to_read)
         erased = want_to_read - available
-        if len(erased) == 1 and len(available) >= self.d:
-            # repair-optimal single-node path: d helpers
-            return set(sorted(available)[: self.d])
+        if len(erased) == 1 and len(want_to_read) == 1:
+            # repair-optimal single-node path: d helpers.  Upstream
+            # is_repair also requires a single *wanted* chunk — with
+            # d < k+m-1 the helper set may exclude other wanted chunks,
+            # so multi-chunk wants take the conventional minimum.
+            helpers = self._repair_helpers(next(iter(erased)), available)
+            if helpers is not None:
+                return helpers
         return self._minimum_to_decode(want_to_read, available)
 
     def minimum_to_decode_subchunks(
@@ -171,13 +204,17 @@ class ErasureCodeClay(ErasureCode):
     ) -> tuple[set[int], list[int]]:
         """Helpers + the plane indices each must supply (the reference's
         sub-chunk-range form of minimum_to_decode)."""
-        if len(available) < self.d:
-            raise ErasureCodeError(f"need d={self.d} helpers")
+        helpers = self._repair_helpers(lost, available)
+        if helpers is None:
+            raise ErasureCodeError(
+                f"no repair-optimal helper set for {lost} in "
+                f"{sorted(available)} (need d={self.d} incl. the lost row)"
+            )
         x0, y0 = self._xy(lost)
         planes = [
             z for z in range(self.sub_chunk_no) if self._digit(z, y0) == x0
         ]
-        return set(sorted(available)[: self.d]), planes
+        return helpers, planes
 
     # ---- core machinery ----
 
@@ -323,11 +360,18 @@ class ErasureCodeClay(ErasureCode):
         lost: int,
         helper_subchunks: dict[int, dict[int, np.ndarray]],
     ) -> np.ndarray:
-        """Recover chunk ``lost`` from helpers supplying ONLY the repair
-        planes (z_{y0} = x0): q^{t-1} sub-chunks each.
+        """Recover chunk ``lost`` from d helpers supplying ONLY the
+        repair planes (z_{y0} = x0): q^{t-1} sub-chunks each.
 
         ``helper_subchunks[i][z]`` = helper i's sub-chunk for plane z.
         Returns the full reconstructed chunk (q^t sub-chunks).
+
+        With d < k+m-1 the k+m-1-d non-helping survivors ("aloof"
+        nodes, upstream repair_one_lost_chunk) are treated as erasures:
+        repair planes are processed in classes of increasing aloof
+        intersection score, exactly like _decode_layered, and each
+        class's MDS solve carries m unknowns (the q-node lost row plus
+        the aloof nodes).
         """
         n = self.n
         x0, y0 = self._xy(lost)
@@ -336,11 +380,12 @@ class ErasureCodeClay(ErasureCode):
         npl = len(planes)
         real = set(range(self.k + self.m))
         helpers = set(helper_subchunks)
-        if helpers != real - {lost}:
+        if helpers != self._repair_helpers(lost, helpers):
             raise ErasureCodeError(
-                "repair needs all surviving real chunks as helpers "
-                f"(d = k+m-1); got {sorted(helpers)}"
+                f"repair of {lost} needs d={self.d} helpers including "
+                f"every survivor in its grid row; got {sorted(helpers)}"
             )
+        aloof = real - helpers - {lost}
         sub = len(next(iter(helper_subchunks[next(iter(helpers))].values())))
 
         # helper sub-chunks on the repair planes; virtual nodes are zero
@@ -349,40 +394,51 @@ class ErasureCodeClay(ErasureCode):
             Cp[i] = np.stack([helper_subchunks[i][int(z)] for z in planes])
 
         # unknown nodes: the whole grid row y0 (incl. virtual columns)
+        # plus the aloof survivors — m base symbols per plane
         unknown = np.zeros(n, bool)
         unknown[lost] = True
         unknown[(yv == y0) & (xv != x0)] = True
+        unknown[list(aloof)] = True
         known = np.nonzero(~unknown)[0]
 
-        u_known_fn, rebuild_fn = self._repair_kernels(lost)
+        known_fns, classes, rebuild_fn = self._repair_kernels(
+            lost, frozenset(aloof)
+        )
 
-        # U at known nodes, all repair planes in one device op; the
-        # partner of a known node is never in row y0 (y != y0 there) and
-        # its pair plane keeps the y0 digit, so it stays in the repair set
         U = np.zeros((n, npl, sub), np.uint8)
-        U[known] = np.asarray(u_known_fn(jnp.asarray(Cp)))
-
-        # one batched MDS solve over all repair planes
-        avail = {
-            self._base_id(node): U[node].reshape(-1)
-            for node in known
-        }
-        want = {self._base_id(node) for node in np.nonzero(unknown)[0]}
-        solved = self.base.decode(avail, want)
-        for node in np.nonzero(unknown)[0]:
-            U[node] = solved[self._base_id(node)].reshape(npl, sub)
+        Cp_dev = jnp.asarray(Cp)
+        for P_pos, fn in zip(classes, known_fns):
+            # U at known nodes for this score class: one device op.  A
+            # known node's partner shares its row (y != y0), so the pair
+            # plane keeps the y0 digit and stays in the repair set; an
+            # aloof partner's U comes from a strictly lower class.
+            U[np.ix_(known, P_pos)] = np.asarray(fn(Cp_dev, jnp.asarray(U)))
+            # batched MDS solve for the class's plane stripe
+            avail = {
+                self._base_id(node): U[node][P_pos].reshape(-1)
+                for node in known
+            }
+            want = {self._base_id(node) for node in np.nonzero(unknown)[0]}
+            solved = self.base.decode(avail, want)
+            for node in np.nonzero(unknown)[0]:
+                U[node][P_pos] = solved[self._base_id(node)].reshape(
+                    len(P_pos), sub
+                )
 
         # reconstruct the lost chunk over the full plane space (device)
-        out = np.asarray(rebuild_fn(jnp.asarray(Cp), jnp.asarray(U)))
+        out = np.asarray(rebuild_fn(Cp_dev, jnp.asarray(U)))
         return np.ascontiguousarray(out.reshape(-1))
 
-    def _repair_kernels(self, lost: int):
+    def _repair_kernels(self, lost: int, aloof_key: frozenset):
         """Jitted device kernels for the repair hot path, cached per
-        lost node: (u_known [n,P,sub]<-Cp, rebuild [Z,sub]<-(Cp,U))."""
+        (lost node, aloof set): per-score-class U-at-known transforms
+        (plane positions indexed into the repair stripe) + the final
+        lost-chunk rebuild [Z,sub] <- (Cp, U)."""
         if not hasattr(self, "_repair_fns"):
             self._repair_fns = {}
-        if lost in self._repair_fns:
-            return self._repair_fns[lost]
+        key = (lost, aloof_key)
+        if key in self._repair_fns:
+            return self._repair_fns[key]
         n, Z = self.n, self.sub_chunk_no
         mt = gf.mul_table()
         x0, y0 = self._xy(lost)
@@ -393,22 +449,45 @@ class ErasureCodeClay(ErasureCode):
         unknown = np.zeros(n, bool)
         unknown[lost] = True
         unknown[(yv == y0) & (xv != x0)] = True
+        unknown[list(aloof_key)] = True
         known = np.nonzero(~unknown)[0]
 
         tab_g = mt[GAMMA]
         tab_di = mt[self._det_inv]
         tab_gi = mt[self._ginv]
-        d_mask = jnp.asarray(diag[known][:, planes][..., None])
-        pa = jnp.asarray(partner[known][:, planes])
-        pz = jnp.asarray(pos[zpair[known][:, planes]])
-        known_j = jnp.asarray(known)
 
-        @jax.jit
-        def u_known_fn(Cp):
-            cn = Cp[known_j]  # [K, P, sub]
-            cpart = Cp[pa, pz]  # [K, P, sub]
-            u_pair = _gf_lut(tab_di, cn ^ _gf_lut(tab_g, cpart))
-            return jnp.where(d_mask, cn, u_pair)
+        # score: per repair plane, how many rows' plane-digit selects an
+        # aloof node (row y0 is never aloof: its survivors must help)
+        aloof_mask = np.zeros(n, bool)
+        aloof_mask[list(aloof_key)] = True
+        node_ids = digits + (np.arange(self.t)[None, :] * self.q)  # [Z, t]
+        score = aloof_mask[node_ids].sum(axis=1)[planes]  # [P]
+
+        classes = []
+        known_fns = []
+        for s in sorted(set(score.tolist())):
+            P_pos = np.nonzero(score == s)[0]  # positions in the stripe
+            classes.append(P_pos)
+            zsel = planes[P_pos]  # absolute plane ids
+            kn = known[:, None]  # [K, 1]
+            d_mask = jnp.asarray(diag[kn, zsel[None, :]][..., None])
+            pa = jnp.asarray(partner[kn, zsel[None, :]])  # [K, P]
+            pz = jnp.asarray(pos[zpair[kn, zsel[None, :]]])
+            pe = jnp.asarray(
+                aloof_mask[partner[kn, zsel[None, :]]][..., None]
+            )
+            known_j = jnp.asarray(known)
+
+            def fn(Cp, U, *, d_mask=d_mask, pa=pa, pz=pz, pe=pe,
+                   known_j=known_j, P_j=jnp.asarray(P_pos)):
+                cn = Cp[known_j[:, None], P_j[None, :]]  # [K, P, sub]
+                cpart = Cp[pa, pz]
+                upa = U[pa, pz]
+                u_pair = _gf_lut(tab_di, cn ^ _gf_lut(tab_g, cpart))
+                u_pe = cn ^ _gf_lut(tab_g, upa)
+                return jnp.where(d_mask, cn, jnp.where(pe, u_pe, u_pair))
+
+            known_fns.append(jax.jit(fn))
 
         zy0 = digits[:, y0]
         partner0 = jnp.asarray(y0 * self.q + zy0)
@@ -426,5 +505,5 @@ class ErasureCodeClay(ErasureCode):
             on_diag = U[lost, on_diag_idx]
             return jnp.where(diag_mask, on_diag, off_diag)
 
-        self._repair_fns[lost] = (u_known_fn, rebuild_fn)
-        return self._repair_fns[lost]
+        self._repair_fns[key] = (known_fns, classes, rebuild_fn)
+        return self._repair_fns[key]

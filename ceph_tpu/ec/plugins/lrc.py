@@ -152,6 +152,56 @@ class ErasureCodeLrc(ErasureCode):
     def _data_positions(self) -> list[int]:
         return [i for i, c in enumerate(self.mapping) if c == "D"]
 
+    def create_rule(self, name: str, crush_map):
+        """LRC's own rule builder (upstream ErasureCodeLrc::create_rule):
+        the profile's ``crush-steps`` JSON — a list of
+        ``[op, type, num]`` with op choose|chooseleaf — replaces the
+        base's single chooseleaf step, so chunks land grouped by
+        locality (e.g. pick 3 racks, then 4 hosts in each)."""
+        from ...crush.map import (
+            OP_CHOOSE_INDEP,
+            OP_CHOOSELEAF_INDEP,
+            OP_EMIT,
+            OP_SET_CHOOSELEAF_TRIES,
+            OP_TAKE,
+            Step,
+        )
+
+        profile = getattr(self, "profile", None) or Profile()
+        root, fd, dc = self._rule_profile()
+        try:
+            steps_spec = json.loads(
+                profile.get("crush-steps", '[["chooseleaf", "%s", 0]]' % fd)
+            )
+            if not isinstance(steps_spec, list):
+                raise ErasureCodeError(
+                    f"crush-steps must be a JSON list, got {steps_spec!r}"
+                )
+            root_id = crush_map._resolve_take(root, dc)
+            steps = [Step(OP_SET_CHOOSELEAF_TRIES, 5), Step(OP_TAKE, root_id)]
+            for spec in steps_spec:
+                if (
+                    not isinstance(spec, (list, tuple))
+                    or len(spec) != 3
+                    or spec[0] not in ("choose", "chooseleaf")
+                ):
+                    raise ErasureCodeError(
+                        f"crush-steps entry {spec!r} must be "
+                        "[choose|chooseleaf, type, num]"
+                    )
+                op, type_name, num = spec
+                opcode = (
+                    OP_CHOOSELEAF_INDEP if op == "chooseleaf"
+                    else OP_CHOOSE_INDEP
+                )
+                steps.append(
+                    Step(opcode, int(num), crush_map.type_id(type_name))
+                )
+            steps.append(Step(OP_EMIT))
+            return crush_map.add_rule(name, steps, kind="erasure")
+        except (KeyError, ValueError, TypeError, json.JSONDecodeError) as e:
+            raise ErasureCodeError(f"create_rule {name!r}: {e}") from e
+
 
     def encode_prepare(self, data: np.ndarray) -> dict[int, np.ndarray]:
         blocksize = self.get_chunk_size(len(data))

@@ -1,25 +1,23 @@
-"""Shingled erasure code (SHEC — structural semantics only).
-
-Parity scope: this plugin reproduces the reference's *structural*
-semantics (shingle geometry, non-MDS recoverability, windowed repair),
-NOT bit-compatible encodings — the parity coefficients below use an
-``alpha^((i+1)(j+1))`` pattern rather than the reference shec plugin's
-exact matrix construction, so encoded parity bytes differ from upstream
-while remaining self-consistent and recoverable.
+"""Shingled erasure code (SHEC).
 
 Semantics per the reference's ``src/erasure-code/shec`` (Miyamae et
 al., "SHEC"): SHEC(k, m, c) places m parities, each covering a
-*shingle* — a window of ceil(k*c/m) consecutive data chunks starting at
-floor(i*k/m) — so single-chunk recovery reads only a window instead of
-k chunks, trading durability (not MDS) for recovery efficiency.  ``c``
-is the average parity coverage per data chunk.
+*shingle* — a circular window of ceil(k*c/m) consecutive data chunks
+starting at floor(i*k/m) — so single-chunk recovery reads only a window
+instead of k chunks, trading durability (not MDS) for recovery
+efficiency.  ``c`` is the average parity coverage per data chunk.
 
-Parity coefficients inside a window come from Vandermonde rows over
-GF(2^8) (non-zero, distinct), zeros outside.  Because the code is not
-MDS, decode solves the available linear system: identity rows for
-surviving data + shingle rows for surviving parities, Gauss-eliminated
-on the host to produce a reconstruction matrix, with the bulk multiply
-on device (:class:`TableEncoder`).
+Matrix construction matches the reference's
+``ErasureCodeShec::shec_reedsolomon_coding_matrix`` at the default
+w = 8: start from jerasure's systematized extended-Vandermonde coding
+matrix (``reed_sol_vandermonde_coding_matrix(k, m, 8)`` — the same
+construction the jerasure reed_sol_van plugin here is bit-exact
+against), then zero every entry outside the row's shingle window, so
+encoded parity bytes equal upstream's.  Because the code is not MDS,
+decode solves the available linear system: identity rows for surviving
+data + shingle rows for surviving parities, Gauss-eliminated on the
+host to produce a reconstruction matrix, with the bulk multiply on
+device (:class:`TableEncoder`).
 """
 
 from __future__ import annotations
@@ -34,14 +32,15 @@ from ..interface import ErasureCode, ErasureCodeError, Profile
 
 
 def _shingle_matrix(k: int, m: int, c: int) -> np.ndarray:
+    """reed_sol Vandermonde coding matrix masked to the shingle pattern
+    (reference shec_reedsolomon_coding_matrix, w=8)."""
     width = math.ceil(k * c / m)
-    mat = np.zeros((m, k), np.uint8)
+    mat = gf.vandermonde_matrix(k, m)
     for i in range(m):
         start = (i * k) // m
-        for off in range(width):
-            j = (start + off) % k
-            # distinct non-zero coefficients: alpha^{(i+1)*j} pattern
-            mat[i, j] = gf.tables()[1][((i + 1) * (j + 1)) % 255]
+        for j in range(k):
+            if (j - start) % k >= width:
+                mat[i, j] = 0
     return mat
 
 
@@ -54,6 +53,14 @@ class ErasureCodeShec(ErasureCode):
         if not (0 < self.c <= self.m <= self.k):
             raise ErasureCodeError(
                 f"need 0 < c={self.c} <= m={self.m} <= k={self.k}"
+            )
+        self.w = profile.get_int("w", 8)
+        if self.w != 8:
+            # upstream allows w in {8,16,32}; the GF(2^8) table engine
+            # here covers the default — reject the rest loudly
+            raise ErasureCodeError(
+                f"w={self.w} not supported (only the upstream default "
+                "w=8)"
             )
         self.matrix = _shingle_matrix(self.k, self.m, self.c)
         self.encoder = TableEncoder(self.matrix)

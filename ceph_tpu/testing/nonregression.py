@@ -71,6 +71,8 @@ def ec_cases() -> dict[str, dict]:
         "lrc_4_2_3": {"plugin": "lrc", "k": "4", "m": "2", "l": "3"},
         "shec_4_3_2": {"plugin": "shec", "k": "4", "m": "3", "c": "2"},
         "clay_4_2": {"plugin": "clay", "k": "4", "m": "2"},
+        "clay_4_3_d5": {"plugin": "clay", "k": "4", "m": "3", "d": "5"},
+        "clay_4_3_d4": {"plugin": "clay", "k": "4", "m": "3", "d": "4"},
         "jerasure_liberation_4_2_w7": {"plugin": "jerasure", "technique": "liberation", "k": "4", "m": "2", "w": "7", "packetsize": "8"},
         "jerasure_blaum_roth_4_2_w6": {"plugin": "jerasure", "technique": "blaum_roth", "k": "4", "m": "2", "w": "6", "packetsize": "8"},
         "jerasure_liber8tion_4_2": {"plugin": "jerasure", "technique": "liber8tion", "k": "4", "m": "2", "packetsize": "8"},

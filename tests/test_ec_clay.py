@@ -76,8 +76,85 @@ def test_clay_repair_bandwidth_optimal(k, m):
 
 
 def test_clay_rejects_bad_d():
+    # valid range is k <= d <= k+m-1 (upstream ErasureCodeClay::parse)
     with pytest.raises(ErasureCodeError):
-        create({"plugin": "clay", "k": "4", "m": "2", "d": "4"})
+        create({"plugin": "clay", "k": "4", "m": "2", "d": "3"})
+    with pytest.raises(ErasureCodeError):
+        create({"plugin": "clay", "k": "4", "m": "2", "d": "6"})
+
+
+@pytest.mark.parametrize("k,m,d", [(4, 3, 5), (4, 3, 4),
+                                   (3, 2, 3), (4, 2, 4)])
+def test_clay_general_d_roundtrip(k, m, d):
+    """Non-default d: encode/decode over sampled <=m erasure patterns
+    (full-m patterns plus a few singles; every pattern is a separate
+    kernel-cache entry, so exhaustive sweeps belong to the default-d
+    test)."""
+    import itertools
+
+    ec = create({"plugin": "clay", "k": str(k), "m": str(m), "d": str(d)})
+    assert ec.get_sub_chunk_count() == (d - k + 1) ** ec.t
+    rng = np.random.default_rng(d * 100 + k)
+    data = rng.integers(0, 256, k * ec.get_sub_chunk_count() * 8, np.uint8)
+    chunks = ec.encode_prepare(data)
+    ec.encode_chunks(chunks)
+    full = {i: c.copy() for i, c in chunks.items()}
+    patterns = [(i,) for i in range(0, k + m, 3)]
+    all_m = list(itertools.combinations(range(k + m), m))
+    patterns += [all_m[i] for i in
+                 rng.choice(len(all_m), size=min(4, len(all_m)),
+                            replace=False)]
+    for lost in patterns:
+        avail = {i: c.copy() for i, c in full.items() if i not in lost}
+        out = ec.decode_chunks(set(lost), avail)
+        for i in lost:
+            np.testing.assert_array_equal(out[i], full[i], err_msg=f"chunk {i}")
+
+
+@pytest.mark.parametrize("k,m,d", [(4, 3, 5), (4, 3, 4), (6, 3, 7)])
+def test_clay_general_d_repair(k, m, d):
+    """Single-node repair with d < k+m-1 helpers: aloof survivors are
+    carried as extra erasures; output must still be byte-exact and read
+    only q^{t-1} sub-chunks from each of the d helpers."""
+    ec = create({"plugin": "clay", "k": str(k), "m": str(m), "d": str(d)})
+    Z = ec.get_sub_chunk_count()
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, k * Z * 8, np.uint8)
+    chunks = ec.encode_prepare(data)
+    ec.encode_chunks(chunks)
+    sub = len(chunks[0]) // Z
+    for lost in range(k + m):
+        helpers, planes = ec.minimum_to_decode_subchunks(
+            lost, set(range(k + m)) - {lost}
+        )
+        assert len(helpers) == d
+        assert len(planes) == Z // ec.q
+        helper_subchunks = {
+            i: {
+                int(z): chunks[i][z * sub:(z + 1) * sub]
+                for z in planes
+            }
+            for i in helpers
+        }
+        got = ec.repair(lost, helper_subchunks)
+        np.testing.assert_array_equal(got, chunks[lost], err_msg=f"lost {lost}")
+
+
+def test_clay_repair_rejects_wrong_helpers():
+    # k=4 m=3 d=5 -> q=2; lost=0 sits in row {0,1}, so node 1 must help
+    ec = create({"plugin": "clay", "k": "4", "m": "3", "d": "5"})
+    lost = 0
+    helpers, planes = ec.minimum_to_decode_subchunks(
+        lost, set(range(7)) - {lost}
+    )
+    assert 1 in helpers
+    sub = 8
+    # drop the lost-row helper, substitute other survivors: must refuse
+    bad_set = sorted(set(range(7)) - {lost, 1})[:5]
+    bad = {i: {int(z): np.zeros(sub, np.uint8) for z in planes}
+           for i in bad_set}
+    with pytest.raises(ErasureCodeError):
+        ec.repair(lost, bad)
 
 
 def test_clay_shortening_nu():
@@ -92,3 +169,16 @@ def test_clay_shortening_nu():
     out = ec.decode({1, 6}, avail, cs)
     assert np.array_equal(out[1], enc[1])
     assert np.array_equal(out[6], enc[6])
+
+
+def test_clay_multi_want_minimum_includes_wants():
+    """minimum_to_decode with several wanted chunks must never return a
+    set that omits a wanted, available chunk (upstream is_repair demands
+    a single want before taking the repair-optimal path)."""
+    ec = create({"plugin": "clay", "k": "4", "m": "3", "d": "4"})
+    avail = set(range(1, 7))
+    got = ec.minimum_to_decode({0, 5}, avail)
+    assert 5 in got
+    # single want still takes the d-helper repair path
+    helpers = ec.minimum_to_decode({0}, avail)
+    assert len(helpers) == 4

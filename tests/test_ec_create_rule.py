@@ -1,0 +1,152 @@
+"""EC profile -> CRUSH rule bridge (reference ErasureCode::create_rule).
+
+An EC profile is self-contained: its ``crush-root`` /
+``crush-failure-domain`` / ``crush-device-class`` keys describe the rule
+the pool needs, and the plugin creates it on the map (upstream
+src/erasure-code/ErasureCode.cc :: create_rule; LRC overrides it with
+``crush-steps`` in src/erasure-code/lrc/ErasureCodeLrc.cc).
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush.engine import run_batch
+from ceph_tpu.crush.map import (
+    OP_CHOOSE_INDEP,
+    OP_CHOOSELEAF_INDEP,
+    OP_EMIT,
+    OP_SET_CHOOSELEAF_TRIES,
+    OP_TAKE,
+)
+from ceph_tpu.ec import ErasureCodeError, create
+from ceph_tpu.models import build_simple
+
+
+def _place(m, rule, result_max, n_x=64):
+    xs = np.arange(n_x, dtype=np.uint32)
+    w = np.full(m.max_devices, 0x10000, np.uint32)
+    res, lens = run_batch(m.to_dense(), rule, xs, w, result_max)
+    return np.asarray(res), np.asarray(lens)
+
+
+def _rack_of(m):
+    """osd id -> rack name."""
+    osd_rack = {}
+    for rack in m.buckets.values():
+        if m.types[rack.type_id] != "rack":
+            continue
+        for hid in rack.items:
+            if hid < 0:
+                for osd in m.buckets[hid].items:
+                    osd_rack[osd] = rack.name
+    return osd_rack
+
+
+def test_base_create_rule_places_across_failure_domain():
+    m = build_simple(192)  # 48 hosts -> 6 racks
+    ec = create({"plugin": "jerasure", "k": "4", "m": "2",
+                 "crush-root": "default", "crush-failure-domain": "rack"})
+    rule = ec.create_rule("ecpool", m)
+    assert rule.kind == "erasure"
+    assert m.rule_by_name("ecpool") is rule
+    ops = [s.op for s in rule.steps]
+    assert ops == [OP_SET_CHOOSELEAF_TRIES, OP_TAKE,
+                   OP_CHOOSELEAF_INDEP, OP_EMIT]
+    res, lens = _place(m, rule, ec.get_chunk_count())
+    assert (lens == 6).all()
+    osd_rack = _rack_of(m)
+    for row in res:
+        racks = [osd_rack[o] for o in row]
+        assert len(set(racks)) == 6, "chunks must land in distinct racks"
+
+
+def test_base_create_rule_defaults():
+    m = build_simple(16)
+    ec = create({"plugin": "jerasure", "k": "2", "m": "1"})
+    rule = ec.create_rule("ecdefault", m)
+    # defaults: root "default", failure domain "host"
+    assert rule.steps[1].arg1 == m.bucket_by_name("default").id
+    assert rule.steps[2].arg2 == m.type_id("host")
+
+
+def test_base_create_rule_osd_failure_domain_uses_choose():
+    m = build_simple(16)
+    ec = create({"plugin": "jerasure", "k": "2", "m": "1",
+                 "crush-failure-domain": "osd"})
+    rule = ec.create_rule("ecosd", m)
+    assert any(s.op == OP_CHOOSE_INDEP for s in rule.steps)
+    res, lens = _place(m, rule, 3)
+    assert (lens == 3).all()
+
+
+def test_base_create_rule_device_class():
+    m = build_simple(32)
+    for osd in range(32):
+        m.device_classes[osd] = "ssd" if osd % 2 else "hdd"
+    ec = create({"plugin": "jerasure", "k": "2", "m": "1",
+                 "crush-device-class": "ssd",
+                 "crush-failure-domain": "osd"})
+    rule = ec.create_rule("ec_ssd", m)
+    res, lens = _place(m, rule, 3)
+    assert (lens == 3).all()
+    assert (np.asarray(res) % 2 == 1).all(), "only ssd (odd) osds eligible"
+
+
+def test_base_create_rule_unknown_root_raises():
+    m = build_simple(16)
+    ec = create({"plugin": "jerasure", "k": "2", "m": "1",
+                 "crush-root": "nonesuch"})
+    with pytest.raises(ErasureCodeError):
+        ec.create_rule("bad", m)
+
+
+def test_every_plugin_has_create_rule():
+    m = build_simple(32)
+    profiles = [
+        {"plugin": "jerasure", "k": "4", "m": "2"},
+        {"plugin": "isa", "k": "4", "m": "2"},
+        {"plugin": "lrc", "k": "4", "m": "2", "l": "3"},
+        {"plugin": "shec", "k": "4", "m": "3", "c": "2"},
+        {"plugin": "clay", "k": "4", "m": "2"},
+    ]
+    for i, prof in enumerate(profiles):
+        ec = create(prof)
+        rule = ec.create_rule(f"rule_{prof['plugin']}", m)
+        res, lens = _place(m, rule, ec.get_chunk_count(), n_x=16)
+        assert (lens == ec.get_chunk_count()).all(), prof["plugin"]
+
+
+def test_lrc_create_rule_crush_steps():
+    """LRC's locality-aware rule: 2 racks, then 4 hosts per rack."""
+    m = build_simple(64, osds_per_host=4, hosts_per_rack=8)  # 2 racks
+    ec = create({
+        "plugin": "lrc", "k": "4", "m": "2", "l": "3",
+        "crush-root": "default",
+        "crush-steps": '[["choose", "rack", 2], ["chooseleaf", "host", 4]]',
+    })
+    assert ec.get_chunk_count() == 8
+    rule = ec.create_rule("lrcpool", m)
+    ops = [(s.op, s.arg1, s.arg2) for s in rule.steps]
+    assert ops[0][0] == OP_SET_CHOOSELEAF_TRIES
+    assert ops[1][0] == OP_TAKE
+    assert ops[2] == (OP_CHOOSE_INDEP, 2, m.type_id("rack"))
+    assert ops[3] == (OP_CHOOSELEAF_INDEP, 4, m.type_id("host"))
+    assert ops[4][0] == OP_EMIT
+    res, lens = _place(m, rule, 8)
+    assert (lens == 8).all()
+    osd_rack = _rack_of(m)
+    for row in res:
+        racks = [osd_rack[o] for o in row]
+        # first 4 chunks share one rack, last 4 the other
+        assert len(set(racks[:4])) == 1
+        assert len(set(racks[4:])) == 1
+        assert racks[0] != racks[4]
+
+
+def test_lrc_create_rule_bad_steps():
+    m = build_simple(16)
+    for bad in ('[["pick", "rack", 2]]', "not json", "[1]", '{"a": 1}'):
+        ec = create({"plugin": "lrc", "k": "4", "m": "2", "l": "3",
+                     "crush-steps": bad})
+        with pytest.raises(ErasureCodeError):
+            ec.create_rule("bad", m)

@@ -128,6 +128,34 @@ def test_shec_not_mds_some_patterns_fail():
     assert failures > 0, "c=1 SHEC should not survive all triple failures"
 
 
+def test_shec_matrix_is_masked_reed_sol_vandermonde():
+    """Upstream shec_reedsolomon_coding_matrix parity: the matrix must
+    be jerasure's systematized extended-Vandermonde coding matrix with
+    entries outside each row's circular shingle window zeroed."""
+    import math
+
+    from ceph_tpu.ec import gf
+    from ceph_tpu.ec.plugins.shec import ErasureCodeShec
+    from ceph_tpu.ec.interface import Profile
+
+    for k, m, c in [(4, 3, 2), (8, 4, 3), (6, 3, 2), (5, 3, 3)]:
+        ec = ErasureCodeShec()
+        ec.init(Profile({"k": str(k), "m": str(m), "c": str(c)}))
+        van = gf.vandermonde_matrix(k, m)
+        width = math.ceil(k * c / m)
+        for i in range(m):
+            start = (i * k) // m
+            for j in range(k):
+                inside = (j - start) % k < width
+                want = van[i, j] if inside else 0
+                assert ec.matrix[i, j] == want, (k, m, c, i, j)
+        # every in-window coefficient is usable (non-zero)
+        assert all(
+            ec.matrix[i, (((i * k) // m) + off) % k] != 0
+            for i in range(m) for off in range(width)
+        )
+
+
 def test_shec_decode_matches_encode_parities():
     rng = random.Random(9)
     ec = create({"plugin": "shec", "k": "4", "m": "3", "c": "2"})
