@@ -1,0 +1,51 @@
+#!/bin/bash
+# One-at-a-time chip session (round-3 lesson: a killed TPU attach can
+# wedge this machine's tunnel for hours — so every step runs to
+# completion with generous timeouts, steps run strictly sequentially in
+# ONE stream, and the session aborts between steps rather than ever
+# killing an in-flight attach).
+#
+# Usage: bash bench/chip_session.sh [ROUND]   (from the repo root)
+
+set -u
+cd "$(dirname "$0")/.."
+R=${1:-4}
+LOG="chip_session_r${R}.log"
+
+probe() {
+  python - <<'EOF'
+import time, sys
+t0 = time.time()
+import jax, jax.numpy as jnp
+s = float(jnp.sum(jnp.arange(64)))
+print(f"probe ok: {jax.devices()[0].platform} in {time.time()-t0:.1f}s "
+      f"(sum={s})", flush=True)
+sys.exit(0 if s == 2016.0 else 1)
+EOF
+}
+
+{
+  echo "=== chip session r$R $(date -u +%H:%M:%SZ) ==="
+
+  echo "--- step 0: probe ---"
+  if ! probe; then
+    echo "ABORT: tunnel unhealthy before start"; exit 1
+  fi
+
+  echo "--- step 1: headline bench.py ---"
+  CEPH_TPU_BENCH_TIMEOUT=1500 python bench.py
+
+  echo "--- step 2: inter-step probe ---"
+  if ! probe; then echo "ABORT: tunnel degraded after bench.py"; exit 1; fi
+
+  echo "--- step 3: all BASELINE configs + tpu tier ---"
+  python bench/run_all.py --round "$R" --timeout 2400
+
+  echo "--- step 4: inter-step probe ---"
+  if ! probe; then echo "ABORT: tunnel degraded after run_all"; exit 1; fi
+
+  echo "--- step 5: level/whole-descent kernel probe ---"
+  python bench/level_kernel_probe.py
+
+  echo "=== session done $(date -u +%H:%M:%SZ) ==="
+} 2>&1 | tee "$LOG"

@@ -1,0 +1,106 @@
+"""On-chip probe for the whole-descent / level Pallas kernels.
+
+Round-3 left the level kernels opt-in because their full-engine Mosaic
+compile was never demonstrated bounded on silicon (local chipless AOT
+exceeded 20 min; the chip-side compile helper is much faster).  This
+probe answers exactly that question, in one process, without killing
+anything:
+
+1. compile the config1 engine with CEPH_TPU_LEVEL_KERNEL=1, timing the
+   compile wall-clock;
+2. measure the placement rate with the honest chained+readback timing;
+3. measure the flat-fused-straw2 baseline rate in the same process;
+4. emit one JSON line with both rates so the kernel's speedup (or lack
+   of it) is an artifact.
+
+Run only inside a healthy chip session (bench/chip_session.sh).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ["CEPH_TPU_LEVEL_KERNEL"] = "1"
+os.environ.setdefault("CEPH_TPU_FUSED_STRAW2", "1")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "bench"))
+
+N_OSDS = 1024
+N = 1_000_000
+REPLICAS = 3
+
+
+def main() -> int:
+    from ceph_tpu.common.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from _timing import chained_rate
+    from ceph_tpu.crush import interp_batch
+    from ceph_tpu.crush.engine import make_batch_runner
+    from ceph_tpu.models.clusters import build_simple
+
+    out: dict = {"metric": "level_kernel_probe",
+                 "platform": jax.devices()[0].platform}
+
+    m = build_simple(N_OSDS)
+    rule = m.rule_by_name("replicated_rule")
+    dense = m.to_dense()
+    osd_weight = jnp.full((dense.max_devices,), 0x10000, jnp.uint32)
+
+    def build_and_rate(tag: str) -> None:
+        t0 = time.perf_counter()
+        crush_arg, batch = make_batch_runner(dense, rule, REPLICAS)
+        xs0 = jnp.arange(N, dtype=jnp.uint32)
+
+        def step(xs):
+            res, lens = batch(crush_arg, osd_weight, xs)
+            return xs + lens.astype(jnp.uint32) + jnp.uint32(1)
+
+        # chained_rate's warmup call performs the compile; time it apart
+        t_warm = time.perf_counter()
+        dt, _ = chained_rate(step, xs0, iters=5, reps=3)
+        total = time.perf_counter() - t0
+        out[f"{tag}_rate_per_sec"] = round(N / dt)
+        out[f"{tag}_compile_upper_bound_s"] = round(
+            time.perf_counter() - t_warm - dt * 3 * 5, 1
+        )
+        out[f"{tag}_total_s"] = round(total, 1)
+        print(f"{tag}: {N / dt:,.0f} placements/s "
+              f"(build+compile+measure {total:.1f}s)",
+              file=sys.stderr, flush=True)
+
+    t_all = time.perf_counter()
+    try:
+        build_and_rate("level_kernel")
+        out["level_kernel_ok"] = True
+    except Exception as e:  # noqa: BLE001
+        out["level_kernel_ok"] = False
+        out["level_kernel_error"] = f"{type(e).__name__}: {e}"[:500]
+        print(f"level kernel failed: {e}", file=sys.stderr, flush=True)
+
+    # baseline in the same process: flat fused straw2, kernel OFF.
+    # interp_batch dispatches on the env at trace time and keys its jit
+    # cache on the resolved mode (_dispatch_sig), so flipping the env
+    # compiles a fresh XLA-path program.
+    os.environ["CEPH_TPU_LEVEL_KERNEL"] = "0"
+    try:
+        build_and_rate("fused_straw2")
+    except Exception as e:  # noqa: BLE001
+        out["fused_straw2_error"] = f"{type(e).__name__}: {e}"[:500]
+
+    out["total_seconds"] = round(time.perf_counter() - t_all, 1)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -227,6 +227,8 @@ def calc_pg_upmaps(
         base_counts = mapping.pg_counts_by_osd(pool_id, acting=False)
 
         pool_entries = 0
+        pool_removed = 0
+        raw_cache: dict[PGId, set[int]] = {}
         trial_items = dict(original_items)
         m.pg_upmap_items = trial_items  # staged; restored below
         up_vec = np.fromiter(
@@ -270,11 +272,6 @@ def calc_pg_upmaps(
                         f, t2 = items[idx]
                         if not (0 <= f < n_osd and 0 <= t2 < n_osd):
                             continue
-                        if t2 not in rowv:
-                            # entry not observably in effect (e.g. its
-                            # `from` left the raw set): reversing it
-                            # would shift deviation for a no-op move
-                            continue
                         # reversal moves one replica t2 -> f
                         if deviation[t2] - deviation[f] <= 1.0:
                             continue
@@ -286,6 +283,18 @@ def calc_pg_upmaps(
                         if not (up_vec[f] and cw[f] > 0):
                             continue
                         if f in rowv:
+                            continue
+                        # the entry must actually be in effect: upstream
+                        # _apply_upmap rewrites f -> t2 only when f is
+                        # in the RAW set and t2 is not; reversing an
+                        # inert entry would shift the deviation vector
+                        # for a placement no-op
+                        if pg not in raw_cache:
+                            raw_cache[pg] = set(
+                                m._pg_to_raw_osds(pool, pg)[0]
+                            )
+                        raw = raw_cache[pg]
+                        if f not in raw or t2 in raw:
                             continue
                         others = rowv[rowv != t2]
                         if dom[f] != -1 and (dom[others] == dom[f]).any():
@@ -318,6 +327,7 @@ def calc_pg_upmaps(
                 # vector: each accepted move shifts one PG replica, so
                 # dev[frm] -= 1 and dev[to] += 1.  One move per PG per
                 # round; a move must still help at acceptance time.
+                pool_removed += gc_removed
                 order = np.argsort(-gains, kind="stable")
                 dev_sim = deviation.copy()
                 accepted = gc_removed
@@ -365,7 +375,7 @@ def calc_pg_upmaps(
             m.pg_upmap_items = original_items
             mapping.update(pool_id)  # restore cached results to reality
 
-        if pool_entries == 0:
+        if pool_entries == 0 and pool_removed == 0:
             continue
         if np.abs(final_counts - expect).max() > np.abs(
             base_counts - expect

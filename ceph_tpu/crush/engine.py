@@ -14,7 +14,9 @@ implementation of the upstream working-vector loop).  Rules land there
 only when no device engine is exact — today that is chained choose
 steps whose per-step fan-out overflows ``result_max`` (where the
 reference caps each inner choose by the lane's remaining space,
-dynamically) and chained chooses on maps the fast engine rejects.
+dynamically), chained chooses on maps the fast engine rejects, and
+maps containing legacy list/tree/straw1 buckets (whose sequential /
+float-derived semantics no device engine implements).
 
 Callers that just want "run this rule for a batch of x" should go
 through :func:`make_batch_runner` / :func:`run_batch` so they always
@@ -122,7 +124,7 @@ def make_batch_runner(dense: DenseCrushMap, rule: Rule, result_max: int):
         rule, result_max
     ):
         return interp_batch.fast_runner(dense, rule, result_max)
-    if _interp_supports(rule):
+    if _interp_supports(rule) and not dense.legacy_algs_present():
         smap = interp.StaticCrushMap(dense)
         return smap, interp.batch_runner(smap, rule, result_max)
     return _host_runner(dense, rule, result_max)
@@ -135,7 +137,7 @@ def runner_signature(dense: DenseCrushMap, rule: Rule, result_max: int) -> tuple
         rule, result_max
     ):
         return ("fast",) + interp_batch.fast_signature(dense, rule, result_max)
-    if not _interp_supports(rule):
+    if not _interp_supports(rule) or dense.legacy_algs_present():
         return ("host", interp.rule_signature(rule), result_max)
     # smap_signature's fields, read straight off the dense map (no
     # StaticCrushMap construction — that would upload the whole map)

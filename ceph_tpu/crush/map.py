@@ -532,6 +532,10 @@ class CrushMap:
             if len(w) != len(b.items):  # stale weight-set row: fall back
                 w = b.item_weights
             weights[i, : len(b.items)] = w
+        from .legacy import aux_arrays
+
+        aux = aux_arrays(alg, size, weights)  # None unless legacy algs
+        scaled, tree_w, max_nodes = aux if aux is not None else (None, None, 0)
         return DenseCrushMap(
             n_buckets=n_buckets,
             max_fanout=max_fanout,
@@ -543,6 +547,9 @@ class CrushMap:
             size=size,
             items=items,
             weights=weights,
+            scaled=scaled,
+            tree_weights=tree_w,
+            max_tree_nodes=max_nodes,
         )
 
 
@@ -560,6 +567,15 @@ class DenseCrushMap:
     size: np.ndarray  # [n_buckets] int32
     items: np.ndarray  # [n_buckets, max_fanout] int32
     weights: np.ndarray  # [n_buckets, max_fanout] uint32
+    # legacy-alg derived state (upstream builder.c), present only when a
+    # list/straw1/tree bucket exists: per-item straws (straw1) or prefix
+    # sums (list) packed in one table, plus tree node weights
+    scaled: np.ndarray | None = None  # [n_buckets, max_fanout] uint32
+    tree_weights: np.ndarray | None = None  # [n_buckets, max_tree_nodes] u32
+    max_tree_nodes: int = 0
 
     def algs_present(self) -> set[int]:
         return set(int(a) for a in np.unique(self.alg[self.size > 0]))
+
+    def legacy_algs_present(self) -> set[int]:
+        return self.algs_present() & {ALG_LIST, ALG_TREE, ALG_STRAW}

@@ -297,18 +297,37 @@ def compile_pool_mapping(dense, pool: Pool, rule):
         acting = jnp.where(has_temp, temp, up)
         return up, up_primary, acting, acting_primary
 
-    @jax.jit
-    def fn(crush_arg, state: PoolMapState, pg_indices):
+    def seeds(pg_indices):
         ps = jnp.asarray(pg_indices, U32)
         folded = ceph_stable_mod(ps, pgp_num, pgp_mask)
         if pool.hashpspool:
             pps = crush_hash32_2(folded, pool_id)
         else:
             pps = folded + pool_id
-        raw, _raw_len = crush_fn(crush_arg, state.osd_weight, pps)
-        return jax.vmap(
-            lambda ps_, pps_, raw_: post_one(state, ps_, pps_, raw_)
-        )(ps, pps, raw)
+        return ps, pps
+
+    if key[0][0] == "host":
+        # exact C++ tier (legacy bucket algs / overflowing chained
+        # chooses): the CRUSH stage is a host ctypes call and cannot be
+        # traced — run it eagerly, jit only the post-processing
+        @jax.jit
+        def post_fn(state, ps, pps, raw):
+            return jax.vmap(
+                lambda ps_, pps_, raw_: post_one(state, ps_, pps_, raw_)
+            )(ps, pps, raw)
+
+        def fn(crush_arg, state: PoolMapState, pg_indices):
+            ps, pps = seeds(pg_indices)
+            raw, _raw_len = crush_fn(crush_arg, state.osd_weight, pps)
+            return post_fn(state, ps, pps, raw)
+    else:
+        @jax.jit
+        def fn(crush_arg, state: PoolMapState, pg_indices):
+            ps, pps = seeds(pg_indices)
+            raw, _raw_len = crush_fn(crush_arg, state.osd_weight, pps)
+            return jax.vmap(
+                lambda ps_, pps_, raw_: post_one(state, ps_, pps_, raw_)
+            )(ps, pps, raw)
 
     _memo_put(_POOL_FN_CACHE, key, fn)
     return crush_arg, fn

@@ -46,6 +46,9 @@ class _CMapSpec(ctypes.Structure):
         ("size", ctypes.POINTER(ctypes.c_int32)),
         ("items", ctypes.POINTER(ctypes.c_int32)),
         ("weights", ctypes.POINTER(ctypes.c_uint32)),
+        ("scaled", ctypes.POINTER(ctypes.c_uint32)),
+        ("tree_weights", ctypes.POINTER(ctypes.c_uint32)),
+        ("max_tree_nodes", ctypes.c_int32),
     ]
 
 
@@ -75,6 +78,9 @@ def _libs():
     crush.ct_str_hash_rjenkins.restype = ctypes.c_uint32
     crush.ct_str_hash_rjenkins.argtypes = [ctypes.c_char_p, ctypes.c_uint32]
     crush.ct_do_rule_batch.restype = None
+    crush.ct_hash4.restype = ctypes.c_uint32
+    crush.ct_hash4.argtypes = [ctypes.c_uint32] * 4
+    crush.ct_bucket_choose.restype = ctypes.c_int32
     gf.gfref_mul.restype = ctypes.c_uint8
     gf.gfref_mul.argtypes = [ctypes.c_uint8, ctypes.c_uint8]
     return crush, gf
@@ -100,23 +106,23 @@ def _as_ptr(arr: np.ndarray, ctype):
     return arr.ctypes.data_as(ctypes.POINTER(ctype))
 
 
-def do_rule_batch(
-    dense,  # ceph_tpu.crush.map.DenseCrushMap
-    steps: list[tuple[int, int, int]],
-    xs: np.ndarray,
-    osd_weight: np.ndarray,
-    result_max: int,
-) -> tuple[np.ndarray, np.ndarray]:
-    """Run a rule for every x on the C++ reference; returns (results, lens).
-
-    results is int32 [n_x, result_max], padded with ITEM_NONE.
-    """
-    crush, _ = _libs()
+def _make_spec(dense):
+    """(_CMapSpec, keepalive-arrays) for a DenseCrushMap."""
     alg = np.ascontiguousarray(dense.alg, np.int32)
     btype = np.ascontiguousarray(dense.btype, np.int32)
     size = np.ascontiguousarray(dense.size, np.int32)
     items = np.ascontiguousarray(dense.items, np.int32)
     weights = np.ascontiguousarray(dense.weights, np.uint32)
+    keep = [alg, btype, size, items, weights]
+    scaled_p = tree_p = None
+    if getattr(dense, "scaled", None) is not None:
+        scaled = np.ascontiguousarray(dense.scaled, np.uint32)
+        keep.append(scaled)
+        scaled_p = _as_ptr(scaled, ctypes.c_uint32)
+    if getattr(dense, "tree_weights", None) is not None:
+        tree_w = np.ascontiguousarray(dense.tree_weights, np.uint32)
+        keep.append(tree_w)
+        tree_p = _as_ptr(tree_w, ctypes.c_uint32)
     spec = _CMapSpec(
         n_buckets=dense.n_buckets,
         max_fanout=dense.max_fanout,
@@ -132,7 +138,43 @@ def do_rule_batch(
         size=_as_ptr(size, ctypes.c_int32),
         items=_as_ptr(items, ctypes.c_int32),
         weights=_as_ptr(weights, ctypes.c_uint32),
+        scaled=scaled_p,
+        tree_weights=tree_p,
+        max_tree_nodes=getattr(dense, "max_tree_nodes", 0),
     )
+    return spec, keep
+
+
+def bucket_choose(dense, bucket_idx: int, x: int, r: int) -> int:
+    """Single legacy/modern bucket choose on the C++ tier (for
+    differential tests against the Python oracle)."""
+    crush, _ = _libs()
+    spec, _keep = _make_spec(dense)
+    return crush.ct_bucket_choose(
+        ctypes.byref(spec), ctypes.c_int32(bucket_idx),
+        ctypes.c_uint32(x & 0xFFFFFFFF), ctypes.c_int32(r)
+    )
+
+
+def hash4(a: int, b: int, c: int, d: int) -> int:
+    return _libs()[0].ct_hash4(
+        a & 0xFFFFFFFF, b & 0xFFFFFFFF, c & 0xFFFFFFFF, d & 0xFFFFFFFF
+    )
+
+
+def do_rule_batch(
+    dense,  # ceph_tpu.crush.map.DenseCrushMap
+    steps: list[tuple[int, int, int]],
+    xs: np.ndarray,
+    osd_weight: np.ndarray,
+    result_max: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Run a rule for every x on the C++ reference; returns (results, lens).
+
+    results is int32 [n_x, result_max], padded with ITEM_NONE.
+    """
+    crush, _ = _libs()
+    spec, _keep = _make_spec(dense)
     csteps = (_CRuleStep * len(steps))(*[_CRuleStep(*s) for s in steps])
     if result_max > 256:
         raise ValueError(

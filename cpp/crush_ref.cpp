@@ -67,6 +67,18 @@ uint32_t hash3(uint32_t a, uint32_t b, uint32_t c) {
   return h;
 }
 
+uint32_t hash4(uint32_t a, uint32_t b, uint32_t c, uint32_t d) {
+  uint32_t h = kHashSeed ^ a ^ b ^ c ^ d;
+  uint32_t x = 231232u, y = 1232u;
+  mix(a, b, h);
+  mix(c, d, h);
+  mix(a, x, h);
+  mix(y, b, h);
+  mix(c, x, h);
+  mix(y, d, h);
+  return h;
+}
+
 // ~2^44 * log2(x+1) for x in [0, 0xffff]; 48-bit fixed point.
 uint64_t crush_ln(uint32_t xin) {
   uint32_t x = xin + 1;
@@ -142,6 +154,12 @@ struct MapSpec {
   const int32_t* size;       // [n_buckets]
   const int32_t* items;      // [n_buckets * max_fanout]
   const uint32_t* weights;   // [n_buckets * max_fanout]
+  // legacy-alg derived state (null when no list/straw1/tree buckets):
+  // per-item straw scalings (straw1) or weight prefix sums (list), and
+  // tree node weights (item i at node 2i+1, internal = subtree sums)
+  const uint32_t* scaled;       // [n_buckets * max_fanout] or null
+  const uint32_t* tree_weights; // [n_buckets * max_tree_nodes] or null
+  int32_t max_tree_nodes;
 };
 
 // One rule step.  op codes are this framework's own enum (the text
@@ -244,14 +262,110 @@ int32_t perm_choose(const Ctx& c, int32_t bidx, int32_t r) {
   return bucket_items(m, bidx)[perm[pr]];
 }
 
+// Legacy straw(1): argmax over hash draws scaled by the builder's
+// float-computed straws (upstream bucket_straw_choose; scalings from
+// crush_calc_straw arrive via MapSpec.scaled).
+int32_t straw_choose(const Ctx& c, int32_t bidx, int32_t r) {
+  const MapSpec* m = c.map;
+  const int32_t* items = bucket_items(m, bidx);
+  const uint32_t* straws = m->scaled + static_cast<int64_t>(bidx) * m->max_fanout;
+  int32_t size = m->size[bidx];
+  int32_t high = 0;
+  uint64_t high_draw = 0;
+  for (int32_t i = 0; i < size; i++) {
+    uint64_t draw = hash3(c.x, static_cast<uint32_t>(items[i]),
+                          static_cast<uint32_t>(r)) & 0xffff;
+    draw *= straws[i];
+    if (i == 0 || draw > high_draw) {
+      high = i;
+      high_draw = draw;
+    }
+  }
+  return items[high];
+}
+
+// Legacy list: walk from the tail; item i wins when its hash point in
+// [0, sum_weights[i]) lands inside its own weight span (upstream
+// bucket_list_choose).
+int32_t list_choose(const Ctx& c, int32_t bidx, int32_t r) {
+  const MapSpec* m = c.map;
+  const int32_t* items = bucket_items(m, bidx);
+  const uint32_t* ws = bucket_weights(m, bidx);
+  const uint32_t* sums = m->scaled + static_cast<int64_t>(bidx) * m->max_fanout;
+  int32_t size = m->size[bidx];
+  int32_t bucket_id = -1 - bidx;
+  for (int32_t i = size - 1; i >= 0; i--) {
+    uint64_t w = hash4(c.x, static_cast<uint32_t>(items[i]),
+                       static_cast<uint32_t>(r),
+                       static_cast<uint32_t>(bucket_id));
+    w &= 0xffff;
+    w *= sums[i];
+    w >>= 16;
+    if (w < ws[i]) return items[i];
+  }
+  return items[0];
+}
+
+// Legacy tree: descend the weight-balanced binary tree, hashing a
+// point in [0, node weight) at each internal node (upstream
+// bucket_tree_choose; item i lives at node 2i+1).
+inline int32_t node_height(int32_t n) {
+  int32_t h = 0;
+  while (n && (n & 1) == 0) { h++; n >>= 1; }
+  return h;
+}
+
+int32_t tree_choose(const Ctx& c, int32_t bidx, int32_t r) {
+  const MapSpec* m = c.map;
+  const uint32_t* nw =
+      m->tree_weights + static_cast<int64_t>(bidx) * m->max_tree_nodes;
+  int32_t size = m->size[bidx];
+  if (size == 0) return kItemNone;
+  int32_t bucket_id = -1 - bidx;
+  // root: the highest power of two in the node array
+  int32_t num_nodes = 1;
+  {
+    int32_t t = size - 1;
+    int32_t depth = 1;
+    while (t) { t >>= 1; depth++; }
+    num_nodes = 1 << depth;
+  }
+  int32_t n = num_nodes >> 1;
+  while (!(n & 1)) {
+    uint32_t w = nw[n];
+    uint64_t t = static_cast<uint64_t>(
+                     hash4(c.x, static_cast<uint32_t>(n),
+                           static_cast<uint32_t>(r),
+                           static_cast<uint32_t>(bucket_id))) *
+                 static_cast<uint64_t>(w);
+    t >>= 32;
+    int32_t h = node_height(n);
+    int32_t l = n - (1 << (h - 1));
+    if (t < nw[l])
+      n = l;
+    else
+      n = n + (1 << (h - 1));
+  }
+  return bucket_items(m, bidx)[n >> 1];
+}
+
 int32_t bucket_choose(const Ctx& c, int32_t bidx, int32_t r) {
   switch (c.map->alg[bidx]) {
     case kAlgUniform:
       return perm_choose(c, bidx, r);
     case kAlgStraw2:
       return straw2_choose(c, bidx, r);
+    case kAlgStraw:
+      if (c.map->scaled) return straw_choose(c, bidx, r);
+      return kItemNone;
+    case kAlgList:
+      if (c.map->scaled) return list_choose(c, bidx, r);
+      return kItemNone;
+    case kAlgTree:
+      if (c.map->tree_weights) return tree_choose(c, bidx, r);
+      return kItemNone;
     default:
-      return kItemNone;  // list/tree/straw1 unsupported in the ref tier
+      return kItemNone;
   }
 }
 
@@ -544,6 +658,22 @@ void ct_do_rule_batch(const MapSpec* map, const RuleStep* steps,
       results[i * result_max + j] = kItemNone;
     }
   }
+}
+
+uint32_t ct_hash4(uint32_t a, uint32_t b, uint32_t c, uint32_t d) {
+  return hash4(a, b, c, d);
+}
+
+// Single bucket choose, exposed so the legacy algorithms can be
+// differentially tested against an independent Python oracle.
+int32_t ct_bucket_choose(const MapSpec* map, int32_t bucket_idx, uint32_t x,
+                         int32_t r) {
+  Ctx c;
+  c.map = map;
+  c.osd_weight = nullptr;
+  c.weight_max = 0;
+  c.x = x;
+  return bucket_choose(c, bucket_idx, r);
 }
 
 }  // extern "C"

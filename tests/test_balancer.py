@@ -119,3 +119,49 @@ def test_balanced_map_yields_empty_plan():
     b = Balancer(m, max_deviation=3.0)
     plan = b.optimize()
     assert not plan.new_pg_upmap_items or len(plan.new_pg_upmap_items) < 3
+
+
+def test_gc_only_plan_removes_harmful_entries():
+    """A pool whose imbalance is caused purely by existing upmap
+    entries: calc_pg_upmaps must emit their REMOVAL (entry GC) even
+    when no new moves are needed — shrinking precious mon-map state."""
+    from ceph_tpu.models.clusters import build_osdmap
+    from ceph_tpu.osdmap.mapping import OSDMapMapping
+
+    m = build_osdmap(32, pg_num=256)
+    mapping = OSDMapMapping(m)
+    mapping.update()
+    counts0 = mapping.pg_counts_by_osd(1, acting=False)
+
+    # pile harmful entries: divert many PGs onto osd 0 from wherever
+    # their first replica naturally lands
+    n_inject = 24
+    injected = {}
+    for ps in range(m.pools[1].pg_num):
+        pg = PGId(1, ps)
+        raw, _ = m._pg_to_raw_osds(m.pools[1], pg)
+        if 0 in raw or not raw:
+            continue
+        m.pg_upmap_items[pg] = ((raw[0], 0),)
+        injected[pg] = (raw[0], 0)
+        if len(injected) >= n_inject:
+            break
+    mapping.update()
+    counts1 = mapping.pg_counts_by_osd(1, acting=False)
+    assert counts1[0] > counts0[0] + n_inject * 0.8  # osd 0 now overfull
+
+    inc = calc_pg_upmaps(m, max_deviation=1.0, max_entries=200,
+                         mapping=mapping)
+    # the harmful entries are REMOVED (not counter-moved): the plan
+    # must delete a majority of them outright
+    gone = sum(
+        1 for pg, pair in injected.items()
+        if pg in inc.old_pg_upmap_items
+        or (pg in inc.new_pg_upmap_items
+            and pair not in inc.new_pg_upmap_items[pg])
+    )
+    assert gone >= n_inject // 2, f"only {gone}/{n_inject} injected entries removed"
+    m.apply_incremental(inc)
+    mapping.update()
+    counts2 = mapping.pg_counts_by_osd(1, acting=False)
+    assert counts2[0] <= counts1[0] - n_inject // 2

@@ -1,0 +1,262 @@
+"""Legacy bucket algorithms (straw1/list/tree): differential + placement.
+
+Two independent implementations are compared: the C++ reference tier
+(``cpp/crush_ref.cpp`` straw_choose/list_choose/tree_choose) and the
+pure-Python oracle below, both written from the recorded semantics of
+upstream ``src/crush/mapper.c`` (bucket_straw_choose /
+bucket_list_choose / bucket_tree_choose) with builder state from
+``ceph_tpu.crush.legacy`` (crush_calc_straw / sum_weights /
+crush_make_tree_bucket).  End-to-end placement then goes through the
+public engine entry point, which routes legacy maps to the exact host
+tier.
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.core import hashes
+from ceph_tpu.crush import legacy
+from ceph_tpu.crush.engine import run_batch, runner_signature
+from ceph_tpu.crush.map import (
+    ALG_LIST,
+    ALG_STRAW,
+    ALG_STRAW2,
+    ALG_TREE,
+    ITEM_NONE,
+    CrushMap,
+)
+from ceph_tpu.testing import cppref
+
+
+# ---- independent Python oracle --------------------------------------------
+
+def _hash4(a, b, c, d):
+    """crush_hash32_rjenkins1_4 via the jnp hashmix (host scalars)."""
+    import jax.numpy as jnp
+
+    a, b, c, d = (jnp.uint32(v & 0xFFFFFFFF) for v in (a, b, c, d))
+    h = jnp.uint32(hashes.CRUSH_HASH_SEED) ^ a ^ b ^ c ^ d
+    x = jnp.uint32(231232)
+    y = jnp.uint32(1232)
+    a, b, h = hashes.hashmix(a, b, h)
+    c, d, h = hashes.hashmix(c, d, h)
+    a, x, h = hashes.hashmix(a, x, h)
+    y, b, h = hashes.hashmix(y, b, h)
+    c, x, h = hashes.hashmix(c, x, h)
+    y, d, h = hashes.hashmix(y, d, h)
+    return int(h)
+
+
+def py_straw_choose(items, straws, x, r):
+    high, high_draw = 0, -1
+    for i, it in enumerate(items):
+        d = (int(hashes.crush_hash32_3(
+            np.uint32(x), np.uint32(it & 0xFFFFFFFF), np.uint32(r)
+        )) & 0xFFFF) * straws[i]
+        if d > high_draw:
+            high, high_draw = i, d
+    return items[high]
+
+
+def py_list_choose(items, weights, sums, bucket_id, x, r):
+    for i in range(len(items) - 1, -1, -1):
+        w = _hash4(x, items[i], r, bucket_id) & 0xFFFF
+        w = (w * sums[i]) >> 16
+        if w < weights[i]:
+            return items[i]
+    return items[0]
+
+
+def py_tree_choose(items, node_weights, bucket_id, x, r):
+    n = len(node_weights) >> 1  # root
+    while n & 1 == 0:
+        t = (_hash4(x, n, r, bucket_id) * node_weights[n]) >> 32
+        h = legacy._height(n)
+        left = n - (1 << (h - 1))
+        n = left if t < node_weights[left] else n + (1 << (h - 1))
+    return items[n >> 1]
+
+
+# ---- fixtures --------------------------------------------------------------
+
+def _legacy_map(alg: int, n: int = 9, weights=None) -> CrushMap:
+    m = CrushMap()
+    m.add_type(1, "root")
+    root = m.add_bucket("default", "root", alg=alg)
+    for i in range(n):
+        w = weights[i] if weights else 0x10000 + (i % 3) * 0x8000
+        m.insert_item(root.id, i, w)
+    m.make_replicated_rule("replicated_rule", "default", "osd")
+    return m
+
+
+@pytest.mark.parametrize("alg", [ALG_STRAW, ALG_LIST, ALG_TREE])
+def test_bucket_choose_cpp_vs_python_oracle(alg):
+    m = _legacy_map(alg)
+    dense = m.to_dense()
+    b = m.bucket_by_name("default")
+    bidx = -1 - b.id
+    items = list(b.items)
+    ws = list(b.item_weights)
+    straws = legacy.calc_straws(ws)
+    sums = legacy.list_sum_weights(ws)
+    node_w = legacy.tree_node_weights(ws)
+    rng = np.random.default_rng(5)
+    for x in rng.integers(0, 1 << 32, 200, dtype=np.uint32):
+        for r in range(4):
+            got = cppref.bucket_choose(dense, bidx, int(x), r)
+            if alg == ALG_STRAW:
+                want = py_straw_choose(items, straws, int(x), r)
+            elif alg == ALG_LIST:
+                want = py_list_choose(items, ws, sums, b.id, int(x), r)
+            else:
+                want = py_tree_choose(items, node_w, b.id, int(x), r)
+            assert got == want, (alg, int(x), r)
+
+
+@pytest.mark.parametrize("alg", [ALG_STRAW, ALG_LIST, ALG_TREE])
+def test_legacy_map_places_through_public_engine(alg):
+    m = _legacy_map(alg, n=12)
+    dense = m.to_dense()
+    rule = m.rule_by_name("replicated_rule")
+    assert runner_signature(dense, rule, 3)[0] == "host"
+    xs = np.arange(3000, dtype=np.uint32)
+    w = np.full(dense.max_devices, 0x10000, np.uint32)
+    res, lens = run_batch(dense, rule, xs, w, 3)
+    res, lens = np.asarray(res), np.asarray(lens)
+    assert (lens == 3).all()
+    for row in res:
+        assert len(set(row.tolist())) == 3  # distinct replicas
+    # every device is reachable
+    assert set(np.unique(res)) == set(range(12))
+
+
+def test_straw_distribution_tracks_two_weight_classes():
+    """straw1 with two weight classes: selection frequency follows the
+    weights (the regime where the legacy algorithm is unbiased)."""
+    weights = [0x10000] * 4 + [0x20000] * 4  # 1.0 x4, 2.0 x4
+    m = _legacy_map(ALG_STRAW, n=8, weights=weights)
+    dense = m.to_dense()
+    rule = m.rule_by_name("replicated_rule")
+    xs = np.arange(24000, dtype=np.uint32)
+    w = np.full(dense.max_devices, 0x10000, np.uint32)
+    res, _ = run_batch(dense, rule, xs, w, 1)
+    first = np.asarray(res)[:, 0]
+    light = (first < 4).sum() / len(first)
+    # expected: light class holds 4/12 of the weight
+    assert abs(light - 4 / 12) < 0.02, light
+
+
+def test_tree_node_weights_structure():
+    ws = [1, 2, 3, 4, 5]
+    nw = legacy.tree_node_weights(ws)
+    assert len(nw) == 16  # depth 4 for 5 leaves
+    for i, w in enumerate(ws):
+        assert nw[2 * i + 1] == w
+    assert nw[8] == sum(ws)  # root holds the total
+
+
+def test_list_sum_weights_prefix():
+    assert legacy.list_sum_weights([1, 2, 3]) == [1, 3, 6]
+
+
+def test_straws_uniform_weights_equal():
+    s = legacy.calc_straws([0x10000] * 5)
+    assert len(set(s)) == 1 and s[0] == 0x10000
+
+
+def test_straws_zero_weight_items():
+    s = legacy.calc_straws([0, 0x10000, 0])
+    assert s[0] == 0 and s[2] == 0 and s[1] > 0
+
+
+def test_mixed_legacy_and_straw2_map():
+    """A map mixing straw2 and legacy buckets routes whole-map to the
+    host tier and still places."""
+    m = CrushMap()
+    m.add_type(1, "root")
+    m.add_type(2, "host")
+    root = m.add_bucket("default", "root", alg=ALG_STRAW2)
+    h0 = m.add_bucket("h0", "host", alg=ALG_LIST)
+    h1 = m.add_bucket("h1", "host", alg=ALG_TREE)
+    for i in range(4):
+        m.insert_item(h0.id, i, 0x10000)
+        m.insert_item(h1.id, 4 + i, 0x10000)
+    m.insert_item(root.id, h0.id, 4 * 0x10000)
+    m.insert_item(root.id, h1.id, 4 * 0x10000)
+    m.make_replicated_rule("replicated_rule", "default", "host")
+    dense = m.to_dense()
+    rule = m.rule_by_name("replicated_rule")
+    xs = np.arange(500, dtype=np.uint32)
+    w = np.full(dense.max_devices, 0x10000, np.uint32)
+    res, lens = run_batch(dense, rule, xs, w, 2)
+    res = np.asarray(res)
+    assert (np.asarray(lens) == 2).all()
+    # one replica per host bucket
+    side = res < 4
+    assert (side.sum(axis=1) == 1).all()
+
+
+def test_crushtool_test_on_legacy_map(tmp_path, capsys):
+    """crushtool -c / --test round-trips a straw1 map (the reference CLI
+    path for legacy maps)."""
+    from ceph_tpu.cli import crushtool
+
+    text = """\
+tunable choose_total_tries 50
+device 0 osd.0
+device 1 osd.1
+device 2 osd.2
+device 3 osd.3
+type 0 osd
+type 1 root
+root default {
+\tid -1
+\talg straw
+\thash 0
+\titem osd.0 weight 1.000
+\titem osd.1 weight 1.000
+\titem osd.2 weight 2.000
+\titem osd.3 weight 2.000
+}
+rule replicated_rule {
+\tid 0
+\ttype replicated
+\tstep take default
+\tstep chooseleaf firstn 0 type osd
+\tstep emit
+}
+"""
+    src = tmp_path / "legacy.txt"
+    src.write_text(text)
+    out = str(tmp_path / "legacy.json")
+    assert crushtool.main(["-c", str(src), "-o", out]) == 0
+    rc = crushtool.main(["-i", out, "--test", "--num-rep", "3",
+                         "--show-mappings", "--min-x", "0", "--max-x", "63"])
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if "CRUSH rule" in l]
+    assert len(lines) == 64
+
+
+def test_osdmap_mapping_on_legacy_map():
+    """The pool-mapping path (host CRUSH tier + jitted post-processing)
+    must work for maps the device engines reject."""
+    from ceph_tpu.osdmap.map import OSDMap, PGId, Pool
+    from ceph_tpu.osdmap.mapping import OSDMapMapping
+
+    crush = _legacy_map(ALG_STRAW, n=8)
+    m = OSDMap(crush)
+    for o in range(8):
+        m.add_osd(o)
+    rule = crush.rule_by_name("replicated_rule")
+    m.add_pool(Pool(id=1, name="p", kind="replicated", size=3,
+                    pg_num=64, pgp_num=64, crush_rule=rule.id))
+    mapping = OSDMapMapping(m)
+    mapping.update()
+    counts = mapping.pg_counts_by_osd(1, acting=False)
+    assert counts.sum() == 64 * 3
+    # batch result matches the scalar host path per-PG
+    for ps in (0, 7, 63):
+        up_scalar = m.pg_to_up_acting_osds(PGId(1, ps))[0]
+        up_batch = mapping.get(PGId(1, ps))[0]
+        assert up_batch == list(up_scalar), ps
