@@ -65,21 +65,23 @@ def _device_measure() -> None:
     from ceph_tpu.crush.engine import make_batch_runner
     from ceph_tpu.models.clusters import build_simple
 
-    m = build_simple(N_OSDS)
-    rule = m.rule_by_name("replicated_rule")
-    dense = m.to_dense()
-    osd_weight = jnp.full((dense.max_devices,), 0x10000, jnp.uint32)
-    crush_arg, batch = make_batch_runner(dense, rule, REPLICAS)
-
     platform = jax.devices()[0].platform
     if platform == "cpu":
         # XLA:CPU runs this integer-heavy program ~3k placements/s on
         # one core — a 1M batch would blow any sane timeout.  The CPU
         # fallback exists to prove the program and give an honest
-        # number, not to win.
+        # number, not to win.  Kernels OFF: Pallas interpret mode at
+        # these sizes would take minutes for nothing.
+        os.environ["CEPH_TPU_LEVEL_KERNEL"] = "0"
         sizes, iters = (20_000, 5_000), 1
     else:
         sizes, iters = (N_OBJECTS, N_OBJECTS // 4, N_OBJECTS // 16), 5
+
+    m = build_simple(N_OSDS)
+    rule = m.rule_by_name("replicated_rule")
+    dense = m.to_dense()
+    osd_weight = jnp.full((dense.max_devices,), 0x10000, jnp.uint32)
+    crush_arg, batch = make_batch_runner(dense, rule, REPLICAS)
     rate = 0.0
     err = None
 
@@ -106,7 +108,13 @@ def _device_measure() -> None:
         except Exception as e:  # noqa: BLE001
             err = f"batch {n}: {type(e).__name__}: {e}"
             print(f"bench child: {err}; retrying smaller", file=sys.stderr)
-    out = {"rate": rate, "platform": platform}
+    out = {
+        "rate": rate,
+        "platform": platform,
+        # the mode actually in force at measure time (the cpu branch
+        # overrides the parent's request)
+        "level_kernel": os.environ.get("CEPH_TPU_LEVEL_KERNEL") == "1",
+    }
     if err is not None:
         out["error"] = err
     print("BENCH_CHILD_RESULT " + json.dumps(out), flush=True)
@@ -151,19 +159,33 @@ def _main_guarded() -> int:
         print(f"bench: CPU baseline failed: {e}", file=sys.stderr)
         cpu_rate = 0.0
 
-    # Attempt 1 + retry: real device (inherit env — axon TPU plugin).
-    # A timed-out attach is not retried — the tunnel won't recover in
+    # Attempt 1: proven flat fused-straw2 path — banks a valid device
+    # number first.  Attempt 2 (only after a device success): the
+    # whole-descent Pallas kernel (compile bounded: 35.6 s chipless
+    # AOT, round 4); keep whichever rate is higher, so a slow or
+    # failing kernel can never forfeit the banked headline.  A
+    # timed-out attach is not retried — the tunnel won't recover in
     # seconds, and the driver's own timeout budget is finite.
     result = None
     errors = []
-    for attempt in range(2):
-        r = _run_child(dict(os.environ), ATTACH_TIMEOUT_S)
+    env_flat = dict(os.environ)
+    env_flat["CEPH_TPU_LEVEL_KERNEL"] = "0"
+    for attempt in (1, 2):
+        r = _run_child(env_flat, ATTACH_TIMEOUT_S)
         if r and r.get("rate"):
             result = r
             break
-        errors.append(f"tpu attempt {attempt + 1}: {(r or {}).get('error')}")
+        errors.append(f"tpu attempt {attempt}: {(r or {}).get('error')}")
         if r and r.get("timed_out"):
             break
+    if result is not None and result.get("platform") not in (None, "cpu"):
+        env_k = dict(os.environ)
+        env_k["CEPH_TPU_LEVEL_KERNEL"] = "1"
+        rk = _run_child(env_k, ATTACH_TIMEOUT_S)
+        if rk and rk.get("rate", 0) > result["rate"]:
+            result = rk
+        elif rk is not None and rk.get("error"):
+            errors.append(f"kernel attempt: {rk.get('error')}")
 
     # Fallback: same jitted program on host CPU in a scrubbed child.
     if result is None:
@@ -211,6 +233,8 @@ def format_result(result: dict | None, cpu_rate: float, errors: list) -> dict:
             )
     if platform:
         out["platform"] = platform
+    if result is not None and "level_kernel" in result:
+        out["level_kernel"] = result["level_kernel"]
     out["cpu_ref_placements_per_sec"] = round(cpu_rate)
     if errors:
         out["error"] = "; ".join(e for e in errors if e)

@@ -7,7 +7,7 @@
 #
 # Usage: bash bench/chip_session.sh [ROUND]   (from the repo root)
 
-set -u
+set -u -o pipefail
 cd "$(dirname "$0")/.."
 R=${1:-4}
 LOG="chip_session_r${R}.log"
@@ -49,3 +49,4 @@ EOF
 
   echo "=== session done $(date -u +%H:%M:%SZ) ==="
 } 2>&1 | tee "$LOG"
+exit "${PIPESTATUS[0]}"

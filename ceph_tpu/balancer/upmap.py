@@ -228,7 +228,30 @@ def calc_pg_upmaps(
 
         pool_entries = 0
         pool_removed = 0
-        raw_cache: dict[PGId, set[int]] = {}
+        # raw (pre-upmap) rows for every PG carrying entries, computed
+        # in ONE batched CRUSH call (raw depends only on crush+weights,
+        # constant during this optimization): the GC below simulates
+        # _apply_upmap against them
+        raw_rows: dict[int, list[int]] = {}
+        entry_ps = sorted({
+            pg.ps for pg in original_items if pg.pool == pool_id
+        })
+        if entry_ps:
+            from ..crush.engine import run_batch
+
+            dense = m.crush.to_dense(
+                choose_args=m.crush.choose_args_name_for_pool(pool_id)
+            )
+            rule_obj = m.crush.rules[pool.crush_rule]
+            pps = np.array(
+                [pool.raw_pg_to_pps(ps) for ps in entry_ps], np.uint32
+            )
+            wfull = np.zeros(max(dense.max_devices, n_osd), np.uint32)
+            wfull[:n_osd] = m.osd_weight[:n_osd]
+            res, lens = run_batch(dense, rule_obj, pps, wfull, pool.size)
+            res, lens = np.asarray(res), np.asarray(lens)
+            for i, ps in enumerate(entry_ps):
+                raw_rows[ps] = [int(o) for o in res[i, : lens[i]]]
         trial_items = dict(original_items)
         m.pg_upmap_items = trial_items  # staged; restored below
         up_vec = np.fromiter(
@@ -261,9 +284,31 @@ def calc_pg_upmaps(
                 # a free rebalancing move that SHRINKS the table.
                 pg_touched: set[int] = set()
                 gc_removed = 0
+
+                def _apply_pairs(raw: list[int], items) -> list[int]:
+                    """Mirror _apply_upmap's sequential pair semantics:
+                    each pair rewrites the first f in the EVOLVING row,
+                    skipped when t already present or weight-zero."""
+                    row = list(raw)
+                    for f2, t in items:
+                        if (
+                            0 <= t < n_osd
+                            and m.osd_weight[t] == 0
+                        ):
+                            continue
+                        if t in row or f2 not in row:
+                            continue
+                        row[row.index(f2)] = t
+                    return row
+
                 for pg in list(trial_items):
                     if pg.pool != pool_id or pg.ps in pg_touched:
                         continue
+                    raw = raw_rows.get(pg.ps)
+                    if raw is None:  # entry added this call; rare
+                        raw = raw_rows[pg.ps] = m._pg_to_raw_osds(
+                            pool, pg
+                        )[0]
                     row = up_all[pg.ps]
                     rowv = row[(row != ITEM_NONE) & (row >= 0) & (row < n_osd)]
                     items = list(trial_items[pg])
@@ -272,36 +317,50 @@ def calc_pg_upmaps(
                         f, t2 = items[idx]
                         if not (0 <= f < n_osd and 0 <= t2 < n_osd):
                             continue
-                        # reversal moves one replica t2 -> f
-                        if deviation[t2] - deviation[f] <= 1.0:
+                        # what does removing this pair actually change?
+                        # (pairs interact through the evolving row, so
+                        # test by re-simulating _apply_upmap)
+                        with_pair = _apply_pairs(raw, items)
+                        without = _apply_pairs(
+                            raw, items[:idx] + items[idx + 1:]
+                        )
+                        delta = [
+                            (a, b)
+                            for a, b in zip(with_pair, without)
+                            if a != b
+                        ]
+                        if not delta:
+                            # inert entry: drop for free (upstream
+                            # clean_pg_upmaps), no deviation change
+                            del items[idx]
+                            gc_removed += 1
+                            changed = True
+                            continue
+                        if len(delta) != 1:
+                            continue  # cascading effect: leave alone
+                        lose, gain_o = delta[0]
+                        if not (0 <= lose < n_osd and 0 <= gain_o < n_osd):
+                            continue
+                        # removal moves one replica lose -> gain_o
+                        if deviation[lose] - deviation[gain_o] <= 1.0:
                             continue
                         if (
-                            deviation[t2] <= max_deviation
-                            and deviation[f] >= -max_deviation
+                            deviation[lose] <= max_deviation
+                            and deviation[gain_o] >= -max_deviation
                         ):
                             continue
-                        if not (up_vec[f] and cw[f] > 0):
+                        if not (up_vec[gain_o] and cw[gain_o] > 0):
                             continue
-                        if f in rowv:
+                        if gain_o in rowv:
                             continue
-                        # the entry must actually be in effect: upstream
-                        # _apply_upmap rewrites f -> t2 only when f is
-                        # in the RAW set and t2 is not; reversing an
-                        # inert entry would shift the deviation vector
-                        # for a placement no-op
-                        if pg not in raw_cache:
-                            raw_cache[pg] = set(
-                                m._pg_to_raw_osds(pool, pg)[0]
-                            )
-                        raw = raw_cache[pg]
-                        if f not in raw or t2 in raw:
-                            continue
-                        others = rowv[rowv != t2]
-                        if dom[f] != -1 and (dom[others] == dom[f]).any():
+                        others = rowv[rowv != lose]
+                        if dom[gain_o] != -1 and (
+                            dom[others] == dom[gain_o]
+                        ).any():
                             continue
                         del items[idx]
-                        deviation[t2] -= 1.0
-                        deviation[f] += 1.0
+                        deviation[lose] -= 1.0
+                        deviation[gain_o] += 1.0
                         gc_removed += 1
                         changed = True
                     if changed:

@@ -181,3 +181,65 @@ def test_gf_kernels_on_silicon(tpu):
     data = rng.integers(0, 256, (8, 1 << 20), dtype=np.uint8)
     got = np.asarray(matrix_encode(M, data, interpret=False))
     np.testing.assert_array_equal(got, gf.matrix_encode(M, data))
+
+
+def test_whole_descent_kernel_on_silicon(tpu, monkeypatch):
+    """Full engine with the whole-descent Pallas kernel forced
+    (non-interpret) == the C++ reference, on a skewed map with
+    reweights and an out device.  This is the round-3 kernel that had
+    never executed on a chip."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.crush.engine import make_batch_runner
+    from ceph_tpu.models.clusters import build_skewed
+    from ceph_tpu.testing import cppref
+
+    m = build_skewed(48)
+    rule = m.rule_by_name("replicated_rule")
+    dense = m.to_dense()
+    osd_weight = np.full(dense.max_devices, 0x10000, np.uint32)
+    osd_weight[3] = 0x8000
+    osd_weight[7] = 0
+    xs = _rng(0xDE5C).integers(0, 1 << 32, 4096, dtype=np.uint32)
+    steps = [(s.op, s.arg1, s.arg2) for s in rule.steps]
+    r_ref, l_ref = cppref.do_rule_batch(dense, steps, xs, osd_weight, 3)
+
+    monkeypatch.setenv("CEPH_TPU_LEVEL_KERNEL", "1")
+    monkeypatch.setenv("CEPH_TPU_FUSED_STRAW2", "1")
+    crush_arg, run = make_batch_runner(dense, rule, 3)
+    got_res, got_len = run(
+        crush_arg, jnp.asarray(osd_weight), jnp.asarray(xs))
+    np.testing.assert_array_equal(r_ref, np.asarray(got_res))
+    np.testing.assert_array_equal(l_ref, np.asarray(got_len))
+
+
+def test_straw2_quotient_2_pow_48_on_silicon(tpu):
+    """The u==0/weight==1 draw (quotient exactly 2^48) through the
+    non-interpret fused kernel — pins the round-4 carry fix on real
+    Mosaic lowering, not just interpret mode."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.core import hashes
+    from ceph_tpu.core.pallas_straw2 import straw2_negdraw_fused
+
+    xs_all = jnp.arange(200_000, dtype=jnp.uint32)
+    pairs = []
+    for item in range(2):
+        h = np.asarray(hashes.crush_hash32_3(
+            xs_all, jnp.full_like(xs_all, item), jnp.zeros_like(xs_all)))
+        hits = np.nonzero((h & 0xFFFF) == 0)[0]
+        pairs.append((int(hits[0]), item))
+    B = len(pairs)
+    x = np.array([[p[0]] for p in pairs], np.uint32)
+    ids = np.array([[p[1], p[1] + 100] for p in pairs], np.uint32)
+    r = np.zeros((B, 1), np.uint32)
+    w = np.ones((B, 2), np.uint32)
+    magic = hashes.magic_reciprocal(w)
+    want = np.asarray(hashes.straw2_negdraw_magic(
+        jnp.asarray(x), jnp.asarray(ids), jnp.asarray(r),
+        jnp.asarray(w), jnp.asarray(magic)))
+    assert (want[:, 0] == np.uint64(1) << np.uint64(48)).all()
+    got = np.asarray(straw2_negdraw_fused(
+        jnp.asarray(x), jnp.asarray(ids), jnp.asarray(r),
+        jnp.asarray(w), jnp.asarray(magic), interpret=False))
+    np.testing.assert_array_equal(got, want)
