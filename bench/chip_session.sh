@@ -25,6 +25,7 @@ EOF
 }
 
 {
+  rc_total=0
   echo "=== chip session r$R $(date -u +%H:%M:%SZ) ==="
 
   echo "--- step 0: probe ---"
@@ -33,20 +34,24 @@ EOF
   fi
 
   echo "--- step 1: headline bench.py ---"
-  CEPH_TPU_BENCH_TIMEOUT=1500 python bench.py
+  CEPH_TPU_BENCH_TIMEOUT=1500 python bench.py \
+    || { echo "STEP FAILED: bench.py"; rc_total=1; }
 
   echo "--- step 2: inter-step probe ---"
   if ! probe; then echo "ABORT: tunnel degraded after bench.py"; exit 1; fi
 
   echo "--- step 3: all BASELINE configs + tpu tier ---"
-  python bench/run_all.py --round "$R" --timeout 2400
+  python bench/run_all.py --round "$R" --timeout 2400 \
+    || { echo "STEP FAILED: run_all.py"; rc_total=1; }
 
   echo "--- step 4: inter-step probe ---"
   if ! probe; then echo "ABORT: tunnel degraded after run_all"; exit 1; fi
 
   echo "--- step 5: level/whole-descent kernel probe ---"
-  python bench/level_kernel_probe.py
+  python bench/level_kernel_probe.py \
+    || { echo "STEP FAILED: level_kernel_probe.py"; rc_total=1; }
 
-  echo "=== session done $(date -u +%H:%M:%SZ) ==="
+  echo "=== session done $(date -u +%H:%M:%SZ) rc=$rc_total ==="
+  exit "$rc_total"
 } 2>&1 | tee "$LOG"
 exit "${PIPESTATUS[0]}"

@@ -232,26 +232,12 @@ def calc_pg_upmaps(
         # in ONE batched CRUSH call (raw depends only on crush+weights,
         # constant during this optimization): the GC below simulates
         # _apply_upmap against them
-        raw_rows: dict[int, list[int]] = {}
         entry_ps = sorted({
             pg.ps for pg in original_items if pg.pool == pool_id
         })
-        if entry_ps:
-            from ..crush.engine import run_batch
-
-            dense = m.crush.to_dense(
-                choose_args=m.crush.choose_args_name_for_pool(pool_id)
-            )
-            rule_obj = m.crush.rules[pool.crush_rule]
-            pps = np.array(
-                [pool.raw_pg_to_pps(ps) for ps in entry_ps], np.uint32
-            )
-            wfull = np.zeros(max(dense.max_devices, n_osd), np.uint32)
-            wfull[:n_osd] = m.osd_weight[:n_osd]
-            res, lens = run_batch(dense, rule_obj, pps, wfull, pool.size)
-            res, lens = np.asarray(res), np.asarray(lens)
-            for i, ps in enumerate(entry_ps):
-                raw_rows[ps] = [int(o) for o in res[i, : lens[i]]]
+        raw_rows: dict[int, list[int]] = (
+            m.pg_to_raw_osds_batch(pool_id, entry_ps) if entry_ps else {}
+        )
         trial_items = dict(original_items)
         m.pg_upmap_items = trial_items  # staged; restored below
         up_vec = np.fromiter(
@@ -306,9 +292,21 @@ def calc_pg_upmaps(
                         continue
                     raw = raw_rows.get(pg.ps)
                     if raw is None:  # entry added this call; rare
-                        raw = raw_rows[pg.ps] = m._pg_to_raw_osds(
-                            pool, pg
-                        )[0]
+                        raw = raw_rows[pg.ps] = m.pg_to_raw_osds_batch(
+                            pool_id, [pg.ps]
+                        )[pg.ps]
+                    # _apply_upmap applies pairs ON TOP of a full
+                    # pg_upmap override when one is in effect
+                    um = m.pg_upmap.get(pg)
+                    if um is not None:
+                        void = any(
+                            0 <= o < n_osd and m.osd_weight[o] == 0
+                            for o in um
+                            if o != ITEM_NONE
+                        )
+                        if void:
+                            continue  # items blocked entirely; leave
+                        raw = list(um)
                     row = up_all[pg.ps]
                     rowv = row[(row != ITEM_NONE) & (row >= 0) & (row < n_osd)]
                     items = list(trial_items[pg])
@@ -361,6 +359,9 @@ def calc_pg_upmaps(
                         del items[idx]
                         deviation[lose] -= 1.0
                         deviation[gain_o] += 1.0
+                        # keep the effective row current for the next
+                        # removal's in-row/domain guards on this PG
+                        rowv = np.where(rowv == lose, gain_o, rowv)
                         gc_removed += 1
                         changed = True
                     if changed:

@@ -192,6 +192,15 @@ class OSDMap:
         return raw, pps
 
     def _crush_do_rule(self, pool: Pool, pps: int) -> list[int]:
+        return self._crush_do_rule_batch(pool, [pps])[0]
+
+    def _crush_do_rule_batch(
+        self, pool: Pool, pps_list: list[int]
+    ) -> list[list[int]]:
+        """CRUSH placement for many pps seeds on the exact C++ tier —
+        the one source of raw rows for the scalar pipeline AND bulk
+        consumers (the upmap GC), so cached rows can never mix
+        engines."""
         from ..testing import cppref
 
         rule = self.crush.rules[pool.crush_rule]
@@ -202,9 +211,23 @@ class OSDMap:
         wfull = np.zeros(max(dense.max_devices, self.max_osd), np.uint32)
         wfull[: self.max_osd] = self.osd_weight
         res, lens = cppref.do_rule_batch(
-            dense, steps, np.array([pps], np.uint32), wfull, pool.size
+            dense, steps, np.asarray(pps_list, np.uint32), wfull, pool.size
         )
-        return [int(o) for o in res[0, : lens[0]]]
+        return [
+            [int(o) for o in res[i, : lens[i]]]
+            for i in range(len(pps_list))
+        ]
+
+    def pg_to_raw_osds_batch(
+        self, pool_id: int, ps_list: list[int]
+    ) -> dict[int, list[int]]:
+        """Pre-upmap raw rows for many folded PG seeds (reference
+        ``_pg_to_raw_osds`` without the per-PG loop)."""
+        pool = self.pools[pool_id]
+        rows = self._crush_do_rule_batch(
+            pool, [pool.raw_pg_to_pps(ps) for ps in ps_list]
+        )
+        return dict(zip(ps_list, rows))
 
     def _upmap_target_out(self, osd: int) -> bool:
         """Reference ``_apply_upmap`` target test: only in-range,

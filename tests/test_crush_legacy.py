@@ -260,3 +260,51 @@ def test_osdmap_mapping_on_legacy_map():
         up_scalar = m.pg_to_up_acting_osds(PGId(1, ps))[0]
         up_batch = mapping.get(PGId(1, ps))[0]
         assert up_batch == list(up_scalar), ps
+
+
+def test_random_mixed_alg_maps_differential():
+    """Randomized topologies with random bucket algorithms per bucket:
+    the public engine (whatever tier it routes to) must match the C++
+    reference placement-for-placement.  This generalizes the per-alg
+    tests to arbitrary alg mixes, depths, weights and reweights."""
+    import random as pyrandom
+
+    from ceph_tpu.crush.map import ALG_UNIFORM
+
+    rng = pyrandom.Random(0xA16)
+    algs_pool = [ALG_STRAW2, ALG_STRAW, ALG_LIST, ALG_TREE, ALG_UNIFORM]
+    for trial in range(12):
+        m = CrushMap()
+        m.add_type(1, "root")
+        m.add_type(2, "host")
+        root = m.add_bucket("default", "root",
+                            alg=rng.choice([ALG_STRAW2, ALG_STRAW]))
+        n_hosts = rng.randint(2, 5)
+        osd = 0
+        for h in range(n_hosts):
+            alg = rng.choice(algs_pool)
+            hb = m.add_bucket(f"h{h}", "host", alg=alg)
+            n_osd = rng.randint(1, 6)
+            hw = 0
+            for _ in range(n_osd):
+                # uniform buckets require equal item weights
+                w = 0x10000 if alg == ALG_UNIFORM else rng.choice(
+                    [0x8000, 0x10000, 0x18000, 0x20000])
+                m.insert_item(hb.id, osd, w)
+                hw += w
+                osd += 1
+            m.insert_item(root.id, hb.id, hw)
+        m.make_replicated_rule("replicated_rule", "default", "host")
+        rule = m.rule_by_name("replicated_rule")
+        dense = m.to_dense()
+        osd_weight = np.full(dense.max_devices, 0x10000, np.uint32)
+        if osd > 2:
+            osd_weight[rng.randrange(osd)] = 0x8000
+            osd_weight[rng.randrange(osd)] = 0
+        xs = np.arange(400, dtype=np.uint32)
+        rmax = min(3, n_hosts)
+        steps = [(s.op, s.arg1, s.arg2) for s in rule.steps]
+        want, wl = cppref.do_rule_batch(dense, steps, xs, osd_weight, rmax)
+        got, gl = run_batch(dense, rule, xs, osd_weight, rmax)
+        np.testing.assert_array_equal(want, np.asarray(got), err_msg=f"trial {trial}")
+        np.testing.assert_array_equal(wl, np.asarray(gl), err_msg=f"trial {trial}")

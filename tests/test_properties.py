@@ -194,3 +194,35 @@ def test_skewed_topology_balancer_converges():
     after = max(bal.evaluate().pool_max_deviation.values())
     assert after < before
     assert after <= 2.0, f"final max deviation {after}"
+
+
+def test_thrasher_invariants_legacy_map():
+    """Thrasher epochs over a straw1 map: the host-tier pool mapping
+    must hold the same invariants and agree with the scalar pipeline."""
+    from ceph_tpu.crush.map import ALG_STRAW, CrushMap
+    from ceph_tpu.osdmap.map import OSDMap, Pool
+
+    crush = CrushMap()
+    crush.add_type(1, "root")
+    root = crush.add_bucket("default", "root", alg=ALG_STRAW)
+    for i in range(12):
+        crush.insert_item(root.id, i, W1 if i % 2 else 0x18000)
+    crush.make_replicated_rule("replicated_rule", "default", "osd")
+    m = OSDMap(crush)
+    for o in range(12):
+        m.add_osd(o)
+    rule = crush.rule_by_name("replicated_rule")
+    m.add_pool(Pool(id=1, name="p", kind="replicated", size=3,
+                    pg_num=32, pgp_num=32, crush_rule=rule.id))
+    th = Thrasher(m, seed=9)
+    for epoch in range(8):
+        th.step()
+        mapping = OSDMapMapping(m)
+        mapping.update()
+        for ps in range(0, 32, 5):
+            up, upp, acting, actp = mapping.get(PGId(1, ps))
+            assert len(up) == len(set(up)), (epoch, ps, up)
+            for o in up:
+                assert m.is_up(o), (epoch, ps, o)
+            host = m.pg_to_up_acting_osds(PGId(1, ps))
+            assert (up, upp) == (host[0], host[1]), (epoch, ps)
