@@ -9,4 +9,5 @@ sharded, and XLA collectives (psum) for cluster-wide reductions such as
 per-OSD utilization histograms.
 """
 
+from . import multihost  # noqa: F401
 from .placement import make_mesh, sharded_placement_step  # noqa: F401

@@ -1,0 +1,136 @@
+"""Two-OS-process multi-host placement: the DCN-analog path, on CPU.
+
+Spawns two child processes (4 virtual CPU devices each) that join one
+jax.distributed group, build the 8-device global mesh, and run the
+SAME sharded placement step used single-host.  The psum-reduced
+histogram every process holds must equal the single-process ground
+truth — proving the cross-host collective path end-to-end without TPU
+hardware (reference scale-out: messenger over TCP; here: XLA
+collectives over the process group).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = r"""
+import json, os, sys
+import numpy as np
+from ceph_tpu.parallel import multihost
+from ceph_tpu.parallel.placement import sharded_placement_step
+from ceph_tpu.models.clusters import build_simple
+
+rank = int(sys.argv[1])
+multihost.init(coordinator=sys.argv[2], num_processes=2, process_id=rank)
+import jax
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+
+mesh = multihost.global_mesh()
+m = build_simple(64)
+rule = m.rule_by_name("replicated_rule")
+dense = m.to_dense()
+step = sharded_placement_step(mesh, dense, rule, 3)
+
+N = 4096
+osd_weight = np.full(dense.max_devices, 0x10000, np.uint32)
+xs = np.arange(N, dtype=np.uint32)
+# each host feeds only its slice, placed onto its local devices
+start, size = multihost.local_shard(N)
+from jax.sharding import NamedSharding, PartitionSpec as P
+xs_sharded = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("objects")), xs[start:start + size], (N,)
+)
+w_repl = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P()), osd_weight, osd_weight.shape
+)
+results, lens, hist = step(w_repl, xs_sharded)
+print("CHILD_RESULT " + json.dumps({
+    "rank": rank,
+    "hist": np.asarray(hist).tolist(),
+}), flush=True)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_global_mesh_matches_single_process():
+    from ceph_tpu.common.hermetic import scrubbed_env
+
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    env = scrubbed_env(_REPO, n_devices=4)
+    # file-backed output: PIPE could deadlock the collective if one
+    # child fills its pipe while the other blocks in the psum
+    import tempfile
+
+    outs = []
+    with tempfile.TemporaryDirectory() as td:
+        files = [open(os.path.join(td, f"r{r}.out"), "w+") for r in (0, 1)]
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", _CHILD, str(rank), coord],
+                env=env,
+                cwd=_REPO,
+                stdout=files[rank],
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+            for rank in range(2)
+        ]
+        rcs = []
+        try:
+            for p in procs:
+                rcs.append(p.wait(timeout=300))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for f in files:
+            f.seek(0)
+            outs.append(f.read())
+            f.close()
+        assert rcs == [0, 0], f"children failed {rcs}:\n" + \
+            "\n".join(o[-2000:] for o in outs)
+
+    hists = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("CHILD_RESULT "):
+                rec = json.loads(line[len("CHILD_RESULT "):])
+                hists[rec["rank"]] = np.array(rec["hist"])
+    assert set(hists) == {0, 1}
+    # both processes hold the identical global histogram
+    np.testing.assert_array_equal(hists[0], hists[1])
+
+    # ground truth: single-process run of the same batch
+    from ceph_tpu.crush.engine import run_batch
+    from ceph_tpu.models.clusters import build_simple
+
+    m = build_simple(64)
+    rule = m.rule_by_name("replicated_rule")
+    dense = m.to_dense()
+    xs = np.arange(4096, dtype=np.uint32)
+    w = np.full(dense.max_devices, 0x10000, np.uint32)
+    res, lens = run_batch(dense, rule, xs, w, 3)
+    from ceph_tpu.crush.map import ITEM_NONE
+
+    res = np.asarray(res)
+    want = np.bincount(
+        res[res != ITEM_NONE].reshape(-1), minlength=dense.max_devices
+    )[: dense.max_devices]
+    np.testing.assert_array_equal(hists[0], want)
