@@ -30,8 +30,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 sys.path.insert(0, os.path.join(_REPO, "bench"))
 
-N_OSDS = 1024
-N = 1_000_000
+N_OSDS = int(os.environ.get("CEPH_TPU_PROBE_OSDS", 1024))
+N = int(os.environ.get("CEPH_TPU_PROBE_N", 1_000_000))
 REPLICAS = 3
 
 
@@ -44,7 +44,6 @@ def main() -> int:
     import jax.numpy as jnp
 
     from _timing import chained_rate
-    from ceph_tpu.crush import interp_batch
     from ceph_tpu.crush.engine import make_batch_runner
     from ceph_tpu.models.clusters import build_simple
 

@@ -149,6 +149,10 @@ def main(argv=None) -> int:
             pools=args.upmap_pool,
         )
         cmds = []
+        # entry GC first: the reference emits rm-pg-upmap-items for
+        # entries the optimizer retires
+        for pg in sorted(inc.old_pg_upmap_items):
+            cmds.append(f"ceph osd rm-pg-upmap-items {pg}")
         for pg, items in sorted(inc.new_pg_upmap_items.items()):
             pairs = " ".join(f"{f} {t}" for f, t in items)
             cmds.append(f"ceph osd pg-upmap-items {pg} {pairs}")

@@ -39,7 +39,9 @@ def calc_straws(weights: list[int]) -> list[int]:
     Items draw ``(hash & 0xffff) * straws[i]``; the scaling makes the
     argmax winner's probability track the weights for <= 2 distinct
     weight classes (the legacy algorithm's known skew beyond that is
-    part of its semantics).
+    part of its semantics).  This is the ``straw_calc_version 1``
+    algorithm — the fixed builder upstream defaults to; the buggier
+    version-0 accumulation is not reproduced.
     """
     size = len(weights)
     straws = [0] * size

@@ -377,3 +377,33 @@ def test_crushtool_loc_last_same_type_wins(tmp_path):
     m = load_map(mapfile)
     assert 100 in m.bucket_by_name("host1").items
     assert 100 not in m.bucket_by_name("host0").items
+
+
+def test_osdmaptool_upmap_emits_removals(tmp_path):
+    """GC'd entries surface as `ceph osd rm-pg-upmap-items` commands
+    (reference osdmaptool --upmap cleanup output)."""
+    from ceph_tpu.cli import osdmaptool
+    from ceph_tpu.cli.osdmaptool import load, save
+    from ceph_tpu.osdmap.map import PGId
+
+    mapfile = str(tmp_path / "om.json")
+    assert osdmaptool.main(
+        ["--createsimple", "32", mapfile, "--pg-num", "128"]) == 0
+    m = load(mapfile)
+    # inject harmful entries diverting many PGs onto osd 0
+    injected = 0
+    for ps in range(128):
+        pg = PGId(1, ps)
+        raw, _ = m._pg_to_raw_osds(m.pools[1], pg)
+        if 0 in raw or not raw:
+            continue
+        m.pg_upmap_items[pg] = ((raw[0], 0),)
+        injected += 1
+        if injected >= 16:
+            break
+    save(m, mapfile)
+    outfile = str(tmp_path / "cmds.sh")
+    assert osdmaptool.main(
+        [mapfile, "--upmap", outfile, "--upmap-max", "200"]) == 0
+    cmds = open(outfile).read()
+    assert "rm-pg-upmap-items" in cmds

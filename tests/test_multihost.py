@@ -100,12 +100,13 @@ def test_two_process_global_mesh_matches_single_process():
             for p in procs:
                 if p.poll() is None:
                     p.kill()
-        for f in files:
-            f.seek(0)
-            outs.append(f.read())
-            f.close()
-        assert rcs == [0, 0], f"children failed {rcs}:\n" + \
-            "\n".join(o[-2000:] for o in outs)
+            for f in files:
+                f.seek(0)
+                outs.append(f.read())
+                f.close()
+            if rcs != [0, 0]:
+                print("child logs:\n" + "\n".join(o[-2000:] for o in outs))
+        assert rcs == [0, 0], f"children failed {rcs}"
 
     hists = {}
     for out in outs:
