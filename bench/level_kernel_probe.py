@@ -1,17 +1,14 @@
-"""On-chip probe for the whole-descent / level Pallas kernels.
+"""On-chip probe for the opt-in engine configurations.
 
-Round-3 left the level kernels opt-in because their full-engine Mosaic
-compile was never demonstrated bounded on silicon (local chipless AOT
-exceeded 20 min; the chip-side compile helper is much faster).  This
-probe answers exactly that question, in one process, without killing
-anything:
-
-1. compile the config1 engine with CEPH_TPU_LEVEL_KERNEL=1, timing the
-   compile wall-clock;
-2. measure the placement rate with the honest chained+readback timing;
-3. measure the flat-fused-straw2 baseline rate in the same process;
-4. emit one JSON line with both rates so the kernel's speedup (or lack
-   of it) is an artifact.
+Two engine features are fenced behind env flags until their value and
+compile time are proven on silicon: the level/whole-descent Pallas
+kernels (CEPH_TPU_LEVEL_KERNEL, round 3) and the compacted-straggler
+retry path (CEPH_TPU_RETRY_COMPACT, round 4).  This probe measures the
+full (kernel x compaction) grid in ONE process — proven flat config
+first, so a failing variant can never cost the earlier measurements —
+timing each config's compile upper bound and its honest
+chained+readback placement rate, and emits one JSON line.  That
+artifact is the basis for flipping either default.
 
 Run only inside a healthy chip session (bench/chip_session.sh).
 """
@@ -23,7 +20,6 @@ import os
 import sys
 import time
 
-os.environ["CEPH_TPU_LEVEL_KERNEL"] = "1"
 os.environ.setdefault("CEPH_TPU_FUSED_STRAW2", "1")
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -78,23 +74,26 @@ def main() -> int:
               file=sys.stderr, flush=True)
 
     t_all = time.perf_counter()
-    try:
-        build_and_rate("level_kernel")
-        out["level_kernel_ok"] = True
-    except Exception as e:  # noqa: BLE001
-        out["level_kernel_ok"] = False
-        out["level_kernel_error"] = f"{type(e).__name__}: {e}"[:500]
-        print(f"level kernel failed: {e}", file=sys.stderr, flush=True)
-
-    # baseline in the same process: flat fused straw2, kernel OFF.
-    # interp_batch dispatches on the env at trace time and keys its jit
-    # cache on the resolved mode (_dispatch_sig), so flipping the env
-    # compiles a fresh XLA-path program.
-    os.environ["CEPH_TPU_LEVEL_KERNEL"] = "0"
-    try:
-        build_and_rate("fused_straw2")
-    except Exception as e:  # noqa: BLE001
-        out["fused_straw2_error"] = f"{type(e).__name__}: {e}"[:500]
+    # the full (kernel x retry-compaction) grid: interp_batch
+    # dispatches on the env at trace time and keys its jit cache on the
+    # resolved modes (_dispatch_sig), so flipping envs compiles fresh
+    # programs in this one process.  Order: proven config first.
+    grid = [
+        ("fused_straw2", "0", "0"),
+        ("level_kernel", "1", "0"),
+        ("level_kernel_compact", "1", "1"),
+        ("fused_straw2_compact", "0", "1"),
+    ]
+    for tag, kmode, cmode in grid:
+        os.environ["CEPH_TPU_LEVEL_KERNEL"] = kmode
+        os.environ["CEPH_TPU_RETRY_COMPACT"] = cmode
+        try:
+            build_and_rate(tag)
+            out[f"{tag}_ok"] = True
+        except Exception as e:  # noqa: BLE001
+            out[f"{tag}_ok"] = False
+            out[f"{tag}_error"] = f"{type(e).__name__}: {e}"[:500]
+            print(f"{tag} failed: {e}", file=sys.stderr, flush=True)
 
     out["total_seconds"] = round(time.perf_counter() - t_all, 1)
     print(json.dumps(out), flush=True)

@@ -417,6 +417,19 @@ def _fused_straw2() -> bool:
     return mode == "1" or (mode == "auto" and jax.default_backend() == "tpu")
 
 
+def _retry_compact() -> bool:
+    """Whether big batches use the compacted-straggler retry path.
+
+    Opt-in (CEPH_TPU_RETRY_COMPACT=1) until its compile time is proven
+    bounded on the chip: the windowed gather/scatter roughly doubles
+    the engine program and local chipless AOT went from ~45 s to >17
+    min for the kernel-mode 1M program — the same caution that kept
+    the level kernels fenced in round 3.  bench/level_kernel_probe.py
+    measures rate AND compile for the kernel x compaction grid in one
+    chip session; flip the default on that artifact."""
+    return os.environ.get("CEPH_TPU_RETRY_COMPACT", "0") == "1"
+
+
 def _kernel_mode() -> str:
     """'1' forces the Pallas level/descent kernels (interpret off-TPU),
     '0' forces the XLA matmul path.  Default is OFF (opt-in): the
@@ -610,57 +623,131 @@ def _choose_firstn_batch(
     """
     B = x.shape[0]
 
+    # Retry compaction (bench/PERF_MODEL.md suspect 4): the masked
+    # whole-batch retry loop runs until the WORST lane settles — 4-6
+    # full-batch rounds at 1M lanes (measured: cppref retry_stats,
+    # max_ftotal 3 on config1 / 5 on skewed maps) although ~99.7 % of
+    # lanes settle in round 1.  At scale, round 1 runs on the full
+    # batch, then each later round gathers a window of up to B/16
+    # stragglers (tracking per-lane ftotal, so a lane outside the
+    # window simply waits with its retry seed unchanged — the body is
+    # fully lane-local, making the gather semantics-preserving and the
+    # window size a pure performance knob).
+    COMPACT = B >= 1 << 16 and _retry_compact()
+    CB = max(B // 16, 8192) if COMPACT else B
+
     def rep_step(carry, rep):
         # one replica slot; ``rep`` is a traced scalar so the whole
         # numrep loop is a lax.scan — the program is traced/compiled
         # once instead of numrep times (compile time and suite speed)
         out, out2, outpos = carry
 
-        def body(st):
-            ftotal, settled, item_acc, leaf_acc, placed = st
-            active = start_active & ~settled & (ftotal < tries)
-            rB = jnp.broadcast_to(rep, (B,)) + ftotal
+        def one_round(xv, lidxv, rv, active, outv, out2v, outposv):
+            """One retry round for any lane subset; returns
+            (good, stop, item, leaf), all lane-local."""
+            n = xv.shape[0]
             item, ok, hard, nlidx = descend(
-                pack, x, lidx0, rB, target_type, False, active, max_devices
+                pack, xv, lidxv, rv, target_type, False, active,
+                max_devices,
             )
-            collide = ok & _collides(out, outpos, item)
-            reject = jnp.zeros((B,), bool)
+            collide = ok & _collides(outv, outposv, item)
+            reject = jnp.zeros((n,), bool)
             leaf = item
             if leaf_pack is not None:
                 is_bucket = item < 0
                 sub_r = (
-                    (rB >> (vary_r - 1)) if vary_r else jnp.zeros((B,), I32)
+                    (rv >> (vary_r - 1)) if vary_r
+                    else jnp.zeros((n,), I32)
                 )
                 lf, lok = _leaf_firstn(
-                    leaf_pack, osd_weight, x, nlidx,
+                    leaf_pack, osd_weight, xv, nlidx,
                     active & ok & ~collide & is_bucket,
-                    sub_r, recurse_tries, out2, outpos, stable, max_devices,
+                    sub_r, recurse_tries, out2v, outposv, stable,
+                    max_devices,
                 )
                 leaf_ok = jnp.where(is_bucket, lok, True)
                 leaf = jnp.where(is_bucket, lf, item)
                 reject = reject | (ok & ~collide & ~leaf_ok)
             if target_type == 0:
-                reject = reject | (ok & ~collide & _is_out(osd_weight, item, x))
+                reject = reject | (
+                    ok & ~collide & _is_out(osd_weight, item, xv)
+                )
             good = active & ok & ~collide & ~reject
             stop = active & hard  # skip_rep: abandon this slot
-            return (
-                ftotal + 1,
-                settled | good | stop,
-                jnp.where(good, item, item_acc),
-                jnp.where(good, leaf, leaf_acc),
-                placed | good,
-            )
+            return good, stop, item, leaf
 
-        init = (
-            jnp.asarray(0, I32), jnp.zeros((B,), bool),
-            jnp.full((B,), ITEM_NONE, I32),
-            jnp.full((B,), ITEM_NONE, I32),
-            jnp.zeros((B,), bool),
-        )
-        _, _, item, leaf, placed = lax.while_loop(
-            lambda s: jnp.any(start_active & ~s[1]) & (s[0] < tries),
-            body, init,
-        )
+        if not COMPACT:
+            def body(st):
+                ftotal, settled, item_acc, leaf_acc, placed = st
+                active = start_active & ~settled & (ftotal < tries)
+                rB = jnp.broadcast_to(rep, (B,)) + ftotal
+                good, stop, item, leaf = one_round(
+                    x, lidx0, rB, active, out, out2, outpos
+                )
+                return (
+                    ftotal + 1,
+                    settled | good | stop,
+                    jnp.where(good, item, item_acc),
+                    jnp.where(good, leaf, leaf_acc),
+                    placed | good,
+                )
+
+            init = (
+                jnp.asarray(0, I32), jnp.zeros((B,), bool),
+                jnp.full((B,), ITEM_NONE, I32),
+                jnp.full((B,), ITEM_NONE, I32),
+                jnp.zeros((B,), bool),
+            )
+            _, _, item, leaf, placed = lax.while_loop(
+                lambda s: jnp.any(start_active & ~s[1]) & (s[0] < tries),
+                body, init,
+            )
+        else:
+            # round 1: the full batch, unrolled (every lane attempts)
+            rB0 = jnp.broadcast_to(rep, (B,))
+            good0, stop0, item0, leaf0 = one_round(
+                x, lidx0, rB0, start_active, out, out2, outpos
+            )
+            settled = ~start_active | good0 | stop0
+            item = jnp.where(good0, item0, ITEM_NONE)
+            leaf = jnp.where(good0, leaf0, ITEM_NONE)
+            placed = good0
+            ftl = jnp.ones((B,), I32)  # unsettled lanes failed once
+
+            def body_c(st):
+                ftl, settled, item, leaf, placed = st
+                # window of stragglers; filler index B: gathers clamp
+                # (masked inactive), scatters drop — fillers can never
+                # collide with a real lane's write
+                idx = jnp.nonzero(~settled, size=CB, fill_value=B)[0]
+                real = idx < B
+                idxc = jnp.clip(idx, 0, B - 1)
+                ftl_v = ftl[idxc]
+                exhausted = ftl_v >= tries
+                act = real & ~exhausted
+                rv = jnp.broadcast_to(rep, (CB,)) + ftl_v
+                good, stopv, it_r, lf_r = one_round(
+                    x[idxc], lidx0[idxc], rv, act,
+                    out[idxc], out2[idxc], outpos[idxc],
+                )
+                settled_v = good | stopv | exhausted
+                failed = act & ~good & ~stopv
+                item = item.at[idx].set(
+                    jnp.where(good, it_r, item[idxc]), mode="drop")
+                leaf = leaf.at[idx].set(
+                    jnp.where(good, lf_r, leaf[idxc]), mode="drop")
+                placed = placed.at[idx].set(
+                    placed[idxc] | good, mode="drop")
+                settled = settled.at[idx].set(settled_v, mode="drop")
+                ftl = ftl.at[idx].set(
+                    ftl_v + failed.astype(I32), mode="drop")
+                return ftl, settled, item, leaf, placed
+
+            _, _, item, leaf, placed = lax.while_loop(
+                lambda s: jnp.any(~s[1]),
+                body_c,
+                (ftl, settled, item, leaf, placed),
+            )
 
         place = placed & (outpos < cap)
         col = jnp.arange(cap, dtype=I32)[None, :] == outpos[:, None]
@@ -1038,7 +1125,7 @@ def _dispatch_sig() -> tuple:
     """Trace-time dispatch state that changes the compiled program —
     the RESOLVED booleans, not the raw env strings, so equivalent
     modes ('1' vs 'auto' on TPU) share one compiled executable."""
-    return (_fused_straw2(), _want_lane_tables())
+    return (_fused_straw2(), _want_lane_tables(), _retry_compact())
 
 
 def fast_signature(dense: DenseCrushMap, rule: Rule, result_max: int) -> tuple:

@@ -162,6 +162,26 @@ def hash4(a: int, b: int, c: int, d: int) -> int:
     )
 
 
+def reset_retry_stats() -> None:
+    _libs()[0].ct_reset_stats()
+
+
+def retry_stats() -> tuple[int, float, int]:
+    """(max_ftotal, mean_ftotal, slots) accumulated since the last
+    reset.  Counts top-level FAILURE rounds only (leaf sub-descents
+    excluded; indep normalized to the same unit), so max_ftotal + 1
+    bounds the batch engine's masked whole-batch retry-round
+    (lax.while_loop trip) count for the same inputs — the number
+    bench/PERF_MODEL.md's suspect 4 asks for."""
+    crush, _ = _libs()
+    mx = ctypes.c_int32()
+    sm = ctypes.c_int64()
+    n = ctypes.c_int64()
+    crush.ct_get_stats(ctypes.byref(mx), ctypes.byref(sm), ctypes.byref(n))
+    slots = max(int(n.value), 1)
+    return int(mx.value), float(sm.value) / slots, int(n.value)
+
+
 def do_rule_batch(
     dense,  # ceph_tpu.crush.map.DenseCrushMap
     steps: list[tuple[int, int, int]],

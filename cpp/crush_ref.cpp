@@ -369,6 +369,21 @@ int32_t bucket_choose(const Ctx& c, int32_t bidx, int32_t r) {
   }
 }
 
+// Retry-ladder statistics (thread-local; ct_reset_stats/ct_get_stats).
+// The batch TPU engine's masked whole-batch retry rounds run until the
+// WORST lane settles, so max_ftotal over a batch is exactly its
+// lax.while_loop trip count minus one — the number the perf model
+// needs (bench/PERF_MODEL.md suspect 4).
+thread_local int32_t g_max_ftotal = 0;
+thread_local int64_t g_sum_ftotal = 0;
+thread_local int64_t g_n_slots = 0;
+
+inline void note_ftotal(int32_t ftotal) {
+  if (ftotal > g_max_ftotal) g_max_ftotal = ftotal;
+  g_sum_ftotal += ftotal;
+  g_n_slots++;
+}
+
 // FIRSTN selection with the full retry ladder.  Returns new outpos.
 int32_t choose_firstn(const Ctx& c, int32_t bucket_idx, int32_t numrep,
                       int32_t type, int32_t* out, int32_t outpos,
@@ -457,6 +472,10 @@ int32_t choose_firstn(const Ctx& c, int32_t bucket_idx, int32_t numrep,
         }
       } while (retry_bucket);
     } while (retry_descent);
+    // top-level slots only (the leaf recursion passes out2 == null):
+    // the stats model the OUTER masked-retry loop the batch engine
+    // compacts, not the bounded leaf sub-descents
+    if (out2) note_ftotal(ftotal);
     if (skip_rep) continue;
     out[outpos] = item;
     outpos++;
@@ -476,7 +495,8 @@ void choose_indep(const Ctx& c, int32_t bucket_idx, int32_t left,
     out[rep] = kItemUndef;
     if (out2) out2[rep] = kItemUndef;
   }
-  for (int32_t ftotal = 0; left > 0 && ftotal < tries; ftotal++) {
+  int32_t ftotal = 0;
+  for (; left > 0 && ftotal < tries; ftotal++) {
     for (int32_t rep = outpos; rep < endpos; rep++) {
       if (out[rep] != kItemUndef) continue;
       int32_t in = bucket_idx;
@@ -534,6 +554,10 @@ void choose_indep(const Ctx& c, int32_t bucket_idx, int32_t left,
     if (out[rep] == kItemUndef) out[rep] = kItemNone;
     if (out2 && out2[rep] == kItemUndef) out2[rep] = kItemNone;
   }
+  // align units with firstn (count FAILURE rounds): a fully
+  // successful indep pass exits with ftotal already incremented once;
+  // a zero-width call (result_max already filled) never ran a round
+  if (out2 && endpos > outpos) note_ftotal(left == 0 ? ftotal - 1 : ftotal);
 }
 
 }  // namespace
@@ -662,6 +686,21 @@ void ct_do_rule_batch(const MapSpec* map, const RuleStep* steps,
 
 uint32_t ct_hash4(uint32_t a, uint32_t b, uint32_t c, uint32_t d) {
   return hash4(a, b, c, d);
+}
+
+// Retry-ladder statistics over everything executed since the last
+// reset (see note_ftotal above).
+void ct_reset_stats() {
+  g_max_ftotal = 0;
+  g_sum_ftotal = 0;
+  g_n_slots = 0;
+}
+
+void ct_get_stats(int32_t* max_ftotal, int64_t* sum_ftotal,
+                  int64_t* n_slots) {
+  *max_ftotal = g_max_ftotal;
+  *sum_ftotal = g_sum_ftotal;
+  *n_slots = g_n_slots;
 }
 
 // Single bucket choose, exposed so the legacy algorithms can be
