@@ -77,12 +77,14 @@ def main() -> int:
     # the full (kernel x retry-compaction) grid: interp_batch
     # dispatches on the env at trace time and keys its jit cache on the
     # resolved modes (_dispatch_sig), so flipping envs compiles fresh
-    # programs in this one process.  Order: proven config first.
+    # programs in this one process.  Both DEFAULT-path configs run
+    # first — they decide the CEPH_TPU_RETRY_COMPACT default and must
+    # never be lost to a kernel-variant hang later in the session
     grid = [
         ("fused_straw2", "0", "0"),
+        ("fused_straw2_compact", "0", "1"),
         ("level_kernel", "1", "0"),
         ("level_kernel_compact", "1", "1"),
-        ("fused_straw2_compact", "0", "1"),
     ]
     for tag, kmode, cmode in grid:
         os.environ["CEPH_TPU_LEVEL_KERNEL"] = kmode

@@ -79,10 +79,12 @@ def run_test(m: CrushMap, args, out) -> int:
     rc = 0
     for rule in rules:
         for num_rep in range(args.min_rep, args.max_rep + 1):
-            if args.cpu:
+            if args.cpu or args.show_choose_tries:
                 from ..testing import cppref
 
                 steps = [(s.op, s.arg1, s.arg2) for s in rule.steps]
+                if args.show_choose_tries:
+                    cppref.reset_retry_stats()
                 results, lens = cppref.do_rule_batch(
                     dense, steps, xs, weights, num_rep
                 )
@@ -126,6 +128,17 @@ def run_test(m: CrushMap, args, out) -> int:
                         f"expected : {expected:.2f}",
                         file=out,
                     )
+            if args.show_choose_tries:
+                # reference CrushTester --show-choose-tries: histogram
+                # of retries needed per placement slot
+                from ..testing import cppref
+
+                hist = cppref.retry_histogram()
+                # reference format: "tries: count" per bucket (indep
+                # rules: counts are failure-normalized, i.e. one less
+                # than upstream's rounds-run — see cppref.retry_stats)
+                for tries_n in np.nonzero(hist)[0]:
+                    print(f" {tries_n}:  {int(hist[tries_n])}", file=out)
             if bad:
                 rc = 1 if args.show_bad_mappings else rc
     return rc
@@ -185,6 +198,10 @@ def main(argv=None) -> int:
     p.add_argument("--show-statistics", action="store_true")
     p.add_argument("--show-utilization", action="store_true")
     p.add_argument("--show-bad-mappings", action="store_true")
+    p.add_argument("--show-choose-tries", action="store_true",
+                   help="histogram of retries per placement slot "
+                        "(runs on the C++ tier, which tracks the "
+                        "retry ladder)")
     p.add_argument("--weight", action="append", metavar="OSD:W")
     p.add_argument("--cpu", action="store_true", help="use the C++ CPU reference")
     # map mutation (reference crushtool --add-item/--remove-item/

@@ -166,6 +166,15 @@ def reset_retry_stats() -> None:
     _libs()[0].ct_reset_stats()
 
 
+def retry_histogram() -> np.ndarray:
+    """[64] int64 histogram of top-level failure counts per slot since
+    the last reset (last bucket clamps) — crushtool --show-choose-tries
+    data."""
+    hist = np.zeros(64, np.int64)
+    _libs()[0].ct_get_try_hist(_as_ptr(hist, ctypes.c_int64))
+    return hist
+
+
 def retry_stats() -> tuple[int, float, int]:
     """(max_ftotal, mean_ftotal, slots) accumulated since the last
     reset.  Counts top-level FAILURE rounds only (leaf sub-descents

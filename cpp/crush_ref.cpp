@@ -374,14 +374,18 @@ int32_t bucket_choose(const Ctx& c, int32_t bidx, int32_t r) {
 // WORST lane settles, so max_ftotal over a batch is exactly its
 // lax.while_loop trip count minus one — the number the perf model
 // needs (bench/PERF_MODEL.md suspect 4).
+constexpr int32_t kTryHistSize = 64;
 thread_local int32_t g_max_ftotal = 0;
 thread_local int64_t g_sum_ftotal = 0;
 thread_local int64_t g_n_slots = 0;
+thread_local int64_t g_try_hist[kTryHistSize] = {};
 
 inline void note_ftotal(int32_t ftotal) {
   if (ftotal > g_max_ftotal) g_max_ftotal = ftotal;
   g_sum_ftotal += ftotal;
   g_n_slots++;
+  int32_t b = ftotal < kTryHistSize ? ftotal : kTryHistSize - 1;
+  g_try_hist[b]++;
 }
 
 // FIRSTN selection with the full retry ladder.  Returns new outpos.
@@ -694,6 +698,7 @@ void ct_reset_stats() {
   g_max_ftotal = 0;
   g_sum_ftotal = 0;
   g_n_slots = 0;
+  std::memset(g_try_hist, 0, sizeof(g_try_hist));
 }
 
 void ct_get_stats(int32_t* max_ftotal, int64_t* sum_ftotal,
@@ -701,6 +706,12 @@ void ct_get_stats(int32_t* max_ftotal, int64_t* sum_ftotal,
   *max_ftotal = g_max_ftotal;
   *sum_ftotal = g_sum_ftotal;
   *n_slots = g_n_slots;
+}
+
+// Per-failure-count histogram (64 buckets; last bucket clamps) —
+// the data behind crushtool --show-choose-tries.
+void ct_get_try_hist(int64_t* hist_out) {
+  std::memcpy(hist_out, g_try_hist, sizeof(g_try_hist));
 }
 
 // Single bucket choose, exposed so the legacy algorithms can be

@@ -407,3 +407,29 @@ def test_osdmaptool_upmap_emits_removals(tmp_path):
         [mapfile, "--upmap", outfile, "--upmap-max", "200"]) == 0
     cmds = open(outfile).read()
     assert "rm-pg-upmap-items" in cmds
+
+
+def test_crushtool_show_choose_tries(tmp_path, capsys):
+    """--show-choose-tries parity: histogram of retry counts per slot
+    (reference CrushTester --output-choose-tries path)."""
+    from ceph_tpu.cli import crushtool
+
+    mapfile = str(tmp_path / "m.json")
+    assert crushtool.main(
+        ["--build", "--num_osds", "32", "-o", mapfile,
+         "host", "straw2", "4", "root", "straw2", "0"]) == 0
+    m = crushtool.load_map(mapfile)
+    m.make_replicated_rule("replicated_rule", "root0", "host")
+    with open(mapfile, "wb") as f:
+        f.write(m.encode())
+    rc = crushtool.main(
+        ["-i", mapfile, "--test", "--num-rep", "3", "--min-x", "0",
+         "--max-x", "1023", "--show-choose-tries",
+         "--weight", "3:0"])  # an out osd forces retries
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines()
+             if l.strip() and l.split(":")[0].strip().isdigit()]
+    assert lines, "no histogram emitted"
+    counts = {int(l.split(":")[0]): int(l.split(":")[1]) for l in lines}
+    assert counts.get(0, 0) > 2000  # most slots settle first try
+    assert sum(v for k, v in counts.items() if k >= 1) > 0  # retries seen
