@@ -13,7 +13,7 @@ from __future__ import annotations
 import json
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 TYPE_U64 = "u64"
 TYPE_GAUGE = "gauge"

@@ -10,7 +10,7 @@ flow) and that `crushtool --test --show-statistics` tallies serially.
 
 from __future__ import annotations
 
-from functools import partial
+
 
 import numpy as np
 
