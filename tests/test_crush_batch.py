@@ -410,3 +410,28 @@ def test_retry_compaction_at_scale_vs_cpp(monkeypatch):
     r_new, l_new = batch_do_rule_fast(dense, rule, xs, osd_weight, 3)
     np.testing.assert_array_equal(r_ref, np.asarray(r_new))
     np.testing.assert_array_equal(l_ref, np.asarray(l_new))
+
+
+def test_retry_compaction_multi_take_vs_cpp(monkeypatch):
+    """Compaction applies per choose entry; a multi-take rule at scale
+    must stay bit-exact through both entries' compacted loops."""
+    monkeypatch.setenv("CEPH_TPU_RETRY_COMPACT", "1")
+    m, roots = _two_root_map()
+    steps = [
+        Step(OP_TAKE, roots["ssd"].id),
+        Step(OP_CHOOSELEAF_FIRSTN, 1, m.type_id("host")),
+        Step(OP_EMIT),
+        Step(OP_TAKE, roots["hdd"].id),
+        Step(OP_CHOOSELEAF_FIRSTN, 2, m.type_id("host")),
+        Step(OP_EMIT),
+    ]
+    rule = m.add_rule("hybrid_scale", steps)
+    dense = m.to_dense()
+    osd_weight = np.full(dense.max_devices, 0x10000, np.uint32)
+    osd_weight[3] = 0  # out device in the ssd root: forced retries
+    xs = RNG.integers(0, 1 << 32, 1 << 16, dtype=np.uint32)
+    spec = [(s.op, s.arg1, s.arg2) for s in rule.steps]
+    r_ref, l_ref = cppref.do_rule_batch(dense, spec, xs, osd_weight, 3)
+    r_new, l_new = batch_do_rule_fast(dense, rule, xs, osd_weight, 3)
+    np.testing.assert_array_equal(r_ref, np.asarray(r_new))
+    np.testing.assert_array_equal(l_ref, np.asarray(l_new))
