@@ -21,6 +21,9 @@ REPLICAS = 3
 
 
 def main() -> None:
+    from ceph_tpu.common.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
     import jax
     import jax.numpy as jnp
 

@@ -81,6 +81,9 @@ def bench_profile(k, m, chunk, batch_mb, technique="reed_sol_van", packetsize=20
 
 
 def main() -> None:
+    from ceph_tpu.common.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
     import jax
 
     on_tpu = jax.default_backend() == "tpu"

@@ -22,6 +22,9 @@ MAX_DEVIATION = 1.0
 
 
 def main() -> None:
+    from ceph_tpu.common.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
     from ceph_tpu.balancer import Balancer
     from ceph_tpu.models.clusters import build_osdmap, build_skewed_osdmap
     from ceph_tpu.osdmap.mapping import OSDMapMapping

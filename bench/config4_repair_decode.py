@@ -15,6 +15,9 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 
 def main() -> None:
+    from ceph_tpu.common.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
     from ceph_tpu.ec import create
 
     rng = np.random.default_rng(1)
