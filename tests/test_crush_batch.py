@@ -435,3 +435,25 @@ def test_retry_compaction_multi_take_vs_cpp(monkeypatch):
     r_new, l_new = batch_do_rule_fast(dense, rule, xs, osd_weight, 3)
     np.testing.assert_array_equal(r_ref, np.asarray(r_new))
     np.testing.assert_array_equal(l_ref, np.asarray(l_new))
+
+
+@pytest.mark.slow
+def test_kernel_plus_compaction_combination(monkeypatch):
+    """The chip session measures CEPH_TPU_LEVEL_KERNEL=1 together with
+    CEPH_TPU_RETRY_COMPACT=1; that combination must be bit-exact too
+    (kernel in interpret mode off-chip; flat map keeps the emulated
+    descend affordable at the 64K compaction threshold)."""
+    monkeypatch.setenv("CEPH_TPU_LEVEL_KERNEL", "1")
+    monkeypatch.setenv("CEPH_TPU_FUSED_STRAW2", "1")
+    monkeypatch.setenv("CEPH_TPU_RETRY_COMPACT", "1")
+    m = build_flat(16)
+    rule = m.rule_by_name("replicated_rule")
+    dense = m.to_dense()
+    osd_weight = np.full(dense.max_devices, 0x10000, np.uint32)
+    osd_weight[5] = 0  # forced retries
+    xs = RNG.integers(0, 1 << 32, 1 << 16, dtype=np.uint32)
+    steps = [(s.op, s.arg1, s.arg2) for s in rule.steps]
+    r_ref, l_ref = cppref.do_rule_batch(dense, steps, xs, osd_weight, 3)
+    r_new, l_new = batch_do_rule_fast(dense, rule, xs, osd_weight, 3)
+    np.testing.assert_array_equal(r_ref, np.asarray(r_new))
+    np.testing.assert_array_equal(l_ref, np.asarray(l_new))
