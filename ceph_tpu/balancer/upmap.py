@@ -29,6 +29,15 @@ from ..osdmap.mapping import OSDMapMapping
 
 _LOG = get_logger("balancer")
 
+# Candidate-scoring truncation bounds: the [R, S, U] broadcasts in
+# _score_candidate_moves would blow past 1 GB unbounded at
+# 10k-OSD/10k-PG scale, so rounds keep the worst rows and neediest
+# targets — exactly the moves a round would accept anyway.  Module
+# level so tests can shrink them and pin that convergence survives
+# truncation (tests/test_balancer_scale.py).
+MAX_ROWS = 8192
+MAX_UNDER = 256
+
 
 def crush_device_weights(crush: CrushMap, rule_id: int, n_osd: int) -> np.ndarray:
     """Effective CRUSH weight per OSD under the rule's TAKE root."""
@@ -142,10 +151,6 @@ def _score_candidate_moves(
     if len(r_sel) == 0 or len(underfull) == 0:
         empty = np.empty(0, np.int64)
         return empty.astype(np.float64), empty, empty, empty
-    # bound the [R, S, U] broadcasts below (at 10k-OSD/10k-PG scale an
-    # unbounded R*S*U bool blows past 1 GB): keep the worst rows and the
-    # most-underfull targets — exactly the moves a round would accept
-    MAX_ROWS, MAX_UNDER = 8192, 256
     if len(r_sel) > MAX_ROWS:
         _LOG.info(
             "candidate truncation: keeping %d of %d overfull PG rows "

@@ -53,3 +53,26 @@ def test_optimizer_converges_with_economical_entries():
     # and never more table entries than PGs it actually moved
     assert pgs <= pairs
     assert pgs < PG_NUM / 2, f"{pgs} of {PG_NUM} PGs carry upmap state"
+
+
+def test_optimizer_converges_under_forced_truncation(monkeypatch):
+    """At 10k-PG scale the candidate scorer truncates to MAX_ROWS worst
+    rows / MAX_UNDER neediest targets per round (round-3 verdict
+    weakness 7).  Shrinking the bounds far below this fixture's size
+    forces every round through the truncation path; convergence and
+    entry economy must survive."""
+    from ceph_tpu.balancer import upmap
+
+    monkeypatch.setattr(upmap, "MAX_ROWS", 48)
+    monkeypatch.setattr(upmap, "MAX_UNDER", 8)
+
+    m = build_skewed_osdmap(128, pg_num=1024)
+    b = Balancer(m, max_deviation=TARGET, max_optimizations=2000)
+    for _ in range(30):
+        if not b.execute(b.optimize()):
+            break
+    ev = b.evaluate()
+    final_dev = max(ev.pool_max_deviation.values())
+    assert final_dev <= TARGET, f"did not converge truncated: {final_dev}"
+    pairs = sum(len(v) for v in m.pg_upmap_items.values())
+    assert pairs < 1024, f"{pairs} pairs for 1024 PGs"
