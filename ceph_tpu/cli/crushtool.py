@@ -53,6 +53,15 @@ def cmd_tree(m: CrushMap, out) -> None:
         walk(r, 0)
 
 
+def repropagate_weights(m: CrushMap) -> None:
+    """Recompute every bucket's recorded child weights bottom-up from
+    the leaves (reference CrushWrapper recursive weight update)."""
+    child_ids = {i for b in m.buckets.values() for i in b.items}
+    for b in list(m.buckets.values()):
+        if b.id not in child_ids:
+            m.adjust_subtree_weights(b.id)
+
+
 def run_test(m: CrushMap, args, out) -> int:
     from ..crush.engine import run_batch
 
@@ -203,6 +212,10 @@ def main(argv=None) -> int:
                         "(runs on the C++ tier, which tracks the "
                         "retry ladder)")
     p.add_argument("--weight", action="append", metavar="OSD:W")
+    p.add_argument("--compare", metavar="MAPFILE",
+                   help="report mappings that differ vs another map")
+    p.add_argument("--reweight", action="store_true",
+                   help="recompute bucket weights bottom-up (needs -o)")
     p.add_argument("--cpu", action="store_true", help="use the C++ CPU reference")
     # map mutation (reference crushtool --add-item/--remove-item/
     # --reweight-item; weights are decimal, 1.0 = 0x10000)
@@ -248,12 +261,12 @@ def main(argv=None) -> int:
         return 0
     if not args.infn:
         p.error("need -i/--infn (or -c/-d/--build)")
-    if (args.add_item or args.remove_item or args.reweight_item) \
-            and not args.outfn:
+    if (args.add_item or args.remove_item or args.reweight_item
+            or args.reweight) and not args.outfn:
         # reference crushtool refuses to mutate without an explicit
         # output file; never silently clobber the -i input map
         p.error("mutation flags (--add-item/--remove-item/"
-                "--reweight-item) require -o OUTFN")
+                "--reweight-item/--reweight) require -o OUTFN")
     m = load_map(args.infn)
 
     def _device_id(name: str) -> int:
@@ -262,13 +275,6 @@ def main(argv=None) -> int:
                 return osd
         p.error(f"unknown device {name!r}")
 
-    def _repropagate() -> None:
-        # reference CrushWrapper mutations update every ancestor's
-        # recorded weight for the child; recompute all roots
-        child_ids = {i for b in m.buckets.values() for i in b.items}
-        for b in list(m.buckets.values()):
-            if b.id not in child_ids:
-                m.adjust_subtree_weights(b.id)
 
     mutated = False
     if args.add_item:
@@ -320,7 +326,7 @@ def main(argv=None) -> int:
                 m.adjust_item_weight(b.id, osd, w)
         mutated = True
     if mutated:
-        _repropagate()
+        repropagate_weights(m)
         dest = args.outfn
         with open(dest, "wb") as f:
             f.write(m.encode())
@@ -328,6 +334,14 @@ def main(argv=None) -> int:
         if not (args.test or args.tree):
             return 0
 
+    if args.reweight:
+        repropagate_weights(m)
+        with open(args.outfn, "wb") as f:
+            f.write(m.encode())
+        print(f"reweighted map written to {args.outfn}", file=sys.stderr)
+        return 0
+    if args.compare:
+        return run_compare(m, args, out)
     if args.tree:
         cmd_tree(m, out)
         return 0
@@ -335,6 +349,56 @@ def main(argv=None) -> int:
         return run_test(m, args, out)
     p.error("nothing to do (--test, --tree, -d ...)")
     return 2
+
+
+def run_compare(m: CrushMap, args, out) -> int:
+    """--compare parity (reference crushtool --compare): map the same x
+    range under both maps and report how many inputs moved — the
+    standard way to preview a tunables/topology change's data motion."""
+    from ..testing import cppref
+
+    other = load_map(args.compare)
+    if args.rule is not None and args.rule not in m.rules:
+        print(f"rule {args.rule} not in map (rules: {sorted(m.rules)})",
+              file=sys.stderr)
+        return 1
+    xs = np.arange(args.min_x, args.max_x + 1, dtype=np.uint32)
+    num_rep = args.max_rep  # --num-rep already folded in by main
+    total = 0
+    moved = 0
+
+    def weights_for(dense):
+        # same --weight overrides run_test applies (out/reweight
+        # previews are the flag's main use with --compare)
+        w = np.full(max(dense.max_devices, 1), 0x10000, np.uint32)
+        for spec in args.weight or ():
+            osd, wv = spec.split(":")
+            if int(osd) < len(w):
+                w[int(osd)] = int(round(float(wv) * 0x10000))
+        return w
+
+    for rule in sorted(m.rules.values(), key=lambda r: r.id):
+        if args.rule is not None and rule.id != args.rule:
+            continue
+        if rule.id not in other.rules:
+            print(f"rule {rule.id} missing from {args.compare}; skipped",
+                  file=sys.stderr)
+            continue
+        rule2 = other.rules[rule.id]
+        d1, d2 = m.to_dense(), other.to_dense()
+        s1 = [(s.op, s.arg1, s.arg2) for s in rule.steps]
+        s2 = [(s.op, s.arg1, s.arg2) for s in rule2.steps]
+        r1, _ = cppref.do_rule_batch(d1, s1, xs, weights_for(d1), num_rep)
+        r2, _ = cppref.do_rule_batch(d2, s2, xs, weights_for(d2), num_rep)
+        diff = int((~(r1 == r2).all(axis=1)).sum())
+        total += len(xs)
+        moved += diff
+        print(f"rule {rule.id} ({rule.name}): {diff}/{len(xs)} mappings "
+              f"changed", file=out)
+    if total:
+        print(f"total: {moved}/{total} ({100.0 * moved / total:.2f}%) "
+              f"mappings changed", file=out)
+    return 0
 
 
 if __name__ == "__main__":

@@ -433,3 +433,50 @@ def test_crushtool_show_choose_tries(tmp_path, capsys):
     counts = {int(l.split(":")[0]): int(l.split(":")[1]) for l in lines}
     assert counts.get(0, 0) > 2000  # most slots settle first try
     assert sum(v for k, v in counts.items() if k >= 1) > 0  # retries seen
+
+
+def test_crushtool_compare_and_reweight(tmp_path, capsys):
+    """--compare (mapping diff between maps, the tunables-impact tool)
+    and --reweight (bottom-up bucket weight recompute)."""
+    from ceph_tpu.cli import crushtool
+    from ceph_tpu.cli.crushtool import load_map
+
+    base = tmp_path / "base.txt"
+    base.write_text(SAMPLE)
+    m = load_map(str(base))
+    f1 = str(tmp_path / "a.json")
+    with open(f1, "wb") as f:
+        f.write(m.encode())
+    # identical maps: nothing moves
+    assert crushtool.main(["-i", f1, "--compare", f1, "--num-rep", "2",
+                           "--min-x", "0", "--max-x", "255"]) == 0
+    out = capsys.readouterr().out
+    assert "total: 0/" in out
+    # reweight osd.0 heavier: some mappings move, most stay
+    m2 = load_map(str(base))
+    h0 = m2.bucket_by_name("host0")
+    m2.adjust_item_weight(h0.id, 0, 4 * 0x10000)
+    m2.adjust_subtree_weights(m2.bucket_by_name("default").id)
+    f2 = str(tmp_path / "b.json")
+    with open(f2, "wb") as f:
+        f.write(m2.encode())
+    assert crushtool.main(["-i", f1, "--compare", f2, "--num-rep", "2",
+                           "--min-x", "0", "--max-x", "1023"]) == 0
+    out = capsys.readouterr().out
+    frac = [l for l in out.splitlines() if l.startswith("total:")][0]
+    moved, total = map(int, frac.split()[1].split("/"))
+    assert 0 < moved < total, frac  # straw2 moves proportionally, not all
+
+    # --reweight repairs a corrupted recorded weight
+    h0 = m.bucket_by_name("host0")
+    root = m.bucket_by_name("default")
+    root.item_weights[root.items.index(h0.id)] = 0x1234  # corrupt
+    f3 = str(tmp_path / "c.json")
+    with open(f3, "wb") as f:
+        f.write(m.encode())
+    f4 = str(tmp_path / "d.json")
+    assert crushtool.main(["-i", f3, "--reweight", "-o", f4]) == 0
+    m3 = load_map(f4)
+    root = m3.bucket_by_name("default")
+    h0 = m3.bucket_by_name("host0")
+    assert root.item_weights[root.items.index(h0.id)] == sum(h0.item_weights)
