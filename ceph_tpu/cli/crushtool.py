@@ -62,6 +62,86 @@ def repropagate_weights(m: CrushMap) -> None:
             m.adjust_subtree_weights(b.id)
 
 
+def check_map(m: CrushMap) -> list:
+    """--check parity: structural invariants the reference validates
+    (dangling bucket references, id collisions, stale recorded
+    weights, rules taking unknown buckets)."""
+    problems = []
+    for bid, b in m.buckets.items():
+        if len(b.items) != len(b.item_weights):
+            problems.append(f"bucket {b.name}: items/weights length skew")
+        for it, w in zip(b.items, b.item_weights):
+            if it >= 0:
+                continue
+            if it not in m.buckets:
+                problems.append(
+                    f"bucket {b.name}: dangling child bucket {it}")
+                continue
+            child_w = sum(m.buckets[it].item_weights)
+            if child_w != w:
+                problems.append(
+                    f"bucket {b.name}: recorded weight for "
+                    f"{m.buckets[it].name} is {w}, children sum "
+                    f"to {child_w} (run --reweight)")
+        seen = set()
+        for it in b.items:
+            if it in seen:
+                problems.append(f"bucket {b.name}: duplicate item {it}")
+            seen.add(it)
+    placed = [i for b in m.buckets.values() for i in b.items if i >= 0]
+    if len(placed) != len(set(placed)):
+        problems.append("a device appears in more than one bucket")
+    # hierarchy cycles crash every other tool (RecursionError in
+    # --tree, no-root no-op in --reweight): iterative DFS over buckets
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {bid: WHITE for bid in m.buckets}
+    for start in m.buckets:
+        if color[start] != WHITE:
+            continue
+        stack = [(start, iter(m.buckets[start].items))]
+        color[start] = GRAY
+        while stack:
+            bid, it = stack[-1]
+            child = next(it, None)
+            if child is None:
+                color[bid] = BLACK
+                stack.pop()
+                continue
+            if child >= 0 or child not in m.buckets:
+                continue
+            if color[child] == GRAY:
+                problems.append(
+                    f"hierarchy cycle through {m.buckets[child].name}")
+                color[child] = BLACK
+            elif color[child] == WHITE:
+                color[child] = GRAY
+                stack.append((child, iter(m.buckets[child].items)))
+
+    from ..crush.map import OP_TAKE
+
+    for r in m.rules.values():
+        for st in r.steps:
+            if st.op == OP_TAKE and st.arg1 < 0 and st.arg1 not in m.buckets:
+                problems.append(
+                    f"rule {r.id} ({r.name}): take of unknown bucket "
+                    f"{st.arg1}")
+    return problems
+
+
+def weight_overrides(specs, n: int) -> np.ndarray:
+    """Full-weight vector with --weight OSD:W overrides applied;
+    out-of-range ids are a hard error (matching run_test's historical
+    strictness rather than silently ignoring a typo)."""
+    w = np.full(max(n, 1), 0x10000, np.uint32)
+    for spec in specs or ():
+        osd_s, wv = spec.split(":")
+        osd = int(osd_s)
+        if not 0 <= osd < len(w):
+            raise SystemExit(f"--weight {spec}: osd {osd} out of range")
+        w[osd] = int(round(float(wv) * 0x10000))
+    return w
+
+
 def run_test(m: CrushMap, args, out) -> int:
     from ..crush.engine import run_batch
 
@@ -80,11 +160,7 @@ def run_test(m: CrushMap, args, out) -> int:
         return 1
     dense = m.to_dense()
     xs = np.arange(args.min_x, args.max_x + 1, dtype=np.uint32)
-    weights = np.full(max(dense.max_devices, 1), 0x10000, np.uint32)
-    if args.weight:
-        for spec in args.weight:
-            osd, w = spec.split(":")
-            weights[int(osd)] = int(round(float(w) * 0x10000))
+    weights = weight_overrides(args.weight, dense.max_devices)
     rc = 0
     for rule in rules:
         for num_rep in range(args.min_rep, args.max_rep + 1):
@@ -216,6 +292,17 @@ def main(argv=None) -> int:
                    help="report mappings that differ vs another map")
     p.add_argument("--reweight", action="store_true",
                    help="recompute bucket weights bottom-up (needs -o)")
+    p.add_argument("--check", action="store_true",
+                   help="validate map invariants; nonzero exit on problems")
+    for knob in ("choose-total-tries", "choose-local-tries",
+                 "choose-local-fallback-tries", "chooseleaf-descend-once",
+                 "chooseleaf-vary-r", "chooseleaf-stable"):
+        p.add_argument(f"--set-{knob}", type=int, default=None,
+                       metavar="N", help=f"set the {knob} tunable (needs -o)")
+    p.add_argument("--tunables-profile", choices=[
+        "legacy", "argonaut", "bobtail", "firefly", "hammer", "jewel",
+        "optimal", "default"], default=None,
+        help="apply a named tunables profile (needs -o)")
     p.add_argument("--cpu", action="store_true", help="use the C++ CPU reference")
     # map mutation (reference crushtool --add-item/--remove-item/
     # --reweight-item; weights are decimal, 1.0 = 0x10000)
@@ -331,7 +418,31 @@ def main(argv=None) -> int:
         with open(dest, "wb") as f:
             f.write(m.encode())
         print(f"wrote crush map to {dest}", file=sys.stderr)
-        if not (args.test or args.tree):
+        if not (args.test or args.tree or args.compare or args.check):
+            return 0
+
+    knobs = {
+        k: getattr(args, f"set_{k}")
+        for k in ("choose_total_tries", "choose_local_tries",
+                  "choose_local_fallback_tries", "chooseleaf_descend_once",
+                  "chooseleaf_vary_r", "chooseleaf_stable")
+        if getattr(args, f"set_{k}") is not None
+    }
+    if knobs or args.tunables_profile:
+        from dataclasses import replace
+
+        from ..crush.map import Tunables
+
+        if not args.outfn:
+            p.error("tunables flags require -o OUTFN")
+        base = (Tunables.profile(args.tunables_profile)
+                if args.tunables_profile else m.tunables)
+        m.tunables = replace(base, **knobs)
+        m._mutated()
+        with open(args.outfn, "wb") as f:
+            f.write(m.encode())
+        print(f"wrote crush map to {args.outfn}", file=sys.stderr)
+        if not (args.test or args.tree or args.compare or args.check):
             return 0
 
     if args.reweight:
@@ -339,7 +450,19 @@ def main(argv=None) -> int:
         with open(args.outfn, "wb") as f:
             f.write(m.encode())
         print(f"reweighted map written to {args.outfn}", file=sys.stderr)
-        return 0
+        if not (args.test or args.tree or args.compare or args.check):
+            return 0
+
+    if args.check:
+        problems = check_map(m)
+        for msg in problems:
+            print(f"check: {msg}", file=out)
+        if problems:
+            return 1
+        print("check: map is consistent", file=out)
+        if not (args.test or args.tree or args.compare):
+            return 0
+
     if args.compare:
         return run_compare(m, args, out)
     if args.tree:
@@ -364,19 +487,11 @@ def run_compare(m: CrushMap, args, out) -> int:
         return 1
     xs = np.arange(args.min_x, args.max_x + 1, dtype=np.uint32)
     num_rep = args.max_rep  # --num-rep already folded in by main
+    d1, d2 = m.to_dense(), other.to_dense()
+    w1 = weight_overrides(args.weight, d1.max_devices)
+    w2 = weight_overrides(args.weight, d2.max_devices)
     total = 0
     moved = 0
-
-    def weights_for(dense):
-        # same --weight overrides run_test applies (out/reweight
-        # previews are the flag's main use with --compare)
-        w = np.full(max(dense.max_devices, 1), 0x10000, np.uint32)
-        for spec in args.weight or ():
-            osd, wv = spec.split(":")
-            if int(osd) < len(w):
-                w[int(osd)] = int(round(float(wv) * 0x10000))
-        return w
-
     for rule in sorted(m.rules.values(), key=lambda r: r.id):
         if args.rule is not None and rule.id != args.rule:
             continue
@@ -385,19 +500,21 @@ def run_compare(m: CrushMap, args, out) -> int:
                   file=sys.stderr)
             continue
         rule2 = other.rules[rule.id]
-        d1, d2 = m.to_dense(), other.to_dense()
         s1 = [(s.op, s.arg1, s.arg2) for s in rule.steps]
         s2 = [(s.op, s.arg1, s.arg2) for s in rule2.steps]
-        r1, _ = cppref.do_rule_batch(d1, s1, xs, weights_for(d1), num_rep)
-        r2, _ = cppref.do_rule_batch(d2, s2, xs, weights_for(d2), num_rep)
+        r1, _ = cppref.do_rule_batch(d1, s1, xs, w1, num_rep)
+        r2, _ = cppref.do_rule_batch(d2, s2, xs, w2, num_rep)
         diff = int((~(r1 == r2).all(axis=1)).sum())
         total += len(xs)
         moved += diff
         print(f"rule {rule.id} ({rule.name}): {diff}/{len(xs)} mappings "
               f"changed", file=out)
-    if total:
-        print(f"total: {moved}/{total} ({100.0 * moved / total:.2f}%) "
-              f"mappings changed", file=out)
+    if not total:
+        print("no rules compared (missing from the other map?)",
+              file=sys.stderr)
+        return 1
+    print(f"total: {moved}/{total} ({100.0 * moved / total:.2f}%) "
+          f"mappings changed", file=out)
     return 0
 
 

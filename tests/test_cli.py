@@ -480,3 +480,81 @@ def test_crushtool_compare_and_reweight(tmp_path, capsys):
     root = m3.bucket_by_name("default")
     h0 = m3.bucket_by_name("host0")
     assert root.item_weights[root.items.index(h0.id)] == sum(h0.item_weights)
+
+
+def test_crushtool_check_and_tunables(tmp_path, capsys):
+    """--check (map invariant validation) and --set-* / --tunables-profile
+    (reference tunable setter flags)."""
+    from ceph_tpu.cli import crushtool
+    from ceph_tpu.cli.crushtool import load_map
+
+    base = tmp_path / "base.txt"
+    base.write_text(SAMPLE)
+    m = load_map(str(base))
+    f1 = str(tmp_path / "a.json")
+    with open(f1, "wb") as f:
+        f.write(m.encode())
+    assert crushtool.main(["-i", f1, "--check"]) == 0
+    assert "consistent" in capsys.readouterr().out
+
+    # corrupt a recorded weight: --check flags it, --reweight fixes it
+    h0 = m.bucket_by_name("host0")
+    root = m.bucket_by_name("default")
+    root.item_weights[root.items.index(h0.id)] = 7
+    f2 = str(tmp_path / "bad.json")
+    with open(f2, "wb") as f:
+        f.write(m.encode())
+    assert crushtool.main(["-i", f2, "--check"]) == 1
+    assert "--reweight" in capsys.readouterr().out
+
+    # tunables profile + individual knob
+    f3 = str(tmp_path / "tuned.json")
+    assert crushtool.main(
+        ["-i", f1, "-o", f3, "--tunables-profile", "firefly",
+         "--set-choose-total-tries", "19"]) == 0
+    m2 = load_map(f3)
+    assert m2.tunables.choose_total_tries == 19
+    assert m2.tunables.chooseleaf_stable == 0  # firefly
+    # tunables change moves mappings (the --compare workflow)
+    assert crushtool.main(["-i", f1, "--compare", f3, "--num-rep", "2",
+                           "--min-x", "0", "--max-x", "511"]) == 0
+    out = capsys.readouterr().out
+    assert "total:" in out
+    # setter without -o refuses
+    import pytest
+    with pytest.raises(SystemExit):
+        crushtool.main(["-i", f1, "--set-chooseleaf-stable", "0"])
+
+
+def test_crushtool_check_detects_cycle(tmp_path, capsys):
+    from ceph_tpu.cli import crushtool
+    from ceph_tpu.cli.crushtool import load_map
+
+    base = tmp_path / "base.txt"
+    base.write_text(SAMPLE)
+    m = load_map(str(base))
+    # corrupt: host0 gains default as a child -> cycle
+    h0 = m.bucket_by_name("host0")
+    root = m.bucket_by_name("default")
+    h0.items.append(root.id)
+    h0.item_weights.append(0x10000)
+    f1 = str(tmp_path / "cyc.json")
+    with open(f1, "wb") as f:
+        f.write(m.encode())
+    assert crushtool.main(["-i", f1, "--check"]) == 1
+    assert "cycle" in capsys.readouterr().out
+
+
+def test_crushtool_mutation_then_check(tmp_path, capsys):
+    """--add-item combined with --check must run the check on the
+    mutated map rather than silently returning after the write."""
+    from ceph_tpu.cli import crushtool
+
+    mapfile = str(tmp_path / "m.json")
+    assert crushtool.main(
+        ["--build", "--num_osds", "8", "-o", mapfile,
+         "host", "straw2", "4", "root", "straw2", "0"]) == 0
+    assert crushtool.main(
+        ["-i", mapfile, "-o", mapfile, "--add-item", "8", "1.0", "osd.8",
+         "--loc", "host", "host0", "--check"]) == 0
+    assert "consistent" in capsys.readouterr().out
