@@ -417,6 +417,16 @@ def _fused_straw2() -> bool:
     return mode == "1" or (mode == "auto" and jax.default_backend() == "tpu")
 
 
+def _compact_window(B: int) -> int | None:
+    """Straggler-window size for the compacted retry paths, or None
+    when compaction should not engage (small batches, or the env gate
+    off).  The floor means the window is B/16 for large batches but up
+    to B/8 right at the threshold."""
+    if B < (1 << 16) or not _retry_compact():
+        return None
+    return max(B // 16, 8192)
+
+
 def _retry_compact() -> bool:
     """Whether big batches use the compacted-straggler retry path.
 
@@ -633,8 +643,8 @@ def _choose_firstn_batch(
     # window simply waits with its retry seed unchanged — the body is
     # fully lane-local, making the gather semantics-preserving and the
     # window size a pure performance knob).
-    COMPACT = B >= 1 << 16 and _retry_compact()
-    CB = max(B // 16, 8192) if COMPACT else B
+    CB = _compact_window(B)
+    COMPACT = CB is not None and tries > 0  # tries<=0 places nothing
 
     def rep_step(carry, rep):
         # one replica slot; ``rep`` is a traced scalar so the whole
@@ -819,33 +829,36 @@ def _choose_indep_batch(
     )
     out2 = out
 
-    def round_body(st):
-        ftotal, out, out2 = st
+    def one_round(xv, lidxv, ftv, activev, outv, out2v):
+        """One retry round (all slots) for any lane subset; ``ftv`` is
+        the per-lane round counter (lane-local semantics: a lane's r
+        sequence depends only on its own participation count)."""
+        n = xv.shape[0]
 
         def slot_step(carry, rep):
             # rep is traced: the out_size slot loop is a lax.scan so
             # the descend program is traced/compiled once per round,
             # not out_size times (EC rules have out_size = k+m)
-            out, out2 = carry
+            outv, out2v = carry
             # rep is a traced scalar: column reads/writes lower to
             # dynamic_slice / dynamic_update_slice (not lane gathers)
             col = lambda a: lax.dynamic_index_in_dim(
                 a, rep, axis=1, keepdims=False)
             setcol = lambda a, v: lax.dynamic_update_index_in_dim(
                 a, v, rep, axis=1)
-            undef = col(out) == ITEM_UNDEF
-            active = start_active & undef
-            rB = jnp.broadcast_to(rep, (B,)) + numrep * ftotal
+            undef = col(outv) == ITEM_UNDEF
+            active = activev & undef
+            rB = jnp.broadcast_to(rep, (n,)) + numrep * ftv
             item, ok, hard, nlidx = descend(
-                pack, x, lidx0, rB, target_type, True, active, max_devices
+                pack, xv, lidxv, rB, target_type, True, active, max_devices
             )
-            collide = ok & jnp.any(out == item[:, None], axis=1)
+            collide = ok & jnp.any(outv == item[:, None], axis=1)
             good = ok & ~collide
             leaf = item
             if leaf_pack is not None:
                 is_bucket = item < 0
                 lf, lok = _leaf_indep(
-                    leaf_pack, osd_weight, x, nlidx,
+                    leaf_pack, osd_weight, xv, nlidx,
                     active & good & is_bucket,
                     rep, numrep, rB, recurse_tries, max_devices,
                 )
@@ -853,30 +866,75 @@ def _choose_indep_batch(
                 leaf = jnp.where(is_bucket, lf, item)
                 good = good & leaf_ok
             if target_type == 0:
-                good = good & ~_is_out(osd_weight, item, x)
+                good = good & ~_is_out(osd_weight, item, xv)
             write_item = active & good
             write_none = active & hard
             newv = jnp.where(
                 write_item, item,
-                jnp.where(write_none, ITEM_NONE, col(out)),
+                jnp.where(write_none, ITEM_NONE, col(outv)),
             )
-            out = setcol(out, newv)
+            outv = setcol(outv, newv)
             newl = jnp.where(
                 write_item, leaf,
-                jnp.where(write_none, ITEM_NONE, col(out2)),
+                jnp.where(write_none, ITEM_NONE, col(out2v)),
             )
-            out2 = setcol(out2, newl)
-            return (out, out2), None
+            out2v = setcol(out2v, newl)
+            return (outv, out2v), None
 
-        (out, out2), _ = lax.scan(
-            slot_step, (out, out2), jnp.arange(out_size, dtype=I32)
+        (outv, out2v), _ = lax.scan(
+            slot_step, (outv, out2v), jnp.arange(out_size, dtype=I32)
         )
-        return (ftotal + 1, out, out2)
+        return outv, out2v
 
-    _, out, out2 = lax.while_loop(
-        lambda s: jnp.any(s[1] == ITEM_UNDEF) & (s[0] < tries),
-        round_body, (jnp.asarray(0, I32), out, out2),
-    )
+    CB = _compact_window(B)
+    COMPACT = CB is not None and tries > 0  # tries<=0 places nothing
+    if not COMPACT:
+        def round_body(st):
+            ftotal, out_, out2_ = st
+            ftv = jnp.full((B,), ftotal, I32)
+            out_, out2_ = one_round(x, lidx0, ftv, start_active, out_, out2_)
+            return (ftotal + 1, out_, out2_)
+
+        _, out, out2 = lax.while_loop(
+            lambda s: jnp.any(s[1] == ITEM_UNDEF) & (s[0] < tries),
+            round_body, (jnp.asarray(0, I32), out, out2),
+        )
+    else:
+        # straggler compaction, as in _choose_firstn_batch: round 1 on
+        # the full batch, later rounds gather a window of lanes that
+        # still have UNDEF slots, tracking per-lane round counts
+        out, out2 = one_round(
+            x, lidx0, jnp.zeros((B,), I32), start_active, out, out2
+        )
+        ftl = jnp.ones((B,), I32)
+
+        def body_c(st):
+            ftl, out_, out2_ = st
+            unsettled = jnp.any(out_ == ITEM_UNDEF, axis=1)
+            idx = jnp.nonzero(unsettled, size=CB, fill_value=B)[0]
+            real = idx < B
+            idxc = jnp.clip(idx, 0, B - 1)
+            ftl_v = ftl[idxc]
+            act = real & (ftl_v < tries)
+            o_v, o2_v = one_round(
+                x[idxc], lidx0[idxc], ftl_v, act, out_[idxc], out2_[idxc]
+            )
+            # exhausted lanes resolve their remaining UNDEF to NONE so
+            # the loop terminates (the full loop's post-pass does the
+            # same conversion)
+            exhausted = (~act & real)[:, None]
+            o_v = jnp.where(exhausted & (o_v == ITEM_UNDEF), ITEM_NONE, o_v)
+            o2_v = jnp.where(
+                exhausted & (o2_v == ITEM_UNDEF), ITEM_NONE, o2_v)
+            out_ = out_.at[idx].set(o_v, mode="drop")
+            out2_ = out2_.at[idx].set(o2_v, mode="drop")
+            ftl = ftl.at[idx].set(ftl_v + 1, mode="drop")
+            return ftl, out_, out2_
+
+        _, out, out2 = lax.while_loop(
+            lambda s: jnp.any(s[1] == ITEM_UNDEF),
+            body_c, (ftl, out, out2),
+        )
     out = jnp.where(out == ITEM_UNDEF, ITEM_NONE, out)
     out2 = jnp.where(out2 == ITEM_UNDEF, ITEM_NONE, out2)
     return out, out2
