@@ -243,3 +243,33 @@ def test_straw2_quotient_2_pow_48_on_silicon(tpu):
         jnp.asarray(x), jnp.asarray(ids), jnp.asarray(r),
         jnp.asarray(w), jnp.asarray(magic), interpret=False))
     np.testing.assert_array_equal(got, want)
+
+
+def test_kernel_with_compaction_on_silicon(tpu, monkeypatch):
+    """The level_kernel_compact probe config (whole-descent kernel +
+    straggler compaction) vs the C++ reference at the 64K threshold —
+    the exact program whose rate decides both env defaults."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.crush.engine import make_batch_runner
+    from ceph_tpu.models.clusters import build_simple
+    from ceph_tpu.testing import cppref
+
+    m = build_simple(256)
+    rule = m.rule_by_name("replicated_rule")
+    dense = m.to_dense()
+    osd_weight = np.full(dense.max_devices, 0x10000, np.uint32)
+    osd_weight[7] = 0
+    osd_weight[100] = 0x8000
+    xs = _rng(0xC0FF).integers(0, 1 << 32, 1 << 16, dtype=np.uint32)
+    steps = [(s.op, s.arg1, s.arg2) for s in rule.steps]
+    r_ref, l_ref = cppref.do_rule_batch(dense, steps, xs, osd_weight, 3)
+
+    monkeypatch.setenv("CEPH_TPU_LEVEL_KERNEL", "1")
+    monkeypatch.setenv("CEPH_TPU_FUSED_STRAW2", "1")
+    monkeypatch.setenv("CEPH_TPU_RETRY_COMPACT", "1")
+    crush_arg, run = make_batch_runner(dense, rule, 3)
+    got_res, got_len = run(
+        crush_arg, jnp.asarray(osd_weight), jnp.asarray(xs))
+    np.testing.assert_array_equal(r_ref, np.asarray(got_res))
+    np.testing.assert_array_equal(l_ref, np.asarray(got_len))
