@@ -27,7 +27,7 @@ def main() -> int:
         env=env,
         capture_output=True,
         text=True,
-        timeout=int(os.environ.get("CEPH_TPU_TIER_TIMEOUT", "600")),
+        timeout=int(os.environ.get("CEPH_TPU_TIER_TIMEOUT", "1500")),
     )
     dt = time.perf_counter() - t0
     tail = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
