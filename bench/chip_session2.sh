@@ -1,0 +1,63 @@
+#!/bin/bash
+# Round-4 second chip session: everything the aborted first session
+# (chip_session_r4.log) did not get to, in strict priority order, with
+# the round's hard-won tunnel-safety rules: NOTHING is ever killed; a
+# hung attach self-resolves into an error in ~25-45 min, and run_all
+# probes health before each config.
+#
+#   step 1  run_all          all 5 BASELINE configs + silicon test tier
+#   step 2  compaction probe fused_straw2 vs fused_straw2_compact
+#                            (decides the CEPH_TPU_RETRY_COMPACT default)
+#   step 3  kernel forensics whole-descent kernel: where the 1500 s went
+#
+# Usage: bash bench/chip_session2.sh [ROUND]   (from the repo root)
+
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+R=${1:-4}
+LOG="chip_session2_r${R}.log"
+
+probe() {
+  python - <<'EOF'
+import time, sys
+t0 = time.time()
+import jax, jax.numpy as jnp
+s = float(jnp.sum(jnp.arange(64)))
+print(f"probe ok: {jax.devices()[0].platform} in {time.time()-t0:.1f}s "
+      f"(sum={s})", flush=True)
+sys.exit(0 if s == 2016.0 else 1)
+EOF
+}
+
+{
+  rc_total=0
+  echo "=== chip session 2 r$R $(date -u +%H:%M:%SZ) ==="
+
+  echo "--- step 0: probe ---"
+  if ! probe; then
+    echo "ABORT: tunnel unhealthy before start"; exit 1
+  fi
+
+  echo "--- step 1: all BASELINE configs + tpu tier ---"
+  python bench/run_all.py --round "$R" --timeout 3600 \
+    || { echo "STEP FAILED: run_all.py"; rc_total=1; }
+
+  echo "--- step 2: inter-step probe ---"
+  if ! probe; then echo "ABORT: tunnel degraded after run_all"; exit 1; fi
+
+  echo "--- step 3: compaction decision probe (flat variants only) ---"
+  CEPH_TPU_PROBE_GRID="fused_straw2,fused_straw2_compact" \
+    python bench/level_kernel_probe.py \
+    || { echo "STEP FAILED: level_kernel_probe.py"; rc_total=1; }
+
+  echo "--- step 4: inter-step probe ---"
+  if ! probe; then echo "ABORT: tunnel degraded after compaction probe"; exit 1; fi
+
+  echo "--- step 5: whole-descent kernel forensics ---"
+  python bench/kernel_forensics.py \
+    || { echo "STEP FAILED: kernel_forensics.py"; rc_total=1; }
+
+  echo "=== session 2 done $(date -u +%H:%M:%SZ) rc=$rc_total ==="
+  exit "$rc_total"
+} 2>&1 | tee "$LOG"
+exit "${PIPESTATUS[0]}"

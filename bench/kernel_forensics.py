@@ -1,0 +1,150 @@
+"""Forensic on-chip probe for the whole-descent Pallas kernel.
+
+Round-4 chip session: the kernel-mode bench child blew a 1500 s
+timeout on the real chip even though the same program AOT-compiles
+chiplessly for v5e in 35-60 s.  The SIGKILL of that child then wedged
+the tunnel for hours.  This script answers *where* that time went
+without ever needing to be killed: every phase prints a timestamped
+line BEFORE it starts, and the phases are ordered so the log localises
+a hang to lowering, Mosaic compile, or on-device execution:
+
+  step 0  attach + tiny op (tunnel health)
+  step 1  flat engine control at 128K      (known-good: compile + run)
+  step 2  kernel engine at 8K   lower -> compile -> execute -> verify
+  step 3  kernel engine at 128K lower -> compile -> execute -> rate
+  step 4  kernel engine at 1M   lower -> compile -> execute -> chained rate
+
+Run only inside a monitored session; let it run to completion no
+matter how long a phase takes (killing an attached child is what
+wedges the tunnel — chip_session_r4.log).  Results land in one JSON
+line at the end AND incrementally in the timestamped log lines.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("CEPH_TPU_FUSED_STRAW2", "1")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "bench"))
+
+N_OSDS = int(os.environ.get("CEPH_TPU_PROBE_OSDS", 1024))
+MAXN = int(os.environ.get("CEPH_TPU_FORENSICS_MAXN", 1_000_000))
+# control/kernel phase sizes; shrink for an off-chip smoke run
+N_MID = int(os.environ.get("CEPH_TPU_FORENSICS_MID", 131_072))
+N_SMALL = int(os.environ.get("CEPH_TPU_FORENSICS_SMALL", 8_192))
+REPLICAS = 3
+
+_T0 = time.perf_counter()
+
+
+def say(msg: str) -> None:
+    print(f"[{time.perf_counter() - _T0:8.1f}s] {msg}", flush=True)
+
+
+def main() -> int:
+    from ceph_tpu.common.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    say("importing jax / attaching")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    say(f"attached: {jax.devices()}")
+
+    from ceph_tpu.crush.engine import make_batch_runner
+    from ceph_tpu.models.clusters import build_simple
+
+    out: dict = {"metric": "kernel_forensics",
+                 "platform": jax.devices()[0].platform}
+
+    m = build_simple(N_OSDS)
+    rule = m.rule_by_name("replicated_rule")
+    dense = m.to_dense()
+    osd_weight = jnp.full((dense.max_devices,), 0x10000, jnp.uint32)
+
+    def build(kmode: str):
+        os.environ["CEPH_TPU_LEVEL_KERNEL"] = kmode
+        os.environ["CEPH_TPU_RETRY_COMPACT"] = "0"
+        crush_arg, fn = make_batch_runner(dense, rule, REPLICAS)
+        return crush_arg, jax.jit(fn)
+
+    def phase(tag: str, kmode: str, n: int) -> tuple:
+        """lower -> compile -> execute, timestamped; returns results."""
+        say(f"{tag}: build (kernel={kmode}, n={n})")
+        crush_arg, jfn = build(kmode)
+        xs = jnp.arange(n, dtype=jnp.uint32)
+        say(f"{tag}: lowering")
+        t = time.perf_counter()
+        lowered = jfn.lower(crush_arg, osd_weight, xs)
+        out[f"{tag}_lower_s"] = round(time.perf_counter() - t, 1)
+        say(f"{tag}: lowered in {out[f'{tag}_lower_s']}s; compiling")
+        t = time.perf_counter()
+        compiled = lowered.compile()
+        out[f"{tag}_compile_s"] = round(time.perf_counter() - t, 1)
+        say(f"{tag}: compiled in {out[f'{tag}_compile_s']}s; executing")
+        t = time.perf_counter()
+        res, lens = compiled(crush_arg, osd_weight, xs)
+        res_np = np.asarray(res)
+        lens_np = np.asarray(lens)
+        out[f"{tag}_first_exec_s"] = round(time.perf_counter() - t, 2)
+        say(f"{tag}: first exec+readback {out[f'{tag}_first_exec_s']}s")
+        t = time.perf_counter()
+        res2, lens2 = compiled(crush_arg, osd_weight, xs)
+        np.asarray(res2)
+        np.asarray(lens2)
+        out[f"{tag}_second_exec_s"] = round(time.perf_counter() - t, 3)
+        say(f"{tag}: second exec+readback {out[f'{tag}_second_exec_s']}s")
+        return res_np, lens_np
+
+    try:
+        say("step 0: tiny-op probe")
+        v = float(jnp.sum(jnp.arange(64)))
+        assert v == 2016.0
+        say("step 0 ok")
+
+        flat_res, flat_lens = phase("flat_mid", "0", N_MID)
+
+        k8_res, k8_lens = phase("kern_small", "1", N_SMALL)
+        same = bool(
+            (k8_res == flat_res[:N_SMALL]).all()
+            and (k8_lens == flat_lens[:N_SMALL]).all()
+        )
+        out["kern_small_matches_flat"] = same
+        say(f"kern_small vs flat: {'BIT-EXACT' if same else 'MISMATCH'}")
+
+        phase("kern_mid", "1", N_MID)
+
+        if MAXN >= 1_000_000:
+            from _timing import chained_rate
+
+            say("step 4: kernel at 1M, chained rate")
+            crush_arg, jfn = build("1")
+            xs0 = jnp.arange(1_000_000, dtype=jnp.uint32)
+
+            def step(xs):
+                res, lens = jfn(crush_arg, osd_weight, xs)
+                return xs + lens.astype(jnp.uint32) + jnp.uint32(1)
+
+            t = time.perf_counter()
+            dt, _ = chained_rate(step, xs0, iters=5, reps=3)
+            out["kern1m_rate_per_sec"] = round(1_000_000 / dt)
+            out["kern1m_total_s"] = round(time.perf_counter() - t, 1)
+            say(f"kernel 1M rate: {1_000_000 / dt:,.0f} placements/s")
+    except Exception as e:  # noqa: BLE001 — bank whatever we measured
+        out["error"] = f"{type(e).__name__}: {e}"[:500]
+        say(f"FAILED: {out['error']}")
+
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -86,6 +86,24 @@ def main() -> int:
         ("level_kernel", "1", "0"),
         ("level_kernel_compact", "1", "1"),
     ]
+    # CEPH_TPU_PROBE_GRID="fused_straw2,fused_straw2_compact" restricts
+    # the grid — the kernel variants cost an unbounded Mosaic compile on
+    # chip (round-4 forensics pending) and can be excluded from a
+    # session that just needs the compaction decision.
+    only = os.environ.get("CEPH_TPU_PROBE_GRID")
+    if only:
+        keep = {t.strip() for t in only.split(",")}
+        unknown = keep - {g[0] for g in grid}
+        if unknown:
+            out["grid_filter_unknown"] = sorted(unknown)
+            print(f"WARNING: CEPH_TPU_PROBE_GRID names unknown variants "
+                  f"{sorted(unknown)}", file=sys.stderr, flush=True)
+        grid = [g for g in grid if g[0] in keep]
+        if not grid:
+            print("ERROR: CEPH_TPU_PROBE_GRID filtered the grid to empty",
+                  file=sys.stderr, flush=True)
+            print(json.dumps(out), flush=True)
+            return 1
     for tag, kmode, cmode in grid:
         os.environ["CEPH_TPU_LEVEL_KERNEL"] = kmode
         os.environ["CEPH_TPU_RETRY_COMPACT"] = cmode
