@@ -182,6 +182,10 @@ def _main_guarded() -> int:
         errors.append(f"tpu attempt {attempt}: {(r or {}).get('error')}")
         if r and r.get("timed_out"):
             break
+    # CAUTION for opt-in users: this attempt keeps the kill-on-timeout
+    # child, and a killed mid-compile attach is the tunnel-wedge
+    # mechanism — only opt in inside a monitored session that can
+    # afford the wedge, or after the kernel program is known cached.
     if (
         os.environ.get("CEPH_TPU_BENCH_TRY_KERNEL") == "1"
         and result is not None

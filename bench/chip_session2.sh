@@ -6,9 +6,10 @@
 # probes health before each config.
 #
 #   step 1  run_all          all 5 BASELINE configs + silicon test tier
-#   step 2  compaction probe fused_straw2 vs fused_straw2_compact
+#   step 3  compaction probe fused_straw2 vs fused_straw2_compact
 #                            (decides the CEPH_TPU_RETRY_COMPACT default)
-#   step 3  kernel forensics whole-descent kernel: where the 1500 s went
+#   step 5  kernel forensics whole-descent kernel: where the 1500 s went
+#   (steps 0/2/4 are health probes)
 #
 # Usage: bash bench/chip_session2.sh [ROUND]   (from the repo root)
 

@@ -96,8 +96,12 @@ def main() -> int:
         lens_np = np.asarray(lens)
         out[f"{tag}_first_exec_s"] = round(time.perf_counter() - t, 2)
         say(f"{tag}: first exec+readback {out[f'{tag}_first_exec_s']}s")
+        # second exec must be a DIFFERENT dispatch: this tunnel elides
+        # byte-identical re-dispatches (round-3 finding, bench/_timing.py),
+        # so derive the input from the first result's data
+        xs2 = (xs + lens.astype(jnp.uint32) + jnp.uint32(1)) % jnp.uint32(1 << 30)
         t = time.perf_counter()
-        res2, lens2 = compiled(crush_arg, osd_weight, xs)
+        res2, lens2 = compiled(crush_arg, osd_weight, xs2)
         np.asarray(res2)
         np.asarray(lens2)
         out[f"{tag}_second_exec_s"] = round(time.perf_counter() - t, 3)
@@ -122,12 +126,12 @@ def main() -> int:
 
         phase("kern_mid", "1", N_MID)
 
-        if MAXN >= 1_000_000:
+        if MAXN > N_MID:
             from _timing import chained_rate
 
-            say("step 4: kernel at 1M, chained rate")
+            say(f"step 4: kernel at {MAXN}, chained rate")
             crush_arg, jfn = build("1")
-            xs0 = jnp.arange(1_000_000, dtype=jnp.uint32)
+            xs0 = jnp.arange(MAXN, dtype=jnp.uint32)
 
             def step(xs):
                 res, lens = jfn(crush_arg, osd_weight, xs)
@@ -135,15 +139,18 @@ def main() -> int:
 
             t = time.perf_counter()
             dt, _ = chained_rate(step, xs0, iters=5, reps=3)
-            out["kern1m_rate_per_sec"] = round(1_000_000 / dt)
-            out["kern1m_total_s"] = round(time.perf_counter() - t, 1)
-            say(f"kernel 1M rate: {1_000_000 / dt:,.0f} placements/s")
+            out["kern_full_n"] = MAXN
+            out["kern_full_rate_per_sec"] = round(MAXN / dt)
+            out["kern_full_total_s"] = round(time.perf_counter() - t, 1)
+            say(f"kernel {MAXN} rate: {MAXN / dt:,.0f} placements/s")
+        else:
+            say(f"step 4 skipped: MAXN={MAXN} <= mid size {N_MID}")
     except Exception as e:  # noqa: BLE001 — bank whatever we measured
         out["error"] = f"{type(e).__name__}: {e}"[:500]
         say(f"FAILED: {out['error']}")
 
     print(json.dumps(out), flush=True)
-    return 0
+    return 1 if "error" in out else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,98 @@
+"""bench/run_all.py tunnel-safety logic: probe budget accounting,
+tunnel-down records, and incremental banking.
+
+These paths exist because of the round-4 wedge (chip_session_r4.log):
+a SIGKILLed TPU attach wedges the tunnel for hours, so the runner must
+probe-gate configs and bank results after every record.  All stubbed —
+no jax, no subprocesses.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+_RUN_ALL = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "bench", "run_all.py",
+)
+_spec = importlib.util.spec_from_file_location("bench_run_all", _RUN_ALL)
+run_all = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(run_all)
+
+
+def test_wait_healthy_charges_only_degraded_time(monkeypatch):
+    monkeypatch.setattr(run_all, "_probe_healthy", lambda: True)
+    healthy, spent = run_all._wait_healthy(100.0)
+    assert healthy and spent == 0.0
+
+
+def test_wait_healthy_gives_up_after_budget(monkeypatch):
+    calls = []
+    monkeypatch.setattr(run_all, "_probe_healthy",
+                        lambda: calls.append(1) or False)
+    monkeypatch.setattr(run_all.time, "sleep", lambda s: None)
+    healthy, spent = run_all._wait_healthy(500.0)
+    assert not healthy
+    assert spent >= 500.0
+    # budget 500 with 300s sleeps: probe, sleep(300), probe, sleep(300) -> out
+    assert len(calls) == 2
+
+
+def test_tunnel_down_banks_not_launched_records(monkeypatch, tmp_path):
+    monkeypatch.setattr(run_all, "_REPO", str(tmp_path))
+    monkeypatch.setattr(run_all, "_probe_healthy", lambda: False)
+    monkeypatch.setattr(run_all.time, "sleep", lambda s: None)
+    launched = []
+    monkeypatch.setattr(
+        run_all, "_run_one",
+        lambda name, path, timeout: launched.append(name) or {"config": name},
+    )
+    monkeypatch.setattr(
+        run_all.sys, "argv",
+        ["run_all.py", "--round", "97", "--probe-budget", "1"],
+    )
+    assert run_all.main() == 0
+    assert launched == []  # nothing may attach into a wedged tunnel
+    data = json.loads((tmp_path / "BENCH_DETAIL_r97.json").read_text())
+    assert len(data["records"]) == len(run_all.CONFIGS)
+    assert all("not launched" in r["error"] for r in data["records"])
+
+
+def test_banks_incrementally_and_records_all(monkeypatch, tmp_path):
+    monkeypatch.setattr(run_all, "_REPO", str(tmp_path))
+    monkeypatch.setattr(run_all, "_probe_healthy", lambda: True)
+    seen_banks = []
+
+    def fake_run_one(name, path, timeout):
+        # the bank file must already hold every EARLIER record when the
+        # next config starts — that is the "abort keeps what was
+        # measured" guarantee
+        dest = tmp_path / "BENCH_DETAIL_r96.json"
+        seen_banks.append(
+            len(json.loads(dest.read_text())["records"]) if dest.exists() else 0
+        )
+        return {"config": name, "rc": 0,
+                "result": {"platform": "tpu", "ok": True}}
+
+    monkeypatch.setattr(run_all, "_run_one", fake_run_one)
+    monkeypatch.setattr(run_all.sys, "argv", ["run_all.py", "--round", "96"])
+    assert run_all.main() == 0
+    n = len(run_all.CONFIGS)
+    assert seen_banks == list(range(n))
+    data = json.loads((tmp_path / "BENCH_DETAIL_r96.json").read_text())
+    assert len(data["records"]) == n
+    assert data["device"] == ["tpu"]
+
+
+def test_unfiltered_configs_cover_all_baseline_configs():
+    names = [n for n, _ in run_all.CONFIGS]
+    assert names == [
+        "config1_crush", "config2_ec_encode", "config3_upmap",
+        "config4_repair_decode", "config5_rebalance_sim", "tpu_tier",
+    ]
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])
