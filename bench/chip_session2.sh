@@ -8,8 +8,9 @@
 #   step 1  run_all          all 5 BASELINE configs + silicon test tier
 #   step 3  compaction probe fused_straw2 vs fused_straw2_compact
 #                            (decides the CEPH_TPU_RETRY_COMPACT default)
-#   step 5  kernel forensics whole-descent kernel: where the 1500 s went
-#   (steps 0/2/4 are health probes)
+#   step 5  flat ablation    cost attribution for the headline path
+#   step 7  kernel forensics whole-descent kernel: where the 1500 s went
+#   (steps 0/2/4/6 are health probes)
 #
 # Usage: bash bench/chip_session2.sh [ROUND]   (from the repo root)
 
@@ -54,7 +55,14 @@ EOF
   echo "--- step 4: inter-step probe ---"
   if ! probe; then echo "ABORT: tunnel degraded after compaction probe"; exit 1; fi
 
-  echo "--- step 5: whole-descent kernel forensics ---"
+  echo "--- step 5: flat-path ablation (cost attribution) ---"
+  python bench/flat_ablation.py \
+    || { echo "STEP FAILED: flat_ablation.py"; rc_total=1; }
+
+  echo "--- step 6: inter-step probe ---"
+  if ! probe; then echo "ABORT: tunnel degraded after ablation"; exit 1; fi
+
+  echo "--- step 7: whole-descent kernel forensics ---"
   python bench/kernel_forensics.py \
     || { echo "STEP FAILED: kernel_forensics.py"; rc_total=1; }
 

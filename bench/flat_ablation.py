@@ -1,0 +1,114 @@
+"""On-chip cost attribution for the flat (fused-straw2) headline path.
+
+PERF_MODEL.md's roofline accounting cannot explain the measured 0.56 s
+per 1M-object batch (naive HBM math predicts ~10s of ms), so this
+script attributes the time EMPIRICALLY by ablation: each variant holds
+everything constant except one axis and measures the honest chained
+rate.  Axes:
+
+  tries     choose_total_tries 50 (default) vs 2 vs 1 — bounds the
+            masked retry-round cost the compaction path removes
+  replicas  3 vs 1 — slot-loop cost
+  batch     1M vs 1/4 vs 1/16 — fixed launch/dispatch overhead
+  depth     3-level rack/host/osd map vs flat root->osd map — per-level
+            descent cost vs one wide straw2 bucket
+
+Timestamped, never killed, banks each variant's line as it lands
+(tunnel-safety rules, chip_session_r4.log).  Variants compile distinct
+programs (different tunables/shapes), so expect ~1-4 min compile each
+on a cold cache.
+
+Semantics note: tries<50 variants may leave some lanes short (lens<3);
+they are TIMING probes, not placement-correctness runs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("CEPH_TPU_FUSED_STRAW2", "1")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "bench"))
+
+N_OSDS = int(os.environ.get("CEPH_TPU_PROBE_OSDS", 1024))
+BASE_N = int(os.environ.get("CEPH_TPU_ABLATION_N", 1_000_000))
+
+_T0 = time.perf_counter()
+
+
+def say(msg: str) -> None:
+    print(f"[{time.perf_counter() - _T0:8.1f}s] {msg}", flush=True)
+
+
+def main() -> int:
+    from ceph_tpu.common.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from _timing import chained_rate
+    from ceph_tpu.crush.engine import make_batch_runner
+    from ceph_tpu.crush.map import Tunables
+    from ceph_tpu.models.clusters import build_flat, build_simple
+
+    out: dict = {"metric": "flat_ablation",
+                 "platform": jax.devices()[0].platform,
+                 "base_n": BASE_N}
+    say(f"attached: {jax.devices()}")
+
+    def variant(tag: str, m, replicas: int, n: int, compact: str = "0"):
+        os.environ["CEPH_TPU_LEVEL_KERNEL"] = "0"
+        os.environ["CEPH_TPU_RETRY_COMPACT"] = compact
+        say(f"{tag}: build+compile (replicas={replicas}, n={n})")
+        t0 = time.perf_counter()
+        out[f"{tag}_n"] = n
+        try:
+            rule = m.rule_by_name("replicated_rule")
+            dense = m.to_dense()
+            osd_weight = jnp.full((dense.max_devices,), 0x10000, jnp.uint32)
+            crush_arg, batch = make_batch_runner(dense, rule, replicas)
+            xs0 = jnp.arange(n, dtype=jnp.uint32)
+
+            def step(xs):
+                res, lens = batch(crush_arg, osd_weight, xs)
+                return xs + lens.astype(jnp.uint32) + jnp.uint32(1)
+
+            dt, _ = chained_rate(step, xs0, iters=3, reps=3)
+        except Exception as e:  # noqa: BLE001 — bank the failure, move on
+            out[f"{tag}_error"] = f"{type(e).__name__}: {e}"[:300]
+            say(f"{tag} FAILED: {out[f'{tag}_error']}")
+            return
+        total = time.perf_counter() - t0
+        out[f"{tag}_rate_per_sec"] = round(n / dt)
+        out[f"{tag}_batch_ms"] = round(1e3 * dt, 2)
+        out[f"{tag}_total_s"] = round(total, 1)
+        say(f"{tag}: {n / dt:,.0f} placements/s "
+            f"({1e3 * dt:.1f} ms/batch; build+compile+measure {total:.1f}s)")
+
+    tun_default = Tunables()
+    tun2 = Tunables(choose_total_tries=2)
+    tun1 = Tunables(choose_total_tries=1)
+
+    base = build_simple(N_OSDS, tunables=tun_default)
+    variant("base", base, 3, BASE_N)
+    variant("tries2", build_simple(N_OSDS, tunables=tun2), 3, BASE_N)
+    variant("tries1", build_simple(N_OSDS, tunables=tun1), 3, BASE_N)
+    variant("replicas1", base, 1, BASE_N)
+    variant("n_quarter", base, 3, max(BASE_N // 4, 1024))
+    variant("n_16th", base, 3, max(BASE_N // 16, 1024))
+    variant("flatmap", build_flat(N_OSDS, tunables=tun_default), 3, BASE_N)
+    variant("compact", base, 3, BASE_N, compact="1")
+
+    print(json.dumps(out), flush=True)
+    return 1 if any(k.endswith("_error") for k in out) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
