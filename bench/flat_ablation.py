@@ -105,6 +105,10 @@ def main() -> int:
     variant("n_16th", base, 3, max(BASE_N // 16, 1024))
     variant("flatmap", build_flat(N_OSDS, tunables=tun_default), 3, BASE_N)
     variant("compact", base, 3, BASE_N, compact="1")
+    # if the batch axis still pays a fixed per-dispatch cost at 1M,
+    # a larger launch is a legitimate headline lever (HBM holds it:
+    # the per-level [n, F] u32 intermediates at 4M x F=32 are ~0.5 GB)
+    variant("n_4x", base, 3, BASE_N * 4)
 
     print(json.dumps(out), flush=True)
     return 1 if any(k.endswith("_error") for k in out) else 0
