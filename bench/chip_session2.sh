@@ -66,6 +66,13 @@ EOF
   python bench/kernel_forensics.py \
     || { echo "STEP FAILED: kernel_forensics.py"; rc_total=1; }
 
+  echo "--- step 8: inter-step probe ---"
+  if ! probe; then echo "ABORT: tunnel degraded after forensics"; exit 1; fi
+
+  echo "--- step 9: clean headline re-run (warm cache, unloaded baseline) ---"
+  CEPH_TPU_BENCH_TIMEOUT=1500 python bench.py \
+    || { echo "STEP FAILED: bench.py rerun"; rc_total=1; }
+
   echo "=== session 2 done $(date -u +%H:%M:%SZ) rc=$rc_total ==="
   exit "$rc_total"
 } 2>&1 | tee "$LOG"
