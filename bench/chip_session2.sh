@@ -5,12 +5,22 @@
 # hung attach self-resolves into an error in ~25-45 min, and run_all
 # probes health before each config.
 #
-#   step 1  run_all          all 5 BASELINE configs + silicon test tier
-#   step 3  compaction probe fused_straw2 vs fused_straw2_compact
-#                            (decides the CEPH_TPU_RETRY_COMPACT default)
-#   step 5  flat ablation    cost attribution for the headline path
-#   step 7  kernel forensics whole-descent kernel: where the 1500 s went
-#   (steps 0/2/4/6 are health probes)
+# Ordering rationale: every bounded, proven-compile step runs first;
+# the two steps that can hit an unbounded on-chip Mosaic kernel
+# compile (forensics, silicon test tier) run LAST so a wedge there
+# forfeits nothing else.  The tier runs after forensics on purpose —
+# forensics compiles the descend kernels with unbounded patience,
+# warming the persistent cache the tier's kernel tests then hit.
+#
+#   step 1   run_all configs 1-5 (BASELINE benches, compile-proven)
+#   step 3   compaction probe: fused_straw2 vs fused_straw2_compact
+#            (decides the CEPH_TPU_RETRY_COMPACT default)
+#   step 5   flat ablation (cost attribution for the headline path)
+#   step 7   clean headline re-run (warm cache, unloaded baseline)
+#   step 9   whole-descent kernel forensics (unbounded compile risk)
+#   step 11  silicon test tier, appended to BENCH_DETAIL (kill risk
+#            only at the 7200s last resort; dead last on purpose)
+#   (even steps are health probes)
 #
 # Usage: bash bench/chip_session2.sh [ROUND]   (from the repo root)
 
@@ -40,8 +50,10 @@ EOF
     echo "ABORT: tunnel unhealthy before start"; exit 1
   fi
 
-  echo "--- step 1: all BASELINE configs + tpu tier ---"
+  echo "--- step 1: BASELINE configs 1-5 ---"
   python bench/run_all.py --round "$R" --timeout 3600 \
+    --only config1_crush --only config2_ec_encode --only config3_upmap \
+    --only config4_repair_decode --only config5_rebalance_sim \
     || { echo "STEP FAILED: run_all.py"; rc_total=1; }
 
   echo "--- step 2: inter-step probe ---"
@@ -62,16 +74,24 @@ EOF
   echo "--- step 6: inter-step probe ---"
   if ! probe; then echo "ABORT: tunnel degraded after ablation"; exit 1; fi
 
-  echo "--- step 7: whole-descent kernel forensics ---"
+  echo "--- step 7: clean headline re-run (warm cache, unloaded baseline) ---"
+  CEPH_TPU_BENCH_TIMEOUT=1500 python bench.py \
+    || { echo "STEP FAILED: bench.py rerun"; rc_total=1; }
+
+  echo "--- step 8: inter-step probe ---"
+  if ! probe; then echo "ABORT: tunnel degraded after headline re-run"; exit 1; fi
+
+  echo "--- step 9: whole-descent kernel forensics ---"
   python bench/kernel_forensics.py \
     || { echo "STEP FAILED: kernel_forensics.py"; rc_total=1; }
 
-  echo "--- step 8: inter-step probe ---"
+  echo "--- step 10: inter-step probe ---"
   if ! probe; then echo "ABORT: tunnel degraded after forensics"; exit 1; fi
 
-  echo "--- step 9: clean headline re-run (warm cache, unloaded baseline) ---"
-  CEPH_TPU_BENCH_TIMEOUT=1500 python bench.py \
-    || { echo "STEP FAILED: bench.py rerun"; rc_total=1; }
+  echo "--- step 11: silicon test tier (appended to BENCH_DETAIL) ---"
+  python bench/run_all.py --round "$R" --timeout 7200 --append \
+    --only tpu_tier \
+    || { echo "STEP FAILED: tpu_tier"; rc_total=1; }
 
   echo "=== session 2 done $(date -u +%H:%M:%SZ) rc=$rc_total ==="
   exit "$rc_total"

@@ -130,24 +130,48 @@ def main() -> int:
         action="store_true",
         help="skip inter-config health probes (hermetic/CPU runs)",
     )
+    p.add_argument(
+        "--append",
+        action="store_true",
+        help="keep existing BENCH_DETAIL records and append new ones "
+             "(for a split session: risky configs run later, same artifact); "
+             "a re-run config replaces its previous record",
+    )
     args = p.parse_args()
+
+    known = {n for n, _ in CONFIGS}
+    unknown = set(args.only or ()) - known
+    if unknown:
+        # a typo must not silently cost an hours-long chip session its
+        # record — fail loudly before anything attaches
+        print(f"ERROR: unknown --only config(s): {sorted(unknown)}; "
+              f"known: {sorted(known)}", file=sys.stderr)
+        return 2
 
     dest = os.path.join(_REPO, f"BENCH_DETAIL_r{args.round:02d}.json")
 
+    prior: list = []
+    if args.append and os.path.exists(dest):
+        with open(dest) as f:
+            prior = json.load(f).get("records", [])
+
     def bank(records: list) -> None:
+        # prior records from --append, minus any this run re-measured
+        new_names = {r["config"] for r in records}
+        merged = [r for r in prior if r["config"] not in new_names] + records
         # device provenance comes from the child records — importing
         # jax here could block the parent forever on a wedged tunnel
         # attach and lose every completed record
         platforms = {
             r["result"]["platform"]
-            for r in records
+            for r in merged
             if isinstance(r.get("result"), dict) and r["result"].get("platform")
         }
         out = {
             "round": args.round,
             "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "device": sorted(platforms) or ["unknown"],
-            "records": records,
+            "records": merged,
         }
         with open(dest, "w") as f:
             json.dump(out, f, indent=1, sort_keys=True)
@@ -165,15 +189,18 @@ def main() -> int:
             if not healthy:
                 tunnel_down = True
         if tunnel_down:
-            records.append(
-                {
-                    "config": name,
-                    "rc": -1,
-                    "error": "not launched: tunnel unhealthy and probe budget exhausted",
-                    "seconds": 0.0,
-                }
-            )
-            bank(records)
+            # a never-launched placeholder must not clobber a prior
+            # banked measurement under --append
+            if not any(r["config"] == name for r in prior):
+                records.append(
+                    {
+                        "config": name,
+                        "rc": -1,
+                        "error": "not launched: tunnel unhealthy and probe budget exhausted",
+                        "seconds": 0.0,
+                    }
+                )
+                bank(records)
             continue
         print(f"== {name} ==", file=sys.stderr, flush=True)
         rec = _run_one(name, path, args.timeout)

@@ -86,6 +86,72 @@ def test_banks_incrementally_and_records_all(monkeypatch, tmp_path):
     assert data["device"] == ["tpu"]
 
 
+def test_append_merges_and_replaces_records(monkeypatch, tmp_path):
+    monkeypatch.setattr(run_all, "_REPO", str(tmp_path))
+    monkeypatch.setattr(run_all, "_probe_healthy", lambda: True)
+    monkeypatch.setattr(
+        run_all, "_run_one",
+        lambda name, path, timeout: {"config": name, "rc": 0,
+                                     "result": {"platform": "tpu"}},
+    )
+    # first invocation: configs 1-2 only
+    monkeypatch.setattr(
+        run_all.sys, "argv",
+        ["run_all.py", "--round", "95",
+         "--only", "config1_crush", "--only", "config2_ec_encode"],
+    )
+    assert run_all.main() == 0
+    # second invocation: tier only, --append; config2 re-run replaces
+    monkeypatch.setattr(
+        run_all.sys, "argv",
+        ["run_all.py", "--round", "95", "--append",
+         "--only", "config2_ec_encode", "--only", "tpu_tier"],
+    )
+    assert run_all.main() == 0
+    data = json.loads((tmp_path / "BENCH_DETAIL_r95.json").read_text())
+    names = [r["config"] for r in data["records"]]
+    assert sorted(names) == ["config1_crush", "config2_ec_encode", "tpu_tier"]
+    assert len(names) == len(set(names))  # re-run replaced, not duplicated
+
+
+def test_unknown_only_name_fails_loudly(monkeypatch, tmp_path):
+    monkeypatch.setattr(run_all, "_REPO", str(tmp_path))
+    monkeypatch.setattr(
+        run_all.sys, "argv",
+        ["run_all.py", "--round", "94", "--only", "config3_upmapp"],
+    )
+    assert run_all.main() == 2
+    assert not (tmp_path / "BENCH_DETAIL_r94.json").exists()
+
+
+def test_append_tunnel_down_preserves_prior_record(monkeypatch, tmp_path):
+    monkeypatch.setattr(run_all, "_REPO", str(tmp_path))
+    monkeypatch.setattr(run_all, "_probe_healthy", lambda: True)
+    monkeypatch.setattr(
+        run_all, "_run_one",
+        lambda name, path, timeout: {"config": name, "rc": 0,
+                                     "result": {"platform": "tpu"}},
+    )
+    monkeypatch.setattr(
+        run_all.sys, "argv",
+        ["run_all.py", "--round", "93", "--only", "config1_crush"],
+    )
+    assert run_all.main() == 0
+    # second run, tunnel dead: the good config1 record must survive
+    monkeypatch.setattr(run_all, "_probe_healthy", lambda: False)
+    monkeypatch.setattr(run_all.time, "sleep", lambda s: None)
+    monkeypatch.setattr(
+        run_all.sys, "argv",
+        ["run_all.py", "--round", "93", "--append", "--probe-budget", "1",
+         "--only", "config1_crush", "--only", "tpu_tier"],
+    )
+    assert run_all.main() == 0
+    data = json.loads((tmp_path / "BENCH_DETAIL_r93.json").read_text())
+    by_name = {r["config"]: r for r in data["records"]}
+    assert by_name["config1_crush"]["rc"] == 0  # preserved, not clobbered
+    assert "not launched" in by_name["tpu_tier"]["error"]
+
+
 def test_unfiltered_configs_cover_all_baseline_configs():
     names = [n for n, _ in run_all.CONFIGS]
     assert names == [
