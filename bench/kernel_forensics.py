@@ -9,10 +9,13 @@ line BEFORE it starts, and the phases are ordered so the log localises
 a hang to lowering, Mosaic compile, or on-device execution:
 
   step 0  attach + tiny op (tunnel health)
-  step 1  flat engine control at 128K      (known-good: compile + run)
-  step 2  kernel engine at 8K   lower -> compile -> execute -> verify
-  step 3  kernel engine at 128K lower -> compile -> execute -> rate
-  step 4  kernel engine at 1M   lower -> compile -> execute -> chained rate
+  step 1  flat engine control at mid size  (known-good: compile + run)
+  step 2  per-level kernels (mode 'level') at small then mid size —
+          ~levels-x smaller Mosaic programs; verified vs flat
+  step 3  whole-descent kernel (mode '1') at small size
+          lower -> compile -> execute -> verify
+  step 4  whole-descent kernel at mid size
+  step 5  whole-descent kernel at MAXN: chained rate
 
 Run only inside a monitored session; let it run to completion no
 matter how long a phase takes (killing an attached child is what
@@ -115,6 +118,18 @@ def main() -> int:
         say("step 0 ok")
 
         flat_res, flat_lens = phase("flat_mid", "0", N_MID)
+
+        # per-level kernels first: ~levels-x smaller Mosaic programs,
+        # so if the whole-descent compile is the pathology these still
+        # land and give the kernel path a priced fallback
+        lv_res, lv_lens = phase("level_small", "level", N_SMALL)
+        same_lv = bool(
+            (lv_res == flat_res[:N_SMALL]).all()
+            and (lv_lens == flat_lens[:N_SMALL]).all()
+        )
+        out["level_small_matches_flat"] = same_lv
+        say(f"level_small vs flat: {'BIT-EXACT' if same_lv else 'MISMATCH'}")
+        phase("level_mid", "level", N_MID)
 
         k8_res, k8_lens = phase("kern_small", "1", N_SMALL)
         same = bool(

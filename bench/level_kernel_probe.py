@@ -83,6 +83,7 @@ def main() -> int:
     grid = [
         ("fused_straw2", "0", "0"),
         ("fused_straw2_compact", "0", "1"),
+        ("level_only", "level", "0"),
         ("level_kernel", "1", "0"),
         ("level_kernel_compact", "1", "1"),
     ]

@@ -325,18 +325,18 @@ def build_pack(
         tables.append(lt)
         lane_nps.append(lane_np)
     desc_tb = desc_meta = None
-    if _want_lane_tables():
+    if _whole_descent_on():
         packed = pallas_straw2.pack_descend_tables(lane_nps)
         if packed is not None:
             desc_tb, desc_meta = jnp.asarray(packed[0]), packed[1]
-        else:
-            # fused table unavailable: fall back to per-level kernels
-            # where the individual level fits
-            tables = [
-                LevelTable(t.tb, t.nb, t.fanout,
-                           None if ln is None else jnp.asarray(ln))
-                for t, ln in zip(tables, lane_nps)
-            ]
+    if desc_tb is None and _want_lane_tables():
+        # per-level kernels: mode 'level', or the fused table failed
+        # its bounds — attach each level's lane table where it fits
+        tables = [
+            LevelTable(t.tb, t.nb, t.fanout,
+                       None if ln is None else jnp.asarray(ln))
+            for t, ln in zip(tables, lane_nps)
+        ]
     return (DescendPack(tuple(tables), desc_tb, desc_meta),
             _stop_buckets(dense, roots, target_type))
 
@@ -442,14 +442,24 @@ def _retry_compact() -> bool:
 
 def _kernel_mode() -> str:
     """'1' forces the Pallas level/descent kernels (interpret off-TPU),
-    '0' forces the XLA matmul path.  Default is OFF (opt-in): the
-    kernels are bit-exact in tests, but whole-descent Mosaic compiles
-    exceeded 20 min in local chipless AOT (superlinear in kernel size
-    even with the fanout fori_loop) and were never demonstrated bounded
-    on silicon before the round-3 tunnel wedge — auto-enabling would
-    put the driver's whole bench run at risk.  The flat fused straw2
-    kernel (CEPH_TPU_FUSED_STRAW2, auto-on) is the proven path."""
+    'level' forces the per-level kernels while keeping the fused
+    whole-descent kernel OFF (its Mosaic program is ~levels x larger —
+    the fallback lever if only the big kernel's on-chip compile is
+    pathological), '0' forces the XLA matmul path.  Default is OFF
+    (opt-in): the kernels are bit-exact in tests, but whole-descent
+    Mosaic compiles exceeded 20 min in local chipless AOT (superlinear
+    in kernel size even with the fanout fori_loop) and were never
+    demonstrated bounded on silicon before the round-3 tunnel wedge —
+    auto-enabling would put the driver's whole bench run at risk.  The
+    flat fused straw2 kernel (CEPH_TPU_FUSED_STRAW2, auto-on) is the
+    proven path."""
     return os.environ.get("CEPH_TPU_LEVEL_KERNEL", "0")
+
+
+def _whole_descent_on() -> bool:
+    """Whether descents may use the fused all-levels kernel (mode '1'
+    only; mode 'level' stops at per-level kernels)."""
+    return _kernel_mode() == "1"
 
 
 def _want_lane_tables() -> bool:
@@ -464,10 +474,10 @@ def _want_lane_tables() -> bool:
     fused_mode = os.environ.get("CEPH_TPU_FUSED_STRAW2", "auto")
     if fused_mode == "0":
         return False
-    # strictly opt-in: ONLY the literal '1' enables the kernel (a
+    # strictly opt-in: ONLY the literal '1'/'level' enable kernels (a
     # legacy 'auto' value must not re-enable the unproven silicon
     # compile the default exists to fence off)
-    return mode == "1"
+    return mode in ("1", "level")
 
 
 def _use_level_kernel(table: LevelTable) -> bool:
@@ -492,7 +502,7 @@ def descend(
     """
     B = x.shape[0]
 
-    if pack.desc_tb is not None and _want_lane_tables():
+    if pack.desc_tb is not None and _whole_descent_on():
         # whole descent in one Pallas call (all levels fused)
         return pallas_straw2.descend_fused(
             x, r.astype(U32), lidx0, active, pack.desc_tb, pack.desc_meta,
@@ -1183,7 +1193,8 @@ def _dispatch_sig() -> tuple:
     """Trace-time dispatch state that changes the compiled program —
     the RESOLVED booleans, not the raw env strings, so equivalent
     modes ('1' vs 'auto' on TPU) share one compiled executable."""
-    return (_fused_straw2(), _want_lane_tables(), _retry_compact())
+    return (_fused_straw2(), _want_lane_tables(), _whole_descent_on(),
+            _retry_compact())
 
 
 def fast_signature(dense: DenseCrushMap, rule: Rule, result_max: int) -> tuple:
@@ -1198,7 +1209,7 @@ def _packs_for(dense: DenseCrushMap, rule: Rule, result_max: int):
     # lane tables are built conditionally on the dispatch mode, so the
     # pack cache must not serve a build made under a different mode
     pkey = (id(dense), rule_signature(rule), result_max,
-            _want_lane_tables())
+            _want_lane_tables(), _whole_descent_on())
     hit = _PACK_CACHE.get(pkey)
     if hit is not None and hit[0] is dense:
         return hit[1], hit[2], hit[3]

@@ -138,6 +138,23 @@ def test_engine_with_level_kernel_matches(monkeypatch):
     np.testing.assert_array_equal(np.asarray(got_res), np.asarray(want_res))
     np.testing.assert_array_equal(np.asarray(got_len), np.asarray(want_len))
 
+    # mode 'level': per-level kernels WITHOUT the fused whole-descent
+    # kernel (the fallback lever if only the big kernel's on-chip
+    # Mosaic compile is pathological) — same placements, and the pack
+    # must actually take the per-level branch
+    monkeypatch.setenv("CEPH_TPU_LEVEL_KERNEL", "level")
+    crush_arg3, run3 = make_batch_runner(dense, rule, 3)
+    import jax.tree_util as jtu
+
+    leaves = jtu.tree_leaves(
+        crush_arg3, is_leaf=lambda q: hasattr(q, "desc_tb"))
+    packs = [p for p in leaves if hasattr(p, "desc_tb")]
+    assert packs and all(p.desc_tb is None for p in packs)
+    assert any(t.lane_tb is not None for p in packs for t in p.tables)
+    got_res3, got_len3 = run3(crush_arg3, osd_w, xs)
+    np.testing.assert_array_equal(np.asarray(got_res3), np.asarray(want_res))
+    np.testing.assert_array_equal(np.asarray(got_len3), np.asarray(want_len))
+
 
 def test_crush_ln_boundary_u_ffff():
     """Pin inputs whose hash hits u == 0xffff (xs == 0x10000): the
