@@ -89,7 +89,10 @@ EOF
   if ! probe; then echo "ABORT: tunnel degraded after forensics"; exit 1; fi
 
   echo "--- step 11: silicon test tier (appended to BENCH_DETAIL) ---"
-  python bench/run_all.py --round "$R" --timeout 7200 --append \
+  # the tier's INNER pytest timeout must track the outer budget, or its
+  # own 1500 s default kill re-creates the wedge the ordering avoids
+  CEPH_TPU_TIER_TIMEOUT=7000 \
+    python bench/run_all.py --round "$R" --timeout 7200 --append \
     --only tpu_tier \
     || { echo "STEP FAILED: tpu_tier"; rc_total=1; }
 
