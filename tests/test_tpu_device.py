@@ -183,11 +183,16 @@ def test_gf_kernels_on_silicon(tpu):
     np.testing.assert_array_equal(got, gf.matrix_encode(M, data))
 
 
-def test_whole_descent_kernel_on_silicon(tpu, monkeypatch):
-    """Full engine with the whole-descent Pallas kernel forced
+@pytest.mark.parametrize("kmode,seed", [("1", 0xDE5C), ("level", 0x1E5E)])
+def test_descent_kernels_on_silicon(tpu, monkeypatch, kmode, seed):
+    """Full engine with the Pallas descent kernels forced
     (non-interpret) == the C++ reference, on a skewed map with
-    reweights and an out device.  This is the round-3 kernel that had
-    never executed on a chip."""
+    reweights and an out device.  Mode '1' is the round-3 whole-descent
+    kernel that had never executed on a chip; mode 'level' is the
+    per-level fallback (~levels-x smaller Mosaic programs) if only the
+    big kernel's on-chip compile is pathological (round-4 forensics
+    question).  Asserts the intended kernel branch is actually taken so
+    a silent fallback to the XLA path cannot fake the proof."""
     import jax.numpy as jnp
 
     from ceph_tpu.crush.engine import make_batch_runner
@@ -200,13 +205,24 @@ def test_whole_descent_kernel_on_silicon(tpu, monkeypatch):
     osd_weight = np.full(dense.max_devices, 0x10000, np.uint32)
     osd_weight[3] = 0x8000
     osd_weight[7] = 0
-    xs = _rng(0xDE5C).integers(0, 1 << 32, 4096, dtype=np.uint32)
+    xs = _rng(seed).integers(0, 1 << 32, 4096, dtype=np.uint32)
     steps = [(s.op, s.arg1, s.arg2) for s in rule.steps]
     r_ref, l_ref = cppref.do_rule_batch(dense, steps, xs, osd_weight, 3)
 
-    monkeypatch.setenv("CEPH_TPU_LEVEL_KERNEL", "1")
+    monkeypatch.setenv("CEPH_TPU_LEVEL_KERNEL", kmode)
     monkeypatch.setenv("CEPH_TPU_FUSED_STRAW2", "1")
     crush_arg, run = make_batch_runner(dense, rule, 3)
+    import jax.tree_util as jtu
+
+    leaves = jtu.tree_leaves(
+        crush_arg, is_leaf=lambda q: hasattr(q, "desc_tb"))
+    packs = [p for p in leaves if hasattr(p, "desc_tb")]
+    assert packs
+    if kmode == "1":
+        assert any(p.desc_tb is not None for p in packs)
+    else:
+        assert all(p.desc_tb is None for p in packs)
+        assert any(t.lane_tb is not None for p in packs for t in p.tables)
     got_res, got_len = run(
         crush_arg, jnp.asarray(osd_weight), jnp.asarray(xs))
     np.testing.assert_array_equal(r_ref, np.asarray(got_res))
