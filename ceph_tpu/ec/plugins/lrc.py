@@ -224,21 +224,53 @@ class ErasureCodeLrc(ErasureCode):
     def minimum_to_decode(
         self, want_to_read: set[int], available: set[int]
     ) -> set[int]:
-        """Prefer the smallest single layer covering the losses."""
-        erased = want_to_read - available
-        if not erased:
+        """Walk the layer structure the way decode_chunks will, smallest
+        layers first (locality: a single lost chunk reads only its local
+        group), accumulating the read set each repair needs — and raise
+        when no repair chain reaches the wanted chunks.  Mirroring the
+        decode iteration exactly keeps the claim and the decode in
+        lockstep (LRC is not MDS: "any k available" is NOT sufficient,
+        upstream ``ErasureCodeLrc::_minimum_to_decode`` walks layers and
+        returns EIO likewise; a 157-trial fuzz found the old any-k
+        fallback claiming patterns decode_chunks then failed)."""
+        if not (want_to_read - available):
             return set(want_to_read)
-        for layer in sorted(self.layers, key=lambda s: len(s.positions)):
-            covered = erased <= set(layer.positions)
-            have = [p for p in layer.positions if p in available]
-            if covered and len(have) >= len(layer.data_pos):
-                return set(have[: len(layer.data_pos)]) | (
-                    want_to_read & available
-                )
-        # fall back to anything decodable
-        if len(available) < self.k:
-            raise ErasureCodeError("not enough chunks")
-        return set(sorted(available)[: self.k]) | (want_to_read & available)
+        # feas_have: what decode_chunks (given every available chunk)
+        # would hold after each repair — drives feasibility, keeping
+        # the claim in lockstep with the decode.  present: what a
+        # replay holding ONLY the returned read set would hold — each
+        # repair selects its inputs from chunks already present (prior
+        # reads/repairs) before adding fresh available reads, so the
+        # returned set is always a subset of ``available`` AND
+        # sufficient on its own (the contract decode_object in
+        # ec/stripe.py enforces).
+        feas_have = set(available)
+        present: set[int] = set()
+        read: set[int] = set()
+        progress = True
+        while (want_to_read - feas_have) and progress:
+            progress = False
+            for layer in sorted(self.layers, key=lambda s: len(s.positions)):
+                lost_here = [p for p in layer.positions if p not in feas_have]
+                have_here = [p for p in layer.positions if p in feas_have]
+                needed = len(layer.data_pos)
+                if lost_here and len(have_here) >= needed:
+                    sel = [p for p in have_here if p in present][:needed]
+                    for p in have_here:
+                        if len(sel) >= needed:
+                            break
+                        if p not in sel and p in available:
+                            sel.append(p)
+                    read |= set(sel) & available
+                    present |= set(sel) | set(layer.positions)
+                    feas_have |= set(layer.positions)
+                    progress = True
+                    break
+        if want_to_read - feas_have:
+            raise ErasureCodeError(
+                f"cannot decode chunks {sorted(want_to_read - feas_have)}"
+            )
+        return read | (want_to_read & available)
 
     def decode_chunks(
         self, want_to_read: set[int], chunks: dict[int, np.ndarray]

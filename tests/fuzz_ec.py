@@ -1,0 +1,138 @@
+"""Extended randomized EC fuzz: every plugin family, random valid
+profiles, random unaligned object sizes, random erasure subsets —
+verify decode round-trips bit-exactly and decode_concat reassembles
+the object.  Patterns a plugin's geometry cannot recover (SHEC is
+non-MDS) are detected via minimum_to_decode raising and skipped, which
+is the interface contract (upstream ErasureCodeInterface
+``minimum_to_decode`` -> EIO when unrecoverable).
+
+NOT collected by pytest (no test_ prefix) — run manually when CPU time
+is free:
+
+    env -u PYTHONPATH CEPH_TPU_TEST_REEXEC=1 PYTHONPATH=/root/repo \\
+      JAX_PLATFORMS=cpu python tests/fuzz_ec.py
+
+Budget via CEPH_TPU_FUZZ_SECONDS (default 1200).
+"""
+
+import itertools
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from ceph_tpu.ec import create  # noqa: E402
+from ceph_tpu.ec.interface import ErasureCodeError  # noqa: E402
+
+
+def gen_profile(rng) -> dict:
+    fam = rng.choice(
+        ["rs_van", "r6", "cauchy", "liberation", "blaum_roth",
+         "liber8tion", "isa", "lrc", "shec", "clay"])
+    if fam == "rs_van":
+        return {"plugin": "jerasure", "technique": "reed_sol_van",
+                "k": str(rng.integers(2, 10)), "m": str(rng.integers(1, 5)),
+                "w": str(rng.choice([8, 8, 16, 32]))}
+    if fam == "r6":
+        return {"plugin": "jerasure", "technique": "reed_sol_r6_op",
+                "k": str(rng.integers(2, 10)), "m": "2"}
+    if fam == "cauchy":
+        return {"plugin": "jerasure",
+                "technique": rng.choice(["cauchy_orig", "cauchy_good"]),
+                "k": str(rng.integers(2, 9)), "m": str(rng.integers(1, 5)),
+                "packetsize": str(rng.choice([8, 16]))}
+    if fam == "liberation":
+        w = int(rng.choice([7, 11, 13]))
+        return {"plugin": "jerasure", "technique": "liberation",
+                "k": str(rng.integers(2, w + 1)), "m": "2",
+                "w": str(w), "packetsize": "8"}
+    if fam == "blaum_roth":
+        w = int(rng.choice([4, 6, 10, 12]))
+        return {"plugin": "jerasure", "technique": "blaum_roth",
+                "k": str(rng.integers(2, w + 1)), "m": "2",
+                "w": str(w), "packetsize": "8"}
+    if fam == "liber8tion":
+        return {"plugin": "jerasure", "technique": "liber8tion",
+                "k": str(rng.integers(2, 9)), "m": "2", "packetsize": "8"}
+    if fam == "isa":
+        return {"plugin": "isa",
+                "k": str(rng.integers(2, 10)), "m": str(rng.integers(1, 5))}
+    if fam == "lrc":
+        k, m, l = [(4, 2, 3), (6, 2, 4), (8, 4, 4)][int(rng.integers(0, 3))]
+        return {"plugin": "lrc", "k": str(k), "m": str(m), "l": str(l)}
+    if fam == "shec":
+        k = int(rng.integers(2, 7))
+        m = int(rng.integers(2, min(k, 4) + 1))
+        c = int(rng.integers(1, m))
+        return {"plugin": "shec", "k": str(k), "m": str(m), "c": str(c)}
+    k = int(rng.integers(2, 6))
+    m = int(rng.integers(2, 5))
+    prof = {"plugin": "clay", "k": str(k), "m": str(m)}
+    if rng.random() < 0.5:
+        prof["d"] = str(int(rng.integers(k, k + m)))
+    return prof
+
+
+def main() -> int:
+    seed = int(time.time())
+    rng = np.random.default_rng(seed)
+    print(f"ec fuzz seed {seed}", flush=True)
+    budget = int(os.environ.get("CEPH_TPU_FUZZ_SECONDS", "1200"))
+    t0 = time.time()
+    trial = 0
+    while time.time() - t0 < budget:
+        trial += 1
+        profile = gen_profile(rng)
+        try:
+            ec = create(dict(profile))
+        except ErasureCodeError as e:
+            # generator emitted a profile this plugin rejects — that
+            # rejection IS reference behavior; record and continue
+            print(f"trial {trial}: rejected {profile}: {e}", flush=True)
+            continue
+        n = ec.get_chunk_count()
+        m_cnt = n - ec.get_data_chunk_count()
+        obj = rng.integers(0, 256,
+                           int(rng.integers(1, 20000)), dtype=np.uint8)
+        all_ids = set(range(n))
+        enc = ec.encode(all_ids, obj)
+        cs = len(enc[0])
+        pats = [p for r in range(1, m_cnt + 1)
+                for p in itertools.combinations(range(n), r)]
+        idx = rng.permutation(len(pats))[:6]
+        for pi in idx:
+            erased = set(pats[int(pi)])
+            avail_ids = all_ids - erased
+            try:
+                minimum = ec.minimum_to_decode(erased | avail_ids, avail_ids)
+            except ErasureCodeError:
+                continue  # unrecoverable by geometry (e.g. SHEC non-MDS)
+            # the claimed read set must be readable and sufficient on
+            # its own (the decode_object contract in ec/stripe.py)
+            assert minimum <= avail_ids, (profile, sorted(erased))
+            dec_min = ec.decode(
+                erased | avail_ids, {i: enc[i] for i in minimum}, cs)
+            avail = {i: enc[i] for i in avail_ids}
+            dec = ec.decode(erased | avail_ids, dict(avail), cs)
+            for i in all_ids:
+                assert np.array_equal(dec[i], enc[i]), \
+                    (profile, sorted(erased), i)
+                assert np.array_equal(dec_min[i], enc[i]), \
+                    (profile, sorted(erased), sorted(minimum), i)
+            out = ec.decode_concat(dict(avail))
+            assert out[: len(obj)] == obj.tobytes(), \
+                (profile, sorted(erased))
+        if trial % 20 == 0:
+            print(f"trial {trial} ok ({time.time() - t0:.0f}s) "
+                  f"last: {profile}", flush=True)
+    print(f"DONE: {trial} trials clean in {time.time() - t0:.0f}s",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

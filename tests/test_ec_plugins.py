@@ -58,6 +58,48 @@ def test_lrc_locality_fewer_reads():
     assert len(ec.minimum_to_decode(set(range(n)), available)) <= n
 
 
+def test_lrc_minimum_to_decode_lockstep_with_decode():
+    """minimum_to_decode's claim and decode_chunks' outcome must agree
+    for EVERY erasure pattern — including beyond-capability ones.  LRC
+    is not MDS: the old any-k-available fallback claimed patterns the
+    layer walk cannot repair (found by tests/fuzz_ec.py; upstream
+    ``ErasureCodeLrc::_minimum_to_decode`` walks layers and EIOs)."""
+    ec = create({"plugin": "lrc", "k": "4", "m": "2", "l": "3"})
+    n = ec.get_chunk_count()
+    obj = rand_bytes(random.Random(5), 2000)
+    enc = ec.encode(set(range(n)), obj)
+    cs = len(enc[0])
+    checked = claimed_no = 0
+    for r in range(1, n - ec.get_data_chunk_count() + 2):
+        for pat in itertools.combinations(range(n), r):
+            erased = set(pat)
+            avail = set(range(n)) - erased
+            try:
+                minimum = ec.minimum_to_decode(erased | avail, avail)
+                claimed = True
+            except ErasureCodeError:
+                claimed = False
+                claimed_no += 1
+            try:
+                ec.decode(erased | avail, {i: enc[i] for i in avail}, cs)
+                actual = True
+            except ErasureCodeError:
+                actual = False
+            assert claimed == actual, (sorted(erased), claimed, actual)
+            if claimed:
+                # the returned read set must be readable (subset of
+                # available — decode_object in ec/stripe.py enforces
+                # this) and SUFFICIENT on its own
+                assert minimum <= avail, (sorted(erased), sorted(minimum))
+                dec = ec.decode(
+                    erased | avail, {i: enc[i] for i in minimum}, cs)
+                for i in range(n):
+                    assert np.array_equal(dec[i], enc[i]), (
+                        sorted(erased), sorted(minimum), i)
+            checked += 1
+    assert checked > 200 and claimed_no > 0  # both branches exercised
+
+
 def test_lrc_explicit_mapping_profile():
     import json
 
