@@ -223,7 +223,12 @@ def decompile_crushmap(m: CrushMap) -> str:
         out.append(f"\talg {ALG_NAMES[b.alg]}")
         out.append("\thash 0\t# rjenkins1")
         for item, w in zip(b.items, b.item_weights):
-            out.append(f"\titem {m.item_name(item)} weight {w / 0x10000:.3f}")
+            # %.5f like the reference's decompiler: 5 decimals resolve
+            # every 16.16 step (error x 0x10000 < 0.5, so the parse's
+            # round() recovers the exact fixed-point weight; 3 decimals
+            # lost up to ~33/65536 per item — found by the round-trip
+            # placement fuzz)
+            out.append(f"\titem {m.item_name(item)} weight {w / 0x10000:.5f}")
         out.append("}")
 
     for bid in sorted(m.buckets, reverse=True):

@@ -97,6 +97,29 @@ def test_compile_decompile_roundtrip():
     assert np.array_equal(r1, r2)
 
 
+def test_decompile_weight_precision_every_16_16_step():
+    """Every 16.16 weight must survive text round-trip bit-exactly.
+    The reference decompiler prints %.5f for exactly this reason: at 5
+    decimals the parse error x 0x10000 stays < 0.5 so round() recovers
+    the fixed-point value; 3 decimals lost up to ~33/65536 per item and
+    flipped straw2 placements (caught by tests/fuzz_compiler.py)."""
+    # adversarial weights: max fractional entropy in the low bits, the
+    # minimum nonzero weight, and a large fraction — every one must be
+    # installed (strict=True would fail on a length mismatch)
+    awkward = [0x10001, 0x15555, 0x2AAAB, 0x00001, 0x7FFFF]
+    for wlist in ([awkward[0], awkward[1]], [awkward[2], awkward[3]],
+                  [awkward[4], awkward[0]]):
+        m = compile_crushmap(SAMPLE)
+        host = m.bucket_by_name("host0")
+        assert len(host.items) == len(wlist)
+        for it, w in zip(host.items, wlist):
+            m.adjust_item_weight(host.id, it, w)
+        m.adjust_subtree_weights(m.bucket_by_name("default").id)
+        m2 = compile_crushmap(decompile_crushmap(m))
+        assert m2.bucket_by_name("host0").item_weights == \
+            m.bucket_by_name("host0").item_weights == wlist
+
+
 def test_compile_errors():
     with pytest.raises(CompileError):
         compile_crushmap("tunable bogus_knob 3")
