@@ -1,0 +1,92 @@
+"""Randomized OSDMap pipeline fuzz: the device batch mapper vs the
+scalar host pipeline on maps with everything mutated at once — random
+cluster sizes, non-power-of-two pg_num, replicated AND erasure pools,
+random downs/outs/reweights, primary affinity, full pg_upmap
+overrides, pg_upmap_items chains, positional pg_temp (with dead
+members), and primary_temp.
+
+NOT collected by pytest — run manually:
+
+    env -u PYTHONPATH CEPH_TPU_TEST_REEXEC=1 PYTHONPATH=/root/repo \\
+      JAX_PLATFORMS=cpu python tests/fuzz_osdmap.py
+
+Budget via CEPH_TPU_FUZZ_SECONDS (default 900).
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+
+from ceph_tpu.models.clusters import build_osdmap  # noqa: E402
+from ceph_tpu.osdmap.map import PGId  # noqa: E402
+from test_osdmap import _assert_pool_agrees  # noqa: E402
+
+
+def main() -> int:
+    seed = int(time.time())
+    rng = np.random.default_rng(seed)
+    print(f"osdmap fuzz seed {seed}", flush=True)
+    budget = int(os.environ.get("CEPH_TPU_FUZZ_SECONDS", "900"))
+    t0 = time.time()
+    trial = 0
+    while time.time() - t0 < budget:
+        trial += 1
+        n = int(rng.integers(8, 64))
+        pg_num = int(rng.integers(4, 96))  # non-power-of-two on purpose
+        erasure = rng.random() < 0.4
+        size = int(rng.integers(2, 5)) if not erasure \
+            else int(rng.integers(3, 6))
+        m = build_osdmap(
+            n, pg_num=pg_num, size=size,
+            pool_kind="erasure" if erasure else "replicated")
+        pool = m.pools[1]
+        for o in rng.choice(n, int(rng.integers(0, n // 4 + 1)), replace=False):
+            m.mark_down(int(o))
+        for o in rng.choice(n, int(rng.integers(0, n // 4 + 1)), replace=False):
+            m.mark_out(int(o))
+        for o in rng.choice(n, int(rng.integers(0, n // 3 + 1)), replace=False):
+            m.osd_weight[int(o)] = int(rng.integers(1, 0x10000))
+        for o in rng.choice(n, int(rng.integers(0, n // 4 + 1)), replace=False):
+            m.osd_primary_affinity[int(o)] = int(rng.integers(0, 0x10001))
+        for ps in rng.choice(pg_num, int(rng.integers(0, 8)), replace=False):
+            pg = PGId(1, int(ps))
+            kind = int(rng.integers(0, 4))
+            if kind == 0:
+                m.pg_upmap[pg] = tuple(
+                    int(x) for x in rng.choice(n, size, replace=False))
+            elif kind == 1:
+                pairs = []
+                for _ in range(int(rng.integers(1, 3))):
+                    pairs.append((int(rng.integers(0, n)),
+                                  int(rng.integers(0, n))))
+                m.pg_upmap_items[pg] = tuple(pairs)
+            elif kind == 2:
+                k = int(rng.integers(1, size + 1))
+                m.pg_temp[pg] = tuple(
+                    int(x) for x in rng.choice(n, k, replace=False))
+                if rng.random() < 0.5:
+                    m.primary_temp[pg] = int(rng.integers(0, n))
+            else:
+                m.primary_temp[pg] = int(rng.integers(0, n))
+        try:
+            _assert_pool_agrees(m, pool)
+        except AssertionError:
+            print(f"MISMATCH trial {trial} seed {seed}: n={n} "
+                  f"pg_num={pg_num} size={size} erasure={erasure}",
+                  flush=True)
+            raise
+        if trial % 10 == 0:
+            print(f"trial {trial} ok ({time.time() - t0:.0f}s)", flush=True)
+    print(f"DONE: {trial} trials clean in {time.time() - t0:.0f}s",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
