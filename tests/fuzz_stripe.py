@@ -1,0 +1,75 @@
+"""Randomized ECUtil stripe-layer fuzz: whole-object encode across
+every plugin family, random object sizes and stripe widths, random
+dropped shards plus post-selection read failures (the EIO re-selection
+path) — decode_object must reassemble bit-exactly or refuse ONLY when
+minimum_to_decode agrees the remaining shards are insufficient.
+
+NOT collected by pytest — run manually:
+
+    env -u PYTHONPATH CEPH_TPU_TEST_REEXEC=1 PYTHONPATH=/root/repo \\
+      JAX_PLATFORMS=cpu python tests/fuzz_stripe.py
+
+Budget via CEPH_TPU_FUZZ_SECONDS (default 900).
+"""
+
+import os
+import time, sys
+import numpy as np
+_REPO = __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+from ceph_tpu.ec import create
+from ceph_tpu.ec.interface import ErasureCodeError
+from ceph_tpu.ec.stripe import encode_object, decode_object
+
+seed = int(time.time())
+rng = np.random.default_rng(seed)
+print(f"stripe fuzz seed {seed}", flush=True)
+PROFILES = [
+    {"plugin": "jerasure", "technique": "reed_sol_van", "k": "4", "m": "2"},
+    {"plugin": "jerasure", "technique": "cauchy_good", "k": "3", "m": "3", "packetsize": "8"},
+    {"plugin": "isa", "k": "5", "m": "2"},
+    {"plugin": "lrc", "k": "4", "m": "2", "l": "3"},
+    {"plugin": "shec", "k": "4", "m": "3", "c": "2"},
+    {"plugin": "clay", "k": "4", "m": "2"},
+]
+t0 = time.time(); trial = 0
+while time.time() - t0 < int(os.environ.get("CEPH_TPU_FUZZ_SECONDS", "900")):
+    trial += 1
+    prof = PROFILES[int(rng.integers(0, len(PROFILES)))]
+    ec = create(dict(prof))
+    n = ec.get_chunk_count()
+    size = int(rng.integers(1, 60000))
+    data = rng.integers(0, 256, size, dtype=np.uint8)
+    # stripe width: multiple of k * alignment
+    su = int(rng.choice([1, 2, 4, 8])) * 64
+    sw = ec.get_data_chunk_count() * su
+    try:
+        sinfo, shards = encode_object(ec, data, sw)
+    except ErasureCodeError:
+        continue  # width rejected by plugin alignment — acceptable
+    # drop a random subset of shards entirely; mark some failed later
+    ids = list(shards)
+    drop = set(int(x) for x in rng.choice(n, int(rng.integers(0, 3)), replace=False))
+    failed = set(int(x) for x in rng.choice(n, int(rng.integers(0, 2)), replace=False))
+    present = {s: v for s, v in shards.items() if s not in drop}
+    try:
+        out = decode_object(ec, sinfo, present, size, failed=failed)
+        ok = True
+    except ErasureCodeError:
+        ok = False
+    if ok:
+        assert out == data.tobytes(), (prof, sorted(drop), sorted(failed), size, sw)
+    else:
+        # decode refused: must be genuinely unrecoverable from the
+        # remaining shards (claim check through minimum_to_decode)
+        avail = set(present) - failed
+        k = ec.get_data_chunk_count()
+        try:
+            ec.minimum_to_decode(set(range(k)), avail)
+            recoverable = True
+        except ErasureCodeError:
+            recoverable = False
+        assert not recoverable, (prof, sorted(drop), sorted(failed), "refused a recoverable read")
+    if trial % 25 == 0:
+        print(f"trial {trial} ok ({time.time()-t0:.0f}s)", flush=True)
+print(f"DONE: {trial} stripe trials clean in {time.time()-t0:.0f}s", flush=True)
