@@ -10,6 +10,9 @@ a hang to lowering, Mosaic compile, or on-device execution:
 
   step 0  attach + tiny op (tunnel health)
   step 1  flat engine control at mid size  (known-good: compile + run)
+  step 1b BARE whole-descent kernel program (one descend_fused call,
+          no engine around it) at small size — isolates the Mosaic
+          kernel compile from the engine's XLA program compile
   step 2  per-level kernels (mode 'level') at small then mid size —
           ~levels-x smaller Mosaic programs; verified vs flat
   step 3  whole-descent kernel (mode '1') at small size
@@ -118,6 +121,55 @@ def main() -> int:
         say("step 0 ok")
 
         flat_res, flat_lens = phase("flat_mid", "0", N_MID)
+
+        # bare whole-descent kernel: ONE descend_fused call with no
+        # engine around it — if this compile alone blows up, the
+        # pathology is the Mosaic kernel itself; if this is fast but
+        # the engine phases below hang, it's the surrounding XLA
+        # program (e.g. per-call-site kernel recompiles)
+        try:
+            say(f"bare kernel: build pack (n={N_SMALL})")
+            os.environ["CEPH_TPU_LEVEL_KERNEL"] = "1"
+            os.environ["CEPH_TPU_RETRY_COMPACT"] = "0"
+            from ceph_tpu.core import pallas_straw2
+            from ceph_tpu.crush import interp_batch
+            from ceph_tpu.crush.map import OP_TAKE, OP_CHOOSELEAF_FIRSTN
+
+            take = next(s for s in rule.steps if s.op == OP_TAKE)
+            choose = next(
+                s for s in rule.steps if s.op == OP_CHOOSELEAF_FIRSTN)
+            pack, _ = interp_batch.build_pack(
+                dense, [-1 - take.arg1], choose.arg2, {})
+            assert pack.desc_tb is not None, "fused table unavailable"
+            meta = pack.desc_meta
+
+            def bare(x, r, lidx, act, tbl):
+                return pallas_straw2.descend_fused(
+                    x, r, lidx, act, tbl, meta, choose.arg2, False,
+                    dense.max_devices)
+
+            jbare = jax.jit(bare)
+            xs = jnp.arange(N_SMALL, dtype=jnp.uint32)
+            rv = jnp.zeros((N_SMALL,), jnp.uint32)
+            lidx = jnp.zeros((N_SMALL,), jnp.int32)
+            act = jnp.ones((N_SMALL,), bool)
+            t = time.perf_counter()
+            lowered = jbare.lower(xs, rv, lidx, act, pack.desc_tb)
+            out["bare_lower_s"] = round(time.perf_counter() - t, 1)
+            say(f"bare kernel: lowered in {out['bare_lower_s']}s; compiling")
+            t = time.perf_counter()
+            compiled = lowered.compile()
+            out["bare_compile_s"] = round(time.perf_counter() - t, 1)
+            say(f"bare kernel: compiled in {out['bare_compile_s']}s; executing")
+            t = time.perf_counter()
+            res = compiled(xs, rv, lidx, act, pack.desc_tb)
+            for leaf in jax.tree_util.tree_leaves(res):
+                np.asarray(leaf)
+            out["bare_exec_s"] = round(time.perf_counter() - t, 2)
+            say(f"bare kernel: exec+readback {out['bare_exec_s']}s")
+        except Exception as e:  # noqa: BLE001 — bank, keep going
+            out["bare_error"] = f"{type(e).__name__}: {e}"[:300]
+            say(f"bare kernel FAILED: {out['bare_error']}")
 
         # per-level kernels first: ~levels-x smaller Mosaic programs,
         # so if the whole-descent compile is the pathology these still
