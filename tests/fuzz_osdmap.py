@@ -54,7 +54,8 @@ def main() -> int:
             m.osd_weight[int(o)] = int(rng.integers(1, 0x10000))
         for o in rng.choice(n, int(rng.integers(0, n // 4 + 1)), replace=False):
             m.osd_primary_affinity[int(o)] = int(rng.integers(0, 0x10001))
-        for ps in rng.choice(pg_num, int(rng.integers(0, 8)), replace=False):
+        n_mut = int(rng.integers(0, min(8, pg_num + 1)))
+        for ps in rng.choice(pg_num, n_mut, replace=False):
             pg = PGId(1, int(ps))
             kind = int(rng.integers(0, 4))
             if kind == 0:
