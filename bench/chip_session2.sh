@@ -23,6 +23,11 @@
 #   (even steps are health probes)
 #
 # Usage: bash bench/chip_session2.sh [ROUND]   (from the repo root)
+#
+# CEPH_TPU_SESSION_TRIM=1 runs only the bounded steps (configs 1-5,
+# compaction probe, headline re-run) — for a tunnel recovery late in
+# the round, when the unbounded kernel steps could straddle the round
+# end and collide with the driver's own bench attach.
 
 set -u -o pipefail
 cd "$(dirname "$0")/.."
@@ -63,6 +68,15 @@ EOF
   CEPH_TPU_PROBE_GRID="fused_straw2,fused_straw2_compact" \
     python bench/level_kernel_probe.py \
     || { echo "STEP FAILED: level_kernel_probe.py"; rc_total=1; }
+
+  if [ "${CEPH_TPU_SESSION_TRIM:-0}" = "1" ]; then
+    echo "--- TRIM: skipping ablation/forensics/tier; headline re-run only ---"
+    if ! probe; then echo "ABORT: tunnel degraded after compaction probe"; exit 1; fi
+    CEPH_TPU_BENCH_TIMEOUT=1500 python bench.py \
+      || { echo "STEP FAILED: bench.py rerun"; rc_total=1; }
+    echo "=== session 2 (trimmed) done $(date -u +%H:%M:%SZ) rc=$rc_total ==="
+    exit "$rc_total"
+  fi
 
   echo "--- step 4: inter-step probe ---"
   if ! probe; then echo "ABORT: tunnel degraded after compaction probe"; exit 1; fi
