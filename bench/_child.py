@@ -1,0 +1,55 @@
+"""Shared no-kill child runner for every bench entry point.
+
+Timeout discipline (round-4 lesson, chip_session_r4.log): SIGKILLing a
+process attached to the TPU wedges this machine's tunnel for hours —
+``subprocess.run(timeout=...)`` does exactly that.  On timeout we send
+SIGINT instead: a Python child executing bytecode raises
+KeyboardInterrupt and exits through normal interpreter finalization
+(atexit, destructors — the PJRT client detaches cleanly), while a child
+blocked inside a C extension call (a hung TPU attach) never sees the
+signal — and that is the desired outcome: it gets ORPHANED, not killed,
+because a hung attach left alone self-resolves in ~25-45 min whereas a
+kill converts it into an hours-long wedge.
+"""
+
+from __future__ import annotations
+
+import signal
+import subprocess
+import sys
+
+
+def communicate_no_kill(
+    proc: subprocess.Popen,
+    timeout_s: float,
+    grace_s: float = 20.0,
+    label: str = "child",
+) -> tuple[str, str, bool]:
+    """``proc.communicate`` with the no-kill timeout discipline.
+
+    Returns ``(stdout, stderr, timed_out)``.  On timeout the child gets
+    SIGINT and ``grace_s`` to exit cleanly; if it is still alive after
+    that (blocked in a C-level attach), it is left running — NEVER
+    SIGKILLed — and empty output is returned.
+    """
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+        return stdout or "", stderr or "", False
+    except subprocess.TimeoutExpired:
+        pass
+    try:
+        proc.send_signal(signal.SIGINT)
+    except ProcessLookupError:
+        pass
+    try:
+        stdout, stderr = proc.communicate(timeout=grace_s)
+        return stdout or "", stderr or "", True
+    except subprocess.TimeoutExpired:
+        print(
+            f"{label}: pid {proc.pid} did not exit on SIGINT after "
+            f"{timeout_s:.0f}s+{grace_s:.0f}s; leaving it attached — "
+            "never SIGKILL a TPU-attached process (it wedges the tunnel)",
+            file=sys.stderr,
+            flush=True,
+        )
+        return "", "", True

@@ -26,6 +26,8 @@ import sys
 import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _child import communicate_no_kill  # noqa: E402
 
 CONFIGS = [
     ("config1_crush", "bench/config1_crush.py"),
@@ -42,29 +44,38 @@ def _run_one(name: str, path: str, timeout: int) -> dict:
     cfg_hash = hashlib.sha256(open(full, "rb").read()).hexdigest()[:12]
     t0 = time.perf_counter()
     rec: dict = {"config": name, "config_hash": cfg_hash}
-    try:
-        proc = subprocess.run(
-            [sys.executable, full],
-            cwd=_REPO,
-            capture_output=True,
-            text=True,
-            timeout=timeout,
-        )
-        rec["rc"] = proc.returncode
-        # last JSON-looking stdout line is the result
-        for line in reversed(proc.stdout.strip().splitlines()):
-            line = line.strip()
-            if line.startswith("{"):
-                try:
-                    rec["result"] = json.loads(line)
-                    break
-                except json.JSONDecodeError:
-                    continue
-        if "result" not in rec:
-            rec["error"] = (proc.stderr or proc.stdout)[-500:]
-    except subprocess.TimeoutExpired:
+    # last-resort timeout discipline: bench/_child.py — SIGINT then
+    # orphan, never SIGKILL (the proven tunnel-wedge mechanism)
+    proc = subprocess.Popen(
+        [sys.executable, full],
+        cwd=_REPO,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    stdout, stderr, timed_out = communicate_no_kill(
+        proc, timeout, label=f"run_all[{name}]"
+    )
+    # last JSON-looking stdout line is the result — scanned even on
+    # timeout, so a config that measured and then hung in teardown
+    # still banks its measurement (the module's whole point)
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec["result"] = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+    if timed_out:
         rec["rc"] = -1
         rec["error"] = f"timeout after {timeout}s"
+        if "result" in rec:
+            rec["teardown_timed_out"] = True
+    else:
+        rec["rc"] = proc.returncode
+        if "result" not in rec:
+            rec["error"] = (stderr or stdout)[-500:]
     rec["seconds"] = round(time.perf_counter() - t0, 1)
     return rec
 

@@ -14,23 +14,39 @@ import sys
 import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _child import communicate_no_kill  # noqa: E402
 
 
 def main() -> int:
     env = dict(os.environ)
     env["CEPH_TPU_TEST_REEXEC"] = "1"  # keep the TPU plugin in place
     t0 = time.perf_counter()
-    proc = subprocess.run(
+    timeout = int(os.environ.get("CEPH_TPU_TIER_TIMEOUT", "1500"))
+    # timeout discipline: bench/_child.py — SIGINT then orphan, never
+    # SIGKILL a TPU-attached child (the tunnel-wedge mechanism)
+    proc = subprocess.Popen(
         [sys.executable, "-m", "pytest", "tests/test_tpu_device.py",
          "-q", "--no-header", "-p", "no:cacheprovider"],
         cwd=_REPO,
         env=env,
-        capture_output=True,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
         text=True,
-        timeout=int(os.environ.get("CEPH_TPU_TIER_TIMEOUT", "1500")),
     )
+    stdout, stderr, timed_out = communicate_no_kill(
+        proc, timeout, label="tpu tier"
+    )
+    if timed_out and "passed" not in (stdout or ""):
+        # nothing salvageable: no pytest summary line reached stdout
+        print(json.dumps({
+            "metric": "tpu_tier", "passed": 0, "failed": 0, "skipped": 0,
+            "seconds": round(time.perf_counter() - t0, 1),
+            "error": f"timeout after {timeout}s",
+        }))
+        return 1
     dt = time.perf_counter() - t0
-    tail = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    tail = stdout.strip().splitlines()[-1] if stdout.strip() else ""
     passed = failed = skipped = 0
     for tok in tail.replace(",", " ").split():
         if tok.isdigit():
@@ -50,7 +66,7 @@ def main() -> int:
         "summary": tail,
     }))
     if proc.returncode != 0:
-        sys.stderr.write(proc.stdout[-2000:] + proc.stderr[-2000:])
+        sys.stderr.write(stdout[-2000:] + stderr[-2000:])
         return proc.returncode
     if passed == 0:
         # all-skipped (no TPU attached) must not read as device

@@ -255,13 +255,21 @@ class ErasureCodeLrc(ErasureCode):
                 have_here = [p for p in layer.positions if p in feas_have]
                 needed = len(layer.data_pos)
                 if lost_here and len(have_here) >= needed:
+                    # inputs already present (prior reads OR prior
+                    # repairs) are free: only chunks appended by the
+                    # fresh-available loop below cost a read.  A
+                    # present-sourced chunk can be in ``available``
+                    # without ever having been read (a prior layer
+                    # repair regenerates ALL its positions), so
+                    # filtering sel by ``available`` would claim
+                    # redundant reads (round-4 ADVICE).
                     sel = [p for p in have_here if p in present][:needed]
                     for p in have_here:
                         if len(sel) >= needed:
                             break
                         if p not in sel and p in available:
                             sel.append(p)
-                    read |= set(sel) & available
+                            read.add(p)
                     present |= set(sel) | set(layer.positions)
                     feas_have |= set(layer.positions)
                     progress = True

@@ -45,3 +45,130 @@ def test_total_failure_still_emits_schema():
     assert out["vs_baseline"] == 0.0
     assert "cpu_fallback_rate" not in out
     assert "error" in out
+
+
+# --- bank-and-carry (round-4 verdict, missing item 5) ---
+
+_BANKED = {
+    "value": 1_795_466,
+    "unit": "placements/s",
+    "platform": "tpu",
+    "level_kernel": False,
+    "timestamp_utc": "2026-07-31T03:50:00Z",
+    "source": "chip_session_r4.log step 1",
+}
+
+
+def test_fallback_carries_banked_silicon_result():
+    out = bench.format_result(
+        {"rate": 50_000.0, "platform": "cpu"},
+        150_000.0,
+        ["tpu attempt 1: timeout after 420s"],
+        banked=_BANKED,
+    )
+    # the fallback stays unmistakable: headline fields still zeroed...
+    assert out["metric"] == "crush_placements_per_sec_cpu_fallback"
+    assert out["value"] == 0
+    # ...but the banked silicon measurement rides along, fully attributed
+    assert out["banked_value"] == 1_795_466
+    assert out["banked_platform"] == "tpu"
+    assert out["banked_timestamp_utc"] == "2026-07-31T03:50:00Z"
+    assert out["banked_source"] == "chip_session_r4.log step 1"
+    assert out["banked_vs_baseline"] == 11.97
+
+
+def test_device_result_ignores_banked():
+    out = bench.format_result(
+        {"rate": 2_000_000.0, "platform": "tpu"}, 200_000.0, [], banked=_BANKED
+    )
+    assert out["metric"] == "crush_placements_per_sec"
+    assert "banked_value" not in out
+
+
+def test_bank_roundtrip(tmp_path):
+    p = str(tmp_path / "bank.json")
+    bench.save_banked(_BANKED, path=p)
+    assert bench.load_banked(path=p) == _BANKED
+
+
+def test_bank_missing_or_corrupt_is_none(tmp_path):
+    assert bench.load_banked(path=str(tmp_path / "absent.json")) is None
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert bench.load_banked(path=str(bad)) is None
+
+
+def test_committed_bank_is_loadable():
+    """The repo ships the round-4 banked headline; a wedge at scoring time
+    must find it."""
+    b = bench.load_banked()
+    assert b is not None
+    # any positive banked value is legitimate (a live device run may
+    # bank a lower-but-honest rate); what matters is full attribution
+    assert b["value"] > 0
+    assert b["platform"] == "tpu"
+    assert b["timestamp_utc"] and b["source"]
+
+
+# --- baseline hygiene (round-4 verdict, weak item 3) ---
+
+
+def _write_pin(tmp_path, rate):
+    import json
+
+    p = tmp_path / "pin.json"
+    p.write_text(
+        json.dumps(
+            {"cpu_ref_placements_per_sec": rate, "timestamp_utc": "2026-07-31T16:00:00Z"}
+        )
+    )
+    return str(p)
+
+
+def test_loaded_host_uses_pinned_baseline(tmp_path):
+    # 26K/s measured while the pin says 150K/s unloaded -> host is loaded;
+    # vs_baseline must come from the pin (the round-4 69x bug)
+    p = _write_pin(tmp_path, 150_000)
+    rate, info = bench.resolve_baseline(26_000.0, path=p)
+    assert rate == 150_000
+    assert info["cpu_ref_source"] == "pinned"
+    assert info["cpu_ref_measured_now"] == 26_000
+
+
+def test_unloaded_measurement_is_trusted_and_refreshes_pin(tmp_path):
+    import json
+
+    p = _write_pin(tmp_path, 150_000)
+    rate, info = bench.resolve_baseline(156_000.0, path=p)
+    assert rate == 156_000.0
+    assert info["cpu_ref_source"] == "measured"
+    pin = json.loads(open(p).read())
+    assert pin["cpu_ref_placements_per_sec"] == 156_000
+
+
+def test_near_pin_measurement_is_trusted_without_refresh(tmp_path):
+    import json
+
+    p = _write_pin(tmp_path, 150_000)
+    rate, info = bench.resolve_baseline(140_000.0, path=p)
+    assert rate == 140_000.0
+    assert info["cpu_ref_source"] == "measured"
+    assert json.loads(open(p).read())["cpu_ref_placements_per_sec"] == 150_000
+
+
+def test_no_pin_trusts_measurement_without_seeding(tmp_path):
+    # with no reference a loaded host is indistinguishable from an
+    # unloaded one — the measurement is used but must NOT become a pin
+    p = tmp_path / "none.json"
+    rate, info = bench.resolve_baseline(100_000.0, path=str(p))
+    assert rate == 100_000.0
+    assert info["cpu_ref_source"] == "measured"
+    assert info["cpu_ref_pin"] == "absent"
+    assert not p.exists()
+
+
+def test_failed_measurement_falls_back_to_pin(tmp_path):
+    p = _write_pin(tmp_path, 150_000)
+    rate, info = bench.resolve_baseline(0.0, path=p)
+    assert rate == 150_000
+    assert info["cpu_ref_source"] == "pinned"

@@ -100,6 +100,26 @@ def test_lrc_minimum_to_decode_lockstep_with_decode():
     assert checked > 200 and claimed_no > 0  # both branches exercised
 
 
+def test_lrc_minimum_to_decode_excludes_regenerated_chunks():
+    """A chunk regenerated for free by an earlier layer repair must not
+    be claimed as a read, even when it is also available (round-4
+    ADVICE: the old ``sel & available`` bookkeeping returned correct
+    but non-minimal sets).  k=4 m=2 l=3, lost {4,5}: the global layer
+    repairs chunk 4 from {1,2,3} + one global parity, regenerating
+    chunk 5's whole layer as a side effect — 4 reads, not 5."""
+    ec = create({"plugin": "lrc", "k": "4", "m": "2", "l": "3"})
+    n = ec.get_chunk_count()
+    obj = rand_bytes(random.Random(7), 2000)
+    enc = ec.encode(set(range(n)), obj)
+    cs = len(enc[0])
+    avail = set(range(n)) - {4, 5}
+    minimum = ec.minimum_to_decode({4, 5}, avail)
+    assert len(minimum) == 4, sorted(minimum)
+    # still sufficient on its own
+    dec = ec.decode({4, 5}, {i: enc[i] for i in minimum}, cs)
+    assert np.array_equal(dec[4], enc[4]) and np.array_equal(dec[5], enc[5])
+
+
 def test_lrc_explicit_mapping_profile():
     import json
 

@@ -152,6 +152,40 @@ def test_append_tunnel_down_preserves_prior_record(monkeypatch, tmp_path):
     assert "not launched" in by_name["tpu_tier"]["error"]
 
 
+def test_communicate_no_kill_salvages_stdout_on_grace_exit():
+    """A child that printed its result and then hung must hand the
+    output back on the SIGINT grace-exit path (round-5 review: dropping
+    it re-creates the wedge-erases-a-real-result failure)."""
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "print('RESULT 42', flush=True)\nimport time\ntime.sleep(60)"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    out, _err, timed_out = run_all.communicate_no_kill(proc, 1.5)
+    assert timed_out
+    assert "RESULT 42" in out
+
+
+def test_run_one_salvages_result_printed_before_teardown_hang(tmp_path):
+    import textwrap
+
+    stub = tmp_path / "stub_cfg.py"
+    stub.write_text(textwrap.dedent("""
+        import json, time
+        print(json.dumps({"metric": "stub", "value": 7}), flush=True)
+        time.sleep(60)
+    """))
+    rec = run_all._run_one(
+        "stub", os.path.relpath(str(stub), run_all._REPO), timeout=2
+    )
+    assert rec["rc"] == -1
+    assert rec["teardown_timed_out"] is True
+    assert rec["result"]["value"] == 7
+
+
 def test_unfiltered_configs_cover_all_baseline_configs():
     names = [n for n, _ in run_all.CONFIGS]
     assert names == [
