@@ -67,13 +67,17 @@ def load_banked(path: str | None = None) -> dict | None:
         return None
 
 
-def save_banked(entry: dict, path: str | None = None) -> None:
+def _write_json(path: str, obj: dict, what: str) -> None:
     try:
-        with open(path or _BANK_PATH, "w") as f:
-            json.dump(entry, f, indent=1)
+        with open(path, "w") as f:
+            json.dump(obj, f, indent=1)
             f.write("\n")
-    except Exception as e:  # noqa: BLE001
-        print(f"bench: bank write failed: {e}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — never kill the JSON line
+        print(f"bench: {what} write failed: {e}", file=sys.stderr)
+
+
+def save_banked(entry: dict, path: str | None = None) -> None:
+    _write_json(path or _BANK_PATH, entry, "bank")
 
 
 def resolve_baseline(measured: float, path: str | None = None) -> tuple[float, dict]:
@@ -107,20 +111,15 @@ def resolve_baseline(measured: float, path: str | None = None) -> tuple[float, d
             "cpu_ref_pinned_at": pin.get("timestamp_utc"),
         }
     if measured > pinned:
-        try:
-            with open(path, "w") as f:
-                json.dump(
-                    {
-                        "cpu_ref_placements_per_sec": round(measured),
-                        "timestamp_utc": _utcnow(),
-                        "note": "best observed unloaded single-core C++ rate",
-                    },
-                    f,
-                    indent=1,
-                )
-                f.write("\n")
-        except Exception as e:  # noqa: BLE001
-            print(f"bench: pin refresh failed: {e}", file=sys.stderr)
+        _write_json(
+            path,
+            {
+                "cpu_ref_placements_per_sec": round(measured),
+                "timestamp_utc": _utcnow(),
+                "note": "best observed unloaded single-core C++ rate",
+            },
+            "pin refresh",
+        )
     return measured, {"cpu_ref_source": "measured"}
 
 
@@ -286,7 +285,9 @@ def _main_guarded() -> int:
             result = r
             break
         errors.append(f"tpu attempt {attempt}: {(r or {}).get('error')}")
-        if r and r.get("timed_out"):
+        if r and (r.get("timed_out") or r.get("teardown_timed_out")):
+            # either way a child is (or was) hung past the timeout —
+            # don't launch another attach against an occupied tunnel
             break
     # CAUTION for opt-in users: a kernel child that blows its timeout
     # mid-compile gets orphaned still attached (bench/_child.py), tying
@@ -404,6 +405,10 @@ def format_result(
         out["platform"] = platform
     if result is not None and "level_kernel" in result:
         out["level_kernel"] = result["level_kernel"]
+    if result is not None and result.get("teardown_timed_out"):
+        # the measurement is valid but its child was orphaned mid-detach
+        # — a monitored session must know the tunnel is still occupied
+        out["teardown_timed_out"] = True
     out["cpu_ref_placements_per_sec"] = round(cpu_rate)
     if errors:
         out["error"] = "; ".join(e for e in errors if e)

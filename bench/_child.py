@@ -44,7 +44,7 @@ def communicate_no_kill(
     try:
         stdout, stderr = proc.communicate(timeout=grace_s)
         return stdout or "", stderr or "", True
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
         print(
             f"{label}: pid {proc.pid} did not exit on SIGINT after "
             f"{timeout_s:.0f}s+{grace_s:.0f}s; leaving it attached — "
@@ -52,4 +52,15 @@ def communicate_no_kill(
             file=sys.stderr,
             flush=True,
         )
-        return "", "", True
+        # the orphan may already have printed its result before blocking
+        # (e.g. measured, then hung in PJRT detach): TimeoutExpired
+        # carries the partial output — as bytes even with text=True
+        return _decode(e.stdout), _decode(e.stderr), True
+
+
+def _decode(v) -> str:
+    if v is None:
+        return ""
+    if isinstance(v, bytes):
+        return v.decode(errors="replace")
+    return v

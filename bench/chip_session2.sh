@@ -19,7 +19,15 @@
 #   step 7   clean headline re-run (warm cache, unloaded baseline)
 #   step 9   whole-descent kernel forensics (unbounded compile risk)
 #   step 11  silicon test tier, appended to BENCH_DETAIL (kill risk
-#            only at the 7200s last resort; dead last on purpose)
+#            only at the 7200s last resort)
+#   step 13  FULL kernel-mode grid at 1M (level_only / level_kernel /
+#            level_kernel_compact) — the artifact that flips the
+#            CEPH_TPU_LEVEL_KERNEL / CEPH_TPU_RETRY_COMPACT defaults.
+#            Dead last on purpose: level_kernel_compact compiles a
+#            fresh ~2x-sized Mosaic program (chipless AOT went >17 min
+#            once), so a hang here forfeits nothing else.  Only runs
+#            if forensics (step 9) exited clean — a kernel that hung
+#            forensics would hang the grid too.
 #   (even steps are health probes)
 #
 # Usage: bash bench/chip_session2.sh [ROUND]   (from the repo root)
@@ -96,8 +104,9 @@ EOF
   if ! probe; then echo "ABORT: tunnel degraded after headline re-run"; exit 1; fi
 
   echo "--- step 9: whole-descent kernel forensics ---"
+  forensics_rc=0
   python bench/kernel_forensics.py \
-    || { echo "STEP FAILED: kernel_forensics.py"; rc_total=1; }
+    || { echo "STEP FAILED: kernel_forensics.py"; rc_total=1; forensics_rc=1; }
 
   echo "--- step 10: inter-step probe ---"
   if ! probe; then echo "ABORT: tunnel degraded after forensics"; exit 1; fi
@@ -109,6 +118,18 @@ EOF
     python bench/run_all.py --round "$R" --timeout 7200 --append \
     --only tpu_tier \
     || { echo "STEP FAILED: tpu_tier"; rc_total=1; }
+
+  if [ "$forensics_rc" = "0" ]; then
+    echo "--- step 12: inter-step probe ---"
+    if ! probe; then echo "ABORT: tunnel degraded after tier"; exit 1; fi
+
+    echo "--- step 13: full kernel-mode grid at 1M (default-flip artifact) ---"
+    CEPH_TPU_PROBE_GRID="level_only,level_kernel,level_kernel_compact" \
+      python bench/level_kernel_probe.py \
+      || { echo "STEP FAILED: kernel grid"; rc_total=1; }
+  else
+    echo "--- step 13 SKIPPED: forensics failed, kernel grid would hang ---"
+  fi
 
   echo "=== session 2 done $(date -u +%H:%M:%SZ) rc=$rc_total ==="
   exit "$rc_total"

@@ -17,6 +17,9 @@ cd "$(dirname "$0")/.."
 R=${1:-5}
 WAIT_PID=${2:-}
 DEADLINE=${CEPH_TPU_ROUND_DEADLINE:-0}
+# a set-but-empty or non-numeric deadline must degrade to "unknown",
+# not silently disable every numeric comparison below
+case "$DEADLINE" in ""|*[!0-9]*) DEADLINE=0;; esac
 LOG="watch_r${R}.log"
 
 say() { echo "[$(date -u +%H:%M:%SZ)] $*" >> "$LOG"; }

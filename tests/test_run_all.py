@@ -169,6 +169,27 @@ def test_communicate_no_kill_salvages_stdout_on_grace_exit():
     assert "RESULT 42" in out
 
 
+def test_communicate_no_kill_salvages_stdout_from_orphan():
+    """Even a child that never dies (SIGINT ignored — the C-blocked
+    PJRT-detach hang mode) must hand back what it printed before
+    blocking: TimeoutExpired carries the partial output."""
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import signal; signal.signal(signal.SIGINT, signal.SIG_IGN)\n"
+         "print('BANKED 7', flush=True)\nimport time\ntime.sleep(15)"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    out, _err, timed_out = run_all.communicate_no_kill(
+        proc, 1.0, grace_s=1.0
+    )
+    assert timed_out
+    assert "BANKED 7" in out
+    assert proc.poll() is None  # orphaned, not killed
+
+
 def test_run_one_salvages_result_printed_before_teardown_hang(tmp_path):
     import textwrap
 
