@@ -1,0 +1,112 @@
+"""Turn a chip session's grid artifacts into a default-mode decision.
+
+Round-4 verdict item 8: whichever (kernel-mode x compaction) cell wins
+the on-chip grid becomes the engine default — decided from data, not
+hope, with the artifact cited.  This tool reads the session log (the
+JSON lines emitted by bench/level_kernel_probe.py and
+bench/kernel_forensics.py), merges every measured 1M-batch rate, and
+prints one JSON line naming the winner, whether the 6.25 M
+placements/s/chip target (BASELINE.md: 1/8 of the 50 M/s v5e-8 north
+star) is met, and the env defaults to flip.
+
+Usage::
+
+    python bench/decide_defaults.py [chip_session2_r5.log ...]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+TARGET_PER_CHIP = 6_250_000
+
+# grid tag -> (CEPH_TPU_LEVEL_KERNEL, CEPH_TPU_RETRY_COMPACT)
+MODES = {
+    "fused_straw2": ("0", "0"),
+    "fused_straw2_compact": ("0", "1"),
+    "level_only": ("level", "0"),
+    "level_kernel": ("1", "0"),
+    "level_kernel_compact": ("1", "1"),
+    # forensics' full-size chained rate is the whole-descent kernel
+    "kern_full": ("1", "0"),
+}
+
+
+def harvest(paths: list[str]) -> dict[str, int]:
+    """Collect tag -> placements/s from every JSON line in the logs.
+
+    Only ``platform: "tpu"`` lines count: a CPU smoke-run line in the
+    same log must never crown the winner (the repo invariant that a
+    host-backend rate can never pass as a device result — round-3
+    verdict, tests/test_bench_schema.py).
+    """
+    rates: dict[str, int] = {}
+    for path in paths:
+        try:
+            lines = open(path).read().splitlines()
+        except OSError as e:
+            print(f"decide_defaults: cannot read {path}: {e}",
+                  file=sys.stderr)
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if d.get("platform") != "tpu":
+                continue
+            if d.get("metric") == "level_kernel_probe":
+                for tag in MODES:
+                    if tag == "kern_full":
+                        continue  # forensics-only, gated on its error field
+                    r = d.get(f"{tag}_rate_per_sec")
+                    if r and d.get(f"{tag}_ok", True):
+                        rates[tag] = max(rates.get(tag, 0), int(r))
+            elif d.get("metric") == "kernel_forensics":
+                r = d.get("kern_full_rate_per_sec")
+                if r and not d.get("error"):
+                    rates["kern_full"] = max(rates.get("kern_full", 0), int(r))
+    return rates
+
+
+def decide(rates: dict[str, int], sources: list[str]) -> dict:
+    out: dict = {
+        "metric": "default_decision",
+        "target_per_chip": TARGET_PER_CHIP,
+        "rates": dict(sorted(rates.items(), key=lambda kv: -kv[1])),
+        "sources": sources,
+    }
+    if not rates:
+        out["decision"] = "no measured rates found — defaults unchanged"
+        return out
+    winner = max(rates, key=lambda k: rates[k])
+    kmode, cmode = MODES[winner]
+    out["winner"] = winner
+    out["winner_rate_per_sec"] = rates[winner]
+    out["target_met"] = rates[winner] >= TARGET_PER_CHIP
+    out["recommend_env"] = {
+        "CEPH_TPU_LEVEL_KERNEL": kmode,
+        "CEPH_TPU_RETRY_COMPACT": cmode,
+    }
+    return out
+
+
+def main() -> int:
+    paths = sys.argv[1:] or ["chip_session2_r5.log"]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        # a typo'd log path must not silently shrink the evidence base
+        print(f"decide_defaults: missing log(s): {missing}", file=sys.stderr)
+        return 2
+    out = decide(harvest(paths), paths)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,89 @@
+"""bench/decide_defaults.py: grid artifact -> default-flip decision."""
+
+import importlib.util
+import json
+import os
+
+_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "bench", "decide_defaults.py",
+)
+_spec = importlib.util.spec_from_file_location("bench_decide", _PATH)
+dd = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(dd)
+
+
+def _log(tmp_path, lines):
+    p = tmp_path / "session.log"
+    p.write_text("\n".join(
+        json.dumps(l) if isinstance(l, dict) else l for l in lines
+    ))
+    return str(p)
+
+
+def test_winner_and_target(tmp_path):
+    p = _log(tmp_path, [
+        "--- step 3 ---",
+        {"metric": "level_kernel_probe", "platform": "tpu",
+         "fused_straw2_rate_per_sec": 1_800_000, "fused_straw2_ok": True,
+         "fused_straw2_compact_rate_per_sec": 4_100_000,
+         "fused_straw2_compact_ok": True},
+        {"metric": "kernel_forensics", "platform": "tpu", "kern_full_rate_per_sec": 14_000_000},
+    ])
+    out = dd.decide(dd.harvest([p]), [p])
+    assert out["winner"] == "kern_full"
+    assert out["winner_rate_per_sec"] == 14_000_000
+    assert out["target_met"] is True
+    assert out["recommend_env"] == {
+        "CEPH_TPU_LEVEL_KERNEL": "1", "CEPH_TPU_RETRY_COMPACT": "0"}
+
+
+def test_failed_variant_and_forensics_error_excluded(tmp_path):
+    p = _log(tmp_path, [
+        {"metric": "level_kernel_probe", "platform": "tpu",
+         "fused_straw2_rate_per_sec": 1_800_000, "fused_straw2_ok": True,
+         "level_kernel_rate_per_sec": 9_000_000, "level_kernel_ok": False},
+        {"metric": "kernel_forensics", "platform": "tpu",
+         "kern_full_rate_per_sec": 20_000_000,
+         "error": "ValueError: exec hang"},
+    ])
+    out = dd.decide(dd.harvest([p]), [p])
+    assert out["winner"] == "fused_straw2"
+    assert out["target_met"] is False
+    assert out["recommend_env"]["CEPH_TPU_LEVEL_KERNEL"] == "0"
+
+
+def test_no_rates(tmp_path):
+    p = _log(tmp_path, ["no json here"])
+    out = dd.decide(dd.harvest([p]), [p])
+    assert "decision" in out and "winner" not in out
+
+
+def test_best_of_multiple_probes(tmp_path):
+    p = _log(tmp_path, [
+        {"metric": "level_kernel_probe", "platform": "tpu",
+         "fused_straw2_rate_per_sec": 1_700_000, "fused_straw2_ok": True},
+        {"metric": "level_kernel_probe", "platform": "tpu",
+         "fused_straw2_rate_per_sec": 1_900_000, "fused_straw2_ok": True},
+    ])
+    assert dd.harvest([p])["fused_straw2"] == 1_900_000
+
+
+def test_cpu_lines_never_crown_a_winner(tmp_path):
+    p = _log(tmp_path, [
+        {"metric": "level_kernel_probe", "platform": "cpu",
+         "level_kernel_rate_per_sec": 99_000_000, "level_kernel_ok": True},
+        {"metric": "level_kernel_probe", "platform": "tpu",
+         "fused_straw2_rate_per_sec": 1_800_000, "fused_straw2_ok": True},
+    ])
+    rates = dd.harvest([p])
+    assert "level_kernel" not in rates
+    assert dd.decide(rates, [p])["winner"] == "fused_straw2"
+
+
+def test_probe_line_cannot_smuggle_kern_full(tmp_path):
+    p = _log(tmp_path, [
+        {"metric": "level_kernel_probe", "platform": "tpu",
+         "kern_full_rate_per_sec": 50_000_000},
+    ])
+    assert dd.harvest([p]) == {}
