@@ -123,6 +123,26 @@ def resolve_baseline(measured: float, path: str | None = None) -> tuple[float, d
     return measured, {"cpu_ref_source": "measured"}
 
 
+def _decided_modes() -> tuple[str, str]:
+    """The committed data-decided (kernel_mode, retry_compact) pair —
+    written only by ``bench/decide_defaults.py --write`` from an
+    on-chip grid artifact; ('0', '0') — the proven flat path — until
+    that artifact exists."""
+    from decide_defaults import DEFAULTS_PATH
+
+    try:
+        with open(DEFAULTS_PATH) as f:
+            d = json.load(f)
+        if not isinstance(d, dict):
+            return "0", "0"
+        m = str(d.get("CEPH_TPU_LEVEL_KERNEL", "0"))
+        c = str(d.get("CEPH_TPU_RETRY_COMPACT", "0"))
+        return (m if m in ("0", "1", "level") else "0",
+                c if c in ("0", "1") else "0")
+    except Exception:  # noqa: BLE001 — absent file is the normal case
+        return "0", "0"
+
+
 def _cpu_baseline() -> float:
     """Single-core C++ reference rate (placements/s) — never touches jax."""
     from ceph_tpu.models.clusters import build_simple
@@ -195,12 +215,17 @@ def _device_measure() -> None:
         except Exception as e:  # noqa: BLE001
             err = f"batch {n}: {type(e).__name__}: {e}"
             print(f"bench child: {err}; retrying smaller", file=sys.stderr)
+    # the modes actually in force at measure time (the cpu branch
+    # overrides the parent's request; interp_batch resolves committed
+    # defaults when the env is unset)
+    from ceph_tpu.crush import interp_batch as _ib
+
     out = {
         "rate": rate,
         "platform": platform,
-        # the mode actually in force at measure time (the cpu branch
-        # overrides the parent's request)
-        "level_kernel": os.environ.get("CEPH_TPU_LEVEL_KERNEL") == "1",
+        "kernel_mode": _ib._kernel_mode(),
+        "retry_compact": _ib._retry_compact(),
+        "level_kernel": _ib._kernel_mode() == "1",
     }
     if err is not None:
         out["error"] = err
@@ -264,9 +289,13 @@ def _main_guarded() -> int:
         measured = 0.0
     cpu_rate, baseline_info = resolve_baseline(measured)
 
-    # Attempt 1: proven flat fused-straw2 path — banks a valid device
-    # number first.  Attempt 2 (opt-in via CEPH_TPU_BENCH_TRY_KERNEL=1,
-    # only after a device success): the whole-descent Pallas kernel.
+    # Attempts 1-2: the historically proven flat path, fully pinned
+    # (kernel AND compaction off) — bank a valid device number FIRST,
+    # whatever any defaults file says.  Only after a device success
+    # does the data-decided mode (bench/kernel_defaults.json, written
+    # from a measured on-chip grid) get an upgrade attempt, taken when
+    # faster; CEPH_TPU_BENCH_TRY_KERNEL=1 forces the whole-descent
+    # upgrade attempt regardless of the decided file.
     # The kernel attempt is OFF by default after the round-4 chip
     # session: its on-chip compile blew a 1500 s child timeout, and the
     # SIGKILL of that mid-compile child is precisely what wedges this
@@ -279,6 +308,7 @@ def _main_guarded() -> int:
     errors = []
     env_flat = dict(os.environ)
     env_flat["CEPH_TPU_LEVEL_KERNEL"] = "0"
+    env_flat["CEPH_TPU_RETRY_COMPACT"] = "0"
     for attempt in (1, 2):
         r = _run_child(env_flat, ATTACH_TIMEOUT_S)
         if r and r.get("rate"):
@@ -289,22 +319,29 @@ def _main_guarded() -> int:
             # either way a child is (or was) hung past the timeout —
             # don't launch another attach against an occupied tunnel
             break
-    # CAUTION for opt-in users: a kernel child that blows its timeout
-    # mid-compile gets orphaned still attached (bench/_child.py), tying
-    # up the tunnel until it self-resolves — only opt in inside a
-    # monitored session, or after the kernel program is known cached.
+    # Upgrade attempt, only after a banked device success: the decided
+    # grid winner (or the whole-descent kernel under the opt-in flag).
+    # CAUTION: an upgrade child that blows its timeout mid-compile gets
+    # orphaned still attached (bench/_child.py), tying up the tunnel
+    # until it self-resolves — the decided file is only ever written
+    # from a session where this mode measured clean (compile bounded,
+    # persistent cache warmed), which is what makes this acceptable.
+    kmode, cmode = _decided_modes()
+    if os.environ.get("CEPH_TPU_BENCH_TRY_KERNEL") == "1":
+        kmode, cmode = "1", "0"
     if (
-        os.environ.get("CEPH_TPU_BENCH_TRY_KERNEL") == "1"
+        (kmode, cmode) != ("0", "0")
         and result is not None
         and result.get("platform") not in (None, "cpu")
     ):
         env_k = dict(os.environ)
-        env_k["CEPH_TPU_LEVEL_KERNEL"] = "1"
+        env_k["CEPH_TPU_LEVEL_KERNEL"] = kmode
+        env_k["CEPH_TPU_RETRY_COMPACT"] = cmode
         rk = _run_child(env_k, ATTACH_TIMEOUT_S)
         if rk and rk.get("rate", 0) > result["rate"]:
             result = rk
         elif rk is not None and rk.get("error"):
-            errors.append(f"kernel attempt: {rk.get('error')}")
+            errors.append(f"upgrade attempt ({kmode},{cmode}): {rk.get('error')}")
 
     # Fallback: same jitted program on host CPU in a scrubbed child.
     if result is None:
@@ -327,6 +364,8 @@ def _main_guarded() -> int:
                 "unit": "placements/s",
                 "platform": result["platform"],
                 "level_kernel": result.get("level_kernel", False),
+                "kernel_mode": result.get("kernel_mode", "0"),
+                "retry_compact": result.get("retry_compact", False),
                 "timestamp_utc": _utcnow(),
                 "source": "bench.py live device run",
             }
@@ -405,6 +444,9 @@ def format_result(
         out["platform"] = platform
     if result is not None and "level_kernel" in result:
         out["level_kernel"] = result["level_kernel"]
+    if result is not None and "kernel_mode" in result:
+        out["kernel_mode"] = result["kernel_mode"]
+        out["retry_compact"] = result.get("retry_compact", False)
     if result is not None and result.get("teardown_timed_out"):
         # the measurement is valid but its child was orphaned mid-detach
         # — a monitored session must know the tunnel is still occupied

@@ -12,6 +12,9 @@ star) is met, and the env defaults to flip.
 Usage::
 
     python bench/decide_defaults.py [chip_session2_r5.log ...]
+    python bench/decide_defaults.py --write [logs ...]   # also flip
+        # the committed engine defaults (bench/kernel_defaults.json,
+        # read by ceph_tpu.crush.interp_batch; env flags still win)
 """
 
 from __future__ import annotations
@@ -19,6 +22,12 @@ from __future__ import annotations
 import json
 import os
 import sys
+import time
+
+DEFAULTS_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "bench", "kernel_defaults.json",
+)
 
 TARGET_PER_CHIP = 6_250_000
 
@@ -96,8 +105,32 @@ def decide(rates: dict[str, int], sources: list[str]) -> dict:
     return out
 
 
+def write_defaults(decision: dict, path: str | None = None) -> None:
+    """Persist the winning modes as the committed engine defaults,
+    with full provenance so the flip is auditable."""
+    if "winner" not in decision:
+        raise ValueError("no winner in decision — refusing to write defaults")
+    out = dict(decision["recommend_env"])
+    out.update(
+        {
+            "winner": decision["winner"],
+            "winner_rate_per_sec": decision["winner_rate_per_sec"],
+            "target_met": decision["target_met"],
+            "decided_from": decision["sources"],
+            "timestamp_utc": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+        }
+    )
+    with open(path or DEFAULTS_PATH, "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+
+
 def main() -> int:
-    paths = sys.argv[1:] or ["chip_session2_r5.log"]
+    args = sys.argv[1:]
+    write = "--write" in args
+    paths = [a for a in args if a != "--write"] or ["chip_session2_r5.log"]
     missing = [p for p in paths if not os.path.exists(p)]
     if missing:
         # a typo'd log path must not silently shrink the evidence base
@@ -105,6 +138,13 @@ def main() -> int:
         return 2
     out = decide(harvest(paths), paths)
     print(json.dumps(out), flush=True)
+    if write:
+        try:
+            write_defaults(out)
+            print(f"decide_defaults: wrote {DEFAULTS_PATH}", file=sys.stderr)
+        except ValueError as e:
+            print(f"decide_defaults: {e}", file=sys.stderr)
+            return 3
     return 0
 
 

@@ -430,14 +430,44 @@ def _compact_window(B: int) -> int | None:
 def _retry_compact() -> bool:
     """Whether big batches use the compacted-straggler retry path.
 
-    Opt-in (CEPH_TPU_RETRY_COMPACT=1) until its compile time is proven
-    bounded on the chip: the windowed gather/scatter roughly doubles
-    the engine program and local chipless AOT went from ~45 s to >17
-    min for the kernel-mode 1M program — the same caution that kept
-    the level kernels fenced in round 3.  bench/level_kernel_probe.py
-    measures rate AND compile for the kernel x compaction grid in one
-    chip session; flip the default on that artifact."""
-    return os.environ.get("CEPH_TPU_RETRY_COMPACT", "0") == "1"
+    Built-in default opt-in (CEPH_TPU_RETRY_COMPACT=1) until its
+    compile time is proven bounded on the chip: the windowed
+    gather/scatter roughly doubles the engine program and local
+    chipless AOT went from ~45 s to >17 min for the kernel-mode 1M
+    program — the same caution that kept the level kernels fenced in
+    round 3.  bench/level_kernel_probe.py measures rate AND compile
+    for the kernel x compaction grid in one chip session; the decision
+    lands in ``bench/kernel_defaults.json`` (env overrides)."""
+    env = os.environ.get("CEPH_TPU_RETRY_COMPACT")
+    if env is not None:
+        return env == "1"
+    return str(_decided_defaults().get("CEPH_TPU_RETRY_COMPACT", "0")) == "1"
+
+
+_DEFAULTS_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "bench", "kernel_defaults.json",
+)
+_defaults_cache: dict | None = None
+
+
+def _decided_defaults() -> dict:
+    """Data-decided engine defaults, written by
+    ``bench/decide_defaults.py --write`` from an on-chip grid artifact
+    (round-4 verdict item 8: defaults flip from measurements, with the
+    artifact cited inside the file).  Env flags always override.  Absent
+    or unreadable file -> conservative built-ins."""
+    global _defaults_cache
+    if _defaults_cache is None:
+        try:
+            import json as _json
+
+            with open(_DEFAULTS_PATH) as f:
+                loaded = _json.load(f)
+            _defaults_cache = loaded if isinstance(loaded, dict) else {}
+        except Exception:  # noqa: BLE001 — missing file is the normal case
+            _defaults_cache = {}
+    return _defaults_cache
 
 
 def _kernel_mode() -> str:
@@ -445,15 +475,21 @@ def _kernel_mode() -> str:
     'level' forces the per-level kernels while keeping the fused
     whole-descent kernel OFF (its Mosaic program is ~levels x larger —
     the fallback lever if only the big kernel's on-chip compile is
-    pathological), '0' forces the XLA matmul path.  Default is OFF
-    (opt-in): the kernels are bit-exact in tests, but whole-descent
+    pathological), '0' forces the XLA matmul path.  Built-in default is
+    OFF (opt-in): the kernels are bit-exact in tests, but whole-descent
     Mosaic compiles exceeded 20 min in local chipless AOT (superlinear
     in kernel size even with the fanout fori_loop) and were never
     demonstrated bounded on silicon before the round-3 tunnel wedge —
     auto-enabling would put the driver's whole bench run at risk.  The
     flat fused straw2 kernel (CEPH_TPU_FUSED_STRAW2, auto-on) is the
-    proven path."""
-    return os.environ.get("CEPH_TPU_LEVEL_KERNEL", "0")
+    proven path.  A committed ``bench/kernel_defaults.json`` (written
+    only from measured on-chip grid data) overrides the built-in; the
+    env flag overrides both."""
+    env = os.environ.get("CEPH_TPU_LEVEL_KERNEL")
+    if env is not None:
+        return env
+    mode = str(_decided_defaults().get("CEPH_TPU_LEVEL_KERNEL", "0"))
+    return mode if mode in ("0", "1", "level") else "0"
 
 
 def _whole_descent_on() -> bool:

@@ -87,3 +87,71 @@ def test_probe_line_cannot_smuggle_kern_full(tmp_path):
          "kern_full_rate_per_sec": 50_000_000},
     ])
     assert dd.harvest([p]) == {}
+
+
+def test_write_defaults_roundtrip_and_engine_pickup(tmp_path, monkeypatch):
+    """--write persists the winning modes with provenance, and the
+    engine + bench.py resolve them as their default (env still wins)."""
+    p = _log(tmp_path, [
+        {"metric": "kernel_forensics", "platform": "tpu",
+         "kern_full_rate_per_sec": 14_000_000},
+    ])
+    decision = dd.decide(dd.harvest([p]), [p])
+    out = tmp_path / "kernel_defaults.json"
+    dd.write_defaults(decision, path=str(out))
+    d = json.loads(out.read_text())
+    assert d["CEPH_TPU_LEVEL_KERNEL"] == "1"
+    assert d["CEPH_TPU_RETRY_COMPACT"] == "0"
+    assert d["winner"] == "kern_full" and d["decided_from"] == [p]
+    assert d["timestamp_utc"]
+
+    # engine resolution: committed file beats built-in, env beats file
+    from ceph_tpu.crush import interp_batch as ib
+
+    monkeypatch.setattr(ib, "_DEFAULTS_PATH", str(out))
+    monkeypatch.setattr(ib, "_defaults_cache", None)
+    monkeypatch.delenv("CEPH_TPU_LEVEL_KERNEL", raising=False)
+    monkeypatch.delenv("CEPH_TPU_RETRY_COMPACT", raising=False)
+    assert ib._kernel_mode() == "1"
+    assert ib._retry_compact() is False
+    monkeypatch.setenv("CEPH_TPU_LEVEL_KERNEL", "level")
+    assert ib._kernel_mode() == "level"
+
+    # bench.py's upgrade attempt picks the same file up
+    import importlib.util as _ilu
+
+    _bp = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")
+    _s = _ilu.spec_from_file_location("bench_headline_dd", _bp)
+    bench = _ilu.module_from_spec(_s)
+    _s.loader.exec_module(bench)
+    monkeypatch.setattr(dd, "DEFAULTS_PATH", str(out))
+    import decide_defaults as dd_canonical
+
+    monkeypatch.setattr(dd_canonical, "DEFAULTS_PATH", str(out))
+    assert bench._decided_modes() == ("1", "0")
+
+
+def test_write_defaults_refuses_without_winner(tmp_path):
+    import pytest
+
+    with pytest.raises(ValueError):
+        dd.write_defaults({"metric": "default_decision"}, path=str(
+            tmp_path / "x.json"))
+
+
+def test_engine_ignores_bogus_defaults_file(tmp_path, monkeypatch):
+    from ceph_tpu.crush import interp_batch as ib
+
+    bogus = tmp_path / "kernel_defaults.json"
+    bogus.write_text('{"CEPH_TPU_LEVEL_KERNEL": "yolo"}')
+    monkeypatch.setattr(ib, "_DEFAULTS_PATH", str(bogus))
+    monkeypatch.setattr(ib, "_defaults_cache", None)
+    monkeypatch.delenv("CEPH_TPU_LEVEL_KERNEL", raising=False)
+    assert ib._kernel_mode() == "0"
+
+    # non-dict top level must fall back to built-ins, not crash
+    bogus.write_text('["not", "a", "dict"]')
+    monkeypatch.setattr(ib, "_defaults_cache", None)
+    assert ib._kernel_mode() == "0"
+    assert ib._retry_compact() is False
