@@ -82,6 +82,13 @@ EOF
     if ! probe; then echo "ABORT: tunnel degraded after compaction probe"; exit 1; fi
     CEPH_TPU_BENCH_TIMEOUT=1500 python bench.py \
       || { echo "STEP FAILED: bench.py rerun"; rc_total=1; }
+    echo "--- TRIM: default decision from measured artifacts ---"
+    # reads the dedicated artifact stream (not the tee'd log, which may
+    # still be draining); --write MERGES with any prior decision, so a
+    # flat-only TRIM session can never clobber a full-grid winner
+    if [ -f chip_probe_artifacts.jsonl ]; then
+      python bench/decide_defaults.py --write chip_probe_artifacts.jsonl || true
+    fi
     echo "=== session 2 (trimmed) done $(date -u +%H:%M:%SZ) rc=$rc_total ==="
     exit "$rc_total"
   fi
@@ -129,6 +136,17 @@ EOF
       || { echo "STEP FAILED: kernel grid"; rc_total=1; }
   else
     echo "--- step 13 SKIPPED: forensics failed, kernel grid would hang ---"
+  fi
+
+  echo "--- step 14: default decision from measured artifacts ---"
+  # auto-flip the committed engine defaults the moment the data exists
+  # — the flip must not depend on an operator being awake when the
+  # session ends.  Reads the dedicated artifact stream (not the tee'd
+  # log, which may still be draining); decide_defaults refuses to
+  # write without a tpu-measured winner and MERGES with any prior
+  # decision, so partial grids can only add rates, never erase one.
+  if [ -f chip_probe_artifacts.jsonl ]; then
+    python bench/decide_defaults.py --write chip_probe_artifacts.jsonl || true
   fi
 
   echo "=== session 2 done $(date -u +%H:%M:%SZ) rc=$rc_total ==="

@@ -107,22 +107,46 @@ def decide(rates: dict[str, int], sources: list[str]) -> dict:
 
 def write_defaults(decision: dict, path: str | None = None) -> None:
     """Persist the winning modes as the committed engine defaults,
-    with full provenance so the flip is auditable."""
+    with full provenance so the flip is auditable.
+
+    Merges with a previously written decision: a session that measured
+    only part of the grid (e.g. a TRIM ladder with just the flat
+    variants) must never clobber a prior full-grid winner — the new
+    rates join the old ones (best per tag) and the winner is recomputed
+    over the union.
+    """
     if "winner" not in decision:
         raise ValueError("no winner in decision — refusing to write defaults")
-    out = dict(decision["recommend_env"])
+    path = path or DEFAULTS_PATH
+    rates = dict(decision["rates"])
+    sources = list(decision["sources"])
+    try:
+        with open(path) as f:
+            prior = json.load(f)
+        if isinstance(prior, dict):
+            for tag, r in (prior.get("rates") or {}).items():
+                if tag in MODES:
+                    rates[tag] = max(rates.get(tag, 0), int(r))
+            for s in prior.get("decided_from", []):
+                if s not in sources:
+                    sources.append(s)
+    except Exception:  # noqa: BLE001 — no prior decision is the normal case
+        pass
+    merged = decide(rates, sources)
+    out = dict(merged["recommend_env"])
     out.update(
         {
-            "winner": decision["winner"],
-            "winner_rate_per_sec": decision["winner_rate_per_sec"],
-            "target_met": decision["target_met"],
-            "decided_from": decision["sources"],
+            "winner": merged["winner"],
+            "winner_rate_per_sec": merged["winner_rate_per_sec"],
+            "target_met": merged["target_met"],
+            "rates": merged["rates"],
+            "decided_from": sources,
             "timestamp_utc": time.strftime(
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
             ),
         }
     )
-    with open(path or DEFAULTS_PATH, "w") as f:
+    with open(path, "w") as f:
         json.dump(out, f, indent=1)
         f.write("\n")
 
@@ -130,7 +154,16 @@ def write_defaults(decision: dict, path: str | None = None) -> None:
 def main() -> int:
     args = sys.argv[1:]
     write = "--write" in args
-    paths = [a for a in args if a != "--write"] or ["chip_session2_r5.log"]
+    paths = [a for a in args if a != "--write"]
+    if not paths:
+        # the dedicated artifact stream is the canonical source (the
+        # tee'd session log can still be draining when this runs)
+        default = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "chip_probe_artifacts.jsonl",
+        )
+        paths = [default if os.path.exists(default)
+                 else "chip_session2_r5.log"]
     missing = [p for p in paths if not os.path.exists(p)]
     if missing:
         # a typo'd log path must not silently shrink the evidence base

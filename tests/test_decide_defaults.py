@@ -132,6 +132,49 @@ def test_write_defaults_roundtrip_and_engine_pickup(tmp_path, monkeypatch):
     assert bench._decided_modes() == ("1", "0")
 
 
+def test_write_defaults_merges_with_prior_decision(tmp_path):
+    """A flat-only (TRIM) session must not clobber a prior full-grid
+    winner: rates merge (best per tag) and the winner is recomputed
+    over the union."""
+    out = tmp_path / "kernel_defaults.json"
+    # prior full-grid decision: whole-descent kernel won at 14M/s
+    full = _log(tmp_path, [
+        {"metric": "kernel_forensics", "platform": "tpu",
+         "kern_full_rate_per_sec": 14_000_000},
+    ])
+    dd.write_defaults(dd.decide(dd.harvest([full]), [full]), path=str(out))
+    # later TRIM session: only flat variants measured
+    trim = _log(tmp_path, [
+        {"metric": "level_kernel_probe", "platform": "tpu",
+         "fused_straw2_rate_per_sec": 1_800_000, "fused_straw2_ok": True},
+    ])
+    dd.write_defaults(dd.decide(dd.harvest([trim]), [trim]), path=str(out))
+    d = json.loads(out.read_text())
+    assert d["winner"] == "kern_full"
+    assert d["CEPH_TPU_LEVEL_KERNEL"] == "1"
+    assert d["rates"]["fused_straw2"] == 1_800_000  # new data still lands
+    assert full in d["decided_from"] and trim in d["decided_from"]
+
+
+def test_write_defaults_new_winner_beats_prior(tmp_path):
+    out = tmp_path / "kernel_defaults.json"
+    old = _log(tmp_path, [
+        {"metric": "level_kernel_probe", "platform": "tpu",
+         "fused_straw2_rate_per_sec": 1_800_000, "fused_straw2_ok": True},
+    ])
+    dd.write_defaults(dd.decide(dd.harvest([old]), [old]), path=str(out))
+    new = _log(tmp_path, [
+        {"metric": "level_kernel_probe", "platform": "tpu",
+         "level_kernel_compact_rate_per_sec": 12_000_000,
+         "level_kernel_compact_ok": True},
+    ])
+    dd.write_defaults(dd.decide(dd.harvest([new]), [new]), path=str(out))
+    d = json.loads(out.read_text())
+    assert d["winner"] == "level_kernel_compact"
+    assert d["CEPH_TPU_LEVEL_KERNEL"] == "1"
+    assert d["CEPH_TPU_RETRY_COMPACT"] == "1"
+
+
 def test_write_defaults_refuses_without_winner(tmp_path):
     import pytest
 
