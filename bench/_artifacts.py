@@ -1,0 +1,25 @@
+"""Shared append-only artifact stream for on-chip measurement JSON.
+
+The chip session's decision step (bench/decide_defaults.py) must read
+measurements through this file, NOT the tee'd session log — the log
+pipe can still be draining when the decision runs.  Every probe that
+emits a scored JSON line appends it here too.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_PATH = os.path.join(_REPO, "chip_probe_artifacts.jsonl")
+
+
+def append_artifact(out: dict) -> None:
+    path = os.environ.get("CEPH_TPU_PROBE_ARTIFACTS", DEFAULT_PATH)
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(out) + "\n")
+    except OSError as e:
+        print(f"artifact append failed: {e}", file=sys.stderr)

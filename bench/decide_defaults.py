@@ -124,14 +124,27 @@ def write_defaults(decision: dict, path: str | None = None) -> None:
         with open(path) as f:
             prior = json.load(f)
         if isinstance(prior, dict):
-            for tag, r in (prior.get("rates") or {}).items():
+            prior_rates = prior.get("rates")
+            if not prior_rates and prior.get("winner") in MODES:
+                # pre-'rates' file format carried only the winner —
+                # still must not be clobbered by a partial session
+                prior_rates = {
+                    prior["winner"]: prior.get("winner_rate_per_sec", 0)
+                }
+            for tag, r in (prior_rates or {}).items():
                 if tag in MODES:
                     rates[tag] = max(rates.get(tag, 0), int(r))
             for s in prior.get("decided_from", []):
                 if s not in sources:
                     sources.append(s)
-    except Exception:  # noqa: BLE001 — no prior decision is the normal case
-        pass
+    except FileNotFoundError:
+        pass  # no prior decision is the normal case
+    except Exception as e:  # noqa: BLE001
+        # a corrupt prior must not abort the new decision, but its
+        # overwrite should leave a trace (evidence also lives in git
+        # and the session logs)
+        print(f"decide_defaults: prior decision unreadable ({e}); "
+              "overwriting", file=sys.stderr)
     merged = decide(rates, sources)
     out = dict(merged["recommend_env"])
     out.update(

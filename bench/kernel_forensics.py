@@ -217,17 +217,9 @@ def main() -> int:
         say(f"FAILED: {out['error']}")
 
     print(json.dumps(out), flush=True)
-    # dedicated artifact stream for decide_defaults (the tee'd session
-    # log may still be draining when the decision step reads it)
-    apath = os.environ.get(
-        "CEPH_TPU_PROBE_ARTIFACTS",
-        os.path.join(_REPO, "chip_probe_artifacts.jsonl"),
-    )
-    try:
-        with open(apath, "a") as f:
-            f.write(json.dumps(out) + "\n")
-    except OSError as e:
-        print(f"forensics: artifact append failed: {e}", file=sys.stderr)
+    from _artifacts import append_artifact
+
+    append_artifact(out)
     return 1 if "error" in out else 0
 
 

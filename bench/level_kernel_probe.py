@@ -118,24 +118,10 @@ def main() -> int:
 
     out["total_seconds"] = round(time.perf_counter() - t_all, 1)
     print(json.dumps(out), flush=True)
-    _append_artifact(out)
+    from _artifacts import append_artifact
+
+    append_artifact(out)
     return 0
-
-
-def _append_artifact(out: dict) -> None:
-    """Also land the JSON line in the dedicated artifact stream that
-    bench/decide_defaults.py reads — the session log is written through
-    a tee pipe and may not contain this line yet when the session's
-    decision step runs (write-then-read race)."""
-    path = os.environ.get(
-        "CEPH_TPU_PROBE_ARTIFACTS",
-        os.path.join(_REPO, "chip_probe_artifacts.jsonl"),
-    )
-    try:
-        with open(path, "a") as f:
-            f.write(json.dumps(out) + "\n")
-    except OSError as e:
-        print(f"probe: artifact append failed: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -19,7 +19,7 @@ _REPO = __import__("os").path.dirname(__import__("os").path.dirname(__import__("
 sys.path.insert(0, _REPO)
 from ceph_tpu.ec import create
 from ceph_tpu.ec.interface import ErasureCodeError
-from ceph_tpu.ec.stripe import encode_object, decode_object
+from ceph_tpu.ec.stripe import _shard_map, encode_object, decode_object
 
 seed = int(time.time())
 rng = np.random.default_rng(seed)
@@ -61,11 +61,19 @@ while time.time() - t0 < int(os.environ.get("CEPH_TPU_FUZZ_SECONDS", "900")):
         assert out == data.tobytes(), (prof, sorted(drop), sorted(failed), size, sw)
     else:
         # decode refused: must be genuinely unrecoverable from the
-        # remaining shards (claim check through minimum_to_decode)
+        # remaining shards (claim check through minimum_to_decode).
+        # The oracle must ask for the same chunks decode_object needs —
+        # the MAPPED data positions, not range(k): for LRC's mapping
+        # `__DD__DD` the data lives at {2,3,6,7}, and asking for
+        # {0..k-1} (parity positions, usually still present) made the
+        # oracle cry recoverable on patterns whose data genuinely
+        # cannot be repaired (false alarm found by this fuzz, round 5).
         avail = set(present) - failed
         k = ec.get_data_chunk_count()
+        shard = _shard_map(ec)
+        want = {shard[j] for j in range(k)}
         try:
-            ec.minimum_to_decode(set(range(k)), avail)
+            ec.minimum_to_decode(want, avail)
             recoverable = True
         except ErasureCodeError:
             recoverable = False

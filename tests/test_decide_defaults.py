@@ -175,6 +175,40 @@ def test_write_defaults_new_winner_beats_prior(tmp_path):
     assert d["CEPH_TPU_RETRY_COMPACT"] == "1"
 
 
+def test_write_defaults_merges_old_format_prior(tmp_path):
+    """A kernel_defaults.json from before the 'rates' field existed
+    carries only the winner — that winner must still survive a
+    partial-session merge."""
+    out = tmp_path / "kernel_defaults.json"
+    out.write_text(json.dumps({
+        "CEPH_TPU_LEVEL_KERNEL": "1", "CEPH_TPU_RETRY_COMPACT": "0",
+        "winner": "kern_full", "winner_rate_per_sec": 14_000_000,
+        "target_met": True, "decided_from": ["old_session.log"],
+    }))
+    trim = _log(tmp_path, [
+        {"metric": "level_kernel_probe", "platform": "tpu",
+         "fused_straw2_rate_per_sec": 1_800_000, "fused_straw2_ok": True},
+    ])
+    dd.write_defaults(dd.decide(dd.harvest([trim]), [trim]), path=str(out))
+    d = json.loads(out.read_text())
+    assert d["winner"] == "kern_full"
+    assert d["rates"]["kern_full"] == 14_000_000
+    assert "old_session.log" in d["decided_from"]
+
+
+def test_write_defaults_corrupt_prior_warns_and_proceeds(tmp_path, capsys):
+    out = tmp_path / "kernel_defaults.json"
+    out.write_text("{truncated")
+    new = _log(tmp_path, [
+        {"metric": "level_kernel_probe", "platform": "tpu",
+         "fused_straw2_rate_per_sec": 1_800_000, "fused_straw2_ok": True},
+    ])
+    dd.write_defaults(dd.decide(dd.harvest([new]), [new]), path=str(out))
+    d = json.loads(out.read_text())
+    assert d["winner"] == "fused_straw2"
+    assert "unreadable" in capsys.readouterr().err
+
+
 def test_write_defaults_refuses_without_winner(tmp_path):
     import pytest
 

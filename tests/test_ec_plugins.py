@@ -100,6 +100,27 @@ def test_lrc_minimum_to_decode_lockstep_with_decode():
     assert checked > 200 and claimed_no > 0  # both branches exercised
 
 
+def test_lrc_beyond_capability_pattern_refused_consistently():
+    """k=4 m=2 l=3 (mapping __DD__DD), chunks {5,6,7} unavailable: the
+    local group {4,5,6,7} keeps 1 of 4 members and the global layer
+    {1,2,3,5,6,7} keeps 3 of the 4-data it needs, so the layer walk —
+    like upstream ``ErasureCodeLrc`` — cannot repair data {6,7}.  Both
+    the claim and the decode must refuse (a round-5 stripe-fuzz false
+    alarm: the old oracle asked for chunks {0..k-1}, which are parity
+    positions here and still present)."""
+    ec = create({"plugin": "lrc", "k": "4", "m": "2", "l": "3"})
+    n = ec.get_chunk_count()
+    obj = rand_bytes(random.Random(11), 3000)
+    enc = ec.encode(set(range(n)), obj)
+    cs = len(enc[0])
+    avail = {0, 1, 2, 3, 4}
+    want = {2, 3, 6, 7}  # the mapped data positions
+    with pytest.raises(ErasureCodeError):
+        ec.minimum_to_decode(set(want), set(avail))
+    with pytest.raises(ErasureCodeError):
+        ec.decode(set(want), {i: enc[i] for i in avail}, cs)
+
+
 def test_lrc_minimum_to_decode_excludes_regenerated_chunks():
     """A chunk regenerated for free by an earlier layer repair must not
     be claimed as a read, even when it is also available (round-4
