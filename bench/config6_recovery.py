@@ -1,0 +1,116 @@
+"""BASELINE config 6: failure-driven recovery (peering + batched repair).
+
+Simulates scenario #1 from the roadmap: a full rack failure on a
+1k-OSD cluster with an (8,3) EC pool.  Times the whole failure loop —
+fault injection, the vmapped whole-cluster peering pass, pattern-
+grouped planning, and the batched repair decode (ONE device launch per
+unique erasure pattern) — and reports the decode rate.  ``vs_baseline``
+is the speedup of the pattern-grouped batch decode over the reference
+structure (per-PG decode setup + per-PG launch), measured on a sample
+of the same degraded PGs.  Emits one JSON line.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+N_OSDS = 1024
+K, M = 8, 3
+PG_NUM = 256
+CHUNK = 16384
+SERIAL_SAMPLE = 8
+
+
+def main() -> None:
+    from ceph_tpu.common.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    import copy
+
+    from ceph_tpu import recovery as rec
+    from ceph_tpu.ec.backend import MatrixCodec
+    from ceph_tpu.ec.gf import vandermonde_matrix
+    from ceph_tpu.models.clusters import build_osdmap
+
+    m = build_osdmap(N_OSDS, pg_num=PG_NUM, size=K + M, pool_kind="erasure")
+    m_prev = copy.deepcopy(m)
+    t0 = time.perf_counter()
+    rec.inject(m, "rack:0:down_out")
+    t_inject = time.perf_counter() - t0
+
+    engine = rec.PeeringEngine(m, 1)  # compile outside the timed region
+    from ceph_tpu.osdmap.mapping import build_pool_state
+
+    s_prev = build_pool_state(m_prev, m_prev.pools[1], 11)
+    s_cur = build_pool_state(m, m.pools[1], 11)
+    engine.run(s_prev, s_cur)  # warm
+    t0 = time.perf_counter()
+    peering = engine.run(s_prev, s_cur, m_prev.epoch, m.epoch)
+    t_peer = time.perf_counter() - t0
+
+    codec = MatrixCodec(vandermonde_matrix(K, M))
+    t0 = time.perf_counter()
+    plan = rec.build_plan(peering, codec)
+    t_plan = time.perf_counter() - t0
+
+    rng = np.random.default_rng(6)
+    store: dict[int, np.ndarray] = {}
+    for g in plan.groups:
+        for pg in g.pgs:
+            data = rng.integers(0, 256, (K, CHUNK), dtype=np.uint8)
+            store[int(pg)] = np.vstack([data, codec.encode(data)])
+
+    launches = []
+    ex = rec.RecoveryExecutor(
+        codec, on_decode_launch=lambda g, n: launches.append(g.mask)
+    )
+    ex.run(plan, lambda pg, s: store[pg][s])  # warm (compile per pattern)
+    t0 = time.perf_counter()
+    result = ex.run(plan, lambda pg, s: store[pg][s])
+    t_decode = time.perf_counter() - t0
+    rate = result.bytes_recovered / t_decode
+    assert result.launches == plan.n_patterns
+
+    # reference structure: one decode launch per PG (decoders warmed, so
+    # this measures launch overhead, not compilation) on a sample
+    sample = [(g, int(pg)) for g in plan.groups for pg in g.pgs][:SERIAL_SAMPLE]
+    serial_codec = MatrixCodec(vandermonde_matrix(K, M))
+    for g, pg in sample:  # warm the per-pattern decoders
+        serial_codec.decode(
+            {s: store[pg][s] for s in g.survivors}, set(g.missing)
+        )
+    t0 = time.perf_counter()
+    sbytes = 0
+    for g, pg in sample:
+        out = serial_codec.decode(
+            {s: store[pg][s] for s in g.survivors}, set(g.missing)
+        )
+        sbytes += sum(v.nbytes for v in out.values())
+    serial_rate = sbytes / (time.perf_counter() - t0)
+
+    print(
+        f"rack failure, {N_OSDS} osds, ({K},{M}) EC, {PG_NUM} pgs: "
+        f"inject {t_inject * 1e3:.1f} ms, peer {t_peer * 1e3:.1f} ms, "
+        f"plan {t_plan * 1e3:.1f} ms ({plan.n_patterns} patterns / "
+        f"{plan.n_pgs} degraded pgs), decode {rate / 1e6:.1f} MB/s in "
+        f"{result.launches} launches (serial ref {serial_rate / 1e6:.1f} MB/s)",
+        file=sys.stderr,
+    )
+
+    import jax
+
+    print(json.dumps({
+        "metric": "recovery_decode_bytes_per_sec",
+        "value": round(rate),
+        "unit": "B/s",
+        "vs_baseline": round(rate / serial_rate, 3) if serial_rate else 0.0,
+        "platform": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

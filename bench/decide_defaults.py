@@ -42,6 +42,39 @@ MODES = {
     "kern_full": ("1", "0"),
 }
 
+# Non-grid metrics worth carrying in the decision record for trend
+# tracking (they never vote on the kernel-mode winner): currently the
+# recovery subsystem's batched repair-decode rate (config6_recovery).
+AUX_METRICS = ("recovery_decode_bytes_per_sec",)
+
+
+def harvest_aux(paths: list[str]) -> dict[str, int]:
+    """Collect auxiliary metric -> best value from the logs.
+
+    Same platform discipline as :func:`harvest`: only ``platform:
+    "tpu"`` lines count.
+    """
+    aux: dict[str, int] = {}
+    for path in paths:
+        try:
+            lines = open(path).read().splitlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if d.get("platform") != "tpu":
+                continue
+            name = d.get("metric")
+            if name in AUX_METRICS and d.get("value"):
+                aux[name] = max(aux.get(name, 0), int(d["value"]))
+    return aux
+
 
 def harvest(paths: list[str]) -> dict[str, int]:
     """Collect tag -> placements/s from every JSON line in the logs.
@@ -183,6 +216,9 @@ def main() -> int:
         print(f"decide_defaults: missing log(s): {missing}", file=sys.stderr)
         return 2
     out = decide(harvest(paths), paths)
+    aux = harvest_aux(paths)
+    if aux:
+        out["aux_metrics"] = aux
     print(json.dumps(out), flush=True)
     if write:
         try:

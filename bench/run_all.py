@@ -35,6 +35,7 @@ CONFIGS = [
     ("config3_upmap", "bench/config3_upmap.py"),
     ("config4_repair_decode", "bench/config4_repair_decode.py"),
     ("config5_rebalance_sim", "bench/config5_rebalance_sim.py"),
+    ("config6_recovery", "bench/config6_recovery.py"),
     ("tpu_tier", "bench/tpu_tier.py"),
 ]
 

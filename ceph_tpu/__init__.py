@@ -17,4 +17,19 @@ from jax import config as _jax_config
 
 _jax_config.update("jax_enable_x64", True)
 
+
+def enable_x64(new_val: bool = True):
+    """Context manager scoping x64 mode on or off (compat shim).
+
+    ``jax.enable_x64`` was removed from the top-level namespace in JAX
+    0.4.37; the supported spelling is ``jax.experimental.enable_x64``.
+    Framework code that must trace with x64 scoped off (the Pallas
+    kernels — Mosaic rejects i64 leaking into BlockSpec index maps)
+    goes through this one shim so the next rename is a one-line fix.
+    """
+    from jax.experimental import enable_x64 as _enable_x64
+
+    return _enable_x64(new_val)
+
+
 __version__ = "0.1.0"

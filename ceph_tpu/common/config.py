@@ -84,6 +84,13 @@ SCHEMA: list[Option] = [
            "balancing strategy", enum_allowed=("upmap", "none")),
     Option("ec_default_packetsize", OPT_INT, 2048, LEVEL_ADVANCED,
            "bitmatrix technique packet size (bytes)", min=8),
+    Option("recovery_max_bytes_per_sec", OPT_FLOAT, 0.0, LEVEL_ADVANCED,
+           "token-bucket cap on recovery decode bandwidth (bytes/s); "
+           "0 disables the throttle", min=0.0,
+           see_also=("recovery_burst_bytes",)),
+    Option("recovery_burst_bytes", OPT_INT, 64 * 1024 * 1024, LEVEL_ADVANCED,
+           "token-bucket burst size for the recovery throttle (bytes)",
+           min=1, see_also=("recovery_max_bytes_per_sec",)),
     Option("placement_batch_size", OPT_INT, 4_000_000, LEVEL_DEV,
            "objects per device batch in streamed placement", min=1),
     Option("debug_crush", OPT_INT, 1, LEVEL_DEV,
@@ -94,6 +101,8 @@ SCHEMA: list[Option] = [
            "erasure-code subsystem log level", min=0, max=20),
     Option("debug_balancer", OPT_INT, 1, LEVEL_DEV,
            "balancer subsystem log level", min=0, max=20),
+    Option("debug_recovery", OPT_INT, 1, LEVEL_DEV,
+           "recovery subsystem log level", min=0, max=20),
 ]
 
 

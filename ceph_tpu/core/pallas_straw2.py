@@ -40,6 +40,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import enable_x64 as _enable_x64
+
 from . import hashes
 
 U32 = jnp.uint32
@@ -253,7 +255,7 @@ def _kernel(x_ref, id_ref, r_ref, w_ref, mlo_ref, mhi_ref, tbl_ref,
 
 
 def _negdraw_call(xf, idf, rf, wf, mlo, mhi, interpret: bool):
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         return _negdraw_jit(xf, idf, rf, wf, mlo, mhi, interpret)
 
 
@@ -361,7 +363,7 @@ def _level_sublanes(fanout: int) -> int:
 
 
 def _level_call(xf, rf, lidxf, tbl, interpret: bool):
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         return _level_jit(xf, rf, lidxf, tbl, interpret)
 
 
@@ -539,8 +541,12 @@ def _make_descend_kernel(meta: tuple, target_type: int,
                         jnp.where(upd, ctnlf, ct))
 
             if fanout > 1:
+                # i32 bounds keep the counter i32 even when the caller
+                # traces under x64 (enable_x64(False) cannot scope dtypes
+                # once inside an outer jit trace)
                 best_lo, best_hi, chosen, ctnl = jax.lax.fori_loop(
-                    1, fanout, fbody, (best_lo, best_hi, chosen, ctnl))
+                    jnp.int32(1), jnp.int32(fanout), fbody,
+                    (best_lo, best_hi, chosen, ctnl))
 
             ctype = ctnl >> 16
             nlidx = ctnl & np.uint32(0xFFFF)
@@ -577,7 +583,7 @@ def _make_descend_kernel(meta: tuple, target_type: int,
 
 def _descend_call(xf, rf, lidxf, actf, tbl, meta, target_type,
                   empty_is_hard, max_devices, interpret):
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         return _descend_jit(xf, rf, lidxf, actf, tbl, meta, target_type,
                             empty_is_hard, max_devices, interpret)
 

@@ -31,6 +31,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import enable_x64 as _enable_x64
+
 SUBLANES = 256
 TILE = SUBLANES * 128  # u32 words per grid step
 
@@ -75,7 +77,7 @@ def _byte_lut_kernel(x_ref, tbl_ref, o_ref):
 
 
 def _byte_lut_call(x32, tbl, interpret: bool):
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         return _byte_lut_jit(x32, tbl, interpret)
 
 
@@ -145,7 +147,7 @@ def _make_matrix_kernel(m: int, k: int):
 
 
 def _matrix_call(d32, tbl, m: int, interpret: bool):
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         return _matrix_jit(d32, tbl, m, interpret)
 
 

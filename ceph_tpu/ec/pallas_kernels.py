@@ -26,6 +26,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import enable_x64 as _enable_x64
+
 W = 8
 LANES = 128  # u32 lane tile
 
@@ -64,7 +66,7 @@ def _encode_padded(masks, d_words, interpret=False):
     ("func.return (i64,i64,i64)", first silicon run).  Everything here
     is u32, so the scope changes no dtypes.
     """
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         return _encode_padded_jit(masks, d_words, interpret=interpret)
 
 

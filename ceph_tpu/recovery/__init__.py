@@ -1,0 +1,79 @@
+"""Failure-driven recovery: fault injection -> peering -> batched repair.
+
+The subsystem that closes the loop the standalone workloads left open
+(SURVEY layer L3, the reference's ``src/osd/PeeringState.cc`` +
+``ECBackend`` recovery path):
+
+- :mod:`~ceph_tpu.recovery.failure`  — inject OSD/host/rack down/out
+  events (and flapping) as ordinary epoch-stamped ``Incremental``s.
+- :mod:`~ceph_tpu.recovery.peering`  — one vmapped device pass diffs
+  up/acting between epochs and classifies every PG.
+- :mod:`~ceph_tpu.recovery.planner`  — degraded PGs grouped by survivor
+  bitmask; one host matrix inversion per unique erasure pattern.
+- :mod:`~ceph_tpu.recovery.executor` — one batched device decode launch
+  per pattern, under a token-bucket bandwidth throttle, with perf
+  counters / tracing / prometheus wired in.
+"""
+
+from .failure import (
+    ACTIONS,
+    FailureSpec,
+    FlapRecord,
+    build_incremental,
+    flap,
+    inject,
+    osds_in_subtree,
+    parse_spec,
+    resolve_targets,
+)
+from .peering import (
+    FLAG_NAMES,
+    PG_STATE_BACKFILL,
+    PG_STATE_CLEAN,
+    PG_STATE_DEGRADED,
+    PG_STATE_INACTIVE,
+    PG_STATE_REMAPPED,
+    PG_STATE_UNDERSIZED,
+    PeeringEngine,
+    PeeringResult,
+    peer_pool,
+)
+from .planner import PatternGroup, RecoveryPlan, build_plan, mask_to_shards
+from .executor import (
+    RecoveryExecutor,
+    RecoveryResult,
+    TokenBucket,
+    recover_pool,
+    recovery_counters,
+)
+
+__all__ = [
+    "ACTIONS",
+    "FailureSpec",
+    "FlapRecord",
+    "build_incremental",
+    "flap",
+    "inject",
+    "osds_in_subtree",
+    "parse_spec",
+    "resolve_targets",
+    "FLAG_NAMES",
+    "PG_STATE_BACKFILL",
+    "PG_STATE_CLEAN",
+    "PG_STATE_DEGRADED",
+    "PG_STATE_INACTIVE",
+    "PG_STATE_REMAPPED",
+    "PG_STATE_UNDERSIZED",
+    "PeeringEngine",
+    "PeeringResult",
+    "peer_pool",
+    "PatternGroup",
+    "RecoveryPlan",
+    "build_plan",
+    "mask_to_shards",
+    "RecoveryExecutor",
+    "RecoveryResult",
+    "TokenBucket",
+    "recover_pool",
+    "recovery_counters",
+]

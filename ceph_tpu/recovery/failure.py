@@ -1,0 +1,167 @@
+"""Fault injector: OSD/host/rack failures as epoch-stamped map edits.
+
+The reference has no single "fault injector" — failures arrive as mon
+epochs flipping ``CEPH_OSD_UP`` bits and zeroing reweights (upstream
+``OSDMonitor::prepare_failure`` -> ``OSDMap::Incremental``).  This
+module reproduces exactly that surface: every injected event is an
+:class:`~ceph_tpu.osdmap.map.Incremental` applied through the normal
+epoch machinery, so the peering pass (:mod:`ceph_tpu.recovery.peering`)
+sees failures the same way the real cluster would — as a diff between
+two epochs — and nothing downstream can tell an injected failure from a
+organic one.
+
+Specs are strings (the CLI surface, ``ceph_tpu.cli.recovery``)::
+
+    osd:5            # one device
+    host:host0_1     # every OSD under the named bucket
+    rack:0           # every OSD under the bucket named "rack0"
+    rack:0:out       # action suffix: down (default) | out | down_out | up | in
+
+Bucket scopes accept either a full bucket name or a bare index that is
+prefixed with the scope (``rack:0`` -> bucket ``rack0``), matching the
+``build_simple``/``build_hierarchy`` naming convention.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..crush.map import CrushMap
+from ..osdmap.map import Incremental, OSDMap, UP
+
+ACTIONS = ("down", "out", "down_out", "up", "in")
+
+
+@dataclass(frozen=True)
+class FailureSpec:
+    """One failure event: a scope (osd or any bucket type), a target
+    (device id or bucket name/index), and an action."""
+
+    scope: str
+    target: str
+    action: str = "down"
+
+    def __str__(self) -> str:
+        return f"{self.scope}:{self.target}:{self.action}"
+
+
+def parse_spec(text: str) -> FailureSpec:
+    """``scope:target[:action]`` -> :class:`FailureSpec`."""
+    parts = text.split(":")
+    if len(parts) == 2:
+        scope, target = parts
+        action = "down"
+    elif len(parts) == 3:
+        scope, target, action = parts
+    else:
+        raise ValueError(f"bad failure spec {text!r} (scope:target[:action])")
+    if action not in ACTIONS:
+        raise ValueError(f"bad action {action!r}; one of {ACTIONS}")
+    return FailureSpec(scope, target, action)
+
+
+def osds_in_subtree(crush: CrushMap, bucket_id: int) -> list[int]:
+    """All device ids under a bucket, depth-first (stable order)."""
+    out: list[int] = []
+    stack = [bucket_id]
+    seen = set()
+    while stack:
+        bid = stack.pop()
+        if bid in seen:
+            raise ValueError(f"cycle at bucket {bid}")
+        seen.add(bid)
+        b = crush.buckets[bid]
+        subs = []
+        for item in b.items:
+            if item >= 0:
+                out.append(item)
+            else:
+                subs.append(item)
+        stack.extend(reversed(subs))
+    return out
+
+
+def resolve_targets(m: OSDMap, spec: FailureSpec) -> list[int]:
+    """OSD ids a spec touches.  ``osd`` scope is the id itself; bucket
+    scopes resolve the bucket by name (bare indices get the scope
+    prefixed: ``rack:0`` -> ``rack0``) and collect its subtree."""
+    if spec.scope == "osd":
+        osd = int(spec.target)
+        if not m.exists(osd):
+            raise ValueError(f"osd.{osd} does not exist")
+        return [osd]
+    name = spec.target
+    try:
+        bucket = m.crush.bucket_by_name(name)
+    except KeyError:
+        try:
+            bucket = m.crush.bucket_by_name(f"{spec.scope}{name}")
+        except KeyError:
+            raise ValueError(
+                f"no bucket {name!r} or {spec.scope}{name!r} in crush map"
+            ) from None
+    tname = m.crush.types[bucket.type_id]
+    if tname != spec.scope:
+        raise ValueError(
+            f"bucket {bucket.name!r} has type {tname!r}, not {spec.scope!r}"
+        )
+    return [o for o in osds_in_subtree(m.crush, bucket.id) if m.exists(o)]
+
+
+def build_incremental(m: OSDMap, specs) -> Incremental:
+    """Compile failure specs into one epoch delta (NOT applied).
+
+    State edits use the reference's xor-mask convention: an OSD that is
+    already in the target state contributes nothing, so re-injecting an
+    event is a no-op rather than a state flip back.
+    """
+    if isinstance(specs, (str, FailureSpec)):
+        specs = [specs]
+    inc = Incremental(epoch=m.epoch + 1)
+    for spec in specs:
+        if isinstance(spec, str):
+            spec = parse_spec(spec)
+        for osd in resolve_targets(m, spec):
+            if spec.action in ("down", "down_out") and m.is_up(osd):
+                inc.new_state[osd] = inc.new_state.get(osd, 0) | UP
+            if spec.action == "up" and m.exists(osd) and not m.is_up(osd):
+                inc.new_state[osd] = inc.new_state.get(osd, 0) | UP
+            if spec.action in ("out", "down_out") and not m.is_out(osd):
+                inc.new_weight[osd] = 0
+            if spec.action == "in" and m.is_out(osd):
+                inc.new_weight[osd] = 0x10000
+    return inc
+
+
+def inject(m: OSDMap, specs) -> Incremental:
+    """Apply failure specs to the map as one new epoch; returns the
+    applied :class:`Incremental` so callers can log/replay it."""
+    inc = build_incremental(m, specs)
+    m.apply_incremental(inc)
+    return inc
+
+
+@dataclass
+class FlapRecord:
+    """One flapping run's epoch trail."""
+
+    osds: list[int]
+    incrementals: list[Incremental] = field(default_factory=list)
+
+
+def flap(m: OSDMap, spec: FailureSpec | str, cycles: int = 3) -> FlapRecord:
+    """Flapping sequence: ``cycles`` down/up pairs, each its own epoch
+    (the mon would see exactly this trail from a flapping NIC).  The
+    map ends back up; every intermediate epoch is returned so a peering
+    pass can replay the churn epoch by epoch."""
+    if isinstance(spec, str):
+        spec = parse_spec(spec)
+    if spec.action != "down":
+        raise ValueError("flap() only makes sense for 'down' specs")
+    rec = FlapRecord(osds=resolve_targets(m, spec))
+    for _ in range(cycles):
+        rec.incrementals.append(inject(m, spec))
+        rec.incrementals.append(
+            inject(m, FailureSpec(spec.scope, spec.target, "up"))
+        )
+    return rec

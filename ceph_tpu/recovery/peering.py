@@ -1,0 +1,233 @@
+"""Whole-cluster peering pass: epoch diff -> per-PG state, on device.
+
+TPU-native replacement for the reference's per-PG peering state machine
+(``src/osd/PeeringState.cc``): where the reference walks every PG
+through an event-driven FSM (AdvMap -> Reset -> Peering -> Active...),
+here the *entire pool* is classified in one launch — the mapping
+program (:func:`ceph_tpu.osdmap.mapping.compile_pool_mapping`) computes
+up/acting for the previous and current epochs as two
+:class:`~ceph_tpu.osdmap.mapping.PoolMapState` evaluations of the SAME
+compiled program (dynamic state is traced, so trial epochs never
+recompile), and a vmapped classifier diffs the two epochs per PG.
+
+State flags (subset of the reference's ``pg_state_t`` relevant to
+placement/recovery):
+
+- ``PG_STATE_DEGRADED``   — fewer than ``pool.size`` slots still hold
+  their data: a slot is a *survivor* only if it is alive AND mapped to
+  the same OSD as the previous epoch.  This covers both failure modes:
+  a down-but-in OSD leaves a hole in acting, and a down+out OSD gets
+  CRUSH-remapped to a fresh (empty) OSD — either way the shard's bytes
+  are gone from where they should be.
+- ``PG_STATE_UNDERSIZED`` — acting set has actual holes (fewer live
+  members than ``pool.size``).
+- ``PG_STATE_INACTIVE``   — live members below ``pool.min_size``; the
+  PG could not serve I/O.
+- ``PG_STATE_REMAPPED``   — up != acting (a temp mapping is steering
+  I/O away from the CRUSH placement).
+- ``PG_STATE_BACKFILL``   — the up set contains members that were not
+  in the previous epoch's acting set: they hold no data yet and need a
+  copy (the reference's backfill reservation trigger).
+- ``PG_STATE_CLEAN``      — none of the above.
+
+The classifier also emits, per PG, the **survivor bitmask**: bit ``s``
+is set iff acting slot ``s`` is alive AND holds the same OSD as the
+previous epoch (i.e. the shard's data actually survived — a freshly
+remapped slot is not a survivor even though it is alive).  For EC pools
+(positional slots == shard ids) this mask IS the erasure pattern the
+repair planner groups by (:mod:`ceph_tpu.recovery.planner`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..crush.map import ITEM_NONE
+from ..osdmap.map import OSDMap
+from ..osdmap.mapping import (
+    PoolMapState,
+    build_pool_state,
+    compile_pool_mapping,
+)
+
+PG_STATE_CLEAN = 1
+PG_STATE_REMAPPED = 2
+PG_STATE_DEGRADED = 4
+PG_STATE_UNDERSIZED = 8
+PG_STATE_BACKFILL = 16
+PG_STATE_INACTIVE = 32
+
+FLAG_NAMES = {
+    PG_STATE_CLEAN: "clean",
+    PG_STATE_REMAPPED: "remapped",
+    PG_STATE_DEGRADED: "degraded",
+    PG_STATE_UNDERSIZED: "undersized",
+    PG_STATE_BACKFILL: "backfill",
+    PG_STATE_INACTIVE: "inactive",
+}
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+@jax.jit
+def _classify(prev_acting, up, acting, min_size):
+    """Per-PG state flags + survivor bitmask, vmapped over the pool.
+
+    All inputs are [pg_num, size] i32 (ITEM_NONE holes) except
+    ``min_size`` (scalar).  Returns (flags [pg] i32, survivor_mask [pg]
+    u32, n_alive [pg] i32).
+    """
+    size = acting.shape[1]
+
+    def one(prev_row, up_row, act_row):
+        alive = act_row != ITEM_NONE
+        n_alive = jnp.sum(alive.astype(I32))
+        # survivor: slot alive and unchanged since the previous epoch
+        # (a remap target is alive but holds no data yet)
+        survivor = alive & (act_row == prev_row)
+        n_surv = jnp.sum(survivor.astype(I32))
+        degraded = n_surv < size
+        undersized = n_alive < size
+        inactive = n_alive < min_size
+        remapped = jnp.any(up_row != act_row)
+        # membership test: up member present anywhere in prev acting
+        up_valid = up_row != ITEM_NONE
+        in_prev = jnp.any(
+            up_row[:, None] == prev_row[None, :], axis=1
+        )
+        backfill = jnp.any(up_valid & ~in_prev)
+        mask = jnp.sum(
+            jnp.where(survivor, jnp.uint32(1) << jnp.arange(size, dtype=U32),
+                      jnp.uint32(0))
+        )
+        flags = (
+            jnp.where(remapped, PG_STATE_REMAPPED, 0)
+            | jnp.where(degraded, PG_STATE_DEGRADED, 0)
+            | jnp.where(undersized, PG_STATE_UNDERSIZED, 0)
+            | jnp.where(backfill, PG_STATE_BACKFILL, 0)
+            | jnp.where(inactive, PG_STATE_INACTIVE, 0)
+        )
+        flags = jnp.where(flags == 0, PG_STATE_CLEAN, flags)
+        return flags.astype(I32), mask, n_alive
+
+    return jax.vmap(one)(prev_acting, up, acting)
+
+
+@dataclass
+class PeeringResult:
+    """One pool's whole-cluster peering pass output (host arrays)."""
+
+    pool_id: int
+    epoch_prev: int
+    epoch_cur: int
+    size: int
+    min_size: int
+    up: np.ndarray  # [pg, size] i32, ITEM_NONE holes
+    up_primary: np.ndarray  # [pg] i32
+    acting: np.ndarray  # [pg, size] i32
+    acting_primary: np.ndarray  # [pg] i32
+    prev_acting: np.ndarray  # [pg, size] i32
+    flags: np.ndarray  # [pg] i32 (PG_STATE_* bits)
+    survivor_mask: np.ndarray  # [pg] u32 (bit s = shard s data survived)
+    n_alive: np.ndarray  # [pg] i32
+
+    @property
+    def pg_num(self) -> int:
+        return len(self.flags)
+
+    def pgs_with(self, flag: int) -> np.ndarray:
+        """PG seeds carrying a state flag."""
+        return np.nonzero((self.flags & flag) != 0)[0]
+
+    def counts(self) -> dict[str, int]:
+        """Flag -> PG count (the ``ceph status`` PG summary analog)."""
+        out = {name: int(((self.flags & bit) != 0).sum())
+               for bit, name in FLAG_NAMES.items()}
+        out["total"] = self.pg_num
+        return out
+
+    def n_survivors(self) -> np.ndarray:
+        """Per-PG surviving-shard count (survivor_mask popcount)."""
+        v = self.survivor_mask.astype(np.uint32)
+        return sum(((v >> s) & 1).astype(np.int64) for s in range(self.size))
+
+    def degraded_shards(self) -> int:
+        """Total lost shard-slots across degraded PGs (the numerator of
+        the reference's degraded-object ratio, in shard units)."""
+        deg = (self.flags & PG_STATE_DEGRADED) != 0
+        return int((self.size - self.n_survivors()[deg]).sum())
+
+
+class PeeringEngine:
+    """Compiled peering pass for one pool.
+
+    Holds the pool's compiled mapping program; :meth:`run` evaluates it
+    for two :class:`PoolMapState` epochs and classifies the diff.  All
+    dynamic state is traced, so any number of trial epochs (the fault
+    injector's output, balancer what-ifs) reuse the same executable.
+    """
+
+    def __init__(self, m: OSDMap, pool_id: int):
+        self.osdmap = m
+        self.pool = m.pools[pool_id]
+        choose_args = m.crush.choose_args_name_for_pool(pool_id)
+        dense = m.crush.to_dense(choose_args=choose_args)
+        rule = m.crush.rules[self.pool.crush_rule]
+        self._crush_arg, self._fn = compile_pool_mapping(
+            dense, self.pool, rule
+        )
+        self._pgs = jnp.arange(self.pool.pg_num, dtype=jnp.uint32)
+
+    def map_epoch(self, state: PoolMapState):
+        """(up, up_primary, acting, acting_primary) for one epoch's
+        dynamic state — one device launch, no recompile."""
+        return self._fn(self._crush_arg, state, self._pgs)
+
+    def run(
+        self, state_prev: PoolMapState, state_cur: PoolMapState,
+        epoch_prev: int = 0, epoch_cur: int = 0,
+    ) -> PeeringResult:
+        _pup, _pupp, pact, _pactp = self.map_epoch(state_prev)
+        up, upp, act, actp = self.map_epoch(state_cur)
+        flags, mask, n_alive = _classify(
+            pact, up, act, jnp.int32(self.pool.min_size)
+        )
+        jax.block_until_ready(flags)
+        return PeeringResult(
+            pool_id=self.pool.id,
+            epoch_prev=epoch_prev,
+            epoch_cur=epoch_cur,
+            size=self.pool.size,
+            min_size=self.pool.min_size,
+            up=np.asarray(up),
+            up_primary=np.asarray(upp),
+            acting=np.asarray(act),
+            acting_primary=np.asarray(actp),
+            prev_acting=np.asarray(pact),
+            flags=np.asarray(flags),
+            survivor_mask=np.asarray(mask, dtype=np.uint32),
+            n_alive=np.asarray(n_alive),
+        )
+
+
+def peer_pool(
+    m_prev: OSDMap, m_cur: OSDMap, pool_id: int, max_items: int = 8
+) -> PeeringResult:
+    """Peer one pool across two map epochs.
+
+    The compiled program is keyed on static structure only; when the
+    two epochs share a crush map (the failure-injection case — only
+    state bits changed) both evaluations hit the same executable.
+    """
+    engine = PeeringEngine(m_cur, pool_id)
+    state_prev = build_pool_state(m_prev, m_prev.pools[pool_id], max_items)
+    state_cur = build_pool_state(m_cur, m_cur.pools[pool_id], max_items)
+    return engine.run(
+        state_prev, state_cur, epoch_prev=m_prev.epoch, epoch_cur=m_cur.epoch
+    )

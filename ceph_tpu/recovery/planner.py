@@ -1,0 +1,172 @@
+"""Pattern-grouped repair planning: one decode matrix per erasure pattern.
+
+The reference decodes per object: every degraded object walks
+``ECBackend::handle_recovery_read_complete`` and re-derives its decode
+matrix from its own missing-shard set.  At cluster scale a failure
+domain (host, rack) produces *thousands* of degraded PGs but only a
+*handful* of distinct erasure patterns — every PG whose acting set lost
+the same shard slots needs the exact same reconstruction matrix.
+
+The planner exploits that: it groups degraded PGs by the survivor
+bitmask from the peering pass (:mod:`ceph_tpu.recovery.peering`), and
+for each unique mask inverts ONE k x k generator submatrix on the host
+(exact GF(2^8) Gauss-Jordan, :func:`ceph_tpu.ec.gf.invert_matrix`) and
+precomposes the repair matrix
+
+    R = G[missing] @ inv(G[rows])        # [n_missing, k] over GF(2^8)
+
+so the executor can rebuild every missing shard of every PG in the
+group with ONE batched device multiply (survivor chunks concatenated
+along the byte axis).  Because GF(2^8) matrix algebra is exact and
+associative, ``R @ survivors`` is byte-identical to the reference's
+two-step path (``inv @ survivors`` then re-encode) — asserted in
+tests/test_recovery.py.
+
+Group ordering mirrors the reference's recovery priorities: patterns
+with the most missing shards (closest to data loss) are planned first.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..ec import gf
+from .peering import PG_STATE_DEGRADED, PeeringResult
+
+
+def mask_to_shards(mask: int, size: int) -> tuple[int, ...]:
+    """Survivor bitmask -> sorted shard ids."""
+    return tuple(s for s in range(size) if (mask >> s) & 1)
+
+
+def _matrix_codec(codec):
+    """Accept a :class:`~ceph_tpu.ec.backend.MatrixCodec` or any plugin
+    wrapper (``ceph_tpu.ec.registry.create`` output) carrying one as
+    ``.codec``.  Bit-matrix-native codes have no GF(2^8) generator to
+    pattern-group over; that's the CLAY/repair-locality follow-on."""
+    for c in (codec, getattr(codec, "codec", None)):
+        if c is not None and hasattr(c, "generator"):
+            return c
+    raise TypeError(
+        f"{type(codec).__name__} exposes no GF(2^8) generator(); "
+        "pattern-grouped repair needs a matrix codec"
+    )
+
+
+@dataclass
+class PatternGroup:
+    """All degraded PGs sharing one erasure pattern.
+
+    ``rows`` are the k source shard slots the decode reads (first k
+    survivors in slot order — the same choice
+    :class:`~ceph_tpu.ec.backend._SystematicCodec` makes, so batch and
+    serial decode agree bit-for-bit); ``missing`` is every dead slot,
+    data and coding alike (recovery restores full redundancy).
+    ``repair_matrix`` maps the k source chunks straight to the missing
+    chunks: one device launch per group.
+    """
+
+    mask: int
+    survivors: tuple[int, ...]
+    rows: tuple[int, ...]
+    missing: tuple[int, ...]
+    pgs: np.ndarray  # PG seeds in this pattern group
+    repair_matrix: np.ndarray  # [len(missing), k] u8 over GF(2^8)
+
+    @property
+    def n_pgs(self) -> int:
+        return len(self.pgs)
+
+
+@dataclass
+class RecoveryPlan:
+    """Host-side repair schedule for one pool's degraded PGs."""
+
+    k: int
+    m: int
+    groups: list[PatternGroup] = field(default_factory=list)
+    # degraded PGs with fewer than k surviving shards: data loss, the
+    # reference would mark these ``incomplete`` and wait for an OSD to
+    # return.  Never silently dropped — callers must surface them.
+    unrecoverable: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.int64)
+    )
+
+    @property
+    def n_patterns(self) -> int:
+        return len(self.groups)
+
+    @property
+    def n_pgs(self) -> int:
+        return sum(g.n_pgs for g in self.groups)
+
+    @property
+    def n_shards(self) -> int:
+        """Total shard rebuilds the plan performs."""
+        return sum(len(g.missing) * g.n_pgs for g in self.groups)
+
+    def bytes_to_read(self, chunk_size: int) -> int:
+        return sum(self.k * g.n_pgs * chunk_size for g in self.groups)
+
+    def bytes_to_write(self, chunk_size: int) -> int:
+        return sum(len(g.missing) * g.n_pgs * chunk_size for g in self.groups)
+
+    def summary(self) -> dict:
+        return {
+            "patterns": self.n_patterns,
+            "degraded_pgs": self.n_pgs,
+            "shard_rebuilds": self.n_shards,
+            "unrecoverable_pgs": int(len(self.unrecoverable)),
+            "launches_required": self.n_patterns,
+        }
+
+
+def build_plan(peering: PeeringResult, codec) -> RecoveryPlan:
+    """Group the peering pass's degraded PGs into pattern groups.
+
+    ``codec`` is any systematic GF(2^8) codec exposing ``k``, ``m`` and
+    ``generator()`` (:class:`ceph_tpu.ec.backend.MatrixCodec`); the
+    pool's ``size`` must equal k+m (EC pools are positional: acting
+    slot == shard id).
+    """
+    codec = _matrix_codec(codec)
+    k, m = codec.k, codec.m
+    if k + m != peering.size:
+        raise ValueError(
+            f"codec k+m={k + m} != pool size {peering.size}"
+        )
+    gen = codec.generator()  # [(k+m), k] identity top block
+    degraded = peering.pgs_with(PG_STATE_DEGRADED)
+    masks = peering.survivor_mask[degraded]
+    plan = RecoveryPlan(k=k, m=m)
+    unrecoverable: list[np.ndarray] = []
+    for mask in np.unique(masks):
+        pgs = degraded[masks == mask]
+        survivors = mask_to_shards(int(mask), peering.size)
+        if len(survivors) < k:
+            unrecoverable.append(pgs)
+            continue
+        rows = survivors[:k]
+        missing = tuple(
+            s for s in range(peering.size) if s not in survivors
+        )
+        inv = gf.invert_matrix(gen[list(rows)])
+        repair = gf.matrix_encode(gen[list(missing)], inv)
+        plan.groups.append(
+            PatternGroup(
+                mask=int(mask),
+                survivors=survivors,
+                rows=rows,
+                missing=missing,
+                pgs=pgs,
+                repair_matrix=repair,
+            )
+        )
+    # most shards lost first (the reference recovers the PGs nearest
+    # data loss ahead of singly-degraded ones)
+    plan.groups.sort(key=lambda g: (-len(g.missing), g.mask))
+    if unrecoverable:
+        plan.unrecoverable = np.concatenate(unrecoverable)
+    return plan

@@ -1,0 +1,122 @@
+"""Randomized recovery-loop fuzz: random clusters, random failure
+specs (osd/host/rack x down/out/down_out, plus flapping), then the
+full pipeline — peering classification re-checked against a pure-NumPy
+reference, plan invariants (every degraded PG either grouped or
+unrecoverable, one launch per pattern), and batch-decode byte-identity
+vs per-PG serial decode on a sampled group.
+
+NOT collected by pytest — run manually:
+
+    env -u PYTHONPATH CEPH_TPU_TEST_REEXEC=1 PYTHONPATH=/root/repo \\
+      JAX_PLATFORMS=cpu python tests/fuzz_recovery.py
+
+Budget via CEPH_TPU_FUZZ_SECONDS (default 900).
+"""
+
+import copy
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+
+from ceph_tpu import recovery as rec  # noqa: E402
+from ceph_tpu.ec import gf  # noqa: E402
+from ceph_tpu.ec.backend import MatrixCodec  # noqa: E402
+from ceph_tpu.models.clusters import build_osdmap  # noqa: E402
+from test_recovery import _numpy_classify  # noqa: E402
+
+
+def _random_specs(rng, m, n_osds):
+    specs = []
+    for _ in range(int(rng.integers(1, 4))):
+        scope = ["osd", "host", "rack"][int(rng.integers(0, 3))]
+        action = rec.ACTIONS[int(rng.integers(0, 3))]  # down/out/down_out
+        if scope == "osd":
+            target = str(int(rng.integers(0, n_osds)))
+        elif scope == "host":
+            hosts = [b.name for b in m.crush.buckets.values()
+                     if m.crush.types[b.type_id] == "host"]
+            target = hosts[int(rng.integers(0, len(hosts)))]
+        else:
+            racks = [b.name for b in m.crush.buckets.values()
+                     if m.crush.types[b.type_id] == "rack"]
+            target = racks[int(rng.integers(0, len(racks)))]
+        specs.append(rec.FailureSpec(scope, target, action))
+    return specs
+
+
+def main() -> int:
+    seed = int(time.time())
+    rng = np.random.default_rng(seed)
+    print(f"recovery fuzz seed {seed}", flush=True)
+    budget = int(os.environ.get("CEPH_TPU_FUZZ_SECONDS", "900"))
+    t0 = time.time()
+    trial = 0
+    while time.time() - t0 < budget:
+        trial += 1
+        n = int(rng.integers(16, 96))
+        k = int(rng.integers(2, 6))
+        m_par = int(rng.integers(1, 4))
+        pg_num = int(rng.integers(8, 64))
+        m = build_osdmap(n, pg_num=pg_num, size=k + m_par,
+                         pool_kind="erasure")
+        m_prev = copy.deepcopy(m)
+        specs = _random_specs(rng, m, n)
+        for spec in specs:
+            rec.inject(m, spec)
+        if rng.random() < 0.3:
+            rec.flap(m, rec.FailureSpec(
+                "osd", str(int(rng.integers(0, n))), "down"),
+                cycles=int(rng.integers(1, 3)))
+
+        p = rec.peer_pool(m_prev, m, 1)
+        ref_flags, ref_mask = _numpy_classify(
+            p.prev_acting, p.up, p.acting, p.size, p.min_size
+        )
+        assert (p.flags == ref_flags).all(), "flags mismatch"
+        assert (p.survivor_mask == ref_mask).all(), "mask mismatch"
+
+        codec = MatrixCodec(gf.vandermonde_matrix(k, m_par))
+        plan = rec.build_plan(p, codec)
+        degraded = set(p.pgs_with(rec.PG_STATE_DEGRADED))
+        planned = {int(pg) for g in plan.groups for pg in g.pgs}
+        lost = {int(pg) for pg in plan.unrecoverable}
+        assert planned | lost == degraded and not planned & lost
+
+        if plan.groups:
+            # byte-identity on the largest group, all PGs
+            g = max(plan.groups, key=lambda g: g.n_pgs)
+            sub = rec.RecoveryPlan(k=k, m=m_par, groups=[g])
+            store = {}
+            for pg in g.pgs:
+                data = rng.integers(0, 256, (k, 64), dtype=np.uint8)
+                store[int(pg)] = np.vstack([data, codec.encode(data)])
+            launches = []
+            ex = rec.RecoveryExecutor(
+                codec, on_decode_launch=lambda gg, nn: launches.append(1)
+            )
+            res = ex.run(sub, lambda pg, s: store[pg][s])
+            assert len(launches) == 1
+            for pg in g.pgs:
+                serial = codec.decode(
+                    {s: store[int(pg)][s] for s in g.survivors},
+                    set(g.missing),
+                )
+                for s in g.missing:
+                    assert np.array_equal(
+                        serial[s], res.shards[int(pg)][s]
+                    ), (int(pg), s)
+        if trial % 10 == 0:
+            print(f"trial {trial} ok ({time.time() - t0:.0f}s)", flush=True)
+    print(f"DONE: {trial} trials clean in {time.time() - t0:.0f}s",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

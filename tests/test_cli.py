@@ -581,3 +581,52 @@ def test_crushtool_mutation_then_check(tmp_path, capsys):
         ["-i", mapfile, "-o", mapfile, "--add-item", "8", "1.0", "osd.8",
          "--loc", "host", "host0", "--check"]) == 0
     assert "consistent" in capsys.readouterr().out
+
+
+def test_recovery_cli_inject_and_plan(capsys):
+    from ceph_tpu.cli import recovery as rcli
+
+    assert rcli.main([
+        "--num-osd", "64", "--pg-num", "32",
+        "--inject", "rack:0", "--plan",
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "inject rack:0: epoch 2" in out
+    assert "degraded" in out
+    assert "decode launches" in out
+    assert "pattern 0x" in out
+
+
+def test_recovery_cli_execute_matches_plan(capsys):
+    from ceph_tpu.cli import recovery as rcli
+
+    assert rcli.main([
+        "--num-osd", "32", "--pg-num", "16",
+        "--inject", "host:host0_1:down_out",
+        "--execute", "--chunk-size", "256",
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "execute:" in out and "launches" in out
+
+
+def test_recovery_cli_flap_and_mapfile(tmp_path, capsys):
+    from ceph_tpu.cli import recovery as rcli
+    from ceph_tpu.models.clusters import build_osdmap
+
+    m = build_osdmap(32, pg_num=16, size=6, pool_kind="erasure")
+    mapfile = str(tmp_path / "osdmap.json")
+    with open(mapfile, "wb") as f:
+        f.write(m.encode())
+    assert rcli.main([mapfile, "--flap", "osd:3", "--cycles", "2",
+                      "--plan"]) == 0
+    out = capsys.readouterr().out
+    assert "flap osd:3: 2 cycles over 4 epochs, 1 osds" in out
+    # net effect of a completed flap is a clean pool
+    assert "all clean" in out
+
+
+def test_recovery_cli_requires_an_action():
+    from ceph_tpu.cli import recovery as rcli
+
+    with pytest.raises(SystemExit):
+        rcli.main(["--plan"])

@@ -1,0 +1,437 @@
+"""Recovery subsystem: fault injection as epochs, peering
+classification bit-exact vs a pure-NumPy reference, pattern-grouped
+batch decode byte-identical to per-PG serial decode, one device launch
+per unique erasure pattern, throttle determinism, and observability
+wiring."""
+
+import copy
+
+import numpy as np
+import pytest
+
+from ceph_tpu import recovery as rec
+from ceph_tpu.common.config import Config
+from ceph_tpu.crush.map import ITEM_NONE
+from ceph_tpu.ec.backend import MatrixCodec
+from ceph_tpu.ec import gf
+from ceph_tpu.models.clusters import build_osdmap
+from ceph_tpu.recovery.peering import (
+    PG_STATE_BACKFILL,
+    PG_STATE_CLEAN,
+    PG_STATE_DEGRADED,
+    PG_STATE_INACTIVE,
+    PG_STATE_REMAPPED,
+    PG_STATE_UNDERSIZED,
+    PeeringResult,
+)
+
+
+# ---- fault injection -------------------------------------------------
+
+
+def test_parse_spec():
+    s = rec.parse_spec("rack:0:down_out")
+    assert (s.scope, s.target, s.action) == ("rack", "0", "down_out")
+    assert rec.parse_spec("osd:5").action == "down"
+    with pytest.raises(ValueError):
+        rec.parse_spec("osd:5:explode")
+    with pytest.raises(ValueError):
+        rec.parse_spec("osd")
+
+
+def test_inject_osd_down_is_ordinary_epoch():
+    m = build_osdmap(16, pg_num=16)
+    e0 = m.epoch
+    inc = rec.inject(m, "osd:3")
+    assert m.epoch == e0 + 1 and inc.epoch == m.epoch
+    assert not m.is_up(3) and not m.is_out(3)
+    # idempotent: re-injecting an applied event edits nothing
+    inc2 = rec.build_incremental(m, "osd:3")
+    assert not inc2.new_state and not inc2.new_weight
+
+
+def test_inject_bucket_scopes_resolve_subtrees():
+    m = build_osdmap(64, pg_num=16)  # 4 osds/host, 8 hosts/rack
+    assert rec.resolve_targets(m, rec.parse_spec("host:host0_1")) == [4, 5, 6, 7]
+    rack = rec.resolve_targets(m, rec.parse_spec("rack:0"))
+    assert rack == list(range(32))
+    with pytest.raises(ValueError):
+        rec.resolve_targets(m, rec.parse_spec("rack:host0_1"))  # wrong type
+    with pytest.raises(ValueError):
+        rec.resolve_targets(m, rec.parse_spec("host:nope"))
+
+
+def test_inject_down_out_and_recovery_actions():
+    m = build_osdmap(16, pg_num=16)
+    rec.inject(m, "host:host0_1:down_out")
+    assert all(not m.is_up(o) and m.is_out(o) for o in (4, 5, 6, 7))
+    rec.inject(m, ["host:host0_1:up", "host:host0_1:in"])
+    assert all(m.is_up(o) and not m.is_out(o) for o in (4, 5, 6, 7))
+
+
+def test_flap_leaves_map_up_and_records_epochs():
+    m = build_osdmap(16, pg_num=16)
+    e0 = m.epoch
+    fr = rec.flap(m, "osd:2", cycles=3)
+    assert m.is_up(2)
+    assert len(fr.incrementals) == 6 and m.epoch == e0 + 6
+    assert fr.osds == [2]
+
+
+# ---- peering vs pure-NumPy reference ---------------------------------
+
+
+def _numpy_classify(prev_acting, up, acting, size, min_size):
+    """Independent reference for the device classifier."""
+    n = len(acting)
+    flags = np.zeros(n, np.int32)
+    mask = np.zeros(n, np.uint32)
+    for i in range(n):
+        alive = acting[i] != ITEM_NONE
+        surv = alive & (acting[i] == prev_acting[i])
+        n_alive = int(alive.sum())
+        f = 0
+        if (up[i] != acting[i]).any():
+            f |= PG_STATE_REMAPPED
+        if int(surv.sum()) < size:
+            f |= PG_STATE_DEGRADED
+        if n_alive < size:
+            f |= PG_STATE_UNDERSIZED
+        if n_alive < min_size:
+            f |= PG_STATE_INACTIVE
+        for u in up[i]:
+            if u != ITEM_NONE and u not in prev_acting[i]:
+                f |= PG_STATE_BACKFILL
+                break
+        flags[i] = f or PG_STATE_CLEAN
+        mask[i] = sum(1 << s for s in range(size) if surv[s])
+    return flags, mask
+
+
+@pytest.mark.parametrize("spec", ["host:host0_1", "host:host0_1:down_out",
+                                  "rack:0:down_out"])
+def test_peering_classification_matches_numpy_reference(spec):
+    # 3-level straw2 map (rack -> host -> osd), EC pool
+    m = build_osdmap(64, pg_num=64, size=6, pool_kind="erasure")
+    m_prev = copy.deepcopy(m)
+    rec.inject(m, spec)
+    p = rec.peer_pool(m_prev, m, 1)
+    ref_flags, ref_mask = _numpy_classify(
+        p.prev_acting, p.up, p.acting, p.size, p.min_size
+    )
+    np.testing.assert_array_equal(p.flags, ref_flags)
+    np.testing.assert_array_equal(p.survivor_mask, ref_mask)
+    assert p.counts()["total"] == 64
+
+
+def test_peering_identical_epochs_all_clean():
+    m = build_osdmap(32, pg_num=32, size=6, pool_kind="erasure")
+    p = rec.peer_pool(m, m, 1)
+    assert (p.flags == PG_STATE_CLEAN).all()
+    full = (1 << p.size) - 1
+    assert (p.survivor_mask == full).all()
+    assert p.degraded_shards() == 0
+
+
+def test_peering_down_vs_down_out_semantics():
+    # down-but-in leaves acting holes (undersized); down+out remaps to
+    # fresh OSDs (backfill) — both are degraded, either way the shard
+    # data is gone from where it should be
+    m1 = build_osdmap(64, pg_num=64, size=6, pool_kind="erasure")
+    m1p = copy.deepcopy(m1)
+    rec.inject(m1, "host:host0_1")
+    p1 = rec.peer_pool(m1p, m1, 1)
+    c1 = p1.counts()
+    assert c1["degraded"] and c1["undersized"] == c1["degraded"]
+    assert c1["backfill"] == 0
+
+    m2 = build_osdmap(64, pg_num=64, size=6, pool_kind="erasure")
+    m2p = copy.deepcopy(m2)
+    rec.inject(m2, "host:host0_1:down_out")
+    p2 = rec.peer_pool(m2p, m2, 1)
+    c2 = p2.counts()
+    assert c2["degraded"] and c2["backfill"] == c2["degraded"]
+    assert c2["undersized"] == 0
+    # same PGs are affected either way: data placement moved or died
+    assert sorted(p1.pgs_with(PG_STATE_DEGRADED)) == \
+        sorted(p2.pgs_with(PG_STATE_DEGRADED))
+
+
+def test_peering_engine_reuses_compiled_program():
+    from ceph_tpu.osdmap.mapping import build_pool_state
+
+    m = build_osdmap(32, pg_num=32, size=6, pool_kind="erasure")
+    m2 = copy.deepcopy(m)
+    rec.inject(m2, "osd:0:down_out")
+    engine = rec.PeeringEngine(m, 1)
+    fn_before = engine._fn
+    s0 = build_pool_state(m, m.pools[1], 8)
+    s1 = build_pool_state(m2, m2.pools[1], 8)
+    r = engine.run(s0, s1)
+    # trial epochs are traced state on the SAME executable
+    assert engine._fn is fn_before
+    assert r.counts()["degraded"] >= 1
+
+
+# ---- pattern-grouped planning + batch decode -------------------------
+
+
+def _synth_peering(k, m_par, masks, extra_clean=0):
+    """Hand-built PeeringResult: one degraded PG per survivor mask."""
+    size = k + m_par
+    n = len(masks) + extra_clean
+    prev = np.arange(n * size, dtype=np.int32).reshape(n, size)
+    acting = prev.copy()
+    flags = np.full(n, PG_STATE_CLEAN, np.int32)
+    mask_arr = np.full(n, (1 << size) - 1, np.uint32)
+    for i, mask in enumerate(masks):
+        for s in range(size):
+            if not (mask >> s) & 1:
+                acting[i, s] = ITEM_NONE
+        flags[i] = PG_STATE_DEGRADED
+        mask_arr[i] = mask
+    alive = (acting != ITEM_NONE).sum(axis=1).astype(np.int32)
+    return PeeringResult(
+        pool_id=1, epoch_prev=1, epoch_cur=2, size=size, min_size=k,
+        up=acting.copy(), up_primary=acting[:, 0].copy(),
+        acting=acting, acting_primary=acting[:, 0].copy(),
+        prev_acting=prev, flags=flags, survivor_mask=mask_arr,
+        n_alive=alive,
+    )
+
+
+def _all_degraded_masks(k, m_par):
+    size = k + m_par
+    full = (1 << size) - 1
+    return [mask for mask in range(1 << size)
+            if bin(mask).count("1") >= k and mask != full]
+
+
+@pytest.mark.parametrize("k,m_par", [(4, 2), (8, 3)])
+def test_every_pattern_byte_identical_host_algebra(k, m_par):
+    """Exhaustive: for EVERY recoverable survivor pattern, the planner's
+    precomposed repair matrix reproduces the serial two-step decode
+    (invert, multiply, re-encode) byte-for-byte — pure host GF algebra,
+    no device in the loop."""
+    codec = MatrixCodec(gf.vandermonde_matrix(k, m_par))
+    masks = _all_degraded_masks(k, m_par)
+    peering = _synth_peering(k, m_par, masks)
+    plan = rec.build_plan(peering, codec)
+    assert plan.n_patterns == len(masks)
+    rng = np.random.default_rng(42)
+    chunk = 64
+    gen = codec.generator()
+    for g in plan.groups:
+        data = rng.integers(0, 256, (k, chunk), dtype=np.uint8)
+        shards = np.vstack([data, gf.matrix_encode(codec.matrix, data)])
+        batched = gf.matrix_encode(g.repair_matrix, shards[list(g.rows)])
+        # serial reference: the _SystematicCodec.decode algebra
+        inv = gf.invert_matrix(gen[list(g.rows)])
+        dec_data = gf.matrix_encode(inv, shards[list(g.rows)])
+        coding = gf.matrix_encode(codec.matrix, dec_data)
+        serial = np.vstack([dec_data, coding])
+        np.testing.assert_array_equal(batched, serial[list(g.missing)])
+        # and both equal the original shards (round trip)
+        np.testing.assert_array_equal(batched, shards[list(g.missing)])
+
+
+def test_batch_decode_byte_identical_to_serial_device():
+    """Device path: every (4,2) pattern through the executor, compared
+    against per-PG MatrixCodec.decode."""
+    k, m_par = 4, 2
+    codec = MatrixCodec(gf.vandermonde_matrix(k, m_par))
+    masks = _all_degraded_masks(k, m_par)
+    peering = _synth_peering(k, m_par, masks, extra_clean=3)
+    plan = rec.build_plan(peering, codec)
+    assert plan.n_pgs == len(masks)  # clean PGs not planned
+    rng = np.random.default_rng(7)
+    chunk = 128
+    store = {}
+    for g in plan.groups:
+        for pg in g.pgs:
+            data = rng.integers(0, 256, (k, chunk), dtype=np.uint8)
+            store[int(pg)] = np.vstack([data, codec.encode(data)])
+    launches = []
+    ex = rec.RecoveryExecutor(
+        codec, on_decode_launch=lambda g, n: launches.append(g.mask)
+    )
+    res = ex.run(plan, lambda pg, s: store[pg][s])
+    # exactly one launch per unique pattern, no repeats
+    assert len(launches) == plan.n_patterns == len(set(launches))
+    for g in plan.groups:
+        for pg in g.pgs:
+            serial = codec.decode(
+                {s: store[int(pg)][s] for s in g.survivors}, set(g.missing)
+            )
+            for s in g.missing:
+                np.testing.assert_array_equal(
+                    serial[s], res.shards[int(pg)][s]
+                )
+
+
+def test_plan_groups_and_unrecoverable():
+    k, m_par = 4, 2
+    codec = MatrixCodec(gf.vandermonde_matrix(k, m_par))
+    # two PGs share a pattern; one has < k survivors (data loss)
+    masks = [0b001111, 0b001111, 0b000111]
+    peering = _synth_peering(k, m_par, masks)
+    plan = rec.build_plan(peering, codec)
+    assert plan.n_patterns == 1 and plan.groups[0].n_pgs == 2
+    assert list(plan.unrecoverable) == [2]
+    s = plan.summary()
+    assert s["launches_required"] == 1 and s["unrecoverable_pgs"] == 1
+    assert plan.bytes_to_read(100) == 2 * k * 100
+    assert plan.bytes_to_write(100) == 2 * 2 * 100
+
+
+def test_plan_orders_most_missing_first():
+    k, m_par = 4, 2
+    codec = MatrixCodec(gf.vandermonde_matrix(k, m_par))
+    masks = [0b011111, 0b001111]  # 1 missing vs 2 missing
+    plan = rec.build_plan(_synth_peering(k, m_par, masks), codec)
+    assert [len(g.missing) for g in plan.groups] == [2, 1]
+
+
+def test_plan_rejects_wrong_codec_size():
+    codec = MatrixCodec(gf.vandermonde_matrix(4, 2))
+    with pytest.raises(ValueError):
+        rec.build_plan(_synth_peering(8, 3, [0b11111111000]), codec)
+
+
+def test_plan_unwraps_plugin_codec():
+    from ceph_tpu.ec.registry import create
+
+    plugin = create({"plugin": "jerasure", "technique": "reed_sol_van",
+                     "k": "4", "m": "2"})
+    plan = rec.build_plan(_synth_peering(4, 2, [0b001111]), plugin)
+    assert plan.n_patterns == 1
+
+
+# ---- throttle + executor ---------------------------------------------
+
+
+def test_token_bucket_deterministic():
+    t = [0.0]
+    slept = []
+
+    def clock():
+        return t[0]
+
+    def sleep(s):
+        slept.append(s)
+        t[0] += s
+
+    tb = rec.TokenBucket(100.0, 50.0, clock=clock, sleep=sleep)
+    assert tb.take(40) == 0.0  # within burst
+    w = tb.take(60)  # 10 left, debt 50 -> 0.5 s
+    assert w == pytest.approx(0.5)
+    t[0] += 10.0  # refill fully (capped at burst)
+    assert tb.take(50) == 0.0
+    assert tb.waited_s == pytest.approx(sum(slept))
+
+
+def test_token_bucket_disabled():
+    tb = rec.TokenBucket(0.0, 0.0, clock=lambda: 0.0,
+                         sleep=lambda s: pytest.fail("slept"))
+    assert tb.take(10**12) == 0.0
+
+
+def test_executor_respects_config_throttle():
+    k, m_par = 4, 2
+    codec = MatrixCodec(gf.vandermonde_matrix(k, m_par))
+    plan = rec.build_plan(
+        _synth_peering(k, m_par, [0b001111, 0b110011]), codec
+    )
+    cfg = Config(env={})
+    cfg.set("recovery_max_bytes_per_sec", 1000.0)
+    cfg.set("recovery_burst_bytes", 64)
+    t = [0.0]
+    ex = rec.RecoveryExecutor(
+        codec, config=cfg,
+        clock=lambda: t[0],
+        sleep=lambda s: t.__setitem__(0, t[0] + s),
+    )
+    rng = np.random.default_rng(1)
+    store = {}
+    for g in plan.groups:
+        for pg in g.pgs:
+            data = rng.integers(0, 256, (k, 64), dtype=np.uint8)
+            store[int(pg)] = np.vstack([data, codec.encode(data)])
+    res = ex.run(plan, lambda pg, s: store[pg][s])
+    # 6 chunks of 64 B move per group at 1000 B/s with a 64 B bucket
+    assert res.throttle_wait_s > 0
+    assert ex.pc.dump()["recovery"]["throttle_waits"] >= 1
+
+
+def test_recover_pool_end_to_end_with_counters():
+    m = build_osdmap(64, pg_num=32, size=6, pool_kind="erasure")
+    m_prev = copy.deepcopy(m)
+    rec.inject(m, "host:host0_1:down_out")
+    codec = MatrixCodec(gf.vandermonde_matrix(4, 2))
+    rng = np.random.default_rng(3)
+    cache = {}
+
+    def read_shard(pg, s):
+        if pg not in cache:
+            data = rng.integers(0, 256, (4, 64), dtype=np.uint8)
+            cache[pg] = np.vstack([data, codec.encode(data)])
+        return cache[pg][s]
+
+    launches = []
+    peering, plan, result = rec.recover_pool(
+        m_prev, m, 1, codec, read_shard,
+        on_decode_launch=lambda g, n: launches.append(g.mask),
+    )
+    assert result.launches == plan.n_patterns == len(launches)
+    assert result.bytes_recovered == plan.bytes_to_write(64)
+    dump = rec.recovery_counters().dump()["recovery"]
+    assert dump["l_peering"]["avgcount"] >= 1
+    assert dump["l_plan"]["avgcount"] >= 1
+    assert dump["decode_launches"] >= plan.n_patterns
+    from ceph_tpu.common import prometheus
+
+    text = prometheus.render()
+    assert "ceph_tpu_recovery_decode_launches" in text
+    assert "ceph_tpu_recovery_bytes_recovered" in text
+
+
+# ---- the acceptance scenario (large map -> slow) ---------------------
+
+
+@pytest.mark.slow
+def test_rack_failure_1k_osd_one_launch_per_pattern():
+    """Acceptance: rack failure on a 1k-OSD / (8,3) EC map issues
+    exactly one device decode launch per unique survivor pattern."""
+    m = build_osdmap(1024, pg_num=256, size=11, pool_kind="erasure")
+    m_prev = copy.deepcopy(m)
+    rec.inject(m, "rack:0:down_out")
+    peering = rec.peer_pool(m_prev, m, 1)
+    codec = MatrixCodec(gf.vandermonde_matrix(8, 3))
+    plan = rec.build_plan(peering, codec)
+    assert plan.n_pgs > 0 and len(plan.unrecoverable) == 0
+    rng = np.random.default_rng(11)
+    store = {}
+    for g in plan.groups:
+        for pg in g.pgs:
+            data = rng.integers(0, 256, (8, 256), dtype=np.uint8)
+            store[int(pg)] = np.vstack([data, codec.encode(data)])
+    launches = []
+    ex = rec.RecoveryExecutor(
+        codec, on_decode_launch=lambda g, n: launches.append(g.mask)
+    )
+    res = ex.run(plan, lambda pg, s: store[pg][s])
+    assert len(launches) == plan.n_patterns
+    assert len(set(launches)) == len(launches)
+    assert res.shards_rebuilt == plan.n_shards
+    # spot-check byte identity on the largest group
+    g = max(plan.groups, key=lambda g: g.n_pgs)
+    pg = int(g.pgs[0])
+    serial = codec.decode(
+        {s: store[pg][s] for s in g.survivors}, set(g.missing)
+    )
+    for s in g.missing:
+        np.testing.assert_array_equal(serial[s], res.shards[pg][s])
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])

@@ -211,7 +211,8 @@ def test_unfiltered_configs_cover_all_baseline_configs():
     names = [n for n, _ in run_all.CONFIGS]
     assert names == [
         "config1_crush", "config2_ec_encode", "config3_upmap",
-        "config4_repair_decode", "config5_rebalance_sim", "tpu_tier",
+        "config4_repair_decode", "config5_rebalance_sim",
+        "config6_recovery", "tpu_tier",
     ]
 
 
