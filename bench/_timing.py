@@ -20,7 +20,8 @@ from __future__ import annotations
 import time
 
 
-def chained_rate(step, state0, *, iters: int = 10, reps: int = 3):
+def chained_rate(step, state0, *, iters: int = 10, reps: int = 3,
+                 on_warm=None):
     """Best seconds/iteration over ``reps`` segments of one continuous
     ``iters``-step chain.
 
@@ -30,6 +31,11 @@ def chained_rate(step, state0, *, iters: int = 10, reps: int = 3):
     no dispatch ever repeats previously-seen input values — reading
     back one scalar per segment.  Returns (best_seconds_per_iter,
     last_checksum).
+
+    ``on_warm``, if given, is called once after the warm-up readback
+    and before any timed segment — the seam where a guard (see
+    ceph_tpu.analysis.runtime_guard) snapshots its first-run compile
+    count, so steady-state recompiles are attributable.
     """
     import jax
     import jax.numpy as jnp
@@ -40,6 +46,8 @@ def chained_rate(step, state0, *, iters: int = 10, reps: int = 3):
 
     st = step(state0)
     _readback(st)  # compile + warm + prove execution
+    if on_warm is not None:
+        on_warm()
     best = float("inf")
     checksum = 0.0
     # One continuous chain across reps — never reset to state0, so no

@@ -49,11 +49,21 @@ def main() -> None:
 
     from _timing import chained_rate
 
+    from ceph_tpu.analysis.runtime_guard import track
+
     def step(xs):
         res, lens = run(crush_arg, osd_weight, xs)
         return xs + lens.astype(jnp.uint32) + jnp.uint32(1)
 
-    dt, _ = chained_rate(step, xs0, iters=5, reps=3)
+    # guard the whole device phase: n_compiles_first is the count after
+    # the warm-up dispatch; a steady-state n_compiles above it means the
+    # timed loop recompiled (the J004 bug class, caught at runtime)
+    warm: dict = {}
+    with track() as guard:
+        dt, _ = chained_rate(
+            step, xs0, iters=5, reps=3,
+            on_warm=lambda: warm.update(guard.snapshot()),
+        )
     tpu_rate = N_OBJECTS / dt
 
     print(json.dumps({
@@ -62,6 +72,9 @@ def main() -> None:
         "unit": "placements/s",
         "vs_baseline": round(tpu_rate / cpu_rate, 2),
         "platform": jax.default_backend(),
+        "n_compiles": guard.n_compiles,
+        "n_compiles_first": warm.get("n_compiles", 0),
+        "host_transfers": guard.host_transfers,
     }))
 
 

@@ -54,6 +54,8 @@ def bench_profile(k, m, chunk, batch_mb, technique="reed_sol_van", packetsize=20
 
     from _timing import chained_rate
 
+    from ceph_tpu.analysis.runtime_guard import track
+
     # Chained timing (see bench/_timing.py): fold one output word back
     # into the next input so every dispatch is a real, un-elidable
     # execution; host-side packing is done once, outside the timed loop.
@@ -67,17 +69,28 @@ def bench_profile(k, m, chunk, batch_mb, technique="reed_sol_van", packetsize=20
             out = _encode_padded(masks_dev, dw, interpret=enc._interpret)
             return dw ^ out[0:1, :]  # [KW,NW] ^ broadcast row: dependency
 
-        dt, _ = chained_rate(step, jnp.asarray(d_words), iters=10, reps=3)
+        state0 = jnp.asarray(d_words)
     elif hasattr(enc, "_encode"):
         def step(dev):
             out = enc._encode(dev)
             return dev ^ out[0:1, :]
 
-        dt, _ = chained_rate(step, jnp.asarray(data), iters=10, reps=3)
+        state0 = jnp.asarray(data)
     else:  # every engine exposes _encode; fail loudly if one stops
         raise TypeError(f"no timing path for {type(enc).__name__}")
+    warm: dict = {}
+    with track() as guard:
+        dt, _ = chained_rate(
+            step, state0, iters=10, reps=3,
+            on_warm=lambda: warm.update(guard.snapshot()),
+        )
     rate = k * size / dt  # data bytes encoded per second
-    return rate, cpu_rate
+    stats = {
+        "n_compiles": guard.n_compiles,
+        "n_compiles_first": warm.get("n_compiles", 0),
+        "host_transfers": guard.host_transfers,
+    }
+    return rate, cpu_rate, stats
 
 
 def main() -> None:
@@ -99,15 +112,15 @@ def main() -> None:
     results = {}
     for name, args in profiles.items():
         k, m, chunk, mb, tech = args
-        rate, cpu = bench_profile(k, m, chunk, mb, tech)
-        results[name] = (rate, cpu)
+        rate, cpu, stats = bench_profile(k, m, chunk, mb, tech)
+        results[name] = (rate, cpu, stats)
         print(
             f"{name}: {rate / 1e9:.2f} GB/s device, {cpu / 1e9:.3f} GB/s cpu-ref",
             file=sys.stderr,
         )
     # the headline is the BASELINE north-star shape — EC(8,3) — on the
     # best engine for it (never a different (k,m) mislabeled as 8_3)
-    best_name, (rate, cpu) = max(
+    best_name, (rate, cpu, stats) = max(
         (kv for kv in results.items() if "8_3" in kv[0]),
         key=lambda kv: kv[1][0],
     )
@@ -118,9 +131,10 @@ def main() -> None:
         "vs_baseline": round(rate / cpu, 2),
         "engine": best_name,
         "profiles_gbps": {
-            name: round(r / 1e9, 3) for name, (r, _) in results.items()
+            name: round(r / 1e9, 3) for name, (r, *_rest) in results.items()
         },
         "platform": jax.default_backend(),
+        **stats,  # n_compiles / n_compiles_first / host_transfers
     }))
 
 

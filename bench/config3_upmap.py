@@ -29,24 +29,28 @@ def main() -> None:
     from ceph_tpu.models.clusters import build_osdmap, build_skewed_osdmap
     from ceph_tpu.osdmap.mapping import OSDMapMapping
 
+    from ceph_tpu.analysis.runtime_guard import track
+
     # --- bulk remap rate on the uniform map (comparable across rounds)
     m = build_osdmap(N_OSDS, pg_num=PG_NUM)
-    mapping = OSDMapMapping(m)
-    mapping.update()  # compile + first run
+    with track() as guard:
+        mapping = OSDMapMapping(m)
+        mapping.update()  # compile + first run
+        warm = guard.snapshot()
 
-    iters = 5
-    t0 = time.perf_counter()
-    for i in range(iters):
-        # perturb one reweight per iteration: every update recomputes a
-        # genuinely different map (elision defense, see bench/_timing.py;
-        # also the reference's actual workload — remap after map change).
-        # Toggle against the stored value so EVERY iteration changes
-        # the map (writing the default back would be a no-op dispatch).
-        m.osd_weight[i % N_OSDS] = (
-            0xFFFF if m.osd_weight[i % N_OSDS] == 0x10000 else 0x10000
-        )
-        mapping.update()
-    per_update = (time.perf_counter() - t0) / iters
+        iters = 5
+        t0 = time.perf_counter()
+        for i in range(iters):
+            # perturb one reweight per iteration: every update recomputes a
+            # genuinely different map (elision defense, see bench/_timing.py;
+            # also the reference's actual workload — remap after map change).
+            # Toggle against the stored value so EVERY iteration changes
+            # the map (writing the default back would be a no-op dispatch).
+            m.osd_weight[i % N_OSDS] = (
+                0xFFFF if m.osd_weight[i % N_OSDS] == 0x10000 else 0x10000
+            )
+            mapping.update()
+        per_update = (time.perf_counter() - t0) / iters
     rate = PG_NUM / per_update
 
     # --- optimizer convergence on a skewed map at the same scale
@@ -92,6 +96,9 @@ def main() -> None:
         "unit": "pg_mappings/s",
         "vs_baseline": None,
         "platform": jax.default_backend(),
+        "n_compiles": guard.n_compiles,
+        "n_compiles_first": warm["n_compiles"],
+        "host_transfers": guard.host_transfers,
         "optimizer": {
             "pg_num": PG_NUM,
             "rounds": rounds,

@@ -32,18 +32,22 @@ def main() -> None:
         i: {z: enc[i][z * sub_size : (z + 1) * sub_size] for z in planes}
         for i in helpers
     }
-    out = clay.repair(0, hs)  # warm (compile decode matrices)
-    # chain: fold the previous output into one helper plane so every
-    # timed call has fresh input values — repeated identical dispatches
-    # are elided below JAX on this machine (see bench/_timing.py)
-    h0 = min(helpers)
-    z0 = int(planes[0])
-    iters = 3
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        hs[h0][z0] = hs[h0][z0] ^ out[:sub_size]
-        out = clay.repair(0, hs)
-    dt = (time.perf_counter() - t0) / iters
+    from ceph_tpu.analysis.runtime_guard import track
+
+    with track() as guard:
+        out = clay.repair(0, hs)  # warm (compile decode matrices)
+        warm = guard.snapshot()
+        # chain: fold the previous output into one helper plane so every
+        # timed call has fresh input values — repeated identical dispatches
+        # are elided below JAX on this machine (see bench/_timing.py)
+        h0 = min(helpers)
+        z0 = int(planes[0])
+        iters = 3
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            hs[h0][z0] = hs[h0][z0] ^ out[:sub_size]
+            out = clay.repair(0, hs)
+        dt = (time.perf_counter() - t0) / iters
     rate = len(enc[0]) / dt
     read_frac = len(planes) / subs * len(helpers) / 4  # vs k full chunks
 
@@ -73,6 +77,9 @@ def main() -> None:
         "unit": "B/s",
         "vs_baseline": round(read_frac, 3),
         "platform": jax.default_backend(),
+        "n_compiles": guard.n_compiles,
+        "n_compiles_first": warm["n_compiles"],
+        "host_transfers": guard.host_transfers,
     }))
 
 

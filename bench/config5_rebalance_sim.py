@@ -61,21 +61,25 @@ def main() -> None:
     failed = np.random.default_rng(0).choice(N_OSDS, FAILED_OSDS, replace=False)
     w_after[failed] = 0
 
-    # warm with the SAME scalar dtype the timed loop uses (a python int
-    # would trace a second jit signature and recompile inside the timing)
-    jax.block_until_ready(step(w_before, w_after, np.uint32(0)))
+    from ceph_tpu.analysis.runtime_guard import track
 
-    n_launches = max(1, N_OBJECTS // per_launch)
-    covered = n_launches * per_launch
-    moved = 0
-    pending = []
-    t0 = time.perf_counter()
-    for i in range(n_launches):
-        pending.append(step(w_before, w_after, np.uint32(i * per_launch)))
-        if len(pending) > 2:  # keep 2 launches in flight
-            moved += int(pending.pop(0))
-    moved += sum(int(p) for p in pending)
-    dt = time.perf_counter() - t0
+    with track() as guard:
+        # warm with the SAME scalar dtype the timed loop uses (a python int
+        # would trace a second jit signature and recompile inside the timing)
+        jax.block_until_ready(step(w_before, w_after, np.uint32(0)))
+        warm = guard.snapshot()
+
+        n_launches = max(1, N_OBJECTS // per_launch)
+        covered = n_launches * per_launch
+        moved = 0
+        pending = []
+        t0 = time.perf_counter()
+        for i in range(n_launches):
+            pending.append(step(w_before, w_after, np.uint32(i * per_launch)))
+            if len(pending) > 2:  # keep 2 launches in flight
+                moved += int(pending.pop(0))
+        moved += sum(int(p) for p in pending)
+        dt = time.perf_counter() - t0
     rate = 2 * covered / dt  # two placements per object (before/after)
 
     frac = moved / covered
@@ -93,6 +97,9 @@ def main() -> None:
         "devices": ndev,
         "objects": covered,
         "platform": jax.default_backend(),
+        "n_compiles": guard.n_compiles,
+        "n_compiles_first": warm["n_compiles"],
+        "host_transfers": guard.host_transfers,
     }))
 
 

@@ -64,14 +64,18 @@ def main() -> None:
             data = rng.integers(0, 256, (K, CHUNK), dtype=np.uint8)
             store[int(pg)] = np.vstack([data, codec.encode(data)])
 
+    from ceph_tpu.analysis.runtime_guard import track
+
     launches = []
     ex = rec.RecoveryExecutor(
         codec, on_decode_launch=lambda g, n: launches.append(g.mask)
     )
-    ex.run(plan, lambda pg, s: store[pg][s])  # warm (compile per pattern)
-    t0 = time.perf_counter()
-    result = ex.run(plan, lambda pg, s: store[pg][s])
-    t_decode = time.perf_counter() - t0
+    with track() as guard:
+        ex.run(plan, lambda pg, s: store[pg][s])  # warm (compile per pattern)
+        warm = guard.snapshot()
+        t0 = time.perf_counter()
+        result = ex.run(plan, lambda pg, s: store[pg][s])
+        t_decode = time.perf_counter() - t0
     rate = result.bytes_recovered / t_decode
     assert result.launches == plan.n_patterns
 
@@ -109,6 +113,9 @@ def main() -> None:
         "unit": "B/s",
         "vs_baseline": round(rate / serial_rate, 3) if serial_rate else 0.0,
         "platform": jax.default_backend(),
+        "n_compiles": guard.n_compiles,
+        "n_compiles_first": warm["n_compiles"],
+        "host_transfers": guard.host_transfers,
     }))
 
 

@@ -47,6 +47,13 @@ MODES = {
 # recovery subsystem's batched repair-decode rate (config6_recovery).
 AUX_METRICS = ("recovery_decode_bytes_per_sec",)
 
+# Runtime-guard fields the bench configs attach to their JSON lines
+# (ceph_tpu.analysis.runtime_guard): compile and device->host transfer
+# counts.  Carried per metric so the decision record shows whether the
+# winning rates were measured compile-once (n_compiles ==
+# n_compiles_first) and device-resident.
+GUARD_FIELDS = ("n_compiles", "n_compiles_first", "host_transfers")
+
 
 def harvest_aux(paths: list[str]) -> dict[str, int]:
     """Collect auxiliary metric -> best value from the logs.
@@ -74,6 +81,42 @@ def harvest_aux(paths: list[str]) -> dict[str, int]:
             if name in AUX_METRICS and d.get("value"):
                 aux[name] = max(aux.get(name, 0), int(d["value"]))
     return aux
+
+
+def harvest_guard(paths: list[str]) -> dict[str, dict]:
+    """Collect metric -> runtime-guard counters from the logs.
+
+    Latest ``platform: "tpu"`` line per metric wins (counters describe
+    that one run, so best-of makes no sense here).  Adds a derived
+    ``steady_state_clean`` flag: True iff nothing compiled after the
+    warm-up dispatch — the compile-once claim the linter's J004 rule
+    makes statically, checked on silicon.
+    """
+    guard: dict[str, dict] = {}
+    for path in paths:
+        try:
+            lines = open(path).read().splitlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if d.get("platform") != "tpu" or not d.get("metric"):
+                continue
+            fields = {f: int(d[f]) for f in GUARD_FIELDS if f in d}
+            if not fields:
+                continue
+            if "n_compiles" in fields and "n_compiles_first" in fields:
+                fields["steady_state_clean"] = (
+                    fields["n_compiles"] == fields["n_compiles_first"]
+                )
+            guard[d["metric"]] = fields
+    return guard
 
 
 def harvest(paths: list[str]) -> dict[str, int]:
@@ -219,6 +262,9 @@ def main() -> int:
     aux = harvest_aux(paths)
     if aux:
         out["aux_metrics"] = aux
+    guard = harvest_guard(paths)
+    if guard:
+        out["guard_metrics"] = guard
     print(json.dumps(out), flush=True)
     if write:
         try:

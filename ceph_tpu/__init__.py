@@ -15,7 +15,9 @@ except that 64-bit types become representable.
 
 from jax import config as _jax_config
 
-_jax_config.update("jax_enable_x64", True)
+# the ONE sanctioned global x64 toggle (everything else goes through
+# the enable_x64 shim below — jaxlint J005 enforces that)
+_jax_config.update("jax_enable_x64", True)  # jaxlint: disable=J005
 
 
 def enable_x64(new_val: bool = True):
@@ -27,9 +29,10 @@ def enable_x64(new_val: bool = True):
     kernels — Mosaic rejects i64 leaking into BlockSpec index maps)
     goes through this one shim so the next rename is a one-line fix.
     """
-    from jax.experimental import enable_x64 as _enable_x64
+    # this function IS the shim jaxlint J005 points everyone at
+    from jax.experimental import enable_x64 as _enable_x64  # jaxlint: disable=J005
 
-    return _enable_x64(new_val)
+    return _enable_x64(new_val)  # jaxlint: disable=J005
 
 
 __version__ = "0.1.0"

@@ -1,0 +1,62 @@
+"""jaxlint — tracing-safety & recompile static analysis for the TPU
+data path, plus the runtime guard that verifies its claims.
+
+Static half (AST, no jax import needed):
+
+====  ======================  ==============================================
+J001  python-branch-on-traced Python ``if``/``while`` on traced values in
+                              jit/Pallas bodies
+J002  unpinned-loop-dtype     fori/while_loop bounds or carries as raw
+                              Python scalars (the PR-1 x64 bug class)
+J003  host-sync-in-loop       block_until_ready/.item()/np.asarray(call)
+                              in host loops of hot modules
+J004  recompile-forcer        jit/pallas_call built per-iteration; Python
+                              constants at non-static jit positions
+J005  raw-x64-toggle          jax_enable_x64 touched outside the
+                              ceph_tpu.enable_x64 shim
+J006  tracer-leak             traced values stored on self/globals
+====  ======================  ==============================================
+
+Runtime half: :func:`ceph_tpu.analysis.runtime_guard.track` counts XLA
+compiles and device->host transfers so bench records ``n_compiles`` /
+``host_transfers`` per config, and
+:func:`~ceph_tpu.analysis.runtime_guard.assert_no_recompile` turns
+"the hot path compiles once" into an assertion.
+
+Suppress a finding with ``# jaxlint: disable=J00x`` on (or directly
+above) the flagged line.
+"""
+
+from .findings import RULES, Finding, Suppressions
+from .runner import (
+    HOT_SEGMENTS,
+    LintResult,
+    is_hot,
+    iter_py_files,
+    lint_paths,
+    lint_source,
+)
+from .runtime_guard import (
+    CompileCounter,
+    GuardStats,
+    TransferCounter,
+    assert_no_recompile,
+    track,
+)
+
+__all__ = [
+    "RULES",
+    "Finding",
+    "Suppressions",
+    "HOT_SEGMENTS",
+    "LintResult",
+    "is_hot",
+    "iter_py_files",
+    "lint_paths",
+    "lint_source",
+    "CompileCounter",
+    "GuardStats",
+    "TransferCounter",
+    "assert_no_recompile",
+    "track",
+]

@@ -1,0 +1,599 @@
+"""jaxlint AST checkers J001-J006, tuned to this codebase's JAX idioms.
+
+One :class:`Analyzer` instance lints one module.  Two passes:
+
+1. *Collect* — find every traced entry point and its static-argument
+   spec: functions decorated ``@jax.jit`` / ``@partial(jax.jit, ...)``,
+   ``name = jax.jit(fn)`` bindings, Pallas kernel bodies (a function
+   whose first argument is passed to ``pl.pallas_call`` or — the repo
+   convention — with two or more parameters ending in ``_ref``), and
+   functions handed to ``lax`` control flow.
+
+2. *Check* — walk the module with a scope stack.  Inside a traced
+   scope a conservative dataflow marks "traced names": non-static
+   parameters plus anything assigned from an expression that touches a
+   traced name or a ``jnp``/``lax`` call.  Shape/dtype/ndim accesses
+   and ``len()`` break the taint (they are static under tracing).
+
+The dataflow is deliberately an under-approximation: helpers that are
+*called from* jit but not decorated are not traced scopes, and a bare
+name flowing in from a closure is assumed static.  The linter's gate
+(tests/test_lint_clean.py) needs zero false positives far more than it
+needs the last false negative — every rule still has a runtime
+counterpart in :mod:`ceph_tpu.analysis.runtime_guard`.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .findings import Finding
+
+#: canonical roots whose calls produce traced values
+_TRACED_CALL_ROOTS = ("jax.numpy", "jax.lax", "jax.nn", "jax.scipy")
+
+#: attributes of a traced value that are static Python objects
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "aval", "sharding",
+                 "weak_type", "nbytes", "itemsize"}
+
+#: calls that return static metadata even on traced arguments
+_STATIC_CALLS = {"len", "jax.numpy.shape", "jax.numpy.ndim",
+                 "jax.numpy.result_type", "jax.numpy.broadcast_shapes",
+                 "isinstance", "hasattr", "type"}
+
+#: dtype-constructor call targets accepted as a J002 "pin"
+_DTYPE_PINS = {
+    f"{root}.{name}"
+    for root in ("jax.numpy", "numpy")
+    for name in ("int8", "int16", "int32", "int64",
+                 "uint8", "uint16", "uint32", "uint64")
+}
+
+_HOST_SYNC_FUNCS = {"jax.block_until_ready"}
+_NP_CONVERT = {"numpy.asarray", "numpy.array", "numpy.ascontiguousarray"}
+
+_LAX_BODY_TAKERS = {"jax.lax.fori_loop", "jax.lax.while_loop",
+                    "jax.lax.scan", "jax.lax.cond", "jax.lax.map",
+                    "jax.lax.switch"}
+
+_LOOP_NODES = (ast.For, ast.While, ast.AsyncFor)
+_COMP_NODES = (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+
+class ImportMap:
+    """Resolve local names to canonical dotted paths."""
+
+    _BUILTIN_CANON = {
+        "jnp": "jax.numpy", "np": "numpy", "lax": "jax.lax",
+    }
+
+    def __init__(self, tree: ast.Module):
+        self.alias: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.alias[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                # relative imports keep their tail (enable_x64 shim is
+                # recognized through "<pkg>.enable_x64")
+                base = ("." * node.level) + node.module if node.level else node.module
+                for a in node.names:
+                    self.alias[a.asname or a.name] = f"{base}.{a.name}"
+
+    def resolve(self, node: ast.expr) -> str | None:
+        """Dotted canonical path for a Name/Attribute chain, else None."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.alias.get(node.id, node.id)
+        return ".".join([root] + list(reversed(parts)))
+
+
+@dataclass
+class StaticSpec:
+    """static_argnums/static_argnames of one jit wrapper."""
+
+    argnums: frozenset[int] = frozenset()
+    argnames: frozenset[str] = frozenset()
+
+
+def _literal_ints(node: ast.expr) -> frozenset[int]:
+    try:
+        v = ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return frozenset()
+    if isinstance(v, int):
+        return frozenset([v])
+    if isinstance(v, (tuple, list)) and all(isinstance(x, int) for x in v):
+        return frozenset(v)
+    return frozenset()
+
+
+def _literal_strs(node: ast.expr) -> frozenset[str]:
+    try:
+        v = ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return frozenset()
+    if isinstance(v, str):
+        return frozenset([v])
+    if isinstance(v, (tuple, list)) and all(isinstance(x, str) for x in v):
+        return frozenset(v)
+    return frozenset()
+
+
+@dataclass
+class _Scope:
+    traced: bool
+    traced_names: set[str] = field(default_factory=set)
+    global_names: set[str] = field(default_factory=set)
+
+
+class Analyzer(ast.NodeVisitor):
+    """Lint one parsed module; collects :class:`Finding` objects."""
+
+    def __init__(self, path: str, tree: ast.Module, hot: bool = True):
+        self.path = path
+        self.tree = tree
+        self.hot = hot
+        self.imports = ImportMap(tree)
+        self.findings: list[Finding] = []
+        self._scopes: list[_Scope] = [_Scope(traced=False)]
+        self._host_loop_depth = 0
+        # collect pass
+        self.jitted: dict[str, StaticSpec] = {}
+        self._kernel_fns: set[str] = set()
+        self._lax_bodies: set[str] = set()
+        self._collect()
+
+    # ------------------------------------------------------------- collect
+
+    def _jit_target(self, call: ast.Call) -> StaticSpec | None:
+        """StaticSpec if ``call`` constructs a jit wrapper, else None."""
+        fn = self.imports.resolve(call.func)
+        if fn in ("jax.jit", "jit", "jax.pjit"):
+            spec = StaticSpec()
+        elif fn in ("functools.partial", "partial") and call.args:
+            inner = self.imports.resolve(call.args[0])
+            if inner not in ("jax.jit", "jit", "jax.pjit"):
+                return None
+            spec = StaticSpec()
+        else:
+            return None
+        nums: frozenset[int] = frozenset()
+        names: frozenset[str] = frozenset()
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                nums = _literal_ints(kw.value)
+            elif kw.arg == "static_argnames":
+                names = _literal_strs(kw.value)
+        return StaticSpec(argnums=nums, argnames=names)
+
+    def _decorator_spec(self, fn: ast.FunctionDef) -> StaticSpec | None:
+        for dec in fn.decorator_list:
+            if isinstance(dec, ast.Call):
+                spec = self._jit_target(dec)
+                if spec is not None:
+                    return spec
+            elif self.imports.resolve(dec) in ("jax.jit", "jit", "jax.pjit"):
+                return StaticSpec()
+        return None
+
+    def _collect(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                spec = self._decorator_spec(node)
+                if spec is not None:
+                    self.jitted[node.name] = spec
+                params = [a.arg for a in node.args.args]
+                if sum(p.endswith("_ref") for p in params) >= 2:
+                    self._kernel_fns.add(node.name)
+            elif isinstance(node, ast.Call):
+                fn = self.imports.resolve(node.func)
+                if fn is None:
+                    continue
+                if fn.endswith("pallas_call") and node.args:
+                    if isinstance(node.args[0], ast.Name):
+                        self._kernel_fns.add(node.args[0].id)
+                elif fn in _LAX_BODY_TAKERS:
+                    for arg in node.args:
+                        if isinstance(arg, ast.Name):
+                            self._lax_bodies.add(arg.id)
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                spec = self._jit_target(node.value)
+                if spec is not None:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.jitted[tgt.id] = spec
+
+    # ----------------------------------------------------------- taint
+
+    @property
+    def _scope(self) -> _Scope:
+        return self._scopes[-1]
+
+    def _is_traced(self, node: ast.expr) -> bool:
+        """Conservative may-be-traced test under the current scope."""
+        sc = self._scope
+        if not sc.traced:
+            return False
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in sc.traced_names
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self._is_traced(node.value)
+        if isinstance(node, ast.Subscript):
+            return self._is_traced(node.value) or self._is_traced(node.slice)
+        if isinstance(node, ast.Call):
+            fn = self.imports.resolve(node.func)
+            if fn in _STATIC_CALLS:
+                return False
+            if fn and fn.startswith(_TRACED_CALL_ROOTS):
+                return True
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            if any(self._is_traced(a) for a in args):
+                return True
+            # method on a traced object (x.astype(...), x.at[i].set(v))
+            if isinstance(node.func, ast.Attribute):
+                return self._is_traced(node.func.value)
+            return False
+        if isinstance(node, (ast.BinOp,)):
+            return self._is_traced(node.left) or self._is_traced(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._is_traced(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self._is_traced(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            return self._is_traced(node.left) or any(
+                self._is_traced(c) for c in node.comparators
+            )
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self._is_traced(e) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return any(
+                self._is_traced(n) for n in (node.test, node.body, node.orelse)
+            )
+        if isinstance(node, ast.Starred):
+            return self._is_traced(node.value)
+        return False
+
+    def _mark_targets(self, target: ast.expr) -> None:
+        if isinstance(target, ast.Name):
+            self._scope.traced_names.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._mark_targets(e)
+        elif isinstance(target, ast.Starred):
+            self._mark_targets(target.value)
+
+    # ----------------------------------------------------------- reporting
+
+    def _report(self, rule: str, node: ast.AST, message: str) -> None:
+        self.findings.append(
+            Finding(rule, self.path,
+                    getattr(node, "lineno", 0),
+                    getattr(node, "col_offset", 0) + 1, message)
+        )
+
+    # ----------------------------------------------------------- visitors
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._enter_function(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._enter_function(node)
+
+    def _enter_function(self, node) -> None:
+        spec = None
+        traced = self._scope.traced  # nested defs trace with their parent
+        if node.name in self.jitted:
+            spec = self.jitted[node.name]
+            traced = True
+        if node.name in self._kernel_fns or node.name in self._lax_bodies:
+            traced = True
+        scope = _Scope(traced=traced)
+        if traced:
+            params = [a.arg for a in node.args.args]
+            for i, p in enumerate(params):
+                if spec is not None and (
+                    i in spec.argnums or p in spec.argnames
+                ):
+                    continue
+                scope.traced_names.add(p)
+        self._scopes.append(scope)
+        outer_loops = self._host_loop_depth
+        if traced:
+            # a Python loop in a traced scope unrolls at trace time; it
+            # is not a host loop (J003/J004 do not apply inside)
+            self._host_loop_depth = 0
+        for stmt in node.body:
+            self.visit(stmt)
+        self._host_loop_depth = outer_loops
+        self._scopes.pop()
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self._scope.global_names.update(node.names)
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_branch(node, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_branch(node, "while")
+        self._visit_host_loop(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        if self._scope.traced and self._is_traced(node.iter):
+            self._report(
+                "J001", node,
+                "Python `for` over a traced value inside a jit/Pallas "
+                "body; use lax.fori_loop/scan",
+            )
+        if self._scope.traced and self._is_traced(node.iter):
+            # iterating a traced value taints the loop targets;
+            # range()/enumerate() iteration stays Python
+            self._mark_targets(node.target)
+        self._visit_host_loop(node)
+
+    visit_AsyncFor = visit_For
+
+    def _visit_host_loop(self, node) -> None:
+        host = not self._scope.traced
+        if host:
+            self._host_loop_depth += 1
+        self.generic_visit(node)
+        if host:
+            self._host_loop_depth -= 1
+
+    def _check_branch(self, node, kw: str) -> None:
+        if self._scope.traced and self._is_traced(node.test):
+            self._report(
+                "J001", node,
+                f"Python `{kw}` on a traced value inside a jit/Pallas "
+                "body; use jnp.where/lax.cond/lax.select",
+            )
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._check_tracer_leak(node.targets, node.value, node)
+        if self._scope.traced and self._is_traced(node.value):
+            for tgt in node.targets:
+                self._mark_targets(tgt)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_tracer_leak([node.target], node.value, node)
+        if self._scope.traced and self._is_traced(node.value):
+            self._mark_targets(node.target)
+        self.generic_visit(node)
+
+    def _check_tracer_leak(self, targets, value, node) -> None:
+        if not self._scope.traced or not self._is_traced(value):
+            return
+        for tgt in targets:
+            if (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                self._report(
+                    "J006", node,
+                    f"traced value stored on `self.{tgt.attr}` inside a "
+                    "jit/Pallas body leaks the tracer; return it instead",
+                )
+            elif (
+                isinstance(tgt, ast.Name)
+                and tgt.id in self._scope.global_names
+            ):
+                self._report(
+                    "J006", node,
+                    f"traced value stored in global `{tgt.id}` inside a "
+                    "jit/Pallas body leaks the tracer; return it instead",
+                )
+
+    # ------------------------------------------------------------- calls
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = self.imports.resolve(node.func)
+        if fn:
+            if fn.endswith("fori_loop") and (
+                fn.startswith("jax.lax") or fn == "lax.fori_loop"
+            ):
+                self._check_fori(node)
+            elif fn.endswith("while_loop") and (
+                fn.startswith("jax.lax") or fn == "lax.while_loop"
+            ):
+                self._check_while_loop(node)
+            elif fn in _HOST_SYNC_FUNCS:
+                self._check_host_sync(
+                    node, "jax.block_until_ready() inside a host loop"
+                )
+            elif fn in _NP_CONVERT and node.args and self._device_call(
+                node.args[0]
+            ):
+                self._check_host_sync(
+                    node, f"{fn}(<device call>) inside a host loop"
+                )
+            elif fn.endswith(".update") and node.args:
+                first = node.args[0]
+                if (
+                    isinstance(first, ast.Constant)
+                    and first.value == "jax_enable_x64"
+                ):
+                    self._report(
+                        "J005", node,
+                        'raw config.update("jax_enable_x64", ...); use '
+                        "the ceph_tpu.enable_x64 shim",
+                    )
+            elif fn == "jax.experimental.enable_x64" or fn.endswith(
+                "experimental.enable_x64"
+            ):
+                self._report(
+                    "J005", node,
+                    "direct jax.experimental.enable_x64; use the "
+                    "ceph_tpu.enable_x64 shim",
+                )
+            if (
+                self._host_loop_depth > 0
+                and not self._scope.traced
+                and (self._jit_target(node) is not None
+                     or fn.endswith("pallas_call"))
+            ):
+                self._report(
+                    "J004", node,
+                    "jit/pallas_call wrapper constructed inside a loop: "
+                    "a fresh wrapper identity recompiles every "
+                    "iteration; hoist it out of the loop",
+                )
+            self._check_static_call_args(node, fn)
+        # .item() on anything inside a host loop of a hot module
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "item"
+            and not node.args
+        ):
+            self._check_host_sync(node, ".item() inside a host loop")
+        self.generic_visit(node)
+
+    def _device_call(self, node: ast.expr) -> bool:
+        """A call plausibly launching device work: a bare local
+        function (the compiled-fn idiom) or a jnp/jax-rooted call.
+        Method calls like ``C[i].reshape(-1)`` are the host-numpy
+        manipulation idiom and stay exempt."""
+        if not isinstance(node, ast.Call):
+            return False
+        if isinstance(node.func, ast.Name):
+            return True
+        fn = self.imports.resolve(node.func)
+        return bool(fn) and fn.startswith(("jax", "jnp"))
+
+    def _check_host_sync(self, node: ast.Call, what: str) -> None:
+        if self.hot and self._host_loop_depth > 0 and not self._scope.traced:
+            self._report(
+                "J003", node,
+                f"{what} serializes the device pipeline in a hot "
+                "module; sync once after the loop",
+            )
+
+    def _check_fori(self, node: ast.Call) -> None:
+        labels = ("lower bound", "upper bound")
+        for i, arg in enumerate(node.args[:2]):
+            if self._plainly_python_int(arg):
+                self._report(
+                    "J002", node,
+                    f"fori_loop {labels[i]} is a raw Python int: under "
+                    "enable_x64 the loop counter traces as i64 (Mosaic "
+                    "rejects it in Pallas kernels); pin with "
+                    "jnp.int32(...)",
+                )
+        if len(node.args) >= 4:
+            self._check_carry(node.args[3], "fori_loop")
+
+    def _check_while_loop(self, node: ast.Call) -> None:
+        if len(node.args) >= 3:
+            self._check_carry(node.args[2], "while_loop")
+
+    def _check_carry(self, init: ast.expr, which: str) -> None:
+        if isinstance(init, (ast.Tuple, ast.List)):
+            for e in init.elts:
+                if isinstance(e, ast.Constant) and isinstance(
+                    e.value, (int, float)
+                ) and not isinstance(e.value, bool):
+                    self._report(
+                        "J002", e,
+                        f"{which} carry seeded with a raw Python scalar "
+                        f"{e.value!r}: its dtype follows the ambient x64 "
+                        "mode; pin with jnp.int32(...)/jnp.asarray(..., "
+                        "dtype=...)",
+                    )
+
+    def _plainly_python_int(self, node: ast.expr) -> bool:
+        """Expression that is certainly a Python int at trace time."""
+        if isinstance(node, ast.Constant):
+            return isinstance(node.value, int) and not isinstance(
+                node.value, bool
+            )
+        if isinstance(node, ast.Call):
+            return self.imports.resolve(node.func) == "len"
+        if isinstance(node, ast.Subscript):
+            # x.shape[i] is a Python int
+            return (
+                isinstance(node.value, ast.Attribute)
+                and node.value.attr == "shape"
+            )
+        if isinstance(node, ast.BinOp):
+            return self._plainly_python_int(node.left) or self._plainly_python_int(
+                node.right
+            )
+        return False
+
+    def _check_static_call_args(self, node: ast.Call, fn: str) -> None:
+        """J004(b): Python constants at non-static positions of a
+        locally-defined jitted function."""
+        spec = self.jitted.get(fn)
+        if spec is None:
+            return
+        for i, arg in enumerate(node.args):
+            if i in spec.argnums:
+                continue
+            if isinstance(arg, ast.Constant) and isinstance(
+                arg.value, (bool, int, float, str)
+            ):
+                self._report(
+                    "J004", arg,
+                    f"Python constant {arg.value!r} passed to jitted "
+                    f"`{fn}` at non-static position {i}: mark it in "
+                    "static_argnums or pass a device array",
+                )
+        for kw in node.keywords:
+            if kw.arg and kw.arg not in spec.argnames and isinstance(
+                kw.value, ast.Constant
+            ) and isinstance(kw.value.value, (bool, int, float, str)):
+                self._report(
+                    "J004", kw.value,
+                    f"Python constant {kw.value.value!r} passed to "
+                    f"jitted `{fn}` as non-static `{kw.arg}`: mark it "
+                    "in static_argnames or pass a device array",
+                )
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module and node.module.endswith("jax.experimental"):
+            for a in node.names:
+                if a.name == "enable_x64":
+                    self._report(
+                        "J005", node,
+                        "direct jax.experimental.enable_x64 import; use "
+                        "the ceph_tpu.enable_x64 shim",
+                    )
+        self.generic_visit(node)
+
+    # comprehensions are host loops too (progress paths build lists of
+    # per-element host pulls)
+    def _visit_comp(self, node) -> None:
+        host = not self._scope.traced
+        if host:
+            self._host_loop_depth += 1
+        self.generic_visit(node)
+        if host:
+            self._host_loop_depth -= 1
+
+    visit_ListComp = _visit_comp
+    visit_SetComp = _visit_comp
+    visit_DictComp = _visit_comp
+    visit_GeneratorExp = _visit_comp
+
+    # ------------------------------------------------------------- entry
+
+    def run(self) -> list[Finding]:
+        self.visit(self.tree)
+        self.findings.sort(key=lambda f: (f.line, f.col, f.rule))
+        return self.findings

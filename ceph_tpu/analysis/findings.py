@@ -1,0 +1,171 @@
+"""jaxlint finding model: rule registry, findings, suppressions.
+
+A finding is one (rule, file, line) diagnostic.  Suppression follows
+the flake8/pylint convention, scoped to this tool's namespace::
+
+    x = compute()  # jaxlint: disable=J003
+    # jaxlint: disable=J001,J006   <- standalone: applies to next line
+    if traced_flag:
+        ...
+
+``disable=all`` silences every rule for the line.  Suppressions are
+parsed from the raw source (comments never reach the AST), so the
+checker reports *which* suppressions actually fired — an unused
+suppression on a clean line is itself reported by the CLI under
+``--show-unused`` (kept out of the default gate to avoid churn).
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+#: rule id -> (title, rationale shown in --explain / README)
+RULES: dict[str, tuple[str, str]] = {
+    "J001": (
+        "python-branch-on-traced",
+        "Python `if`/`while` on a traced value inside a jit/Pallas body "
+        "fails at trace time (ConcretizationTypeError) or silently bakes "
+        "one branch into the compiled program.  Use jnp.where / "
+        "lax.cond / lax.select.",
+    ),
+    "J002": (
+        "unpinned-loop-dtype",
+        "fori_loop/while_loop bounds or carry seeded with raw Python ints "
+        "pick up the ambient x64 mode: under ceph_tpu's enable_x64 the "
+        "counter becomes i64, which Mosaic rejects inside Pallas kernels "
+        "and which silently widens carries elsewhere (the PR-1 "
+        "pallas_straw2 fanout-loop bug).  Pin with jnp.int32(...) / an "
+        "explicitly dtyped array.",
+    ),
+    "J003": (
+        "host-sync-in-loop",
+        "block_until_ready / .item() / np.asarray(device_fn(...)) inside "
+        "a host loop in a hot module serializes the device pipeline: "
+        "each iteration round-trips device->host before the next launch "
+        "can be enqueued.  Sync once after the loop, or keep the loop "
+        "on device (vmap/scan).",
+    ),
+    "J004": (
+        "recompile-forcer",
+        "Constructing a jit/pallas_call wrapper inside a loop (or "
+        "passing call-site Python constants to a jitted function at a "
+        "non-static position) defeats the compile cache: every "
+        "iteration gets a fresh wrapper identity and recompiles.  Hoist "
+        "the wrapper, or mark the argument in static_argnums / pass a "
+        "device array.",
+    ),
+    "J005": (
+        "raw-x64-toggle",
+        'Raw jax.config.update("jax_enable_x64", ...) or a direct '
+        "jax.experimental.enable_x64 import bypasses the "
+        "ceph_tpu.enable_x64 shim; the next upstream rename breaks "
+        "every call site instead of one (and unscoped global toggles "
+        "invalidate every cached executable in the process).",
+    ),
+    "J006": (
+        "tracer-leak",
+        "Storing a traced value on self/globals inside a jit/Pallas "
+        "body leaks the tracer out of its trace: the next use raises "
+        "UnexpectedTracerError, or worse, a stale concrete value from "
+        "a previous trace is silently reused.  Return values instead.",
+    ),
+}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*disable=([A-Za-z0-9_,\s]+?)\s*(?:#|$)"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic, pre-suppression."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+
+    def render(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}{tag}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "suppressed": self.suppressed,
+            "name": RULES.get(self.rule, ("", ""))[0],
+        }
+
+
+@dataclass
+class Suppressions:
+    """Per-file suppression map parsed from raw source lines."""
+
+    by_line: dict[int, frozenset[str]] = field(default_factory=dict)
+    used: set[int] = field(default_factory=set)
+
+    @classmethod
+    def parse(cls, source: str) -> "Suppressions":
+        by_line: dict[int, frozenset[str]] = {}
+
+        def add(line: int, text: str) -> None:
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                return
+            codes = frozenset(
+                c.strip().upper() for c in m.group(1).split(",") if c.strip()
+            )
+            if codes:
+                by_line[line] = codes
+
+        # tokenize so a suppression *example* inside a docstring is not
+        # a suppression; fall back to raw lines when the source does
+        # not tokenize (the fuzz harness feeds mangled snippets)
+        try:
+            for tok in tokenize.generate_tokens(
+                io.StringIO(source).readline
+            ):
+                if tok.type == tokenize.COMMENT:
+                    add(tok.start[0], tok.string)
+        except (tokenize.TokenizeError, IndentationError, SyntaxError,
+                ValueError):
+            for i, raw in enumerate(source.splitlines(), start=1):
+                add(i, raw)
+        return cls(by_line=by_line)
+
+    def _match(self, line: int, rule: str) -> int | None:
+        """The suppressing line for (line, rule), if any.
+
+        A comment suppresses its own line; a standalone comment line
+        also suppresses the line after it.
+        """
+        for cand in (line, line - 1):
+            codes = self.by_line.get(cand)
+            if codes and (rule in codes or "ALL" in codes):
+                return cand
+        return None
+
+    def apply(self, findings: list[Finding]) -> list[Finding]:
+        """Mark suppressed findings; record which comments fired."""
+        out = []
+        for f in findings:
+            hit = self._match(f.line, f.rule)
+            if hit is not None:
+                self.used.add(hit)
+                f = Finding(
+                    f.rule, f.path, f.line, f.col, f.message, suppressed=True
+                )
+            out.append(f)
+        return out
+
+    def unused(self) -> list[int]:
+        return sorted(set(self.by_line) - self.used)

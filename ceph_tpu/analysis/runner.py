@@ -1,0 +1,136 @@
+"""jaxlint driver: walk files, run the checkers, format reports.
+
+The module scoping mirrors the rule definitions: J003's host-sync rule
+only fires in the hot data-path packages (``HOT_SEGMENTS``); every
+other rule applies everywhere.  ``lint_source`` is the unit-test entry
+(fixtures pass source strings), ``lint_paths`` the CLI/test-gate entry.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+
+from .checkers import Analyzer
+from .findings import Finding, Suppressions
+
+#: path segments whose modules are "hot" for J003 (device data path +
+#: the CLI progress paths that drive it)
+HOT_SEGMENTS = frozenset(
+    {"crush", "ec", "recovery", "osdmap", "balancer", "cli", "core",
+     "parallel"}
+)
+
+
+@dataclass
+class LintResult:
+    """Findings for a set of files, suppression-aware."""
+
+    findings: list[Finding] = field(default_factory=list)
+    files: int = 0
+    errors: list[str] = field(default_factory=list)
+    unused_suppressions: list[tuple[str, int]] = field(default_factory=list)
+
+    @property
+    def active(self) -> list[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self) -> list[Finding]:
+        return [f for f in self.findings if f.suppressed]
+
+    def render_text(self, show_suppressed: bool = False) -> str:
+        lines = [
+            f.render()
+            for f in self.findings
+            if show_suppressed or not f.suppressed
+        ]
+        lines.extend(f"jaxlint: error: {e}" for e in self.errors)
+        n = len(self.active)
+        lines.append(
+            f"jaxlint: {n} finding{'s' if n != 1 else ''} "
+            f"({len(self.suppressed)} suppressed) in {self.files} file"
+            f"{'s' if self.files != 1 else ''}"
+        )
+        return "\n".join(lines)
+
+    def to_json(self) -> dict:
+        return {
+            "tool": "jaxlint",
+            "files": self.files,
+            "findings": [f.to_json() for f in self.findings],
+            "n_active": len(self.active),
+            "n_suppressed": len(self.suppressed),
+            "errors": list(self.errors),
+            "unused_suppressions": [
+                {"path": p, "line": ln} for p, ln in self.unused_suppressions
+            ],
+        }
+
+
+def is_hot(path: str) -> bool:
+    parts = os.path.normpath(path).split(os.sep)
+    return any(seg in HOT_SEGMENTS for seg in parts)
+
+
+def lint_source(
+    source: str,
+    path: str = "<string>",
+    hot: bool = True,
+    select: frozenset[str] | None = None,
+) -> LintResult:
+    """Lint one source string (the fixture/test entry point)."""
+    res = LintResult(files=1)
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        res.errors.append(f"{path}: syntax error: {e.msg} (line {e.lineno})")
+        return res
+    findings = Analyzer(path, tree, hot=hot).run()
+    if select is not None:
+        findings = [f for f in findings if f.rule in select]
+    supp = Suppressions.parse(source)
+    res.findings = supp.apply(findings)
+    res.unused_suppressions = [(path, ln) for ln in supp.unused()]
+    return res
+
+
+def iter_py_files(paths: list[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in {"__pycache__", ".git", "build"}
+                )
+                out.extend(
+                    os.path.join(root, f)
+                    for f in sorted(files)
+                    if f.endswith(".py")
+                )
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def lint_paths(
+    paths: list[str], select: frozenset[str] | None = None
+) -> LintResult:
+    """Lint every ``.py`` under ``paths`` (the CLI/gate entry point)."""
+    res = LintResult()
+    for path in iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            res.errors.append(f"{path}: unreadable: {e}")
+            continue
+        one = lint_source(source, path=path, hot=is_hot(path), select=select)
+        res.files += 1
+        res.findings.extend(one.findings)
+        res.errors.extend(one.errors)
+        res.unused_suppressions.extend(one.unused_suppressions)
+    return res

@@ -1,0 +1,214 @@
+"""Runtime half of jaxlint: prove the linter's claims on a live run.
+
+The static rules assert two dynamic properties of the hot path —
+*compiled once* and *device resident*.  This module measures both so
+the bench harness can record them next to every rate:
+
+- :class:`CompileCounter` counts XLA compilations through
+  ``jax.monitoring``'s duration events (``/jax/core/compile/
+  backend_compile_duration`` fires per backend compile; persistent-
+  cache hits fire ``/jax/compilation_cache/cache_hits`` instead and
+  are counted separately — a cache *hit* still means a fresh program
+  signature was traced, i.e. a recompile was requested).
+
+- :class:`TransferCounter` counts device->host pulls at the seams this
+  codebase actually uses: ``np.asarray``/``np.array``/
+  ``np.ascontiguousarray`` on a ``jax.Array``, ``ArrayImpl.__array__``
+  (implicit conversions), ``.item()``, and ``jax.device_get``.  It is
+  an approximation by construction (a zero-copy buffer-protocol read
+  on CPU can bypass ``__array__``), which is exactly why the counting
+  happens at the numpy entry points too.
+
+Both are re-entrant context managers; :func:`track` composes them::
+
+    with track() as g:
+        run_hot_path()
+    record(n_compiles=g.n_compiles, host_transfers=g.host_transfers)
+"""
+
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass, field
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+
+
+class CompileCounter:
+    """Counts backend compiles (and persistent-cache hits) in scope."""
+
+    def __init__(self) -> None:
+        self.backend_compiles = 0
+        self.cache_hits = 0
+        self._registered = False
+
+    @property
+    def n_compiles(self) -> int:
+        return self.backend_compiles + self.cache_hits
+
+    def _on_duration(self, event: str, duration: float, **kw) -> None:
+        if event == _COMPILE_EVENT:
+            self.backend_compiles += 1
+
+    def _on_event(self, event: str, **kw) -> None:
+        if event == _CACHE_HIT_EVENT:
+            self.cache_hits += 1
+
+    def __enter__(self) -> "CompileCounter":
+        from jax._src import monitoring
+
+        monitoring.register_event_duration_secs_listener(self._on_duration)
+        monitoring.register_event_listener(self._on_event)
+        self._registered = True
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if not self._registered:
+            return
+        from jax._src import monitoring
+
+        monitoring._unregister_event_duration_listener_by_callback(
+            self._on_duration
+        )
+        monitoring._unregister_event_listener_by_callback(self._on_event)
+        self._registered = False
+
+
+class TransferCounter:
+    """Counts device->host pulls while active (see module docstring)."""
+
+    def __init__(self) -> None:
+        self.host_transfers = 0
+        self._undo: list = []
+
+    def _count_if_device(self, obj) -> None:
+        import jax
+
+        if isinstance(obj, jax.Array):
+            self.host_transfers += 1
+
+    def __enter__(self) -> "TransferCounter":
+        import numpy as np
+
+        import jax
+
+        counter = self
+
+        def wrap_np(name):
+            orig = getattr(np, name)
+
+            def wrapped(a, *args, **kwargs):
+                counter._count_if_device(a)
+                return orig(a, *args, **kwargs)
+
+            setattr(np, name, wrapped)
+            counter._undo.append(lambda: setattr(np, name, orig))
+
+        for name in ("asarray", "array", "ascontiguousarray"):
+            wrap_np(name)
+
+        orig_get = jax.device_get
+
+        def wrapped_get(x):
+            counter._count_if_device(x)
+            return orig_get(x)
+
+        jax.device_get = wrapped_get
+        self._undo.append(lambda: setattr(jax, "device_get", orig_get))
+
+        # implicit conversions + .item() on the concrete array class;
+        # patchable because jax copies these Python methods onto the
+        # C++ ArrayImpl at class-decoration time
+        try:
+            import jaxlib.xla_extension as _xe
+
+            cls = _xe.ArrayImpl
+            for meth in ("__array__", "item"):
+                orig = getattr(cls, meth, None)
+                if orig is None:
+                    continue
+
+                def make(orig):
+                    def wrapped(self_, *a, **k):
+                        counter.host_transfers += 1
+                        return orig(self_, *a, **k)
+
+                    return wrapped
+
+                try:
+                    setattr(cls, meth, make(orig))
+                    self._undo.append(
+                        lambda cls=cls, meth=meth, orig=orig: setattr(
+                            cls, meth, orig
+                        )
+                    )
+                except (AttributeError, TypeError):
+                    pass  # immutable class on this jaxlib: numpy seams
+                    # above still count the codebase's idioms
+        except ImportError:
+            pass
+        return self
+
+    def __exit__(self, *exc) -> None:
+        while self._undo:
+            self._undo.pop()()
+
+
+@dataclass
+class GuardStats:
+    """Combined counters from one :func:`track` scope."""
+
+    compile_counter: CompileCounter = field(default_factory=CompileCounter)
+    transfer_counter: TransferCounter = field(
+        default_factory=TransferCounter
+    )
+
+    @property
+    def n_compiles(self) -> int:
+        return self.compile_counter.n_compiles
+
+    @property
+    def backend_compiles(self) -> int:
+        return self.compile_counter.backend_compiles
+
+    @property
+    def cache_hits(self) -> int:
+        return self.compile_counter.cache_hits
+
+    @property
+    def host_transfers(self) -> int:
+        return self.transfer_counter.host_transfers
+
+    def snapshot(self) -> dict:
+        return {
+            "n_compiles": self.n_compiles,
+            "backend_compiles": self.backend_compiles,
+            "compile_cache_hits": self.cache_hits,
+            "host_transfers": self.host_transfers,
+        }
+
+
+@contextlib.contextmanager
+def track(transfers: bool = True):
+    """Measure compiles (and optionally host transfers) in a scope."""
+    stats = GuardStats()
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(stats.compile_counter)
+        if transfers:
+            stack.enter_context(stats.transfer_counter)
+        yield stats
+
+
+@contextlib.contextmanager
+def assert_no_recompile(what: str = "steady state"):
+    """Raise if anything compiles inside the scope — the runtime teeth
+    behind J004 and the bench's compile-once claim."""
+    with CompileCounter() as cc:
+        yield cc
+    if cc.n_compiles:
+        raise AssertionError(
+            f"{what}: expected zero recompiles, observed "
+            f"{cc.backend_compiles} backend compile(s) + "
+            f"{cc.cache_hits} cache hit(s)"
+        )

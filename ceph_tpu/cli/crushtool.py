@@ -174,11 +174,10 @@ def run_test(m: CrushMap, args, out) -> int:
                     dense, steps, xs, weights, num_rep
                 )
             else:
-                import jax
-
-                results, lens = jax.block_until_ready(
-                    run_batch(dense, rule, xs, weights, num_rep)
-                )
+                # the np.asarray pulls synchronize; an extra
+                # block_until_ready per (rule, num_rep) would serialize
+                # the next launch behind this one (jaxlint J003)
+                results, lens = run_batch(dense, rule, xs, weights, num_rep)
                 results = np.asarray(results)
                 lens = np.asarray(lens)
             if args.show_mappings:

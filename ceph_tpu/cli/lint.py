@@ -1,0 +1,85 @@
+"""``python -m ceph_tpu.cli.lint`` — run jaxlint over the tree.
+
+Exit codes: 0 clean, 1 unsuppressed findings, 2 usage/IO error.
+
+::
+
+    python -m ceph_tpu.cli.lint ceph_tpu/            # text report
+    python -m ceph_tpu.cli.lint --json ceph_tpu/     # machine-readable
+    python -m ceph_tpu.cli.lint --select J002,J005 ceph_tpu/ec
+    python -m ceph_tpu.cli.lint --explain J002
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from ..analysis import RULES, lint_paths
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="lint",
+        description="jaxlint: tracing-safety & recompile static analysis",
+    )
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/directories to lint (default: the "
+                        "ceph_tpu package)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit one JSON document instead of text")
+    p.add_argument("--select", metavar="RULES",
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also list suppressed findings in the text report")
+    p.add_argument("--show-unused", action="store_true",
+                   help="report suppression comments that silenced nothing")
+    p.add_argument("--explain", metavar="RULE",
+                   help="print the rationale for one rule id and exit")
+    args = p.parse_args(argv)
+
+    if args.explain:
+        rid = args.explain.upper()
+        if rid not in RULES:
+            print(f"unknown rule {rid}; known: {', '.join(sorted(RULES))}",
+                  file=sys.stderr)
+            return 2
+        name, why = RULES[rid]
+        print(f"{rid} ({name})\n\n{why}")
+        return 0
+
+    select = None
+    if args.select:
+        select = frozenset(s.strip().upper() for s in args.select.split(","))
+        unknown = select - set(RULES)
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}; "
+                  f"known: {', '.join(sorted(RULES))}", file=sys.stderr)
+            return 2
+
+    paths = args.paths or [
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ]
+    missing = [p_ for p_ in paths if not os.path.exists(p_)]
+    if missing:
+        print(f"no such path(s): {', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    res = lint_paths(paths, select=select)
+
+    if args.as_json:
+        print(json.dumps(res.to_json(), indent=1, sort_keys=True))
+    else:
+        print(res.render_text(show_suppressed=args.show_suppressed))
+        if args.show_unused and res.unused_suppressions:
+            for path, line in res.unused_suppressions:
+                print(f"{path}:{line}: unused `jaxlint: disable` comment")
+    if res.errors:
+        return 2
+    return 1 if res.active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -192,7 +192,11 @@ def _perm_choose(smap: StaticCrushMap, bidx, x, r):
         perm = perm.at[p].set(jnp.where(do_swap, pi, pp))
         return perm
 
-    perm = lax.fori_loop(0, F, body, jnp.arange(F, dtype=I32))
+    # i32-pinned bounds: raw Python ints trace the counter as i64
+    # under the package-wide x64 mode (jaxlint J002)
+    perm = lax.fori_loop(
+        jnp.int32(0), jnp.int32(F), body, jnp.arange(F, dtype=I32)
+    )
     return smap.items[bidx, perm[pr]]
 
 
@@ -279,7 +283,7 @@ def _descend(
         jnp.asarray(0, I32),
     )
     bidx, item, done, ok, hard, r_out = lax.fori_loop(
-        0, smap.max_depth + 1, body, init
+        jnp.int32(0), jnp.int32(smap.max_depth + 1), body, init
     )
     # depth exhausted without reaching target: soft failure
     return item, ok, hard, r_out
@@ -493,8 +497,8 @@ def _indep_leaf(
         return (done | newly, new_failed, jnp.where(newly, item, leaf))
 
     done, _, leaf = lax.fori_loop(
-        0,
-        recurse_tries,
+        jnp.int32(0),
+        jnp.int32(recurse_tries),
         ftotal_body,
         (FALSE(), FALSE(), jnp.asarray(ITEM_NONE, I32)),
     )
@@ -570,7 +574,9 @@ def _choose_indep(
                 out2 = out2.at[rep].set(newl)
         return (out, out2)
 
-    out, out2 = lax.fori_loop(0, tries, ftotal_body, (out, out2))
+    out, out2 = lax.fori_loop(
+        jnp.int32(0), jnp.int32(tries), ftotal_body, (out, out2)
+    )
     out = jnp.where(out == ITEM_UNDEF, ITEM_NONE, out)
     out2 = jnp.where(out2 == ITEM_UNDEF, ITEM_NONE, out2)
     return out, out2

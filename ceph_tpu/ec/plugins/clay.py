@@ -282,7 +282,10 @@ class ErasureCodeClay(ErasureCode):
         C_dev = jnp.asarray(C)  # C is read-only until step 3: upload once
         for (P, fn) in zip(classes, known_fns):
             # 1) U at surviving nodes for the whole class: one device op
-            U[np.ix_(known, P)] = np.asarray(fn(C_dev, jnp.asarray(U)))
+            # per score class — the next class's host-side MDS solve
+            # reads this U, so the pull is a real data dependency, not
+            # a stray sync (q classes total, not per-plane)
+            U[np.ix_(known, P)] = np.asarray(fn(C_dev, jnp.asarray(U)))  # jaxlint: disable=J003
             # 2) one batched MDS solve for the whole class
             avail = {
                 self._base_id(node): U[node, P].reshape(-1)
@@ -336,7 +339,10 @@ class ErasureCodeClay(ErasureCode):
                 u_pe = cn ^ _gf_lut(tab_g, upa)
                 return jnp.where(d_mask, cn, jnp.where(pe, u_pe, u_pair))
 
-            known_fns.append(jax.jit(fn))
+            # one wrapper per score class, built once and memoized per
+            # erasure pattern (self._decode_fns / _repair_fns) — not a
+            # per-iteration recompile
+            known_fns.append(jax.jit(fn))  # jaxlint: disable=J004
 
         er_nodes = np.array(sorted(erased_key), np.int32)
         d_e = jnp.asarray(diag[er_nodes][..., None])
@@ -411,8 +417,10 @@ class ErasureCodeClay(ErasureCode):
             # U at known nodes for this score class: one device op.  A
             # known node's partner shares its row (y != y0), so the pair
             # plane keeps the y0 digit and stays in the repair set; an
-            # aloof partner's U comes from a strictly lower class.
-            U[np.ix_(known, P_pos)] = np.asarray(fn(Cp_dev, jnp.asarray(U)))
+            # aloof partner's U comes from a strictly lower class — the
+            # per-class pull is that sequential dependency, not a stray
+            # sync
+            U[np.ix_(known, P_pos)] = np.asarray(fn(Cp_dev, jnp.asarray(U)))  # jaxlint: disable=J003
             # batched MDS solve for the class's plane stripe
             avail = {
                 self._base_id(node): U[node][P_pos].reshape(-1)
@@ -487,7 +495,10 @@ class ErasureCodeClay(ErasureCode):
                 u_pe = cn ^ _gf_lut(tab_g, upa)
                 return jnp.where(d_mask, cn, jnp.where(pe, u_pe, u_pair))
 
-            known_fns.append(jax.jit(fn))
+            # one wrapper per score class, built once and memoized per
+            # erasure pattern (self._decode_fns / _repair_fns) — not a
+            # per-iteration recompile
+            known_fns.append(jax.jit(fn))  # jaxlint: disable=J004
 
         zy0 = digits[:, y0]
         partner0 = jnp.asarray(y0 * self.q + zy0)

@@ -247,7 +247,11 @@ def compile_pool_mapping(dense, pool: Pool, rule):
                 do & (jnp.arange(size) == first), to, r
             )
 
-        raw = jax.lax.fori_loop(0, items.shape[0], apply_item, raw)
+        # i32-pinned bounds (jaxlint J002): raw ints would trace the
+        # counter as i64 under the package-wide x64 mode
+        raw = jax.lax.fori_loop(
+            jnp.int32(0), jnp.int32(items.shape[0]), apply_item, raw
+        )
 
         # ---- _raw_to_up_osds ----
         rc = jnp.clip(raw, 0, n_osd - 1)
@@ -380,9 +384,10 @@ class OSDMapMapping:
             crush_arg, fn = self._fn_for(pool)
             state = build_pool_state(self.osdmap, pool, self.max_items)
             pgs = jnp.arange(pool.pg_num, dtype=jnp.uint32)
-            up, upp, acting, actp = jax.block_until_ready(
-                fn(crush_arg, state, pgs)
-            )
+            # no block_until_ready here (jaxlint J003): the np.asarray
+            # pulls below already synchronize, and an extra per-pool
+            # barrier would keep the next pool's launch off the device
+            up, upp, acting, actp = fn(crush_arg, state, pgs)
             self._results[pool.id] = (
                 np.asarray(up),
                 np.asarray(upp),

@@ -94,6 +94,101 @@ def ec_cases() -> dict[str, dict]:
     return out
 
 
+def compile_once_cases() -> dict[str, dict]:
+    """Runtime non-regression: the hot paths compile exactly once.
+
+    Digests pin *what* the programs compute; this pins *how often they
+    compile*.  Two second-invocation scenarios, both with value-only
+    changes (weights / chunk bytes) that must not alter the program
+    signature:
+
+    - ``pool_mapping``: :class:`~ceph_tpu.osdmap.mapping.OSDMapMapping`
+      ``.update()`` after a reweight — the whole-map remap loop of the
+      upmap balancer and config3's timed region.
+    - ``pattern_decode``: :class:`~ceph_tpu.recovery.executor
+      .RecoveryExecutor` ``.run()`` on the same plan with fresh chunk
+      data — config6's timed region.
+
+    Raises ``AssertionError`` (from
+    :func:`ceph_tpu.analysis.runtime_guard.assert_no_recompile`) if
+    either second invocation triggers any XLA compile; returns the
+    per-scenario compile counts observed during warm-up, for the
+    report.
+    """
+    from ..analysis.runtime_guard import CompileCounter, assert_no_recompile
+    from ..models.clusters import build_osdmap
+    from ..osdmap.mapping import OSDMapMapping
+
+    report: dict[str, dict] = {}
+
+    # ---- compiled pool mapping: update -> reweight -> update ----
+    m = build_osdmap(32, pg_num=16)
+    mapping = OSDMapMapping(m)
+    with CompileCounter() as warm:
+        mapping.update()
+    m.osd_weight[0] = 0x8000  # value-only edit: same program signature
+    with assert_no_recompile("pool mapping second update"):
+        mapping.update()
+    report["pool_mapping"] = {
+        "warm_compiles": warm.n_compiles, "second_compiles": 0,
+    }
+
+    # ---- pattern-grouped repair decode: run -> fresh data -> run ----
+    from ..crush.map import ITEM_NONE as PEER_NONE
+    from ..ec.backend import MatrixCodec
+    from ..ec.gf import vandermonde_matrix
+    from ..recovery import RecoveryExecutor, build_plan
+    from ..recovery.peering import (
+        PG_STATE_CLEAN,
+        PG_STATE_DEGRADED,
+        PeeringResult,
+    )
+
+    k, m_par, chunk = 4, 2, 128
+    size = k + m_par
+    masks = [0b001111, 0b110011]  # two erasure patterns -> two launches
+    prev = np.arange(len(masks) * size, dtype=np.int32).reshape(-1, size)
+    acting = prev.copy()
+    flags = np.full(len(masks), PG_STATE_CLEAN, np.int32)
+    mask_arr = np.full(len(masks), (1 << size) - 1, np.uint32)
+    for i, mask in enumerate(masks):
+        for s in range(size):
+            if not (mask >> s) & 1:
+                acting[i, s] = PEER_NONE
+        flags[i] = PG_STATE_DEGRADED
+        mask_arr[i] = mask
+    peering = PeeringResult(
+        pool_id=1, epoch_prev=1, epoch_cur=2, size=size, min_size=k,
+        up=acting.copy(), up_primary=acting[:, 0].copy(),
+        acting=acting, acting_primary=acting[:, 0].copy(),
+        prev_acting=prev, flags=flags, survivor_mask=mask_arr,
+        n_alive=(acting != PEER_NONE).sum(axis=1).astype(np.int32),
+    )
+    codec = MatrixCodec(vandermonde_matrix(k, m_par))
+    plan = build_plan(peering, codec)
+
+    def store_for(seed: int) -> dict[int, np.ndarray]:
+        rng = np.random.default_rng(seed)
+        out = {}
+        for g in plan.groups:
+            for pg in g.pgs:
+                data = rng.integers(0, 256, (k, chunk), dtype=np.uint8)
+                out[int(pg)] = np.vstack([data, codec.encode(data)])
+        return out
+
+    ex = RecoveryExecutor(codec)
+    s1 = store_for(1)
+    with CompileCounter() as warm:
+        ex.run(plan, lambda pg, s: s1[pg][s])  # compiles per pattern
+    s2 = store_for(2)  # fresh values, identical shapes
+    with assert_no_recompile("pattern-grouped decode second run"):
+        ex.run(plan, lambda pg, s: s2[pg][s])
+    report["pattern_decode"] = {
+        "warm_compiles": warm.n_compiles, "second_compiles": 0,
+    }
+    return report
+
+
 def generate() -> dict:
     return {"version": 1, "crush": crush_cases(), "ec": ec_cases()}
 

@@ -1,0 +1,159 @@
+"""jaxlint mutation fuzz: the analyzer must never crash on mangled
+input — it either lints the snippet or reports a syntax error.
+
+Strategy: start from the real per-rule fixtures (the same shapes
+tests/test_analysis.py asserts on), apply random token-level mutations
+(identifier swaps, operator flips, line deletion/duplication/
+truncation, random line splices between fixtures), and run
+``lint_source`` on each mutant.  Any exception other than the
+structured error path is a fuzz failure.
+
+NOT collected by pytest — run manually:
+
+    env -u PYTHONPATH PYTHONPATH=/root/repo \\
+      JAX_PLATFORMS=cpu python tests/fuzz_lint.py
+
+Budget via CEPH_TPU_FUZZ_SECONDS (default 120) or CEPH_TPU_FUZZ_ITERS.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import re
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from ceph_tpu.analysis import lint_source  # noqa: E402
+
+SEEDS = [
+    # one per rule family, mirroring tests/test_analysis.py
+    """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    y = jnp.sum(x)
+    if y > 0:
+        return y
+    while x > 0:
+        x = x - 1
+    return -y
+""",
+    """
+import jax
+import jax.numpy as jnp
+
+def _make_level_kernel(fanout, halves):
+    def kern(x_ref, r_ref, item_ref):
+        x = x_ref[:, :]
+
+        def fbody(f, st):
+            return st
+
+        best = jax.lax.fori_loop(1, fanout, fbody, x)
+        item_ref[:, :] = best
+    return kern
+""",
+    """
+import jax
+import numpy as np
+
+def drain(batches, fn):
+    out = []
+    for b in batches:
+        arr = np.asarray(fn(b))
+        out.append(jax.jit(fn)(b).sum().item())
+    return out
+""",
+    """
+import jax
+from functools import partial
+
+jax.config.update("jax_enable_x64", True)  # jaxlint: disable=J005
+
+@partial(jax.jit, static_argnums=(1,))
+def f(x, mode):
+    global _leak
+    _leak = x * 2
+    return f(x, True)
+""",
+]
+
+IDENTS = ["x", "jnp", "jax", "fn", "fori_loop", "self", "np", "item",
+          "config", "update", "lax", "partial", "kern", "x_ref"]
+OPS = [("==", "!="), (">", "<"), ("+", "-"), ("*", "/"), ("(", ""),
+       (")", ""), (":", ""), (",", " ")]
+
+
+def mutate(src: str, rng: random.Random) -> str:
+    lines = src.splitlines()
+    op = rng.randrange(7)
+    if op == 0 and lines:  # delete a line
+        del lines[rng.randrange(len(lines))]
+    elif op == 1 and lines:  # duplicate a line
+        i = rng.randrange(len(lines))
+        lines.insert(i, lines[i])
+    elif op == 2 and lines:  # truncate mid-file
+        lines = lines[: rng.randrange(1, len(lines) + 1)]
+    elif op == 3:  # identifier swap
+        src2 = src
+        for _ in range(rng.randrange(1, 4)):
+            a, b = rng.sample(IDENTS, 2)
+            src2 = re.sub(rf"\b{re.escape(a)}\b", b, src2, count=1)
+        return src2
+    elif op == 4:  # operator/punct flip (often a syntax error)
+        a, b = rng.choice(OPS)
+        return src.replace(a, b, 1)
+    elif op == 5:  # splice a random line from another seed
+        donor = rng.choice(SEEDS).splitlines()
+        if donor and lines:
+            lines.insert(rng.randrange(len(lines)),
+                         donor[rng.randrange(len(donor))])
+    else:  # random indentation damage
+        if lines:
+            i = rng.randrange(len(lines))
+            lines[i] = " " * rng.randrange(9) + lines[i].lstrip()
+    return "\n".join(lines)
+
+
+def main() -> int:
+    budget_s = float(os.environ.get("CEPH_TPU_FUZZ_SECONDS", "120"))
+    max_iters = int(os.environ.get("CEPH_TPU_FUZZ_ITERS", "0")) or None
+    rng = random.Random(0xCE9)
+    t0 = time.monotonic()
+    n = syntax_errors = clean = found = 0
+    while time.monotonic() - t0 < budget_s:
+        if max_iters is not None and n >= max_iters:
+            break
+        src = rng.choice(SEEDS)
+        for _ in range(rng.randrange(1, 6)):
+            src = mutate(src, rng)
+        try:
+            res = lint_source(src, path=f"<mutant-{n}>",
+                              hot=bool(rng.getrandbits(1)))
+        except Exception as e:  # noqa: BLE001 — any escape is the bug
+            print(f"FUZZ FAILURE at mutant {n}: {type(e).__name__}: {e}\n"
+                  f"--- source ---\n{src}\n--------------")
+            return 1
+        n += 1
+        if res.errors:
+            syntax_errors += 1
+        elif res.findings:
+            found += 1
+        else:
+            clean += 1
+    print(
+        f"fuzz_lint: {n} mutants in {time.monotonic() - t0:.1f}s — "
+        f"{syntax_errors} syntax-error, {found} with findings, "
+        f"{clean} clean; 0 crashes"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

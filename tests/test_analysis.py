@@ -1,0 +1,430 @@
+"""jaxlint unit tests: one good/bad fixture pair per rule, each bad
+fixture reproducing a real bug class from this repo's history, plus
+suppression and JSON-output coverage.
+
+The J002 bad fixture is the literal PR-1 bug: pallas_straw2.py's
+fanout fori_loop with raw Python bounds, which traced the counter as
+i64 under the package-wide x64 mode and broke Mosaic lowering — the
+bug class that cost 16 seed tests before any test caught it.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+
+import pytest
+
+from ceph_tpu.analysis import RULES, lint_source
+from ceph_tpu.analysis.runner import is_hot
+
+
+def rules_of(src: str, **kw) -> list[str]:
+    res = lint_source(textwrap.dedent(src), **kw)
+    return [f.rule for f in res.active]
+
+
+# ---------------------------------------------------------------- J001
+
+
+def test_j001_flags_python_if_on_traced():
+    bad = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        y = jnp.sum(x)
+        if y > 0:
+            return y
+        return -y
+    """
+    assert "J001" in rules_of(bad)
+
+
+def test_j001_flags_while_on_traced_param():
+    bad = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        while x > 0:
+            x = x - 1
+        return x
+    """
+    assert "J001" in rules_of(bad)
+
+
+def test_j001_clean_on_static_branches():
+    good = """
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    @partial(jax.jit, static_argnums=(1,))
+    def f(x, mode):
+        if mode == "fast":          # static arg: fine
+            return jnp.sum(x)
+        if x.shape[0] > 128:        # shape is static under tracing
+            return jnp.max(x)
+        return jnp.where(x > 0, x, -x)   # traced select: fine
+    """
+    assert rules_of(good) == []
+
+
+def test_j001_kernel_ref_params_are_traced():
+    bad = """
+    import jax.numpy as jnp
+
+    def kern(x_ref, o_ref):
+        v = x_ref[:, :]
+        if v[0, 0] > 0:
+            o_ref[:, :] = v
+    """
+    assert "J001" in rules_of(bad)
+
+
+# ---------------------------------------------------------------- J002
+
+
+# the pre-PR-1 pallas_straw2.py fanout loop, verbatim shape: raw
+# Python bounds on the fori_loop inside the Pallas kernel body
+PRE_PR1_FANOUT_LOOP = """
+import jax
+import jax.numpy as jnp
+
+def _make_level_kernel(fanout, halves):
+    def kern(x_ref, r_ref, item_ref):
+        x = x_ref[:, :]
+        r = r_ref[:, :]
+        best = x
+
+        def fbody(f, st):
+            return st
+
+        if fanout > 1:
+            best = jax.lax.fori_loop(1, fanout, fbody, best)
+        item_ref[:, :] = best
+    return kern
+"""
+
+
+def test_j002_flags_pre_pr1_fanout_loop():
+    """Regression-proof for the x64/fori_loop bug class: the linter
+    must fail the pre-PR-1 version of the fanout loop."""
+    res = lint_source(PRE_PR1_FANOUT_LOOP)
+    assert any(f.rule == "J002" for f in res.active)
+
+
+def test_j002_clean_on_pinned_bounds():
+    good = PRE_PR1_FANOUT_LOOP.replace(
+        "jax.lax.fori_loop(1, fanout, fbody, best)",
+        "jax.lax.fori_loop(jnp.int32(1), jnp.int32(fanout), fbody, best)",
+    )
+    assert rules_of(good) == []
+
+
+def test_j002_flags_shape_derived_bound_and_literal_carry():
+    bad = """
+    import jax
+    from jax import lax
+
+    def step(items, raw):
+        raw = lax.fori_loop(0, items.shape[0], lambda i, r: r, raw)
+        tot = lax.while_loop(
+            lambda c: c[0] < 3, lambda c: (c[0] + 1, c[1]), (0, raw)
+        )
+        return raw, tot
+    """
+    rs = rules_of(bad)
+    assert rs.count("J002") >= 3  # lower, upper, while carry literal
+
+
+def test_j002_actual_pallas_straw2_is_clean():
+    with open("ceph_tpu/core/pallas_straw2.py") as f:
+        src = f.read()
+    assert not [
+        x for x in lint_source(src, path="pallas_straw2.py").active
+        if x.rule == "J002"
+    ]
+
+
+# ---------------------------------------------------------------- J003
+
+
+def test_j003_flags_block_until_ready_in_loop():
+    bad = """
+    import jax
+
+    def drain(batches, fn):
+        out = []
+        for b in batches:
+            out.append(jax.block_until_ready(fn(b)))
+        return out
+    """
+    assert "J003" in rules_of(bad, hot=True)
+
+
+def test_j003_flags_item_and_device_pull_in_loop():
+    bad = """
+    import numpy as np
+
+    def progress(chunks, fn):
+        done = 0
+        for c in chunks:
+            arr = np.asarray(fn(c))
+            done += arr.sum().item()
+        return done
+    """
+    rs = rules_of(bad, hot=True)
+    assert rs.count("J003") == 2
+
+
+def test_j003_only_fires_in_hot_modules():
+    bad = """
+    import jax
+
+    def drain(batches, fn):
+        return [jax.block_until_ready(fn(b)) for b in batches]
+    """
+    assert "J003" in rules_of(bad, hot=True)
+    assert "J003" not in rules_of(bad, hot=False)
+
+
+def test_j003_clean_outside_loops_and_on_host_numpy():
+    good = """
+    import jax
+    import numpy as np
+
+    def run_once(fn, x):
+        out = jax.block_until_ready(fn(x))   # one sync, not per-iter
+        rows = [np.ascontiguousarray(out[i].reshape(-1)) for i in range(3)]
+        return rows
+    """
+    assert "J003" not in rules_of(good, hot=True)
+
+
+def test_hot_module_classification():
+    assert is_hot("ceph_tpu/crush/interp.py")
+    assert is_hot("ceph_tpu/recovery/executor.py")
+    assert is_hot("ceph_tpu/cli/crushtool.py")
+    assert not is_hot("ceph_tpu/common/config.py")
+    assert not is_hot("ceph_tpu/testing/nonregression.py")
+
+
+# ---------------------------------------------------------------- J004
+
+
+def test_j004_flags_jit_in_loop():
+    bad = """
+    import jax
+
+    def sweep(fns, x):
+        outs = []
+        for fn in fns:
+            outs.append(jax.jit(fn)(x))
+        return outs
+    """
+    assert "J004" in rules_of(bad)
+
+
+def test_j004_flags_constant_at_nonstatic_position():
+    bad = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, static_argnums=(2,))
+    def f(x, flag, mode):
+        return x
+
+    def call(x):
+        return f(x, True, "fast")
+    """
+    rs = rules_of(bad)
+    # True at pos 1 is non-static -> flagged; "fast" at pos 2 is static
+    assert rs.count("J004") == 1
+
+
+def test_j004_clean_on_hoisted_and_cached_wrappers():
+    good = """
+    import jax
+
+    def build(run):
+        fn = jax.jit(run)        # hoisted: one wrapper
+        def call(xs):
+            return [fn(x) for x in xs]
+        return call
+    """
+    assert "J004" not in rules_of(good)
+
+
+# ---------------------------------------------------------------- J005
+
+
+def test_j005_flags_raw_config_update_and_direct_import():
+    bad = """
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    def scoped():
+        from jax.experimental import enable_x64
+        with enable_x64(False):
+            pass
+    """
+    rs = rules_of(bad)
+    assert rs.count("J005") >= 2
+
+
+def test_j005_clean_on_shim():
+    good = """
+    from ceph_tpu import enable_x64
+
+    def scoped():
+        with enable_x64(False):
+            pass
+    """
+    assert "J005" not in rules_of(good)
+
+
+# ---------------------------------------------------------------- J006
+
+
+def test_j006_flags_traced_self_store():
+    bad = """
+    import jax
+    import jax.numpy as jnp
+
+    class Engine:
+        @jax.jit
+        def f(self, x):
+            y = jnp.sum(x)
+            self.last = y
+            return y
+    """
+    assert "J006" in rules_of(bad)
+
+
+def test_j006_flags_traced_global_store():
+    bad = """
+    import jax
+
+    _last = None
+
+    @jax.jit
+    def f(x):
+        global _last
+        _last = x * 2
+        return x
+    """
+    assert "J006" in rules_of(bad)
+
+
+def test_j006_clean_on_host_side_caching():
+    good = """
+    import jax
+
+    class Engine:
+        def run(self, fn, x):
+            out = fn(x)          # not a traced scope
+            self.last = out
+            return out
+    """
+    assert "J006" not in rules_of(good)
+
+
+# ------------------------------------------------------- suppressions
+
+
+def test_suppression_same_line_and_preceding_line():
+    src = """
+    import jax
+
+    jax.config.update("jax_enable_x64", True)  # jaxlint: disable=J005
+
+    # jaxlint: disable=J005
+    jax.config.update("jax_enable_x64", False)
+    """
+    res = lint_source(textwrap.dedent(src))
+    assert not res.active
+    assert len(res.suppressed) == 2
+
+
+def test_suppression_wrong_rule_does_not_silence():
+    src = """
+    import jax
+
+    jax.config.update("jax_enable_x64", True)  # jaxlint: disable=J001
+    """
+    res = lint_source(textwrap.dedent(src))
+    assert [f.rule for f in res.active] == ["J005"]
+    assert res.unused_suppressions  # the J001 comment silenced nothing
+
+
+def test_suppression_all_keyword():
+    src = """
+    import jax
+
+    jax.config.update("jax_enable_x64", True)  # jaxlint: disable=all
+    """
+    assert not lint_source(textwrap.dedent(src)).active
+
+
+# ---------------------------------------------------------- reporting
+
+
+def test_json_output_shape():
+    res = lint_source(PRE_PR1_FANOUT_LOOP, path="fixture.py")
+    doc = json.loads(json.dumps(res.to_json()))
+    assert doc["tool"] == "jaxlint"
+    assert doc["n_active"] == len(res.active) > 0
+    f = doc["findings"][0]
+    assert set(f) >= {"rule", "path", "line", "col", "message",
+                      "suppressed", "name"}
+    assert f["path"] == "fixture.py"
+    assert f["rule"] in RULES
+
+
+def test_syntax_error_is_reported_not_raised():
+    res = lint_source("def broken(:\n    pass")
+    assert res.errors and not res.findings
+
+
+def test_rules_registry_complete():
+    assert set(RULES) == {"J001", "J002", "J003", "J004", "J005", "J006"}
+    for rid, (name, why) in RULES.items():
+        assert name and why, rid
+
+
+# ------------------------------------------------------------- CLI
+
+
+def test_cli_json_and_exit_codes(tmp_path, capsys):
+    from ceph_tpu.cli.lint import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        'import jax\njax.config.update("jax_enable_x64", True)\n'
+    )
+    assert main([str(bad), "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["n_active"] == 1
+
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    assert main([str(good)]) == 0
+    assert main([str(tmp_path / "missing.py")]) == 2
+    assert main(["--explain", "J002"]) == 0
+    assert main(["--explain", "J999"]) == 2
+    assert main([str(good), "--select", "J001,NOPE"]) == 2
+
+
+def test_cli_select_filters_rules(tmp_path, capsys):
+    from ceph_tpu.cli.lint import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        'import jax\njax.config.update("jax_enable_x64", True)\n'
+    )
+    assert main([str(bad), "--select", "J001"]) == 0
+    assert main([str(bad), "--select", "J005"]) == 1

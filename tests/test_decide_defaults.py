@@ -89,6 +89,42 @@ def test_probe_line_cannot_smuggle_kern_full(tmp_path):
     assert dd.harvest([p]) == {}
 
 
+def test_harvest_guard_collects_counters_and_clean_flag(tmp_path):
+    p = _log(tmp_path, [
+        {"metric": "crush_placements_per_sec", "platform": "tpu",
+         "value": 1_800_000, "n_compiles": 3, "n_compiles_first": 3,
+         "host_transfers": 4},
+        {"metric": "recovery_decode_bytes_per_sec", "platform": "tpu",
+         "value": 9_000_000, "n_compiles": 7, "n_compiles_first": 5,
+         "host_transfers": 12},
+        # cpu smoke line must not shadow the tpu counters
+        {"metric": "crush_placements_per_sec", "platform": "cpu",
+         "value": 50_000, "n_compiles": 99, "n_compiles_first": 1,
+         "host_transfers": 99},
+        # line without guard fields contributes nothing
+        {"metric": "ec_encode_8_3_bytes_per_sec", "platform": "tpu",
+         "value": 1},
+    ])
+    g = dd.harvest_guard([p])
+    assert g["crush_placements_per_sec"] == {
+        "n_compiles": 3, "n_compiles_first": 3, "host_transfers": 4,
+        "steady_state_clean": True,
+    }
+    assert g["recovery_decode_bytes_per_sec"]["steady_state_clean"] is False
+    assert "ec_encode_8_3_bytes_per_sec" not in g
+
+
+def test_harvest_guard_latest_line_wins(tmp_path):
+    p = _log(tmp_path, [
+        {"metric": "crush_placements_per_sec", "platform": "tpu",
+         "n_compiles": 5, "n_compiles_first": 3, "host_transfers": 1},
+        {"metric": "crush_placements_per_sec", "platform": "tpu",
+         "n_compiles": 3, "n_compiles_first": 3, "host_transfers": 1},
+    ])
+    assert dd.harvest_guard([p])["crush_placements_per_sec"][
+        "steady_state_clean"] is True
+
+
 def test_write_defaults_roundtrip_and_engine_pickup(tmp_path, monkeypatch):
     """--write persists the winning modes with provenance, and the
     engine + bench.py resolve them as their default (env still wins)."""

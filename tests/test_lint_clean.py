@@ -1,0 +1,49 @@
+"""Tier-1 lint gate: the tree must be jaxlint-clean.
+
+Runs the analyzer over the whole ``ceph_tpu`` package (the same
+invocation as ``python -m ceph_tpu.cli.lint ceph_tpu/``) and fails on
+any unsuppressed finding — so a new Python-branch-on-tracer, unpinned
+loop dtype, stray host sync, recompile-forcer, raw x64 toggle, or
+tracer leak fails CI before it costs a chip session.  Fast (pure AST,
+no jax import in the analyzed path) and deliberately not ``slow``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+from ceph_tpu.analysis import lint_paths
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "ceph_tpu")
+
+
+def test_tree_is_lint_clean():
+    res = lint_paths([PKG])
+    assert res.files > 50, "walked suspiciously few files"
+    assert not res.errors, res.errors
+    assert not res.active, "\n" + "\n".join(
+        f.render() for f in res.active
+    )
+
+
+def test_suppressions_all_earn_their_keep():
+    """Every `jaxlint: disable` comment in the tree must silence a
+    real finding — dead suppressions rot into lies."""
+    res = lint_paths([PKG])
+    assert not res.unused_suppressions, res.unused_suppressions
+
+
+def test_cli_module_entry_exits_zero():
+    """The documented invocation: python -m ceph_tpu.cli.lint ceph_tpu/"""
+    proc = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.cli.lint", "ceph_tpu/"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 findings" in proc.stdout

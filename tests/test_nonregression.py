@@ -33,3 +33,15 @@ def test_ec_encodings_pinned():
             f"EC encoding for {name} changed! Stored chunks become unreadable."
         )
     assert set(current) == set(golden)
+
+
+def test_hot_paths_compile_once():
+    """Second invocations of the compiled pool mapping and the
+    pattern-grouped repair decode must trigger zero new XLA compiles —
+    a value-only change (reweight / fresh chunk bytes) that recompiles
+    is the J004 bug class at runtime and would gut the bench rates."""
+    report = nonregression.compile_once_cases()  # raises on recompile
+    assert set(report) == {"pool_mapping", "pattern_decode"}
+    for name, counts in report.items():
+        assert counts["warm_compiles"] > 0, (name, counts)
+        assert counts["second_compiles"] == 0
