@@ -8,6 +8,15 @@ unique erasure pattern) — and reports the decode rate.  ``vs_baseline``
 is the speedup of the pattern-grouped batch decode over the reference
 structure (per-PG decode setup + per-PG launch), measured on a sample
 of the same degraded PGs.  Emits one JSON line.
+
+A second pass drives a chaos timeline (``--chaos SCENARIO``, default
+``mid-repair-loss``) through the supervised executor on the same map
+shape and folds its convergence metrics into the JSON line
+(``chaos_*`` fields: retries, re-plans, stale launches, unrecoverable
+count, time-to-zero-degraded) — the guard surface for
+``decide_defaults`` (a regression that starts retrying or re-planning
+more under the same seeded timeline is a robustness bug even when the
+decode rate looks fine).
 """
 
 import json
@@ -23,6 +32,56 @@ K, M = 8, 3
 PG_NUM = 256
 CHUNK = 16384
 SERIAL_SAMPLE = 8
+CHAOS_CHUNK = 4096
+
+
+def run_chaos(scenario: str) -> dict:
+    """Supervised chaos pass -> ``chaos_*`` JSON fields (seeded and
+    virtual-clocked, so the numbers are exactly reproducible)."""
+    import copy
+
+    from ceph_tpu import recovery as rec
+    from ceph_tpu.ec.backend import MatrixCodec
+    from ceph_tpu.ec.gf import vandermonde_matrix
+    from ceph_tpu.models.clusters import build_osdmap
+
+    m = build_osdmap(N_OSDS, pg_num=PG_NUM, size=K + M, pool_kind="erasure")
+    m_prev = copy.deepcopy(m)
+    chaos = rec.ChaosEngine(m, rec.build_scenario(scenario, m))
+    codec = MatrixCodec(vandermonde_matrix(K, M))
+    rng = np.random.default_rng(6)
+    chunks: dict[tuple[int, int], np.ndarray] = {}
+
+    def read_shard(pg, s):
+        key = (int(pg), int(s))
+        if key not in chunks:
+            chunks[key] = rng.integers(0, 256, CHAOS_CHUNK, dtype=np.uint8)
+        return chunks[key]
+
+    sup = rec.SupervisedRecovery(codec, chaos, seed=0)
+    t0 = time.perf_counter()
+    res = sup.run(m_prev, 1, read_shard)
+    wall = time.perf_counter() - t0
+    print(
+        f"chaos {scenario}: {'converged' if res.converged else 'DIVERGED'} "
+        f"at t={res.time_to_zero_degraded_s:g}s virtual "
+        f"({wall:.2f}s wall), {res.launches} launches, "
+        f"{res.retries} retries, {res.stale_launches} stale, "
+        f"{res.plan_revisions} re-plans, "
+        f"{len(res.unrecoverable)} unrecoverable",
+        file=sys.stderr,
+    )
+    return {
+        "chaos_scenario": scenario,
+        "chaos_converged": res.converged,
+        "chaos_time_to_zero_degraded_s": round(
+            res.time_to_zero_degraded_s, 6
+        ),
+        "chaos_retries": res.retries,
+        "chaos_replans": res.plan_revisions,
+        "chaos_stale_launches": res.stale_launches,
+        "chaos_unrecoverable": int(len(res.unrecoverable)),
+    }
 
 
 def main() -> None:
@@ -105,6 +164,11 @@ def main() -> None:
         file=sys.stderr,
     )
 
+    scenario = "mid-repair-loss"
+    if "--chaos" in sys.argv:
+        scenario = sys.argv[sys.argv.index("--chaos") + 1]
+    chaos_fields = run_chaos(scenario)
+
     import jax
 
     print(json.dumps({
@@ -116,6 +180,7 @@ def main() -> None:
         "n_compiles": guard.n_compiles,
         "n_compiles_first": warm["n_compiles"],
         "host_transfers": guard.host_transfers,
+        **chaos_fields,
     }))
 
 

@@ -54,6 +54,15 @@ AUX_METRICS = ("recovery_decode_bytes_per_sec",)
 # n_compiles_first) and device-resident.
 GUARD_FIELDS = ("n_compiles", "n_compiles_first", "host_transfers")
 
+# Chaos-run counters from config6_recovery's supervised pass: the
+# scenario and clock are seeded, so these are exact expectations, not
+# noisy rates — a diff under the same timeline means the supervised
+# loop's behavior changed (more retrying, more re-planning, or PGs
+# newly lost), which is a robustness regression even when the decode
+# rate still looks healthy.
+CHAOS_GUARD_FIELDS = ("chaos_retries", "chaos_replans",
+                      "chaos_unrecoverable")
+
 
 def harvest_aux(paths: list[str]) -> dict[str, int]:
     """Collect auxiliary metric -> best value from the logs.
@@ -109,12 +118,17 @@ def harvest_guard(paths: list[str]) -> dict[str, dict]:
             if d.get("platform") != "tpu" or not d.get("metric"):
                 continue
             fields = {f: int(d[f]) for f in GUARD_FIELDS if f in d}
+            fields.update(
+                {f: int(d[f]) for f in CHAOS_GUARD_FIELDS if f in d}
+            )
             if not fields:
                 continue
             if "n_compiles" in fields and "n_compiles_first" in fields:
                 fields["steady_state_clean"] = (
                     fields["n_compiles"] == fields["n_compiles_first"]
                 )
+            if "chaos_converged" in d:
+                fields["chaos_converged"] = bool(d["chaos_converged"])
             guard[d["metric"]] = fields
     return guard
 

@@ -11,6 +11,11 @@ the framework's failure loop, driving
     # same but on a saved map, actually running the batched decode
     python -m ceph_tpu.cli.recovery map.bin --inject host:host0_1 --execute
 
+    # drive a continuous failure schedule through the supervised
+    # executor: epochs land mid-repair, the plan revises, and the run
+    # ends with a structured convergence report (one JSON line)
+    python -m ceph_tpu.cli.recovery --chaos mid-repair-loss
+
 With a ``mapfilename`` the map is loaded from the framework's
 versioned encoding (``osdmaptool --createsimple`` output); without
 one a synthetic EC cluster is built in-process (``--num-osd`` etc.).
@@ -37,6 +42,66 @@ def _pick_pool(m: OSDMap, pool_id: int | None) -> int:
         return pool_id
     ec = [pid for pid, p in m.pools.items() if p.kind == "erasure"]
     return ec[0] if ec else sorted(m.pools)[0]
+
+
+def _run_chaos(args, m, m_prev, pool_id, out) -> int:
+    """Drive a named chaos timeline through the supervised executor."""
+    import json
+
+    from ..common.config import Config
+    from ..ec.registry import create
+    from ..recovery import ChaosEngine, SupervisedRecovery, build_scenario
+
+    pool = m.pools[pool_id]
+    if pool.kind != "erasure":
+        print(f"pool {pool_id} is not erasure-coded; chaos needs an EC pool",
+              file=out)
+        return 1
+    timeline = build_scenario(
+        args.chaos, m, start_s=args.chaos_start,
+        period_s=args.chaos_period, cycles=args.cycles,
+    )
+    print(f"chaos {args.chaos}: {len(timeline)} scheduled events", file=out)
+    chaos = ChaosEngine(m, timeline)
+    codec = create({
+        "plugin": "jerasure",
+        "technique": "reed_sol_van",
+        "k": str(pool.size - args.ec_m if args.mapfilename else args.ec_k),
+        "m": str(args.ec_m),
+    })
+    cfg = Config()
+    if args.max_bytes_per_sec is not None:
+        cfg.set("recovery_max_bytes_per_sec", args.max_bytes_per_sec)
+    rng = np.random.default_rng(0)
+    chunks: dict[tuple[int, int], np.ndarray] = {}
+
+    def read_shard(pg: int, s: int) -> np.ndarray:
+        key = (pg, s)
+        if key not in chunks:
+            chunks[key] = rng.integers(
+                0, 256, args.chunk_size, dtype=np.uint8
+            )
+        return chunks[key]
+
+    sup = SupervisedRecovery(codec, chaos, config=cfg, seed=args.seed)
+    res = sup.run(m_prev, pool_id, read_shard)
+    for ev in chaos.applied:
+        specs = " ".join(str(s) for s in ev.specs)
+        print(f"  t={ev.t:g}s epoch {ev.epoch}: {specs}", file=out)
+    s = res.summary()
+    print(
+        f"chaos done: {'converged' if res.converged else 'NOT converged'} "
+        f"at t={s['time_to_zero_degraded_s']:g}s, {res.launches} launches "
+        f"({res.retries} retries, {res.stale_launches} stale), "
+        f"{res.plan_revisions} plan revisions, "
+        f"{len(res.completed_pgs)} pgs recovered, "
+        f"{len(s['unrecoverable_pgs'])} unrecoverable, "
+        f"{len(res.failed_pgs)} failed",
+        file=out,
+    )
+    print(json.dumps({"scenario": args.chaos, "seed": args.seed, **s}),
+          file=out)
+    return 0 if res.converged else 1
 
 
 def main(argv=None) -> int:
@@ -67,6 +132,17 @@ def main(argv=None) -> int:
                    help="shard chunk bytes for --execute")
     p.add_argument("--max-bytes-per-sec", type=float, default=None,
                    help="recovery throttle override for --execute")
+    p.add_argument("--chaos", metavar="SCENARIO", default=None,
+                   help="run a named chaos timeline (flap, rack-cascade, "
+                        "mid-repair-loss) through the supervised executor "
+                        "and report convergence as one JSON line")
+    p.add_argument("--chaos-start", type=float, default=0.25,
+                   help="virtual seconds before the first chaos event")
+    p.add_argument("--chaos-period", type=float, default=1.0,
+                   help="virtual seconds between chaos events")
+    p.add_argument("--seed", type=int, default=0,
+                   help="retry-jitter seed for --chaos (determinism: same "
+                        "seed, same run)")
     args = p.parse_args(argv)
     out = sys.stdout
 
@@ -93,8 +169,11 @@ def main(argv=None) -> int:
     pool_id = _pick_pool(m, args.pool)
     m_prev = copy.deepcopy(m)
 
+    if args.chaos:
+        return _run_chaos(args, m, m_prev, pool_id, out)
+
     if not args.inject and not args.flap:
-        p.error("nothing to do: give --inject and/or --flap")
+        p.error("nothing to do: give --inject, --flap and/or --chaos")
     for spec in args.inject:
         inc = inject(m, spec)
         print(

@@ -91,6 +91,24 @@ SCHEMA: list[Option] = [
     Option("recovery_burst_bytes", OPT_INT, 64 * 1024 * 1024, LEVEL_ADVANCED,
            "token-bucket burst size for the recovery throttle (bytes)",
            min=1, see_also=("recovery_max_bytes_per_sec",)),
+    Option("recovery_max_debt_bytes", OPT_INT, 256 * 1024 * 1024,
+           LEVEL_ADVANCED,
+           "clamp on how far a single oversized request may drive the "
+           "recovery token bucket negative (bytes); bounds the worst-case "
+           "throttle stall to max_debt/rate seconds",
+           min=1, see_also=("recovery_burst_bytes",)),
+    Option("recovery_retry_max", OPT_INT, 4, LEVEL_ADVANCED,
+           "decode-launch retries before a pattern group's PGs are "
+           "reported failed (0 disables retry)", min=0,
+           see_also=("recovery_backoff_base_ms",)),
+    Option("recovery_backoff_base_ms", OPT_FLOAT, 50.0, LEVEL_ADVANCED,
+           "base delay for exponential backoff between decode-launch "
+           "retries (milliseconds); doubled per attempt plus seeded "
+           "jitter", min=0.0, see_also=("recovery_retry_max",)),
+    Option("osd_max_backfills", OPT_INT, 1, LEVEL_ADVANCED,
+           "backfill pattern groups admitted per repair group in the "
+           "supervised scheduler (the reference's backfill reservation "
+           "analog); repair and backfill share one token bucket", min=1),
     Option("placement_batch_size", OPT_INT, 4_000_000, LEVEL_DEV,
            "objects per device batch in streamed placement", min=1),
     Option("debug_crush", OPT_INT, 1, LEVEL_DEV,

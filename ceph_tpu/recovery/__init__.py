@@ -12,16 +12,32 @@ The subsystem that closes the loop the standalone workloads left open
   bitmask; one host matrix inversion per unique erasure pattern.
 - :mod:`~ceph_tpu.recovery.executor` — one batched device decode launch
   per pattern, under a token-bucket bandwidth throttle, with perf
-  counters / tracing / prometheus wired in.
+  counters / tracing / prometheus wired in; the supervised variant
+  (:class:`~ceph_tpu.recovery.executor.SupervisedRecovery`) survives
+  epochs advancing mid-plan.
+- :mod:`~ceph_tpu.recovery.chaos`    — timeline engine driving
+  multi-epoch failure schedules (flapping, cascades, mid-repair loss)
+  on a seeded virtual clock.
 """
 
+from .chaos import (
+    SCENARIOS,
+    AppliedEvent,
+    ChaosEngine,
+    ChaosEvent,
+    ChaosTimeline,
+    VirtualClock,
+    build_scenario,
+)
 from .failure import (
     ACTIONS,
+    KNOWN_SCOPES,
     FailureSpec,
     FlapRecord,
     build_incremental,
     flap,
     inject,
+    normalize,
     osds_in_subtree,
     parse_spec,
     resolve_targets,
@@ -38,10 +54,19 @@ from .peering import (
     PeeringResult,
     peer_pool,
 )
-from .planner import PatternGroup, RecoveryPlan, build_plan, mask_to_shards
+from .planner import (
+    PatternGroup,
+    RecoveryPlan,
+    build_plan,
+    invalidated_groups,
+    mask_to_shards,
+)
 from .executor import (
+    LaunchError,
     RecoveryExecutor,
     RecoveryResult,
+    SupervisedRecovery,
+    SupervisedResult,
     TokenBucket,
     recover_pool,
     recovery_counters,
@@ -49,11 +74,20 @@ from .executor import (
 
 __all__ = [
     "ACTIONS",
+    "KNOWN_SCOPES",
+    "SCENARIOS",
+    "AppliedEvent",
+    "ChaosEngine",
+    "ChaosEvent",
+    "ChaosTimeline",
+    "VirtualClock",
+    "build_scenario",
     "FailureSpec",
     "FlapRecord",
     "build_incremental",
     "flap",
     "inject",
+    "normalize",
     "osds_in_subtree",
     "parse_spec",
     "resolve_targets",
@@ -70,9 +104,13 @@ __all__ = [
     "PatternGroup",
     "RecoveryPlan",
     "build_plan",
+    "invalidated_groups",
     "mask_to_shards",
+    "LaunchError",
     "RecoveryExecutor",
     "RecoveryResult",
+    "SupervisedRecovery",
+    "SupervisedResult",
     "TokenBucket",
     "recover_pool",
     "recovery_counters",

@@ -1,0 +1,232 @@
+"""Chaos timeline engine: continuous failure schedules on a seeded clock.
+
+The fault injector (:mod:`ceph_tpu.recovery.failure`) delivers one-shot
+failures; real clusters — and the reference's ``OSDMonitor`` epoch
+stream — deliver them *continuously*: flapping NICs, cascading rack
+loss, and fresh faults landing while a repair is still in flight.  This
+module drives exactly that: a :class:`ChaosTimeline` is a sorted
+``(t, FailureSpec...)`` schedule, a :class:`ChaosEngine` owns the live
+map plus a deterministic :class:`VirtualClock`, and the supervised
+executor (:class:`ceph_tpu.recovery.executor.SupervisedRecovery`) polls
+it between — and across — its peer/plan/decode phases.
+
+Everything is deterministic by construction: the clock is virtual (no
+wall time), timelines are explicit, and the only randomness (retry
+jitter) comes from a seeded generator — two runs of the same scenario
+produce identical retry counts, plan revisions, and final PG states
+(asserted in tests/test_chaos.py).
+
+Named scenarios (:func:`build_scenario`, the CLI/bench ``--chaos``
+surface):
+
+- ``flap``             — an OSD flaps down/up ``cycles`` times: the
+  degraded set appears, shrinks, and vanishes as the device returns;
+  exercises plan invalidation by *restored* survivors.
+- ``rack-cascade``     — a rack dies host by host, one epoch per host:
+  each epoch deepens existing erasure patterns mid-repair.
+- ``mid-repair-loss``  — a host fails, its repair starts, then the
+  whole surrounding rack fails while the repair is in flight (the
+  acceptance scenario).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..osdmap.map import Incremental, OSDMap
+from .failure import FailureSpec, inject, parse_spec
+
+
+class VirtualClock:
+    """Deterministic manual clock: ``now``/``sleep`` drop into any
+    ``clock=``/``sleep=`` seam (token bucket, backoff, chaos engine).
+    Time only moves when something explicitly advances it."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+
+    def now(self) -> float:
+        return self._now
+
+    def sleep(self, seconds: float) -> None:
+        if seconds < 0:
+            raise ValueError(f"cannot sleep {seconds}s")
+        self._now += seconds
+
+    advance = sleep
+
+
+@dataclass(frozen=True)
+class ChaosEvent:
+    """One timeline entry: at virtual time ``t``, inject ``specs`` as
+    ONE epoch (multiple specs batch into a single Incremental, the way
+    the mon batches simultaneous failure reports)."""
+
+    t: float
+    specs: tuple[FailureSpec, ...]
+
+
+class ChaosTimeline:
+    """An ordered, consumable schedule of failure events.
+
+    Construction sorts by time with a stable tiebreak on insertion
+    order, so two timelines built from the same pairs replay
+    identically.
+    """
+
+    def __init__(self, events: list[ChaosEvent] | None = None):
+        self._events = sorted(
+            events or [], key=lambda e: e.t
+        )  # sorted() is stable: equal-t events keep insertion order
+
+    @classmethod
+    def from_pairs(cls, pairs) -> "ChaosTimeline":
+        """``[(t, spec), ...]`` where spec is a string, a FailureSpec,
+        or a list of either (one epoch)."""
+        events = []
+        for t, spec in pairs:
+            if isinstance(spec, (str, FailureSpec)):
+                spec = [spec]
+            specs = tuple(
+                parse_spec(s) if isinstance(s, str) else s for s in spec
+            )
+            events.append(ChaosEvent(float(t), specs))
+        return cls(events)
+
+    def __len__(self) -> int:
+        return len(self._events)
+
+    def peek_next(self) -> float | None:
+        """Time of the next pending event, or None when exhausted."""
+        return self._events[0].t if self._events else None
+
+    def due(self, now: float) -> list[ChaosEvent]:
+        """Pop every event with ``t <= now``, in order."""
+        out = []
+        while self._events and self._events[0].t <= now:
+            out.append(self._events.pop(0))
+        return out
+
+
+SCENARIOS = ("flap", "rack-cascade", "mid-repair-loss")
+
+
+def _rack_and_hosts(m: OSDMap, rack_name: str | None) -> tuple[str, list[str]]:
+    """A rack bucket name plus its child host bucket names, in stable
+    (CRUSH item) order."""
+    racks = sorted(
+        b.name for b in m.crush.buckets.values()
+        if m.crush.types[b.type_id] == "rack"
+    )
+    if not racks:
+        raise ValueError("map has no rack buckets")
+    rack = rack_name or racks[0]
+    rb = m.crush.bucket_by_name(rack)
+    hosts = [
+        m.crush.buckets[i].name for i in rb.items
+        if i < 0 and m.crush.types[m.crush.buckets[i].type_id] == "host"
+    ]
+    if not hosts:
+        raise ValueError(f"rack {rack!r} has no host buckets")
+    return rack, hosts
+
+
+def build_scenario(
+    name: str,
+    m: OSDMap,
+    start_s: float = 0.25,
+    period_s: float = 1.0,
+    cycles: int = 3,
+    rack: str | None = None,
+) -> ChaosTimeline:
+    """Named chaos scenario -> timeline, parameterized by the map's
+    own topology (first rack by default)."""
+    if name == "flap":
+        # one OSD of the target rack flaps down/up `cycles` times
+        _, hosts = _rack_and_hosts(m, rack)
+        from .failure import resolve_targets
+
+        osd = resolve_targets(m, FailureSpec("host", hosts[0], "down"))[0]
+        pairs: list[tuple[float, object]] = []
+        t = start_s
+        for _ in range(cycles):
+            pairs.append((t, FailureSpec("osd", str(osd), "down")))
+            pairs.append((t + period_s / 2, FailureSpec("osd", str(osd), "up")))
+            t += period_s
+        return ChaosTimeline.from_pairs(pairs)
+    if name == "rack-cascade":
+        rname, hosts = _rack_and_hosts(m, rack)
+        return ChaosTimeline.from_pairs([
+            (start_s + i * period_s, FailureSpec("host", h, "down_out"))
+            for i, h in enumerate(hosts)
+        ])
+    if name == "mid-repair-loss":
+        rname, hosts = _rack_and_hosts(m, rack)
+        return ChaosTimeline.from_pairs([
+            (start_s, FailureSpec("host", hosts[0], "down_out")),
+            # the surrounding rack falls while the host repair is in
+            # flight (already-down OSDs contribute nothing: xor-safe)
+            (start_s + period_s, FailureSpec("rack", rname, "down_out")),
+        ])
+    raise ValueError(f"unknown chaos scenario {name!r}; one of {SCENARIOS}")
+
+
+@dataclass
+class AppliedEvent:
+    """Audit-trail entry: what :meth:`ChaosEngine.poll` injected."""
+
+    t: float
+    epoch: int
+    specs: tuple[FailureSpec, ...]
+    incremental: Incremental
+
+
+class ChaosEngine:
+    """Owns the live map, the timeline, and the virtual clock.
+
+    The supervised executor calls :meth:`poll` between phases; every
+    due event becomes an ordinary epoch through the normal
+    ``Incremental`` machinery, so nothing downstream can tell a chaos
+    event from an organic mon update.
+    """
+
+    def __init__(
+        self,
+        m: OSDMap,
+        timeline: ChaosTimeline | None = None,
+        clock: VirtualClock | None = None,
+    ):
+        self.osdmap = m
+        self.timeline = timeline or ChaosTimeline()
+        self.clock = clock or VirtualClock()
+        self.applied: list[AppliedEvent] = []
+
+    @property
+    def epoch(self) -> int:
+        return self.osdmap.epoch
+
+    def exhausted(self) -> bool:
+        return len(self.timeline) == 0
+
+    def poll(self) -> list[Incremental]:
+        """Inject every event due at the current virtual time; returns
+        the applied incrementals (empty list = no epoch advance)."""
+        incs = []
+        for ev in self.timeline.due(self.clock.now()):
+            inc = inject(self.osdmap, list(ev.specs))
+            incs.append(inc)
+            self.applied.append(
+                AppliedEvent(ev.t, inc.epoch, ev.specs, inc)
+            )
+        return incs
+
+    def advance_to_next(self) -> bool:
+        """Jump the clock to the next scheduled event (the idle path:
+        no repair work pending but chaos still scheduled).  Returns
+        False when the timeline is exhausted."""
+        t = self.timeline.peek_next()
+        if t is None:
+            return False
+        if t > self.clock.now():
+            self.clock.advance(t - self.clock.now())
+        return True

@@ -33,8 +33,20 @@ from ..common.config import Config, global_config
 from ..common.perf_counters import PerfCounters, PerfCountersBuilder, registry
 from ..common.tracing import timed_block, trace_annotation
 from ..ec.backend import TableEncoder
-from .peering import PeeringResult, peer_pool
-from .planner import PatternGroup, RecoveryPlan, build_plan
+from ..osdmap.map import OSDMap
+from .peering import (
+    PG_STATE_BACKFILL,
+    PG_STATE_DEGRADED,
+    PeeringEngine,
+    PeeringResult,
+    peer_pool,
+)
+from .planner import (
+    PatternGroup,
+    RecoveryPlan,
+    build_plan,
+    invalidated_groups,
+)
 
 
 class TokenBucket:
@@ -43,7 +55,11 @@ class TokenBucket:
     Debt model: a request always proceeds, driving the bucket negative
     if oversized, and the caller sleeps until the debt is refilled —
     so a single burst larger than the bucket is delayed, not deadlocked.
-    ``clock``/``sleep`` are injectable so tests advance virtual time.
+    ``max_debt`` clamps how far negative a pathological burst can drive
+    the bucket, bounding the worst-case stall to ``max_debt / rate``
+    seconds (default 4x burst; ``recovery_max_debt_bytes`` at the
+    executor surface).  ``clock``/``sleep`` are injectable so tests
+    advance virtual time.
     """
 
     def __init__(
@@ -52,9 +68,14 @@ class TokenBucket:
         burst_bytes: float,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
+        max_debt: float | None = None,
     ):
         self.rate = float(rate_bytes_per_sec)
         self.burst = max(float(burst_bytes), 1.0)
+        self.max_debt = (
+            max(float(max_debt), 1.0) if max_debt is not None
+            else 4.0 * self.burst
+        )
         self._clock = clock
         self._sleep = sleep
         self._tokens = self.burst
@@ -71,7 +92,7 @@ class TokenBucket:
             self.burst, self._tokens + (now - self._last) * self.rate
         )
         self._last = now
-        self._tokens -= nbytes
+        self._tokens = max(self._tokens - nbytes, -self.max_debt)
         if self._tokens >= 0:
             return 0.0
         wait = -self._tokens / self.rate
@@ -93,8 +114,20 @@ def _build_counters() -> PerfCounters:
         .add_u64_counter("shards_rebuilt", "shard chunks rebuilt")
         .add_u64_counter("pgs_recovered", "degraded PGs repaired")
         .add_u64_counter("throttle_waits", "throttle sleep events")
+        .add_u64_counter("launch_retries",
+                         "decode launches retried after a failure")
+        .add_u64_counter("stale_launches",
+                         "decode launches discarded: epoch advanced "
+                         "mid-flight and killed a source shard")
+        .add_u64_counter("plan_revisions",
+                         "mid-flight plan revisions (epoch advances "
+                         "that invalidated pattern groups)")
+        .add_u64_counter("epochs_observed",
+                         "map epochs observed during supervised runs")
         .add_gauge("degraded_pgs", "degraded PGs in the last plan")
         .add_gauge("unrecoverable_pgs", "PGs below k survivors")
+        .add_gauge("failed_pgs",
+                   "PGs abandoned after decode-retry exhaustion")
         .create_perf_counters()
     )
 
@@ -146,11 +179,67 @@ class RecoveryExecutor:
             cfg.get("recovery_burst_bytes"),
             clock=clock,
             sleep=sleep,
+            max_debt=cfg.get("recovery_max_debt_bytes"),
         )
         self.on_decode_launch = on_decode_launch
         self.pc = recovery_counters()
         # one encoder per erasure pattern, reused across runs
         self._encoders: dict[int, TableEncoder] = {}
+
+    def _launch_group(
+        self,
+        g: PatternGroup,
+        read_shard: Callable[[int, int], np.ndarray],
+        result: RecoveryResult,
+    ) -> tuple[np.ndarray, int]:
+        """Read survivors, throttle, and run the batched decode launch
+        for one group.  Returns ``(out, chunk)`` WITHOUT committing the
+        rebuilt shards — the supervised loop may discard a launch whose
+        sources died mid-flight."""
+        src = np.stack(
+            [
+                np.concatenate([read_shard(int(pg), s) for pg in g.pgs])
+                for s in g.rows
+            ]
+        )
+        chunk = src.shape[1] // g.n_pgs
+        nbytes = (len(g.rows) + len(g.missing)) * g.n_pgs * chunk
+        if self.throttle.take(nbytes):
+            self.pc.inc("throttle_waits")
+        enc = self._encoders.get(g.mask)
+        if enc is None:
+            enc = self._encoders[g.mask] = TableEncoder(g.repair_matrix)
+        if self.on_decode_launch is not None:
+            self.on_decode_launch(g, nbytes)
+        t0 = time.perf_counter()
+        with timed_block(self.pc, "l_decode"), trace_annotation(
+            f"recovery:decode:{g.mask:#x}"
+        ):
+            out = enc.encode(src)  # [n_missing, n_pgs * chunk]
+        result.decode_s += time.perf_counter() - t0
+        result.launches += 1
+        self.pc.inc("decode_launches")
+        return out, chunk
+
+    def _commit_group(
+        self,
+        g: PatternGroup,
+        out: np.ndarray,
+        chunk: int,
+        result: RecoveryResult,
+    ) -> None:
+        """Record one launched group's rebuilt shards into the result."""
+        for i, pg in enumerate(g.pgs):
+            result.shards[int(pg)] = {
+                s: out[j, i * chunk:(i + 1) * chunk]
+                for j, s in enumerate(g.missing)
+            }
+        rebuilt = len(g.missing) * g.n_pgs
+        result.shards_rebuilt += rebuilt
+        result.bytes_recovered += rebuilt * chunk
+        self.pc.inc("shards_rebuilt", rebuilt)
+        self.pc.inc("bytes_recovered", rebuilt * chunk)
+        self.pc.inc("pgs_recovered", g.n_pgs)
 
     def run(
         self,
@@ -163,40 +252,8 @@ class RecoveryExecutor:
         property, constant per pool)."""
         result = RecoveryResult(shards={}, unrecoverable=plan.unrecoverable)
         for g in plan.groups:
-            src = np.stack(
-                [
-                    np.concatenate([read_shard(int(pg), s) for pg in g.pgs])
-                    for s in g.rows
-                ]
-            )
-            chunk = src.shape[1] // g.n_pgs
-            nbytes = (len(g.rows) + len(g.missing)) * g.n_pgs * chunk
-            if self.throttle.take(nbytes):
-                self.pc.inc("throttle_waits")
-            enc = self._encoders.get(g.mask)
-            if enc is None:
-                enc = self._encoders[g.mask] = TableEncoder(g.repair_matrix)
-            if self.on_decode_launch is not None:
-                self.on_decode_launch(g, nbytes)
-            t0 = time.perf_counter()
-            with timed_block(self.pc, "l_decode"), trace_annotation(
-                f"recovery:decode:{g.mask:#x}"
-            ):
-                out = enc.encode(src)  # [n_missing, n_pgs * chunk]
-            result.decode_s += time.perf_counter() - t0
-            for i, pg in enumerate(g.pgs):
-                result.shards[int(pg)] = {
-                    s: out[j, i * chunk:(i + 1) * chunk]
-                    for j, s in enumerate(g.missing)
-                }
-            rebuilt = len(g.missing) * g.n_pgs
-            result.launches += 1
-            result.shards_rebuilt += rebuilt
-            result.bytes_recovered += rebuilt * chunk
-            self.pc.inc("decode_launches")
-            self.pc.inc("shards_rebuilt", rebuilt)
-            self.pc.inc("bytes_recovered", rebuilt * chunk)
-            self.pc.inc("pgs_recovered", g.n_pgs)
+            out, chunk = self._launch_group(g, read_shard, result)
+            self._commit_group(g, out, chunk, result)
         result.throttle_wait_s = self.throttle.waited_s
         return result
 
@@ -225,3 +282,316 @@ def recover_pool(
     )
     result = executor.run(plan, read_shard)
     return peering, plan, result
+
+
+class LaunchError(RuntimeError):
+    """A decode launch failed (injected by a fault hook, or a real
+    device error surfaced as RuntimeError); retried with backoff."""
+
+
+@dataclass
+class SupervisedResult:
+    """Outcome of one supervised (chaos-tolerant) recovery run."""
+
+    shards: dict[int, dict[int, np.ndarray]]
+    epochs: list[int] = field(default_factory=list)
+    launches: int = 0
+    retries: int = 0  # failed-launch retries (backoff path)
+    stale_launches: int = 0  # discarded: epoch killed a source mid-flight
+    plan_revisions: int = 0
+    completed_pgs: set[int] = field(default_factory=set)
+    failed_pgs: list[int] = field(default_factory=list)
+    unrecoverable: np.ndarray = field(
+        default_factory=lambda: np.empty(0, np.int64)
+    )
+    converged: bool = False
+    time_to_zero_degraded_s: float = 0.0
+    bytes_recovered: int = 0
+    shards_rebuilt: int = 0
+    decode_s: float = 0.0
+    throttle_wait_s: float = 0.0
+    final_counts: dict[str, int] = field(default_factory=dict)
+
+    def summary(self) -> dict:
+        """Structured run report (the ``ceph status`` analog for a
+        chaos run): never a crash, never a silent drop — every PG is
+        accounted for as completed, failed, or unrecoverable."""
+        return {
+            "converged": self.converged,
+            "time_to_zero_degraded_s": round(
+                self.time_to_zero_degraded_s, 6
+            ),
+            "epochs_observed": len(self.epochs),
+            "launches": self.launches,
+            "retries": self.retries,
+            "stale_launches": self.stale_launches,
+            "plan_revisions": self.plan_revisions,
+            "completed_pgs": len(self.completed_pgs),
+            "failed_pgs": sorted(self.failed_pgs),
+            "unrecoverable_pgs": sorted(int(p) for p in self.unrecoverable),
+            "bytes_recovered": self.bytes_recovered,
+        }
+
+
+class SupervisedRecovery:
+    """Chaos-tolerant recovery driver: the executor's run loop made
+    safe against epochs advancing *while the plan executes*.
+
+    Per iteration the loop (a) polls the chaos engine — due failure
+    events become ordinary epochs; (b) on epoch advance, re-peers the
+    delta (:meth:`PeeringEngine.repeer`, zero recompiles) and re-plans
+    ONLY invalidated pattern groups (:func:`invalidated_groups` — valid
+    groups keep their matrices and cached device encoders); (c) retries
+    failed decode launches with bounded exponential backoff + seeded
+    jitter (``recovery_retry_max`` / ``recovery_backoff_base_ms``); (d)
+    checkpoints per-PG completion (acting-row snapshot) so a revision
+    never re-decodes a PG the chaos left untouched; and (e) reports
+    below-k PGs as ``unrecoverable`` — the run always terminates with a
+    structured summary, never a crash or an infinite retry.
+
+    Scheduling is reservation-style (the reference's
+    ``osd_max_backfills``): pattern groups whose PGs are all
+    backfill-flagged (remap-induced) interleave with pure-repair groups
+    at a ratio of ``osd_max_backfills`` backfill groups per repair
+    group, sharing the one token bucket, so neither class starves the
+    other.
+
+    All time is the chaos engine's virtual clock (launches occupy
+    ``launch_duration_s`` of it; backoff and throttle sleep on it), and
+    the only randomness is the seeded jitter generator — two runs of
+    one scenario are bit-identical.
+    """
+
+    def __init__(
+        self,
+        codec,
+        chaos,
+        config: Config | None = None,
+        on_decode_launch: Callable[[PatternGroup, int], None] | None = None,
+        fault_hook: Callable[[PatternGroup, int], bool] | None = None,
+        seed: int = 0,
+        launch_duration_s: float = 0.5,
+        max_items: int = 8,
+    ):
+        self.codec = codec
+        self.chaos = chaos
+        self.cfg = config or global_config()
+        self.fault_hook = fault_hook
+        self.launch_duration_s = float(launch_duration_s)
+        self.max_items = max_items
+        self._rng = np.random.default_rng(seed)
+        self.retry_max = int(self.cfg.get("recovery_retry_max"))
+        self.backoff_base_s = (
+            float(self.cfg.get("recovery_backoff_base_ms")) / 1000.0
+        )
+        self.max_backfills = int(self.cfg.get("osd_max_backfills"))
+        self.ex = RecoveryExecutor(
+            codec,
+            config=self.cfg,
+            on_decode_launch=on_decode_launch,
+            clock=chaos.clock.now,
+            sleep=chaos.clock.sleep,
+        )
+        self.pc = self.ex.pc
+
+    def _schedule(
+        self, groups: list[PatternGroup], peering: PeeringResult
+    ) -> list[PatternGroup]:
+        """Priority order with backfill fair-share: most-missing first
+        within each class, then ``osd_max_backfills`` backfill groups
+        admitted after each repair group."""
+        groups = sorted(groups, key=lambda g: (-len(g.missing), g.mask))
+        backfill = [
+            g for g in groups
+            if all(peering.flags[pg] & PG_STATE_BACKFILL for pg in g.pgs)
+        ]
+        # partition by identity, not mask: a revision can carry two
+        # groups with the same erasure pattern (a still-valid backfill
+        # group plus a freshly re-planned repair group) and both must
+        # survive the split
+        bf_ids = {id(g) for g in backfill}
+        repair = [g for g in groups if id(g) not in bf_ids]
+        out: list[PatternGroup] = []
+        bi = 0
+        for r in repair:
+            out.append(r)
+            out.extend(backfill[bi:bi + self.max_backfills])
+            bi += self.max_backfills
+        out.extend(backfill[bi:])
+        return out
+
+    @staticmethod
+    def _is_stale(
+        g: PatternGroup, peering: PeeringResult, m: OSDMap
+    ) -> bool:
+        """Did the epoch advance kill any OSD this launch read from?"""
+        for pg in g.pgs:
+            for s in g.rows:
+                osd = int(peering.acting[int(pg), s])
+                if not m.is_up(osd):
+                    return True
+        return False
+
+    def run(
+        self,
+        m_prev: OSDMap,
+        pool_id: int,
+        read_shard: Callable[[int, int], np.ndarray],
+    ) -> SupervisedResult:
+        """Drive recovery of one pool to convergence under the chaos
+        timeline.  ``m_prev`` is the pre-failure epoch (where the data
+        lives); the chaos engine owns the live map."""
+        from ..osdmap.mapping import build_pool_state
+
+        chaos = self.chaos
+        clock = chaos.clock
+        engine = PeeringEngine(chaos.osdmap, pool_id)
+        state_prev = build_pool_state(
+            m_prev, m_prev.pools[pool_id], self.max_items
+        )
+
+        def cur_state():
+            return build_pool_state(
+                chaos.osdmap, chaos.osdmap.pools[pool_id], self.max_items
+            )
+
+        inner = RecoveryResult(shards={})
+        res = SupervisedResult(shards=inner.shards)
+        peering = engine.run(
+            state_prev, cur_state(), m_prev.epoch, chaos.epoch
+        )
+        res.epochs.append(chaos.epoch)
+        plan = build_plan(peering, self.codec)
+        pending = self._schedule(plan.groups, peering)
+        unrecoverable = plan.unrecoverable
+        # checkpoint: pg -> acting row at completion time.  A later
+        # epoch that moves/kills anything in the row voids the entry.
+        completed: dict[int, np.ndarray] = {}
+        # retry-exhausted PGs and the mask they failed under: re-planned
+        # only if a later epoch changes the pattern (a fresh chance),
+        # never retried identically forever.
+        failed: dict[int, int] = {}
+
+        def revise() -> None:
+            nonlocal peering, pending, unrecoverable
+            res.plan_revisions += 1
+            self.pc.inc("plan_revisions")
+            peering, _changed = engine.repeer(
+                peering, state_prev, cur_state(), chaos.epoch
+            )
+            for pg in list(completed):
+                if not np.array_equal(peering.acting[pg], completed[pg]):
+                    del completed[pg]
+            valid, _invalid_pgs = invalidated_groups(
+                pending, peering.survivor_mask
+            )
+            for pg in list(failed):
+                if int(peering.survivor_mask[pg]) != failed[pg]:
+                    del failed[pg]  # pattern changed: worth a new try
+            covered = set(completed) | set(failed)
+            for g in valid:
+                covered.update(int(p) for p in g.pgs)
+            need = np.array(
+                sorted(
+                    int(pg)
+                    for pg in peering.pgs_with(PG_STATE_DEGRADED)
+                    if int(pg) not in covered
+                ),
+                dtype=np.int64,
+            )
+            sub = build_plan(peering, self.codec, pgs=need)
+            pending = self._schedule(valid + sub.groups, peering)
+            unrecoverable = sub.unrecoverable
+
+        def observe(incs) -> None:
+            res.epochs.extend(i.epoch for i in incs)
+            self.pc.inc("epochs_observed", len(incs))
+
+        while True:
+            incs = chaos.poll()
+            if incs:
+                observe(incs)
+                revise()
+            if not pending:
+                res.time_to_zero_degraded_s = clock.now()
+                if chaos.advance_to_next():
+                    continue
+                break
+            g = pending.pop(0)
+            attempt = 0
+            while True:
+                try:
+                    if self.fault_hook is not None and self.fault_hook(
+                        g, attempt
+                    ):
+                        raise LaunchError(
+                            f"injected launch failure {g.mask:#x}"
+                        )
+                    out, chunk = self.ex._launch_group(
+                        g, read_shard, inner
+                    )
+                except (LaunchError, RuntimeError):
+                    attempt += 1
+                    if attempt > self.retry_max:
+                        for pg in g.pgs:
+                            failed[int(pg)] = g.mask
+                        break
+                    res.retries += 1
+                    self.pc.inc("launch_retries")
+                    # bounded exponential backoff + seeded jitter
+                    clock.sleep(
+                        self.backoff_base_s
+                        * (2 ** (attempt - 1))
+                        * (1.0 + self._rng.random())
+                    )
+                    continue
+                # the launch occupies virtual time; chaos may land
+                # inside that window
+                clock.advance(self.launch_duration_s)
+                incs = chaos.poll()
+                if incs:
+                    observe(incs)
+                    if self._is_stale(g, peering, chaos.osdmap):
+                        # a source shard died under the launch: the
+                        # output may mix pre/post-failure reads — drop
+                        # it; revise() re-plans these PGs
+                        res.stale_launches += 1
+                        self.pc.inc("stale_launches")
+                        revise()
+                        break
+                    # commit against the pre-event acting rows, THEN
+                    # revise: if the event touched this PG, the
+                    # snapshot mismatch un-checkpoints it right here
+                    self.ex._commit_group(g, out, chunk, inner)
+                    for pg in g.pgs:
+                        completed[int(pg)] = peering.acting[int(pg)].copy()
+                        failed.pop(int(pg), None)
+                    revise()
+                    break
+                self.ex._commit_group(g, out, chunk, inner)
+                for pg in g.pgs:
+                    completed[int(pg)] = peering.acting[int(pg)].copy()
+                    failed.pop(int(pg), None)
+                break
+
+        res.launches = inner.launches
+        res.bytes_recovered = inner.bytes_recovered
+        res.shards_rebuilt = inner.shards_rebuilt
+        res.decode_s = inner.decode_s
+        res.throttle_wait_s = self.ex.throttle.waited_s
+        res.completed_pgs = set(completed)
+        res.failed_pgs = sorted(failed)
+        res.unrecoverable = unrecoverable
+        res.final_counts = peering.counts()
+        degraded = {int(p) for p in peering.pgs_with(PG_STATE_DEGRADED)}
+        outstanding = (
+            degraded
+            - set(completed)
+            - set(failed)
+            - {int(p) for p in unrecoverable}
+        )
+        res.converged = not failed and not outstanding
+        self.pc.set("degraded_pgs", len(outstanding))
+        self.pc.set("unrecoverable_pgs", int(len(unrecoverable)))
+        self.pc.set("failed_pgs", len(failed))
+        return res

@@ -31,6 +31,14 @@ from ..osdmap.map import Incremental, OSDMap, UP
 
 ACTIONS = ("down", "out", "down_out", "up", "in")
 
+# The scopes a spec may name: ``osd`` plus the reference's stock CRUSH
+# bucket types (``src/crush/CrushWrapper.cc`` default type set).  Maps
+# with exotic custom type names can pass ``scopes=`` to parse_spec.
+KNOWN_SCOPES = (
+    "osd", "host", "chassis", "rack", "row", "pdu", "pod", "room",
+    "datacenter", "dc", "zone", "region", "root",
+)
+
 
 @dataclass(frozen=True)
 class FailureSpec:
@@ -45,8 +53,16 @@ class FailureSpec:
         return f"{self.scope}:{self.target}:{self.action}"
 
 
-def parse_spec(text: str) -> FailureSpec:
-    """``scope:target[:action]`` -> :class:`FailureSpec`."""
+def parse_spec(text: str, scopes: tuple[str, ...] = KNOWN_SCOPES) -> FailureSpec:
+    """``scope:target[:action]`` -> :class:`FailureSpec`.
+
+    Validates eagerly — a bad spec must die at the CLI/timeline surface
+    with a clear message, not deep inside map application: the scope
+    must be ``osd`` or a known bucket type, the target non-empty (and a
+    non-negative integer for ``osd``, normalized so ``osd:007`` and
+    ``osd:7`` are the same event), and the action one of
+    :data:`ACTIONS`.
+    """
     parts = text.split(":")
     if len(parts) == 2:
         scope, target = parts
@@ -55,9 +71,27 @@ def parse_spec(text: str) -> FailureSpec:
         scope, target, action = parts
     else:
         raise ValueError(f"bad failure spec {text!r} (scope:target[:action])")
+    if scope not in scopes:
+        raise ValueError(
+            f"unknown scope {scope!r} in {text!r}; one of {scopes}"
+        )
+    if not target:
+        raise ValueError(f"empty target in failure spec {text!r}")
+    if scope == "osd":
+        if not target.isdigit():
+            raise ValueError(
+                f"osd target must be a non-negative integer, got {target!r}"
+            )
+        target = str(int(target))  # canonical: no leading zeros
     if action not in ACTIONS:
         raise ValueError(f"bad action {action!r}; one of {ACTIONS}")
     return FailureSpec(scope, target, action)
+
+
+def normalize(text: str, scopes: tuple[str, ...] = KNOWN_SCOPES) -> str:
+    """Canonical ``scope:target:action`` string for a spec; the fixed
+    point of parsing (``str(parse_spec(s)) == normalize(s)``)."""
+    return str(parse_spec(text, scopes))
 
 
 def osds_in_subtree(crush: CrushMap, bucket_id: int) -> list[int]:

@@ -189,6 +189,40 @@ class PeeringEngine:
         dynamic state — one device launch, no recompile."""
         return self._fn(self._crush_arg, state, self._pgs)
 
+    def repeer(
+        self,
+        prev_result: PeeringResult,
+        state_prev: PoolMapState,
+        state_cur: PoolMapState,
+        epoch_cur: int = 0,
+    ) -> tuple[PeeringResult, np.ndarray]:
+        """Incremental re-peer after a mid-flight epoch advance.
+
+        Returns ``(result, changed_pgs)`` where ``changed_pgs`` are the
+        PG seeds whose up/acting/survivor state differs from
+        ``prev_result`` — the only PGs a mid-flight re-plan needs to
+        touch (:func:`ceph_tpu.recovery.planner.invalidated_groups`).
+
+        "Incremental" the TPU way: the device passes stay full-width
+        fixed-shape (the SAME cached executables as :meth:`run` — a
+        delta-sized gather would recompile per distinct delta, J004),
+        and the epoch delta is extracted host-side by diffing against
+        the previous result.  Cost per epoch is therefore one mapping
+        launch + one classify launch, zero recompiles, regardless of
+        how many epochs the chaos timeline delivers.
+        """
+        result = self.run(
+            state_prev, state_cur,
+            epoch_prev=prev_result.epoch_prev, epoch_cur=epoch_cur,
+        )
+        changed = np.nonzero(
+            np.any(result.acting != prev_result.acting, axis=1)
+            | np.any(result.up != prev_result.up, axis=1)
+            | (result.survivor_mask != prev_result.survivor_mask)
+            | (result.flags != prev_result.flags)
+        )[0]
+        return result, changed
+
     def run(
         self, state_prev: PoolMapState, state_cur: PoolMapState,
         epoch_prev: int = 0, epoch_cur: int = 0,

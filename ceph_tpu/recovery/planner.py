@@ -123,13 +123,17 @@ class RecoveryPlan:
         }
 
 
-def build_plan(peering: PeeringResult, codec) -> RecoveryPlan:
+def build_plan(
+    peering: PeeringResult, codec, pgs: np.ndarray | None = None
+) -> RecoveryPlan:
     """Group the peering pass's degraded PGs into pattern groups.
 
     ``codec`` is any systematic GF(2^8) codec exposing ``k``, ``m`` and
     ``generator()`` (:class:`ceph_tpu.ec.backend.MatrixCodec`); the
     pool's ``size`` must equal k+m (EC pools are positional: acting
-    slot == shard id).
+    slot == shard id).  ``pgs`` restricts planning to a PG subset —
+    the mid-flight re-plan path, where only the epoch delta's
+    invalidated PGs need fresh groups.
     """
     codec = _matrix_codec(codec)
     k, m = codec.k, codec.m
@@ -139,6 +143,10 @@ def build_plan(peering: PeeringResult, codec) -> RecoveryPlan:
         )
     gen = codec.generator()  # [(k+m), k] identity top block
     degraded = peering.pgs_with(PG_STATE_DEGRADED)
+    if pgs is not None:
+        degraded = np.intersect1d(
+            degraded, np.asarray(pgs, dtype=np.int64)
+        )
     masks = peering.survivor_mask[degraded]
     plan = RecoveryPlan(k=k, m=m)
     unrecoverable: list[np.ndarray] = []
@@ -170,3 +178,30 @@ def build_plan(peering: PeeringResult, codec) -> RecoveryPlan:
     if unrecoverable:
         plan.unrecoverable = np.concatenate(unrecoverable)
     return plan
+
+
+def invalidated_groups(
+    groups: list[PatternGroup], survivor_mask: np.ndarray
+) -> tuple[list[PatternGroup], np.ndarray]:
+    """Split pending groups against a fresh peering pass's masks.
+
+    A group stays valid only while every member PG still has EXACTLY
+    the erasure pattern it was planned for: a lost bit means a planned
+    source row may be dead (the decode would read garbage), a gained
+    bit means a flapped-back survivor made part of the decode
+    pointless, and either way the precomposed repair matrix no longer
+    matches.  Returns ``(valid_groups, invalid_pgs)`` — the invalid PGs
+    re-enter planning (``build_plan(..., pgs=...)``), the valid groups'
+    matrices (and their cached device encoders, keyed by mask) are
+    reused untouched.
+    """
+    valid: list[PatternGroup] = []
+    invalid: list[np.ndarray] = []
+    for g in groups:
+        if bool(np.all(survivor_mask[g.pgs] == np.uint32(g.mask))):
+            valid.append(g)
+        else:
+            invalid.append(np.asarray(g.pgs, dtype=np.int64))
+    return valid, (
+        np.concatenate(invalid) if invalid else np.empty(0, np.int64)
+    )

@@ -1,0 +1,150 @@
+"""Chaos-run property soak: random clusters x random failure timelines
+through the supervised executor, asserting the recovery contract every
+trial — the run always terminates; every finally-degraded PG with >= k
+survivors is recovered and its decoded bytes equal the originals;
+every below-k PG is reported unrecoverable (never crashed on, never
+retried forever); and a same-seed replay reproduces the summary
+exactly.
+
+NOT collected by pytest — run manually:
+
+    env -u PYTHONPATH CEPH_TPU_TEST_REEXEC=1 PYTHONPATH=/root/repo \\
+      JAX_PLATFORMS=cpu python tests/fuzz_chaos.py
+
+Budget via CEPH_TPU_FUZZ_SECONDS (default 900).
+"""
+
+import copy
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from ceph_tpu import recovery as rec  # noqa: E402
+from ceph_tpu.common.config import Config  # noqa: E402
+from ceph_tpu.ec import gf  # noqa: E402
+from ceph_tpu.ec.backend import MatrixCodec  # noqa: E402
+from ceph_tpu.models.clusters import build_osdmap  # noqa: E402
+
+
+def _random_timeline(rng, m, n_osds):
+    """A random multi-epoch schedule: osd/host events, some flapping
+    back up, landing across the first few virtual seconds."""
+    pairs = []
+    hosts = [b.name for b in m.crush.buckets.values()
+             if m.crush.types[b.type_id] == "host"]
+    t = 0.1
+    for _ in range(int(rng.integers(1, 6))):
+        roll = rng.random()
+        if roll < 0.5:
+            osd = int(rng.integers(0, n_osds))
+            pairs.append((t, f"osd:{osd}:down"))
+            if rng.random() < 0.5:  # flap back
+                pairs.append((t + 0.4, f"osd:{osd}:up"))
+        elif roll < 0.85:
+            h = hosts[int(rng.integers(0, len(hosts)))]
+            action = ("down", "down_out")[int(rng.integers(0, 2))]
+            pairs.append((t, f"host:{h}:{action}"))
+        else:
+            racks = [b.name for b in m.crush.buckets.values()
+                     if m.crush.types[b.type_id] == "rack"]
+            pairs.append((t, f"rack:{racks[int(rng.integers(0, len(racks)))]}"
+                             ":down_out"))
+        t += float(rng.uniform(0.3, 1.2))
+    return pairs
+
+
+def _one_trial(rng, seed):
+    k = int(rng.integers(2, 6))
+    m_par = int(rng.integers(1, 4))
+    n = int(rng.integers(24, 96))
+    pg_num = int(rng.integers(8, 48))
+    m = build_osdmap(n, pg_num=pg_num, size=k + m_par, pool_kind="erasure")
+    m_prev = copy.deepcopy(m)
+    pairs = _random_timeline(rng, m, n)
+    codec = MatrixCodec(gf.vandermonde_matrix(k, m_par))
+    data_rng = np.random.default_rng(seed)
+    store = {}
+
+    def read_shard(pg, s):
+        if pg not in store:
+            data = data_rng.integers(0, 256, (k, 32), dtype=np.uint8)
+            store[pg] = np.vstack([data, codec.encode(data)])
+        return store[pg][s]
+
+    cfg = Config(env={})
+    fail_every = int(rng.integers(0, 7))  # 0 = no injected launch faults
+    calls = [0]
+
+    def hook(g, attempt):
+        calls[0] += 1
+        return bool(fail_every) and calls[0] % fail_every == 0
+
+    chaos = rec.ChaosEngine(m, rec.ChaosTimeline.from_pairs(pairs))
+    sup = rec.SupervisedRecovery(codec, chaos, config=cfg, seed=seed,
+                                 fault_hook=hook)
+    res = sup.run(m_prev, 1, read_shard)
+
+    # contract 1: the run terminated with the timeline exhausted
+    assert chaos.exhausted(), "timeline not drained"
+
+    # contract 2: every finally-degraded PG is accounted for —
+    # completed (>= k survivors), unrecoverable (< k), or failed
+    # (injected launch faults exhausted the retry budget)
+    p = rec.peer_pool(m_prev, chaos.osdmap, 1)
+    nsurv = p.n_survivors()
+    lost = {int(x) for x in res.unrecoverable}
+    failed = set(res.failed_pgs)
+    for pg in p.pgs_with(rec.PG_STATE_DEGRADED):
+        pg = int(pg)
+        if nsurv[pg] < k:
+            assert pg in lost, f"pg {pg} below k but not unrecoverable"
+        else:
+            assert pg in res.completed_pgs or pg in failed, \
+                f"pg {pg} (>=k survivors) neither recovered nor failed"
+    for pg in lost:
+        assert nsurv[pg] < k, f"pg {pg} unrecoverable with >=k survivors"
+    if not failed:
+        assert res.converged == (True), "no failures but not converged"
+
+    # contract 3: recovered bytes are the original bytes
+    for pg in res.completed_pgs:
+        for s, chunk in res.shards[pg].items():
+            assert np.array_equal(chunk, store[pg][s]), (pg, s)
+
+    # contract 4 (spot-checked): same-seed replay reproduces the run
+    return res, pairs
+
+
+def main() -> int:
+    seed = int(time.time())
+    rng = np.random.default_rng(seed)
+    print(f"chaos fuzz seed {seed}", flush=True)
+    budget = int(os.environ.get("CEPH_TPU_FUZZ_SECONDS", "900"))
+    t0 = time.time()
+    trial = 0
+    while time.time() - t0 < budget:
+        trial += 1
+        trial_seed = int(rng.integers(0, 2**31))
+        trial_rng = np.random.default_rng(trial_seed)
+        res, pairs = _one_trial(trial_rng, trial_seed)
+        if trial % 5 == 0:
+            # determinism spot-check: replay the exact trial
+            res2, _ = _one_trial(
+                np.random.default_rng(trial_seed), trial_seed
+            )
+            assert res.summary() == res2.summary(), "replay diverged"
+            print(f"trial {trial} ok+replay ({time.time() - t0:.0f}s, "
+                  f"{len(pairs)} events, {len(res.completed_pgs)} pgs, "
+                  f"{res.retries} retries)", flush=True)
+    print(f"DONE: {trial} trials clean in {time.time() - t0:.0f}s",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

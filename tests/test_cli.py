@@ -630,3 +630,41 @@ def test_recovery_cli_requires_an_action():
 
     with pytest.raises(SystemExit):
         rcli.main(["--plan"])
+
+
+def test_recovery_cli_chaos_scenario(capsys):
+    import json
+
+    from ceph_tpu.cli import recovery as rcli
+
+    assert rcli.main([
+        "--num-osd", "64", "--pg-num", "32",
+        "--chaos", "mid-repair-loss", "--chunk-size", "128",
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "chaos mid-repair-loss: 2 scheduled events" in out
+    assert "chaos done: converged" in out
+    line = [l for l in out.splitlines() if l.startswith("{")][-1]
+    d = json.loads(line)
+    assert d["scenario"] == "mid-repair-loss" and d["converged"]
+    assert d["plan_revisions"] >= 1 and d["epochs_observed"] >= 2
+    assert "time_to_zero_degraded_s" in d and "unrecoverable_pgs" in d
+
+
+def test_recovery_cli_chaos_is_deterministic(capsys):
+    from ceph_tpu.cli import recovery as rcli
+
+    args = ["--num-osd", "64", "--pg-num", "32", "--chaos", "flap",
+            "--cycles", "2", "--chunk-size", "128", "--seed", "3"]
+    assert rcli.main(args) == 0
+    first = capsys.readouterr().out
+    assert rcli.main(args) == 0
+    assert capsys.readouterr().out == first
+
+
+def test_recovery_cli_chaos_unknown_scenario():
+    from ceph_tpu.cli import recovery as rcli
+
+    with pytest.raises(ValueError, match="unknown chaos scenario"):
+        rcli.main(["--num-osd", "32", "--pg-num", "16",
+                   "--chaos", "earthquake"])

@@ -114,6 +114,33 @@ def test_harvest_guard_collects_counters_and_clean_flag(tmp_path):
     assert "ec_encode_8_3_bytes_per_sec" not in g
 
 
+def test_harvest_guard_collects_chaos_counters(tmp_path):
+    p = _log(tmp_path, [
+        {"metric": "recovery_decode_bytes_per_sec", "platform": "tpu",
+         "value": 9_000_000, "n_compiles": 5, "n_compiles_first": 5,
+         "host_transfers": 2, "chaos_scenario": "mid-repair-loss",
+         "chaos_converged": True, "chaos_retries": 0, "chaos_replans": 2,
+         "chaos_unrecoverable": 0, "chaos_stale_launches": 1},
+    ])
+    g = dd.harvest_guard([p])["recovery_decode_bytes_per_sec"]
+    assert g["chaos_retries"] == 0 and g["chaos_replans"] == 2
+    assert g["chaos_unrecoverable"] == 0
+    assert g["chaos_converged"] is True
+    assert g["steady_state_clean"] is True
+    # non-guard chaos fields are not harvested
+    assert "chaos_scenario" not in g and "chaos_stale_launches" not in g
+
+
+def test_harvest_guard_chaos_fields_absent_when_not_emitted(tmp_path):
+    p = _log(tmp_path, [
+        {"metric": "recovery_decode_bytes_per_sec", "platform": "tpu",
+         "value": 9_000_000, "n_compiles": 5, "n_compiles_first": 5,
+         "host_transfers": 2},
+    ])
+    g = dd.harvest_guard([p])["recovery_decode_bytes_per_sec"]
+    assert not any(k.startswith("chaos_") for k in g)
+
+
 def test_harvest_guard_latest_line_wins(tmp_path):
     p = _log(tmp_path, [
         {"metric": "crush_placements_per_sec", "platform": "tpu",
