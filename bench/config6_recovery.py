@@ -1,5 +1,15 @@
 """BASELINE config 6: failure-driven recovery (peering + batched repair).
 
+``--multichip`` runs the mesh-sharded variant instead: every pattern
+group's byte axis is split over all devices
+(:class:`ceph_tpu.recovery.sharded.ShardedDecoder`), the repair LUTs
+replicated and the recovered-byte counters psum-reduced.  On a CPU
+host the device count is forced to >= 2 virtual devices (XLA_FLAGS,
+set before jax imports) so the collective path is exercised without
+hardware; the JSON line carries ``n_devices``, the psum'd byte/shard
+counters, and the same compile/transfer guard fields.
+
+
 Simulates scenario #1 from the roadmap: a full rack failure on a
 1k-OSD cluster with an (8,3) EC pool.  Times the whole failure loop —
 fault injection, the vmapped whole-cluster peering pass, pattern-
@@ -33,6 +43,107 @@ PG_NUM = 256
 CHUNK = 16384
 SERIAL_SAMPLE = 8
 CHAOS_CHUNK = 4096
+
+
+def build_multichip_record(
+    platform: str,
+    rate: float,
+    n_devices: int,
+    guard: dict,
+    warm: dict,
+    result,
+) -> dict:
+    """The ``--multichip`` JSON line (pure: schema-tested without
+    running the bench).  ``guard``/``warm`` are runtime-guard snapshot
+    dicts; ``result`` is the measured run's RecoveryResult."""
+    return {
+        "metric": "recovery_multichip_bytes_per_sec",
+        "value": round(rate),
+        "unit": "B/s",
+        "platform": platform,
+        "n_devices": int(n_devices),
+        "n_compiles": int(guard["n_compiles"]),
+        "n_compiles_first": int(warm["n_compiles"]),
+        "host_transfers": int(guard["host_transfers"]),
+        "sharded_launches": int(result.sharded_launches),
+        "psum_bytes_rebuilt": int(result.psum_bytes_rebuilt),
+        "psum_shards_rebuilt": int(result.psum_shards_rebuilt),
+    }
+
+
+def run_multichip() -> None:
+    """Mesh-sharded recovery decode over every device; one JSON line."""
+    from ceph_tpu.common.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    import copy
+
+    import jax
+
+    from ceph_tpu import recovery as rec
+    from ceph_tpu.common.config import Config
+    from ceph_tpu.ec.backend import MatrixCodec
+    from ceph_tpu.ec.gf import vandermonde_matrix
+    from ceph_tpu.models.clusters import build_osdmap
+    from ceph_tpu.parallel import make_mesh
+
+    n_devices = len(jax.devices())
+    assert n_devices >= 2, (
+        f"multichip bench needs >= 2 devices, got {n_devices}"
+    )
+    mesh = make_mesh(axis="bytes")
+
+    m = build_osdmap(N_OSDS, pg_num=PG_NUM, size=K + M, pool_kind="erasure")
+    m_prev = copy.deepcopy(m)
+    rec.inject(m, "rack:0:down_out")
+    peering = rec.peer_pool(m_prev, m, 1)
+    codec = MatrixCodec(vandermonde_matrix(K, M))
+    plan = rec.build_plan(peering, codec)
+
+    rng = np.random.default_rng(6)
+    store: dict[int, np.ndarray] = {}
+    for g in plan.groups:
+        for pg in g.pgs:
+            data = rng.integers(0, 256, (K, CHUNK), dtype=np.uint8)
+            store[int(pg)] = np.vstack([data, codec.encode(data)])
+
+    from ceph_tpu.analysis.runtime_guard import track
+
+    cfg = Config()
+    cfg.set("recovery_shard_min_bytes", 0)  # every group takes the mesh
+    ex = rec.RecoveryExecutor(codec, config=cfg, mesh=mesh)
+    with track() as guard:
+        ex.run(plan, lambda pg, s: store[pg][s])  # warm (compile per shape)
+        warm = guard.snapshot()
+        t0 = time.perf_counter()
+        result = ex.run(plan, lambda pg, s: store[pg][s])
+        t_decode = time.perf_counter() - t0
+    rate = result.bytes_recovered / t_decode
+    assert result.sharded_launches == plan.n_patterns, (
+        result.sharded_launches, plan.n_patterns
+    )
+    assert result.psum_bytes_rebuilt == result.bytes_recovered, (
+        result.psum_bytes_rebuilt, result.bytes_recovered
+    )
+
+    # spot-check the sharded output against the single-device decode
+    single = rec.RecoveryExecutor(codec)
+    ref = single.run(plan, lambda pg, s: store[pg][s])
+    for pg in list(result.shards)[:4]:
+        for s, chunk in result.shards[pg].items():
+            assert np.array_equal(chunk, ref.shards[pg][s]), (pg, s)
+
+    print(
+        f"multichip: {n_devices} devices, {result.launches} launches "
+        f"({plan.n_patterns} patterns / {plan.n_pgs} pgs), "
+        f"{rate / 1e6:.1f} MB/s, psum {result.psum_bytes_rebuilt} B / "
+        f"{result.psum_shards_rebuilt} shards",
+        file=sys.stderr,
+    )
+    print(json.dumps(build_multichip_record(
+        jax.default_backend(), rate, n_devices, guard.snapshot(), warm,
+        result,
+    )))
 
 
 def run_chaos(scenario: str) -> dict:
@@ -185,4 +296,16 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--multichip" in sys.argv:
+        # >= 2 virtual devices on a CPU host, set before any jax
+        # import so the collective path runs without hardware
+        import os
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        run_multichip()
+    else:
+        main()

@@ -45,7 +45,8 @@ MODES = {
 # Non-grid metrics worth carrying in the decision record for trend
 # tracking (they never vote on the kernel-mode winner): currently the
 # recovery subsystem's batched repair-decode rate (config6_recovery).
-AUX_METRICS = ("recovery_decode_bytes_per_sec",)
+AUX_METRICS = ("recovery_decode_bytes_per_sec",
+               "recovery_multichip_bytes_per_sec")
 
 # Runtime-guard fields the bench configs attach to their JSON lines
 # (ceph_tpu.analysis.runtime_guard): compile and device->host transfer
@@ -62,6 +63,15 @@ GUARD_FIELDS = ("n_compiles", "n_compiles_first", "host_transfers")
 # rate still looks healthy.
 CHAOS_GUARD_FIELDS = ("chaos_retries", "chaos_replans",
                       "chaos_unrecoverable")
+
+# Multichip recovery counters (config6_recovery --multichip): the
+# device count the rate was measured on, how many launches actually
+# routed through the mesh-sharded step, and the psum-reduced byte/
+# shard totals — a sharded rate measured with zero sharded launches
+# or counters that disagree with the committed bytes is a routing
+# regression, not a perf result.
+MULTICHIP_GUARD_FIELDS = ("n_devices", "sharded_launches",
+                          "psum_bytes_rebuilt", "psum_shards_rebuilt")
 
 
 def harvest_aux(paths: list[str]) -> dict[str, int]:
@@ -120,6 +130,9 @@ def harvest_guard(paths: list[str]) -> dict[str, dict]:
             fields = {f: int(d[f]) for f in GUARD_FIELDS if f in d}
             fields.update(
                 {f: int(d[f]) for f in CHAOS_GUARD_FIELDS if f in d}
+            )
+            fields.update(
+                {f: int(d[f]) for f in MULTICHIP_GUARD_FIELDS if f in d}
             )
             if not fields:
                 continue

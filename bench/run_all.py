@@ -29,6 +29,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from _child import communicate_no_kill  # noqa: E402
 
+# (name, path) or (name, path, extra_argv): the same config file can
+# register under several names with different modes
 CONFIGS = [
     ("config1_crush", "bench/config1_crush.py"),
     ("config2_ec_encode", "bench/config2_ec_encode.py"),
@@ -36,11 +38,14 @@ CONFIGS = [
     ("config4_repair_decode", "bench/config4_repair_decode.py"),
     ("config5_rebalance_sim", "bench/config5_rebalance_sim.py"),
     ("config6_recovery", "bench/config6_recovery.py"),
+    ("config6_recovery_multichip", "bench/config6_recovery.py",
+     ("--multichip",)),
     ("tpu_tier", "bench/tpu_tier.py"),
 ]
 
 
-def _run_one(name: str, path: str, timeout: int) -> dict:
+def _run_one(name: str, path: str, timeout: int,
+             extra_argv: tuple = ()) -> dict:
     full = os.path.join(_REPO, path)
     cfg_hash = hashlib.sha256(open(full, "rb").read()).hexdigest()[:12]
     t0 = time.perf_counter()
@@ -48,7 +53,7 @@ def _run_one(name: str, path: str, timeout: int) -> dict:
     # last-resort timeout discipline: bench/_child.py — SIGINT then
     # orphan, never SIGKILL (the proven tunnel-wedge mechanism)
     proc = subprocess.Popen(
-        [sys.executable, full],
+        [sys.executable, full, *extra_argv],
         cwd=_REPO,
         stdout=subprocess.PIPE,
         stderr=subprocess.PIPE,
@@ -151,7 +156,7 @@ def main() -> int:
     )
     args = p.parse_args()
 
-    known = {n for n, _ in CONFIGS}
+    known = {c[0] for c in CONFIGS}
     unknown = set(args.only or ()) - known
     if unknown:
         # a typo must not silently cost an hours-long chip session its
@@ -192,7 +197,7 @@ def main() -> int:
     records = []
     probe_budget = float(args.probe_budget)
     tunnel_down = False
-    for name, path in CONFIGS:
+    for name, path, *extra in CONFIGS:
         if args.only and name not in args.only:
             continue
         if not args.no_probe and not tunnel_down:
@@ -215,7 +220,8 @@ def main() -> int:
                 bank(records)
             continue
         print(f"== {name} ==", file=sys.stderr, flush=True)
-        rec = _run_one(name, path, args.timeout)
+        rec = _run_one(name, path, args.timeout,
+                       tuple(extra[0]) if extra else ())
         print(json.dumps(rec), flush=True)
         records.append(rec)
         bank(records)

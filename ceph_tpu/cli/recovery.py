@@ -44,6 +44,18 @@ def _pick_pool(m: OSDMap, pool_id: int | None) -> int:
     return ec[0] if ec else sorted(m.pools)[0]
 
 
+def _build_mesh(args, out):
+    """``--mesh N`` -> a 1-D device mesh (None when the flag is absent)."""
+    if args.mesh is None:
+        return None
+    from ..parallel import make_mesh
+
+    mesh = make_mesh(args.mesh or None, axis="bytes")
+    print(f"mesh: sharding large pattern groups over "
+          f"{mesh.devices.size} devices", file=out)
+    return mesh
+
+
 def _run_chaos(args, m, m_prev, pool_id, out) -> int:
     """Drive a named chaos timeline through the supervised executor."""
     import json
@@ -72,6 +84,8 @@ def _run_chaos(args, m, m_prev, pool_id, out) -> int:
     cfg = Config()
     if args.max_bytes_per_sec is not None:
         cfg.set("recovery_max_bytes_per_sec", args.max_bytes_per_sec)
+    if args.shard_min_bytes is not None:
+        cfg.set("recovery_shard_min_bytes", args.shard_min_bytes)
     rng = np.random.default_rng(0)
     chunks: dict[tuple[int, int], np.ndarray] = {}
 
@@ -83,7 +97,10 @@ def _run_chaos(args, m, m_prev, pool_id, out) -> int:
             )
         return chunks[key]
 
-    sup = SupervisedRecovery(codec, chaos, config=cfg, seed=args.seed)
+    mesh = _build_mesh(args, out)
+    sup = SupervisedRecovery(
+        codec, chaos, config=cfg, seed=args.seed, mesh=mesh
+    )
     res = sup.run(m_prev, pool_id, read_shard)
     for ev in chaos.applied:
         specs = " ".join(str(s) for s in ev.specs)
@@ -143,6 +160,15 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0,
                    help="retry-jitter seed for --chaos (determinism: same "
                         "seed, same run)")
+    p.add_argument("--mesh", type=int, default=None, metavar="N",
+                   help="shard large pattern groups over an N-device "
+                        "mesh for --execute/--chaos (0 = every local "
+                        "device); small groups stay single-device and "
+                        "are co-scheduled")
+    p.add_argument("--shard-min-bytes", type=int, default=None,
+                   help="crossover threshold override: smallest group "
+                        "operand (bytes) routed to the sharded decode "
+                        "(recovery_shard_min_bytes)")
     args = p.parse_args(argv)
     out = sys.stdout
 
@@ -240,6 +266,8 @@ def main(argv=None) -> int:
     cfg = Config()
     if args.max_bytes_per_sec is not None:
         cfg.set("recovery_max_bytes_per_sec", args.max_bytes_per_sec)
+    if args.shard_min_bytes is not None:
+        cfg.set("recovery_shard_min_bytes", args.shard_min_bytes)
     k = codec.k
     rng = np.random.default_rng(0)
     chunks: dict[tuple[int, int], np.ndarray] = {}
@@ -252,10 +280,15 @@ def main(argv=None) -> int:
             )
         return chunks[key]
 
-    ex = RecoveryExecutor(codec, config=cfg)
+    ex = RecoveryExecutor(codec, config=cfg, mesh=_build_mesh(args, out))
     result = ex.run(plan, read_shard)
+    sharded = (
+        f" ({result.sharded_launches} mesh-sharded, "
+        f"{result.psum_bytes_rebuilt} psum'd bytes)"
+        if result.sharded_launches else ""
+    )
     print(
-        f"execute: {result.launches} launches, "
+        f"execute: {result.launches} launches{sharded}, "
         f"{result.shards_rebuilt} shards / "
         f"{result.bytes_recovered} bytes rebuilt, "
         f"{result.bytes_per_sec / 1e6:.1f} MB/s decode, "

@@ -105,6 +105,25 @@ SCHEMA: list[Option] = [
            "base delay for exponential backoff between decode-launch "
            "retries (milliseconds); doubled per attempt plus seeded "
            "jitter", min=0.0, see_also=("recovery_retry_max",)),
+    Option("recovery_shard_groups", OPT_BOOL, True, LEVEL_ADVANCED,
+           "route large pattern groups through the mesh-sharded decode "
+           "when the executor is given a mesh (byte axis split over "
+           "devices, psum'd progress counters)",
+           see_also=("recovery_shard_min_bytes",)),
+    Option("recovery_shard_min_bytes", OPT_INT, 1 << 23, LEVEL_ADVANCED,
+           "smallest pattern-group operand (bytes moved: read + "
+           "rebuilt) routed to the mesh-sharded decode; smaller groups "
+           "stay on the single-device fast path where dispatch + "
+           "collective overhead beats the parallelism.  Default is the "
+           "measured CPU crossover (8-virtual-device mesh: sharded "
+           "wins >= ~8 MiB moved); real multi-chip meshes should set "
+           "this lower (~1 MiB) since their devices are genuinely "
+           "parallel", min=0, see_also=("recovery_shard_groups",)),
+    Option("recovery_coschedule_max", OPT_INT, 4, LEVEL_ADVANCED,
+           "small pattern groups dispatched back-to-back per "
+           "supervised scheduling window when a mesh is attached "
+           "(async launches round-robined over local devices); 1 "
+           "serializes launches as before", min=1),
     Option("osd_max_backfills", OPT_INT, 1, LEVEL_ADVANCED,
            "backfill pattern groups admitted per repair group in the "
            "supervised scheduler (the reference's backfill reservation "

@@ -77,7 +77,18 @@ class TableEncoder:
 
     def encode(self, data: np.ndarray) -> np.ndarray:
         """data [k, S] u8 -> coding [m, S] u8."""
-        return np.asarray(self._encode(jnp.asarray(data)))
+        return np.asarray(self.encode_async(data))
+
+    def encode_async(self, data) -> jnp.ndarray:
+        """Dispatch the encode without a host sync; the caller
+        materializes with ``np.asarray`` when it needs the bytes.
+
+        Lets the recovery executor co-schedule several small pattern
+        groups: a committed input (``jax.device_put`` onto a chosen
+        device) pins where the launch runs, so back-to-back dispatches
+        round-robined over a mesh's local devices genuinely overlap.
+        """
+        return self._encode(jnp.asarray(data))
 
 
 class BitmatrixEncoder:

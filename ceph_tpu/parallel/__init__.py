@@ -10,4 +10,9 @@ per-OSD utilization histograms.
 """
 
 from . import multihost  # noqa: F401
+from .padding import (  # noqa: F401
+    pad_to_multiple,
+    padded_size,
+    trim_to_size,
+)
 from .placement import make_mesh, sharded_placement_step  # noqa: F401

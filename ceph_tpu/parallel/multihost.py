@@ -51,6 +51,17 @@ def init(
     global _initialized
     if _initialized:
         return
+    # CPU backend: cross-process collectives need an explicit transport
+    # (gloo) or every multiprocess computation fails to compile with
+    # "Multiprocess computations aren't implemented on the CPU backend".
+    # Must be set before the process group forms; harmless single-host.
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        try:
+            jax.config.update(
+                "jax_cpu_collectives_implementation", "gloo"
+            )
+        except (AttributeError, ValueError):
+            pass  # jaxlib without the option or without gloo built in
     kwargs = {}
     coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
     if coordinator:
@@ -90,20 +101,30 @@ def process_count() -> int:
     return jax.process_count()
 
 
-def local_shard(global_batch: int) -> tuple[int, int]:
+def local_shard(global_batch: int, pad: bool = False) -> tuple[int, int]:
     """(start, size) of this process's slice of a global object batch.
 
     The slice matches ``NamedSharding(global_mesh(), P(axis))``'s
     per-device partitioning, so it feeds straight into
     ``jax.make_array_from_process_local_data``.  The batch must divide
-    evenly over devices (shard_map's 1-D in_spec requires it anyway).
+    evenly over devices (shard_map's 1-D in_spec requires it anyway) —
+    unless ``pad``, which rounds the batch up to a device multiple
+    first and returns this process's slice of the PADDED batch (pad the
+    operand to match with
+    :func:`ceph_tpu.parallel.padding.pad_to_multiple`).
     """
+    from .padding import padded_size
+
     devs = _global_devices()
     if global_batch % len(devs):
-        raise ValueError(
-            f"global batch {global_batch} must be divisible by the "
-            f"device count {len(devs)}"
-        )
+        if not pad:
+            raise ValueError(
+                f"global batch {global_batch} must be divisible by the "
+                f"device count {len(devs)}; pad the operand to a device "
+                f"multiple (parallel.padding.pad_to_multiple) and call "
+                f"with pad=True, or trim the batch"
+            )
+        global_batch = padded_size(global_batch, len(devs))
     per_dev = global_batch // len(devs)
     mine = [
         i for i, d in enumerate(devs)

@@ -71,6 +71,7 @@ from .executor import (
     recover_pool,
     recovery_counters,
 )
+from .sharded import ShardedDecoder, sharded_decode_step
 
 __all__ = [
     "ACTIONS",
@@ -114,4 +115,6 @@ __all__ = [
     "TokenBucket",
     "recover_pool",
     "recovery_counters",
+    "ShardedDecoder",
+    "sharded_decode_step",
 ]

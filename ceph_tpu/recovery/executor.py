@@ -29,6 +29,8 @@ from typing import Callable
 
 import numpy as np
 
+import jax
+
 from ..common.config import Config, global_config
 from ..common.perf_counters import PerfCounters, PerfCountersBuilder, registry
 from ..common.tracing import timed_block, trace_annotation
@@ -47,6 +49,7 @@ from .planner import (
     build_plan,
     invalidated_groups,
 )
+from .sharded import ShardedDecoder
 
 
 class TokenBucket:
@@ -124,6 +127,15 @@ def _build_counters() -> PerfCounters:
                          "that invalidated pattern groups)")
         .add_u64_counter("epochs_observed",
                          "map epochs observed during supervised runs")
+        .add_u64_counter("sharded_launches",
+                         "decode launches routed through the "
+                         "mesh-sharded step")
+        .add_u64_counter("coscheduled_windows",
+                         "supervised scheduling windows that dispatched "
+                         "more than one group")
+        .add_u64_counter("salvaged_pgs",
+                         "PGs committed from a stale launch because "
+                         "their own sources all survived the epoch")
         .add_gauge("degraded_pgs", "degraded PGs in the last plan")
         .add_gauge("unrecoverable_pgs", "PGs below k survivors")
         .add_gauge("failed_pgs",
@@ -150,10 +162,35 @@ class RecoveryResult:
     unrecoverable: np.ndarray = field(
         default_factory=lambda: np.empty(0, np.int64)
     )
+    # mesh-sharded path: launch count plus the psum-reduced byte/shard
+    # totals every host observed from the collective (zero when no
+    # launch routed through the mesh)
+    sharded_launches: int = 0
+    psum_bytes_rebuilt: int = 0
+    psum_shards_rebuilt: int = 0
 
     @property
     def bytes_per_sec(self) -> float:
         return self.bytes_recovered / self.decode_s if self.decode_s else 0.0
+
+
+@dataclass
+class _Inflight:
+    """A dispatched-but-unsynced decode launch.
+
+    ``out`` is a device array (jax) whose bytes are still in flight;
+    :meth:`RecoveryExecutor._finalize_group` materializes it.  The
+    supervised loop dispatches a window of these back-to-back so small
+    groups occupy the mesh concurrently, then syncs once.
+    """
+
+    group: PatternGroup
+    out: object  # jax.Array
+    chunk: int
+    sharded: bool
+    valid: int | None  # un-padded width (sharded path only)
+    counters: tuple | None  # psum'd (bytes, shards) arrays, sharded only
+    t_dispatch: float
 
 
 class RecoveryExecutor:
@@ -162,6 +199,15 @@ class RecoveryExecutor:
     ``on_decode_launch(group, nbytes)`` fires immediately before each
     device launch — the launch-count hook the tests assert against
     (exactly one call per unique survivor pattern).
+
+    With a ``mesh``, pattern groups whose operand moves at least
+    ``recovery_shard_min_bytes`` route through the mesh-sharded decode
+    (:class:`~ceph_tpu.recovery.sharded.ShardedDecoder`: byte axis
+    split over every chip, repair LUTs replicated, progress counters
+    psum-reduced); smaller groups stay on the single-device fast path,
+    round-robined over the mesh's local devices so back-to-back async
+    dispatches overlap.  Without a mesh the behavior is byte-identical
+    to the single-device executor.
     """
 
     def __init__(
@@ -171,6 +217,7 @@ class RecoveryExecutor:
         on_decode_launch: Callable[[PatternGroup, int], None] | None = None,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
+        mesh=None,
     ):
         self.codec = codec
         cfg = config or global_config()
@@ -185,17 +232,33 @@ class RecoveryExecutor:
         self.pc = recovery_counters()
         # one encoder per erasure pattern, reused across runs
         self._encoders: dict[int, TableEncoder] = {}
+        self.mesh = mesh
+        self.shard_min_bytes = int(cfg.get("recovery_shard_min_bytes"))
+        self._sharded: ShardedDecoder | None = None
+        self._devices: list = []
+        self._rr = 0
+        if mesh is not None:
+            if bool(cfg.get("recovery_shard_groups")):
+                # multihost needs the gathered (replicated) output so
+                # every process can materialize the rebuilt bytes
+                self._sharded = ShardedDecoder(
+                    mesh, gather=jax.process_count() > 1
+                )
+            proc = jax.process_index()
+            self._devices = [
+                d for d in mesh.devices.flat if d.process_index == proc
+            ]
 
-    def _launch_group(
+    def _dispatch_group(
         self,
         g: PatternGroup,
         read_shard: Callable[[int, int], np.ndarray],
         result: RecoveryResult,
-    ) -> tuple[np.ndarray, int]:
-        """Read survivors, throttle, and run the batched decode launch
-        for one group.  Returns ``(out, chunk)`` WITHOUT committing the
-        rebuilt shards — the supervised loop may discard a launch whose
-        sources died mid-flight."""
+    ) -> _Inflight:
+        """Read survivors, throttle, and dispatch the batched decode
+        for one group WITHOUT waiting for the device — the supervised
+        loop windows several dispatches before one sync, and may
+        discard a launch whose sources died mid-flight."""
         src = np.stack(
             [
                 np.concatenate([read_shard(int(pg), s) for pg in g.pgs])
@@ -206,20 +269,66 @@ class RecoveryExecutor:
         nbytes = (len(g.rows) + len(g.missing)) * g.n_pgs * chunk
         if self.throttle.take(nbytes):
             self.pc.inc("throttle_waits")
-        enc = self._encoders.get(g.mask)
-        if enc is None:
-            enc = self._encoders[g.mask] = TableEncoder(g.repair_matrix)
         if self.on_decode_launch is not None:
             self.on_decode_launch(g, nbytes)
         t0 = time.perf_counter()
-        with timed_block(self.pc, "l_decode"), trace_annotation(
-            f"recovery:decode:{g.mask:#x}"
-        ):
-            out = enc.encode(src)  # [n_missing, n_pgs * chunk]
-        result.decode_s += time.perf_counter() - t0
+        sharded = (
+            self._sharded is not None and nbytes >= self.shard_min_bytes
+        )
+        with trace_annotation(f"recovery:decode:{g.mask:#x}"):
+            if sharded:
+                out, nb, sh, valid = self._sharded.decode_async(
+                    self._sharded.luts_for(g), src, chunk
+                )
+                self.pc.inc("sharded_launches")
+                result.sharded_launches += 1
+                fl = _Inflight(g, out, chunk, True, valid, (nb, sh), t0)
+            else:
+                enc = self._encoders.get(g.mask)
+                if enc is None:
+                    enc = self._encoders[g.mask] = TableEncoder(
+                        g.repair_matrix
+                    )
+                data = src
+                if self._devices:
+                    # committed input pins the launch's device: round-
+                    # robin over local chips so co-scheduled windows
+                    # genuinely overlap
+                    data = jax.device_put(
+                        src, self._devices[self._rr % len(self._devices)]
+                    )
+                    self._rr += 1
+                fl = _Inflight(
+                    g, enc.encode_async(data), chunk, False, None, None, t0
+                )
         result.launches += 1
         self.pc.inc("decode_launches")
-        return out, chunk
+        return fl
+
+    def _finalize_group(
+        self, fl: _Inflight, result: RecoveryResult
+    ) -> tuple[np.ndarray, int]:
+        """Materialize one in-flight launch's output on the host."""
+        with timed_block(self.pc, "l_decode"):
+            out = np.asarray(fl.out)  # [n_missing, width (padded)]
+        if fl.sharded:
+            out = out[:, : fl.valid]
+            nb, sh = fl.counters
+            result.psum_bytes_rebuilt += int(nb)
+            result.psum_shards_rebuilt += int(sh)
+        result.decode_s += time.perf_counter() - fl.t_dispatch
+        return out, fl.chunk
+
+    def _launch_group(
+        self,
+        g: PatternGroup,
+        read_shard: Callable[[int, int], np.ndarray],
+        result: RecoveryResult,
+    ) -> tuple[np.ndarray, int]:
+        """Dispatch + sync one group's decode (the serial path)."""
+        return self._finalize_group(
+            self._dispatch_group(g, read_shard, result), result
+        )
 
     def _commit_group(
         self,
@@ -227,19 +336,30 @@ class RecoveryExecutor:
         out: np.ndarray,
         chunk: int,
         result: RecoveryResult,
-    ) -> None:
-        """Record one launched group's rebuilt shards into the result."""
+        only_pgs: set[int] | None = None,
+    ) -> int:
+        """Record a launched group's rebuilt shards into the result.
+
+        ``only_pgs`` restricts the commit to a PG subset — the
+        partial-launch salvage path, valid because per-PG byte columns
+        are independent in the batched operand.  Returns the number of
+        PGs committed."""
+        committed = 0
         for i, pg in enumerate(g.pgs):
+            if only_pgs is not None and int(pg) not in only_pgs:
+                continue
             result.shards[int(pg)] = {
                 s: out[j, i * chunk:(i + 1) * chunk]
                 for j, s in enumerate(g.missing)
             }
-        rebuilt = len(g.missing) * g.n_pgs
+            committed += 1
+        rebuilt = len(g.missing) * committed
         result.shards_rebuilt += rebuilt
         result.bytes_recovered += rebuilt * chunk
         self.pc.inc("shards_rebuilt", rebuilt)
         self.pc.inc("bytes_recovered", rebuilt * chunk)
-        self.pc.inc("pgs_recovered", g.n_pgs)
+        self.pc.inc("pgs_recovered", committed)
+        return committed
 
     def run(
         self,
@@ -298,6 +418,10 @@ class SupervisedResult:
     launches: int = 0
     retries: int = 0  # failed-launch retries (backoff path)
     stale_launches: int = 0  # discarded: epoch killed a source mid-flight
+    salvaged_pgs: int = 0  # committed out of a stale launch anyway
+    sharded_launches: int = 0  # routed through the mesh-sharded step
+    coscheduled_windows: int = 0  # windows that dispatched >1 group
+    psum_bytes_rebuilt: int = 0  # collective-reduced byte progress
     plan_revisions: int = 0
     completed_pgs: set[int] = field(default_factory=set)
     failed_pgs: list[int] = field(default_factory=list)
@@ -325,6 +449,8 @@ class SupervisedResult:
             "launches": self.launches,
             "retries": self.retries,
             "stale_launches": self.stale_launches,
+            "salvaged_pgs": self.salvaged_pgs,
+            "sharded_launches": self.sharded_launches,
             "plan_revisions": self.plan_revisions,
             "completed_pgs": len(self.completed_pgs),
             "failed_pgs": sorted(self.failed_pgs),
@@ -372,6 +498,7 @@ class SupervisedRecovery:
         seed: int = 0,
         launch_duration_s: float = 0.5,
         max_items: int = 8,
+        mesh=None,
     ):
         self.codec = codec
         self.chaos = chaos
@@ -385,12 +512,22 @@ class SupervisedRecovery:
             float(self.cfg.get("recovery_backoff_base_ms")) / 1000.0
         )
         self.max_backfills = int(self.cfg.get("osd_max_backfills"))
+        # with a mesh, up to recovery_coschedule_max small groups are
+        # dispatched back-to-back per scheduling window (one clock
+        # advance, one chaos poll for the whole window); without one
+        # the window is 1 and the loop behaves exactly as before
+        self.window = (
+            int(self.cfg.get("recovery_coschedule_max"))
+            if mesh is not None
+            else 1
+        )
         self.ex = RecoveryExecutor(
             codec,
             config=self.cfg,
             on_decode_launch=on_decode_launch,
             clock=chaos.clock.now,
             sleep=chaos.clock.sleep,
+            mesh=mesh,
         )
         self.pc = self.ex.pc
 
@@ -421,16 +558,27 @@ class SupervisedRecovery:
         return out
 
     @staticmethod
+    def _stale_pgs(
+        g: PatternGroup, peering: PeeringResult, m: OSDMap
+    ) -> set[int]:
+        """The group's PGs whose launch read from an OSD the epoch
+        advance killed.  Per-PG (not group-level) liveness: the batched
+        operand's byte columns are independent, so every OTHER PG's
+        slice of the output is still exact and can be salvaged."""
+        stale: set[int] = set()
+        for pg in g.pgs:
+            for s in g.rows:
+                if not m.is_up(int(peering.acting[int(pg), s])):
+                    stale.add(int(pg))
+                    break
+        return stale
+
+    @staticmethod
     def _is_stale(
         g: PatternGroup, peering: PeeringResult, m: OSDMap
     ) -> bool:
         """Did the epoch advance kill any OSD this launch read from?"""
-        for pg in g.pgs:
-            for s in g.rows:
-                osd = int(peering.acting[int(pg), s])
-                if not m.is_up(osd):
-                    return True
-        return False
+        return bool(SupervisedRecovery._stale_pgs(g, peering, m))
 
     def run(
         self,
@@ -517,64 +665,97 @@ class SupervisedRecovery:
                 if chaos.advance_to_next():
                     continue
                 break
-            g = pending.pop(0)
-            attempt = 0
-            while True:
-                try:
-                    if self.fault_hook is not None and self.fault_hook(
-                        g, attempt
-                    ):
-                        raise LaunchError(
-                            f"injected launch failure {g.mask:#x}"
+            # dispatch a window of up to self.window groups back-to-back
+            # (async device work overlaps); a mesh-sharded group closes
+            # its window — it already occupies every chip.  A retry-
+            # exhausted group also closes the window so the next poll
+            # happens before anything else dispatches (matching the
+            # serial loop's ordering).
+            window: list[_Inflight] = []
+            while pending and len(window) < self.window:
+                g = pending.pop(0)
+                attempt = 0
+                fl = None
+                while True:
+                    try:
+                        if self.fault_hook is not None and self.fault_hook(
+                            g, attempt
+                        ):
+                            raise LaunchError(
+                                f"injected launch failure {g.mask:#x}"
+                            )
+                        fl = self.ex._dispatch_group(g, read_shard, inner)
+                    except (LaunchError, RuntimeError):
+                        attempt += 1
+                        if attempt > self.retry_max:
+                            for pg in g.pgs:
+                                failed[int(pg)] = g.mask
+                            break
+                        res.retries += 1
+                        self.pc.inc("launch_retries")
+                        # bounded exponential backoff + seeded jitter
+                        clock.sleep(
+                            self.backoff_base_s
+                            * (2 ** (attempt - 1))
+                            * (1.0 + self._rng.random())
                         )
-                    out, chunk = self.ex._launch_group(
-                        g, read_shard, inner
-                    )
-                except (LaunchError, RuntimeError):
-                    attempt += 1
-                    if attempt > self.retry_max:
-                        for pg in g.pgs:
-                            failed[int(pg)] = g.mask
-                        break
-                    res.retries += 1
-                    self.pc.inc("launch_retries")
-                    # bounded exponential backoff + seeded jitter
-                    clock.sleep(
-                        self.backoff_base_s
-                        * (2 ** (attempt - 1))
-                        * (1.0 + self._rng.random())
-                    )
-                    continue
-                # the launch occupies virtual time; chaos may land
-                # inside that window
-                clock.advance(self.launch_duration_s)
-                incs = chaos.poll()
-                if incs:
-                    observe(incs)
-                    if self._is_stale(g, peering, chaos.osdmap):
-                        # a source shard died under the launch: the
-                        # output may mix pre/post-failure reads — drop
-                        # it; revise() re-plans these PGs
-                        res.stale_launches += 1
-                        self.pc.inc("stale_launches")
-                        revise()
-                        break
-                    # commit against the pre-event acting rows, THEN
-                    # revise: if the event touched this PG, the
-                    # snapshot mismatch un-checkpoints it right here
-                    self.ex._commit_group(g, out, chunk, inner)
-                    for pg in g.pgs:
-                        completed[int(pg)] = peering.acting[int(pg)].copy()
-                        failed.pop(int(pg), None)
-                    revise()
+                        continue
                     break
+                if fl is None:
+                    break
+                window.append(fl)
+                if fl.sharded:
+                    break
+            if not window:
+                continue
+            if len(window) > 1:
+                res.coscheduled_windows += 1
+                self.pc.inc("coscheduled_windows")
+            # the window occupies virtual time; chaos may land inside it
+            clock.advance(self.launch_duration_s)
+            incs = chaos.poll()
+            if incs:
+                observe(incs)
+            for fl in window:
+                g = fl.group
+                out, chunk = self.ex._finalize_group(fl, inner)
+                stale = (
+                    self._stale_pgs(g, peering, chaos.osdmap)
+                    if incs
+                    else set()
+                )
+                if stale:
+                    # a source shard died under the launch: those PGs'
+                    # outputs may mix pre/post-failure reads — drop
+                    # them; revise() below re-plans.  Every PG whose
+                    # OWN sources all survived is salvaged from the
+                    # same device output (byte columns are independent)
+                    res.stale_launches += 1
+                    self.pc.inc("stale_launches")
+                    fresh = {int(pg) for pg in g.pgs} - stale
+                    if fresh:
+                        self.ex._commit_group(
+                            g, out, chunk, inner, only_pgs=fresh
+                        )
+                        for pg in fresh:
+                            completed[pg] = peering.acting[pg].copy()
+                            failed.pop(pg, None)
+                        res.salvaged_pgs += len(fresh)
+                        self.pc.inc("salvaged_pgs", len(fresh))
+                    continue
+                # commit against the pre-event acting rows, THEN
+                # revise: if the event touched this PG, the snapshot
+                # mismatch un-checkpoints it right there
                 self.ex._commit_group(g, out, chunk, inner)
                 for pg in g.pgs:
                     completed[int(pg)] = peering.acting[int(pg)].copy()
                     failed.pop(int(pg), None)
-                break
+            if incs:
+                revise()
 
         res.launches = inner.launches
+        res.sharded_launches = inner.sharded_launches
+        res.psum_bytes_rebuilt = inner.psum_bytes_rebuilt
         res.bytes_recovered = inner.bytes_recovered
         res.shards_rebuilt = inner.shards_rebuilt
         res.decode_s = inner.decode_s

@@ -1,0 +1,171 @@
+"""Multi-chip recovery: pattern-group decodes sharded over the mesh.
+
+The single-device executor already collapses a rack failure into one
+decode launch per erasure pattern, but each launch still runs on ONE
+chip while the rest of the mesh idles.  This module spreads a pattern
+group's ``[k, n_pgs * chunk]`` operand along the byte/PG axis over the
+global mesh — the same shard_map + psum recipe
+:func:`ceph_tpu.parallel.placement.sharded_placement_step` proves for
+placement:
+
+- the repair LUTs (one 256-entry product row per matrix coefficient)
+  are replicated — every device holds the whole ``[n_missing, k, 256]``
+  table, a few KiB;
+- each device decodes only its contiguous slice of the byte axis
+  (per-PG columns are independent in GF(2^8), so a slice boundary can
+  fall anywhere, even mid-chunk);
+- recovered-byte and shards-rebuilt counters are ``psum``-reduced over
+  the mesh, so every host observes the same global progress number —
+  the multihost analog of the reference's mgr-aggregated recovery
+  counters.
+
+Group widths that don't divide the device count are zero-padded to a
+device multiple (:mod:`ceph_tpu.parallel.padding`; a zero byte decodes
+to zero and never leaks into real columns) and trimmed on the way
+back; the psum'd counters use the *unpadded* width so padding never
+inflates progress.
+
+Compile discipline: the step is jitted once per decoder; jax retraces
+only per operand shape, so every pattern group with the same
+``(n_missing, k, width)`` reuses one executable —
+``assert_no_recompile`` holds across same-shape groups
+(tests/test_sharded.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ec import gf
+from ..parallel.padding import pad_to_multiple, trim_to_size
+from ..parallel.placement import shard_map
+
+
+def sharded_decode_step(mesh: Mesh, axis: str | None = None,
+                        gather: bool = False):
+    """Build the jitted sharded decode:
+    ``f(luts, src, valid, chunk) -> (out, bytes_rebuilt, shards_rebuilt)``.
+
+    ``luts`` is the replicated ``[n_missing, k, 256]`` u8 repair table
+    (``gf.mul_table()[repair_matrix]``); ``src`` is the ``[k, W]`` u8
+    survivor operand sharded along ``W`` (``W`` must divide the device
+    count — pad first); ``valid`` is the un-padded payload width and
+    ``chunk`` the per-PG chunk size (both i64 scalars, replicated).
+
+    ``out`` is ``[n_missing, W]``, sharded along ``W`` — or fully
+    replicated when ``gather`` (``lax.all_gather``), which multihost
+    callers need so every process can materialize the rebuilt bytes.
+    ``bytes_rebuilt``/``shards_rebuilt`` are psum-reduced globals.
+    """
+    axis = axis or mesh.axis_names[0]
+
+    def local(luts, src, valid, chunk):
+        n_missing, k = luts.shape[0], luts.shape[1]
+        idx = src.astype(jnp.int32)  # [k, w_local]
+        rows = []
+        for i in range(n_missing):
+            acc = jnp.zeros((src.shape[1],), jnp.uint8)
+            for j in range(k):
+                acc = acc ^ jnp.take(luts[i, j], idx[j], axis=0)
+            rows.append(acc)
+        out = jnp.stack(rows)
+        # this device owns columns [d*w, (d+1)*w) of the padded width;
+        # clip against the valid prefix so padding never counts
+        w = src.shape[1]
+        start = jax.lax.axis_index(axis).astype(jnp.int64) * w
+        valid_here = jnp.clip(valid.astype(jnp.int64) - start, 0, w)
+        bytes_rebuilt = jax.lax.psum(valid_here * n_missing, axis)
+        shards_rebuilt = bytes_rebuilt // jnp.maximum(
+            chunk.astype(jnp.int64), 1
+        )
+        if gather:
+            out = jax.lax.all_gather(out, axis, axis=1, tiled=True)
+        return out, bytes_rebuilt, shards_rebuilt
+
+    return jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(), P(None, axis), P(), P()),
+            out_specs=(P() if gather else P(None, axis), P(), P()),
+        )
+    )
+
+
+class ShardedDecoder:
+    """Pattern-group decodes over a mesh, with padding + LUT caching.
+
+    One instance per executor; repair LUTs are cached per erasure
+    pattern (survivor bitmask), mirroring the single-device encoder
+    cache.  Construct with ``gather=True`` under multihost
+    (``jax.process_count() > 1``) so :meth:`fetch` works on every
+    process — the sharded-output variant is only fully addressable
+    single-process.
+    """
+
+    def __init__(self, mesh: Mesh, axis: str | None = None,
+                 gather: bool = False):
+        self.mesh = mesh
+        self.axis = axis or mesh.axis_names[0]
+        self.gather = bool(gather)
+        self.n_devices = int(mesh.devices.size)
+        self._step = sharded_decode_step(mesh, self.axis, gather=self.gather)
+        self._luts: dict[int, np.ndarray] = {}
+
+    def luts_for(self, group) -> np.ndarray:
+        """The replicated repair table for one pattern group, cached
+        by survivor mask."""
+        luts = self._luts.get(group.mask)
+        if luts is None:
+            luts = self._luts[group.mask] = gf.mul_table()[
+                group.repair_matrix
+            ]
+        return luts
+
+    def _put(self, host: np.ndarray, spec: P):
+        # make_array_from_callback assembles a *global* array from
+        # whatever slices this process's devices own — the one operand
+        # path that works identically single- and multi-process (each
+        # process holds the full host operand and contributes only its
+        # addressable shards)
+        sharding = NamedSharding(self.mesh, spec)
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx: host[idx]
+        )
+
+    def decode_async(
+        self, luts: np.ndarray, src: np.ndarray, chunk: int
+    ) -> tuple[jax.Array, jax.Array, jax.Array, int]:
+        """Dispatch one sharded decode without a host sync.
+
+        ``src`` is ``[k, width]`` u8 with any width — zero-padded here
+        to a device multiple.  Returns ``(out, bytes_rebuilt,
+        shards_rebuilt, valid)``; pass ``out``/``valid`` to
+        :meth:`fetch` to materialize the trimmed host bytes.
+        """
+        padded, valid = pad_to_multiple(
+            np.asarray(src, np.uint8), self.n_devices, axis=1
+        )
+        out, nbytes, shards = self._step(
+            self._put(np.asarray(luts, np.uint8), P()),
+            self._put(padded, P(None, self.axis)),
+            self._put(np.asarray(valid, np.int64), P()),
+            self._put(np.asarray(int(chunk), np.int64), P()),
+        )
+        return out, nbytes, shards, valid
+
+    def decode(
+        self, luts: np.ndarray, src: np.ndarray, chunk: int
+    ) -> tuple[np.ndarray, int, int]:
+        """Synchronous decode: ``(out [n_missing, width], bytes_rebuilt,
+        shards_rebuilt)`` with the padding already trimmed."""
+        out, nbytes, shards, valid = self.decode_async(luts, src, chunk)
+        return self.fetch(out, valid), int(nbytes), int(shards)
+
+    def fetch(self, out: jax.Array, valid: int) -> np.ndarray:
+        """Sync one decode's output to host bytes, trimming padding."""
+        return trim_to_size(np.asarray(out), valid, axis=1)

@@ -15,6 +15,47 @@ bench = importlib.util.module_from_spec(_spec)
 _spec.loader.exec_module(bench)
 
 
+# --- config6_recovery --multichip JSON schema ---
+
+_CONFIG6 = os.path.join(
+    os.path.dirname(_BENCH), "bench", "config6_recovery.py"
+)
+_spec6 = importlib.util.spec_from_file_location("bench_config6", _CONFIG6)
+config6 = importlib.util.module_from_spec(_spec6)
+_spec6.loader.exec_module(config6)
+
+
+class _FakeMultichipResult:
+    sharded_launches = 21
+    psum_bytes_rebuilt = 1_458_176
+    psum_shards_rebuilt = 89
+
+
+def test_multichip_record_schema():
+    import json
+
+    rec = config6.build_multichip_record(
+        "tpu",
+        23_183_922.4,
+        8,
+        {"n_compiles": 11, "host_transfers": 84},
+        {"n_compiles": 11},
+        _FakeMultichipResult(),
+    )
+    assert rec["metric"] == "recovery_multichip_bytes_per_sec"
+    assert rec["value"] == 23_183_922 and rec["unit"] == "B/s"
+    assert rec["platform"] == "tpu" and rec["n_devices"] == 8
+    # compile-once guard: warm-run compiles == total compiles
+    assert rec["n_compiles"] == 11 and rec["n_compiles_first"] == 11
+    assert rec["host_transfers"] == 84
+    # every launch must have actually routed through the mesh, and the
+    # psum'd counters ride along for decide_defaults' guard harvest
+    assert rec["sharded_launches"] == 21
+    assert rec["psum_bytes_rebuilt"] == 1_458_176
+    assert rec["psum_shards_rebuilt"] == 89
+    json.dumps(rec)  # one JSON line, always serializable
+
+
 def test_device_result_uses_headline_metric():
     out = bench.format_result({"rate": 2_000_000.0, "platform": "tpu"}, 200_000.0, [])
     assert out["metric"] == "crush_placements_per_sec"

@@ -131,6 +131,24 @@ def test_harvest_guard_collects_chaos_counters(tmp_path):
     assert "chaos_scenario" not in g and "chaos_stale_launches" not in g
 
 
+def test_harvest_guard_collects_multichip_counters(tmp_path):
+    p = _log(tmp_path, [
+        {"metric": "recovery_multichip_bytes_per_sec", "platform": "tpu",
+         "value": 23_000_000, "n_compiles": 11, "n_compiles_first": 11,
+         "host_transfers": 84, "n_devices": 8, "sharded_launches": 21,
+         "psum_bytes_rebuilt": 1_458_176, "psum_shards_rebuilt": 89},
+    ])
+    g = dd.harvest_guard([p])["recovery_multichip_bytes_per_sec"]
+    assert g["n_devices"] == 8 and g["sharded_launches"] == 21
+    assert g["psum_bytes_rebuilt"] == 1_458_176
+    assert g["psum_shards_rebuilt"] == 89
+    assert g["steady_state_clean"] is True
+    # the rate itself rides the aux harvest (never votes on the
+    # kernel-mode winner)
+    aux = dd.harvest_aux([p])
+    assert aux["recovery_multichip_bytes_per_sec"] == 23_000_000
+
+
 def test_harvest_guard_chaos_fields_absent_when_not_emitted(tmp_path):
     p = _log(tmp_path, [
         {"metric": "recovery_decode_bytes_per_sec", "platform": "tpu",

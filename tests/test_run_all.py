@@ -65,7 +65,7 @@ def test_banks_incrementally_and_records_all(monkeypatch, tmp_path):
     monkeypatch.setattr(run_all, "_probe_healthy", lambda: True)
     seen_banks = []
 
-    def fake_run_one(name, path, timeout):
+    def fake_run_one(name, path, timeout, extra_argv=()):
         # the bank file must already hold every EARLIER record when the
         # next config starts — that is the "abort keeps what was
         # measured" guarantee
@@ -91,8 +91,8 @@ def test_append_merges_and_replaces_records(monkeypatch, tmp_path):
     monkeypatch.setattr(run_all, "_probe_healthy", lambda: True)
     monkeypatch.setattr(
         run_all, "_run_one",
-        lambda name, path, timeout: {"config": name, "rc": 0,
-                                     "result": {"platform": "tpu"}},
+        lambda name, path, timeout, extra_argv=(): {
+            "config": name, "rc": 0, "result": {"platform": "tpu"}},
     )
     # first invocation: configs 1-2 only
     monkeypatch.setattr(
@@ -129,8 +129,8 @@ def test_append_tunnel_down_preserves_prior_record(monkeypatch, tmp_path):
     monkeypatch.setattr(run_all, "_probe_healthy", lambda: True)
     monkeypatch.setattr(
         run_all, "_run_one",
-        lambda name, path, timeout: {"config": name, "rc": 0,
-                                     "result": {"platform": "tpu"}},
+        lambda name, path, timeout, extra_argv=(): {
+            "config": name, "rc": 0, "result": {"platform": "tpu"}},
     )
     monkeypatch.setattr(
         run_all.sys, "argv",
@@ -208,12 +208,17 @@ def test_run_one_salvages_result_printed_before_teardown_hang(tmp_path):
 
 
 def test_unfiltered_configs_cover_all_baseline_configs():
-    names = [n for n, _ in run_all.CONFIGS]
+    names = [c[0] for c in run_all.CONFIGS]
     assert names == [
         "config1_crush", "config2_ec_encode", "config3_upmap",
         "config4_repair_decode", "config5_rebalance_sim",
-        "config6_recovery", "tpu_tier",
+        "config6_recovery", "config6_recovery_multichip", "tpu_tier",
     ]
+    # the multichip entry re-uses the config6 file in --multichip mode
+    multi = next(c for c in run_all.CONFIGS
+                 if c[0] == "config6_recovery_multichip")
+    assert multi[1] == "bench/config6_recovery.py"
+    assert tuple(multi[2]) == ("--multichip",)
 
 
 if __name__ == "__main__":
