@@ -146,42 +146,18 @@ def run_multichip() -> None:
     )))
 
 
-def run_chaos(scenario: str) -> dict:
-    """Supervised chaos pass -> ``chaos_*`` JSON fields (seeded and
-    virtual-clocked, so the numbers are exactly reproducible)."""
-    import copy
+#: SLO budgets the seeded chaos pass is graded against (virtual time)
+CHAOS_SLO = dict(
+    max_inactive_seconds=60.0,
+    min_availability_fraction=0.5,
+    max_time_to_zero_degraded_s=60.0,
+)
 
-    from ceph_tpu import recovery as rec
-    from ceph_tpu.ec.backend import MatrixCodec
-    from ceph_tpu.ec.gf import vandermonde_matrix
-    from ceph_tpu.models.clusters import build_osdmap
 
-    m = build_osdmap(N_OSDS, pg_num=PG_NUM, size=K + M, pool_kind="erasure")
-    m_prev = copy.deepcopy(m)
-    chaos = rec.ChaosEngine(m, rec.build_scenario(scenario, m))
-    codec = MatrixCodec(vandermonde_matrix(K, M))
-    rng = np.random.default_rng(6)
-    chunks: dict[tuple[int, int], np.ndarray] = {}
-
-    def read_shard(pg, s):
-        key = (int(pg), int(s))
-        if key not in chunks:
-            chunks[key] = rng.integers(0, 256, CHAOS_CHUNK, dtype=np.uint8)
-        return chunks[key]
-
-    sup = rec.SupervisedRecovery(codec, chaos, seed=0)
-    t0 = time.perf_counter()
-    res = sup.run(m_prev, 1, read_shard)
-    wall = time.perf_counter() - t0
-    print(
-        f"chaos {scenario}: {'converged' if res.converged else 'DIVERGED'} "
-        f"at t={res.time_to_zero_degraded_s:g}s virtual "
-        f"({wall:.2f}s wall), {res.launches} launches, "
-        f"{res.retries} retries, {res.stale_launches} stale, "
-        f"{res.plan_revisions} re-plans, "
-        f"{len(res.unrecoverable)} unrecoverable",
-        file=sys.stderr,
-    )
+def build_chaos_record(scenario: str, res, timeline, report) -> dict:
+    """The ``chaos_*`` JSON fields (pure: schema-tested without running
+    the bench).  ``res`` is the SupervisedResult, ``timeline`` the
+    HealthTimeline, ``report`` the SLO HealthReport."""
     return {
         "chaos_scenario": scenario,
         "chaos_converged": res.converged,
@@ -192,7 +168,69 @@ def run_chaos(scenario: str) -> dict:
         "chaos_replans": res.plan_revisions,
         "chaos_stale_launches": res.stale_launches,
         "chaos_unrecoverable": int(len(res.unrecoverable)),
+        "chaos_health_status": report.status,
+        "chaos_slo_checks": {c.name: c.status for c in report.checks},
+        "chaos_availability_fraction": round(
+            timeline.min_availability(), 9
+        ),
+        "chaos_inactive_seconds": round(timeline.inactive_seconds(), 6),
+        "chaos_pg_state_series": timeline.series(),
     }
+
+
+def run_chaos(scenario: str) -> dict:
+    """Supervised chaos pass -> ``chaos_*`` JSON fields (seeded and
+    virtual-clocked, so the numbers are exactly reproducible).  The
+    run records a per-epoch PG-state time series and grades it against
+    the ``CHAOS_SLO`` budgets (obs subsystem)."""
+    import copy
+
+    from ceph_tpu import recovery as rec
+    from ceph_tpu.ec.backend import MatrixCodec
+    from ceph_tpu.ec.gf import vandermonde_matrix
+    from ceph_tpu.models.clusters import build_osdmap
+    from ceph_tpu.obs import EventJournal, HealthTimeline, SLOSpec, evaluate
+
+    m = build_osdmap(N_OSDS, pg_num=PG_NUM, size=K + M, pool_kind="erasure")
+    m_prev = copy.deepcopy(m)
+    clock = rec.VirtualClock()
+    journal = EventJournal(clock=clock.now, trace_id=f"bench6-{scenario}")
+    chaos = rec.ChaosEngine(
+        m, rec.build_scenario(scenario, m), clock=clock, journal=journal
+    )
+    codec = MatrixCodec(vandermonde_matrix(K, M))
+    spec = SLOSpec(**CHAOS_SLO)
+    timeline = HealthTimeline(
+        clock.now, k=K, sample_status=spec.sample_status
+    )
+    rng = np.random.default_rng(6)
+    chunks: dict[tuple[int, int], np.ndarray] = {}
+
+    def read_shard(pg, s):
+        key = (int(pg), int(s))
+        if key not in chunks:
+            chunks[key] = rng.integers(0, 256, CHAOS_CHUNK, dtype=np.uint8)
+        return chunks[key]
+
+    sup = rec.SupervisedRecovery(
+        codec, chaos, seed=0, journal=journal, health=timeline
+    )
+    t0 = time.perf_counter()
+    res = sup.run(m_prev, 1, read_shard)
+    wall = time.perf_counter() - t0
+    report = evaluate(timeline, spec)
+    print(
+        f"chaos {scenario}: {'converged' if res.converged else 'DIVERGED'} "
+        f"at t={res.time_to_zero_degraded_s:g}s virtual "
+        f"({wall:.2f}s wall), {res.launches} launches, "
+        f"{res.retries} retries, {res.stale_launches} stale, "
+        f"{res.plan_revisions} re-plans, "
+        f"{len(res.unrecoverable)} unrecoverable; "
+        f"{len(timeline)} health samples, {len(journal.records)} journal "
+        f"records, SLO {report.status}",
+        file=sys.stderr,
+    )
+    return build_chaos_record(scenario, res, timeline, report)
 
 
 def main() -> None:

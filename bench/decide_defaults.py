@@ -64,6 +64,14 @@ GUARD_FIELDS = ("n_compiles", "n_compiles_first", "host_transfers")
 CHAOS_GUARD_FIELDS = ("chaos_retries", "chaos_replans",
                       "chaos_unrecoverable")
 
+# SLO fields from the same chaos pass (the obs subsystem's verdict):
+# the minimum availability over the timeline, the virtual seconds any
+# PG sat below k survivors, and the rolled-up HEALTH_* status — typed
+# float/float/str, unlike the int counters above.
+CHAOS_SLO_FLOAT_FIELDS = ("chaos_availability_fraction",
+                          "chaos_inactive_seconds")
+CHAOS_SLO_STR_FIELDS = ("chaos_health_status",)
+
 # Multichip recovery counters (config6_recovery --multichip): the
 # device count the rate was measured on, how many launches actually
 # routed through the mesh-sharded step, and the psum-reduced byte/
@@ -130,6 +138,12 @@ def harvest_guard(paths: list[str]) -> dict[str, dict]:
             fields = {f: int(d[f]) for f in GUARD_FIELDS if f in d}
             fields.update(
                 {f: int(d[f]) for f in CHAOS_GUARD_FIELDS if f in d}
+            )
+            fields.update(
+                {f: float(d[f]) for f in CHAOS_SLO_FLOAT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: str(d[f]) for f in CHAOS_SLO_STR_FIELDS if f in d}
             )
             fields.update(
                 {f: int(d[f]) for f in MULTICHIP_GUARD_FIELDS if f in d}

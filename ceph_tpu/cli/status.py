@@ -1,0 +1,184 @@
+"""Cluster status CLI (the ``ceph -s`` analog).
+
+Two modes::
+
+    # query a live daemon's admin socket (the obs trio registered via
+    # ceph_tpu.obs.register_admin_hooks)
+    python -m ceph_tpu.cli.status --socket /tmp/ceph-tpu.asok
+    python -m ceph_tpu.cli.status --socket /tmp/ceph-tpu.asok health
+
+    # no socket: demo mode — drive a seeded chaos scenario through the
+    # supervised executor in-process and report its health timeline,
+    # SLO verdict, and event journal
+    python -m ceph_tpu.cli.status
+    python -m ceph_tpu.cli.status timeline --scenario flap --json
+
+Commands: ``status`` (default; the ``ceph -s`` shape), ``health``
+(SLO healthchecks), ``timeline`` (the per-epoch PG-state series),
+``journal`` (correlated span/event records; demo mode only unless the
+daemon registered a journal).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+COMMANDS = ("status", "health", "timeline", "journal")
+
+
+def _render(cmd: str, reply: dict, as_json: bool, out) -> None:
+    from ..obs.status import render_status
+
+    if as_json:
+        print(json.dumps(reply, sort_keys=True), file=out)
+        return
+    if cmd == "status":
+        print(render_status(reply), file=out)
+    elif cmd == "health":
+        print(reply.get("status", "?"), file=out)
+        for name, check in sorted(reply.get("checks", {}).items()):
+            print(f"  {name} {check['status']}: {check['detail']}",
+                  file=out)
+    elif cmd == "timeline":
+        for s in reply.get("series", []):
+            states = " ".join(
+                f"{n}={c}" for n, c in s["pgs"].items() if c
+            )
+            print(
+                f"t={s['t']:g} epoch={s['epoch']} {s['health']} "
+                f"avail={s['availability']:.4f} "
+                f"degraded_objs={s['degraded_objects']} "
+                f"bw={s['repair_bandwidth_bps']:.0f}B/s  {states}",
+                file=out,
+            )
+    else:  # journal
+        for r in reply.get("records", []):
+            print(json.dumps(r, sort_keys=True), file=out)
+
+
+def _demo(args, out) -> tuple[dict, dict]:
+    """Seeded in-process chaos run -> replies for every command."""
+    import copy
+
+    import numpy as np
+
+    from ..ec.backend import MatrixCodec
+    from ..ec.gf import vandermonde_matrix
+    from ..models.clusters import build_osdmap
+    from ..obs import (
+        EventJournal,
+        HealthTimeline,
+        SLOSpec,
+        evaluate,
+        status_dict,
+    )
+    from ..recovery import (
+        ChaosEngine,
+        SupervisedRecovery,
+        VirtualClock,
+        build_scenario,
+    )
+
+    m = build_osdmap(
+        args.num_osd,
+        pg_num=args.pg_num,
+        size=args.ec_k + args.ec_m,
+        pool_kind="erasure",
+    )
+    m_prev = copy.deepcopy(m)
+    clock = VirtualClock()
+    journal = EventJournal(
+        path=args.journal_path,
+        clock=clock.now,
+        trace_id=f"status-demo-{args.scenario}",
+    )
+    chaos = ChaosEngine(
+        m, build_scenario(args.scenario, m), clock=clock, journal=journal
+    )
+    spec = SLOSpec(
+        max_inactive_seconds=args.max_inactive_seconds,
+        min_availability_fraction=args.min_availability,
+        max_time_to_zero_degraded_s=args.max_recovery_seconds,
+    )
+    timeline = HealthTimeline(
+        clock.now, k=args.ec_k, sample_status=spec.sample_status
+    )
+    codec = MatrixCodec(vandermonde_matrix(args.ec_k, args.ec_m))
+    rng = np.random.default_rng(args.seed)
+    chunks: dict[tuple[int, int], np.ndarray] = {}
+
+    def read_shard(pg: int, s: int) -> np.ndarray:
+        key = (int(pg), int(s))
+        if key not in chunks:
+            chunks[key] = rng.integers(0, 256, 1024, dtype=np.uint8)
+        return chunks[key]
+
+    sup = SupervisedRecovery(
+        codec, chaos, seed=args.seed, journal=journal, health=timeline
+    )
+    res = sup.run(m_prev, 1, read_shard)
+    journal.close()
+    print(
+        f"demo {args.scenario}: "
+        f"{'converged' if res.converged else 'NOT converged'}, "
+        f"{len(timeline)} samples, {len(journal.records)} journal records",
+        file=sys.stderr,
+    )
+    return {
+        "status": status_dict(timeline, spec),
+        "health": evaluate(timeline, spec).to_dict(),
+        "timeline": {"series": timeline.to_dicts()},
+        "journal": {"records": journal.records},
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="status")
+    p.add_argument("command", nargs="?", default="status",
+                   choices=COMMANDS)
+    p.add_argument("--socket", metavar="PATH", default=None,
+                   help="admin socket of a live daemon; omitted -> "
+                        "seeded in-process chaos demo")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="raw JSON reply instead of text rendering")
+    # demo-mode knobs
+    p.add_argument("--scenario", default="flap",
+                   help="chaos scenario for the demo run")
+    p.add_argument("--num-osd", type=int, default=64)
+    p.add_argument("--pg-num", type=int, default=128)
+    p.add_argument("--ec-k", type=int, default=4)
+    p.add_argument("--ec-m", type=int, default=2)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--journal-path", default=None,
+                   help="also append demo journal records to this "
+                        "JSONL file")
+    p.add_argument("--max-inactive-seconds", type=float, default=30.0)
+    p.add_argument("--min-availability", type=float, default=0.75)
+    p.add_argument("--max-recovery-seconds", type=float, default=30.0)
+    args = p.parse_args(argv)
+    out = sys.stdout
+
+    if args.socket is not None:
+        from ..common.admin_socket import ask
+
+        try:
+            reply = ask(args.socket, args.command)
+        except OSError as e:
+            print(f"status: cannot reach {args.socket}: {e}",
+                  file=sys.stderr)
+            return 1
+        if "error" in reply and len(reply) == 1:
+            print(f"status: {reply['error']}", file=sys.stderr)
+            return 1
+        _render(args.command, reply, args.as_json, out)
+        return 0
+
+    replies = _demo(args, out)
+    _render(args.command, replies[args.command], args.as_json, out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,6 +28,10 @@ class AdminSocket:
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
         self.register("perf dump", lambda cmd: registry().dump())
+        self.register("perf schema", lambda cmd: registry().schema())
+        self.register(
+            "perf reset", lambda cmd: (registry().reset(), {"success": "reset"})[1]
+        )
         self.register("config show", lambda cmd: self.config.show())
         self.register("config set", self._config_set)
         self.register("help", lambda cmd: {"commands": sorted(self._hooks)})

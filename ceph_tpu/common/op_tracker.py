@@ -18,21 +18,30 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
+from typing import Callable
 
 
 @dataclass
 class TrackedOp:
     tracker: "OpTracker"
     description: str
-    start: float = field(default_factory=time.perf_counter)
+    # injectable clock: a chaos run passes the VirtualClock's now so op
+    # dumps are deterministic and replayable (no wall time in seeded
+    # scenarios); default stays the wall-clock perf counter
+    clock: Callable[[], float] = time.perf_counter
+    start: float | None = None
     events: list[tuple[float, str]] = field(default_factory=list)
     done: float | None = None
 
+    def __post_init__(self) -> None:
+        if self.start is None:
+            self.start = self.clock()
+
     def mark_event(self, name: str) -> None:
-        self.events.append((time.perf_counter(), name))
+        self.events.append((self.clock(), name))
 
     def finish(self) -> None:
-        self.done = time.perf_counter()
+        self.done = self.clock()
         self.tracker._finish(self)
 
     def __enter__(self) -> "TrackedOp":
@@ -45,13 +54,13 @@ class TrackedOp:
 
     @property
     def duration(self) -> float:
-        return (self.done or time.perf_counter()) - self.start
+        return (self.done if self.done is not None else self.clock()) - self.start
 
     def dump(self) -> dict:
         return {
             "description": self.description,
             "duration": round(self.duration, 6),
-            "age": round(time.perf_counter() - self.start, 6),
+            "age": round(self.clock() - self.start, 6),
             "events": [
                 {"time": round(t - self.start, 6), "event": e}
                 for t, e in self.events
@@ -64,9 +73,11 @@ class OpTracker:
         self,
         history_size: int = 20,
         slow_op_threshold: float = 1.0,
+        clock: Callable[[], float] = time.perf_counter,
     ):
         self.history_size = history_size
         self.slow_op_threshold = slow_op_threshold
+        self.clock = clock
         self._lock = threading.Lock()
         self._in_flight: dict[int, TrackedOp] = {}
         self._history: deque[TrackedOp] = deque(maxlen=history_size)
@@ -74,7 +85,7 @@ class OpTracker:
         self.num_slow = 0
 
     def create_op(self, description: str) -> TrackedOp:
-        op = TrackedOp(self, description)
+        op = TrackedOp(self, description, clock=self.clock)
         with self._lock:
             self._in_flight[id(op)] = op
         return op

@@ -41,7 +41,11 @@ class PerfCounters:
         self._counters[name] = _Counter(name, type_, desc)
 
     def inc(self, name: str, amount: int = 1) -> None:
-        self._counters[name].value += amount
+        c = self._counters[name]
+        assert c.type == TYPE_U64, (
+            f"inc() on non-u64 counter {self.name}.{name} ({c.type})"
+        )
+        c.value += amount
 
     def dec(self, name: str, amount: int = 1) -> None:
         c = self._counters[name]
@@ -49,7 +53,11 @@ class PerfCounters:
         c.value -= amount
 
     def set(self, name: str, value: float) -> None:
-        self._counters[name].value = value
+        c = self._counters[name]
+        assert c.type == TYPE_GAUGE, (
+            f"set() on non-gauge counter {self.name}.{name} ({c.type})"
+        )
+        c.value = value
 
     def tinc(self, name: str, seconds: float) -> None:
         c = self._counters[name]
@@ -72,6 +80,29 @@ class PerfCounters:
                 return False
 
         return _Timer()
+
+    def reset(self) -> None:
+        """Zero every counter (test isolation; ``perf reset`` hook)."""
+        with self._lock:
+            for c in self._counters.values():
+                c.value = 0
+                c.total = 0.0
+                c.count = 0
+
+    def counters(self) -> list[_Counter]:
+        """The typed counter records (the prometheus renderer reads
+        types and HELP text from here; ``dump()`` stays value-only for
+        ``perf dump`` parity)."""
+        return list(self._counters.values())
+
+    def schema(self) -> dict:
+        """``perf schema`` analog: name -> {type, desc}."""
+        return {
+            self.name: {
+                c.name: {"type": c.type, "desc": c.desc}
+                for c in self._counters.values()
+            }
+        }
 
     def dump(self) -> dict:
         out: dict = {}
@@ -131,6 +162,22 @@ class _Registry:
             for pc in self._all.values():
                 out.update(pc.dump())
         return out
+
+    def schema(self) -> dict:
+        out: dict = {}
+        with self._lock:
+            for pc in self._all.values():
+                out.update(pc.schema())
+        return out
+
+    def components(self) -> list[PerfCounters]:
+        with self._lock:
+            return list(self._all.values())
+
+    def reset(self) -> None:
+        """Zero every registered component's counters."""
+        for pc in self.components():
+            pc.reset()
 
     def get(self, name: str) -> PerfCounters | None:
         return self._all.get(name)

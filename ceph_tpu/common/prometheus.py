@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import re
 
-from .perf_counters import registry
+from .perf_counters import TYPE_TIME_AVG, TYPE_U64, registry
 
 
 def _sanitize(name: str) -> str:
@@ -18,20 +18,36 @@ def _sanitize(name: str) -> str:
 
 
 def render() -> str:
-    """Current registry state in Prometheus text format."""
+    """Current registry state in Prometheus text format.
+
+    Counter types carry through from the registry: monotonic ``u64``
+    counters emit ``# TYPE ... counter`` (Prometheus semantics — a
+    ``rate()`` over a gauge is meaningless), gauges stay ``gauge``,
+    ``time_avg`` splits into ``_sum``/``_count`` counters; ``desc``
+    becomes the ``# HELP`` line.
+    """
     lines: list[str] = []
-    for component, counters in sorted(registry().dump().items()):
-        comp = _sanitize(component)
-        for cname, value in sorted(counters.items()):
-            metric = f"ceph_tpu_{comp}_{_sanitize(cname)}"
-            if isinstance(value, dict):  # time_avg
-                lines.append(f"# TYPE {metric}_sum counter")
-                lines.append(f"{metric}_sum {value['sum']}")
-                lines.append(f"# TYPE {metric}_count counter")
-                lines.append(f"{metric}_count {value['avgcount']}")
+    for pc in sorted(registry().components(), key=lambda p: p.name):
+        comp = _sanitize(pc.name)
+        for c in sorted(pc.counters(), key=lambda c: c.name):
+            metric = f"ceph_tpu_{comp}_{_sanitize(c.name)}"
+            if c.type == TYPE_TIME_AVG:
+                for suffix, value in (
+                    ("_sum", round(c.total, 9)),
+                    ("_count", c.count),
+                ):
+                    if c.desc:
+                        lines.append(
+                            f"# HELP {metric}{suffix} {c.desc}"
+                        )
+                    lines.append(f"# TYPE {metric}{suffix} counter")
+                    lines.append(f"{metric}{suffix} {value}")
             else:
-                lines.append(f"# TYPE {metric} gauge")
-                lines.append(f"{metric} {value}")
+                kind = "counter" if c.type == TYPE_U64 else "gauge"
+                if c.desc:
+                    lines.append(f"# HELP {metric} {c.desc}")
+                lines.append(f"# TYPE {metric} {kind}")
+                lines.append(f"{metric} {c.value}")
     return "\n".join(lines) + "\n"
 
 

@@ -1,0 +1,54 @@
+"""Cluster-health telemetry: PG-state time series, SLOs, event journal.
+
+The observability layer over the recovery/chaos machinery:
+
+- :mod:`~ceph_tpu.obs.pg_states` — device-side (vmapped, optionally
+  mesh-sharded + psum'd) survivor-bitmask -> PG-state histogram.
+- :mod:`~ceph_tpu.obs.timeline` — :class:`HealthTimeline`, the
+  per-epoch series on the chaos engine's virtual clock.
+- :mod:`~ceph_tpu.obs.slo` — declarative :class:`SLOSpec` budgets
+  graded into ``HEALTH_OK/WARN/ERR`` healthchecks.
+- :mod:`~ceph_tpu.obs.journal` — correlated JSONL span/event log.
+- :mod:`~ceph_tpu.obs.status` — ``ceph -s`` analog + admin-socket trio.
+"""
+
+from .journal import EventJournal
+from .pg_states import (
+    N_STATES,
+    STATE_NAMES,
+    PGStateClassifier,
+    pg_state_step,
+    sharded_pg_state_step,
+)
+from .slo import HealthCheck, HealthReport, SLOSpec, evaluate
+from .status import register_admin_hooks, render_status, status_dict
+from .timeline import (
+    HEALTH_ERR,
+    HEALTH_OK,
+    HEALTH_WARN,
+    HealthSample,
+    HealthTimeline,
+    worst_status,
+)
+
+__all__ = [
+    "EventJournal",
+    "HEALTH_ERR",
+    "HEALTH_OK",
+    "HEALTH_WARN",
+    "HealthCheck",
+    "HealthReport",
+    "HealthSample",
+    "HealthTimeline",
+    "N_STATES",
+    "PGStateClassifier",
+    "SLOSpec",
+    "STATE_NAMES",
+    "evaluate",
+    "pg_state_step",
+    "register_admin_hooks",
+    "render_status",
+    "sharded_pg_state_step",
+    "status_dict",
+    "worst_status",
+]

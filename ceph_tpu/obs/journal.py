@@ -1,0 +1,124 @@
+"""Correlated event journal: JSONL spans on virtual + wall clocks.
+
+Recovery already emits device-side profiler annotations
+(:func:`ceph_tpu.common.tracing.trace_annotation`) and host-side perf
+counters, but neither answers "what happened, in order, and why" after
+a chaos run: counters are aggregates and Perfetto traces have no
+injection/phase context.  The journal is the correlation layer — every
+record carries a shared ``trace_id``, its own ``span_id`` (and
+``parent_id`` inside an open span), the *virtual* clock (deterministic,
+replayable) and the wall clock (lines up with profiler traces), plus
+free-form attrs.  :meth:`EventJournal.span` additionally opens a
+matching :func:`jax.profiler` annotation so device traces and host
+spans share names.
+
+Records are kept in memory and, when ``path`` is given, appended as
+JSON lines — readable back with :meth:`EventJournal.read` for
+round-trip tests and the ``cli.status`` timeline view.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from contextlib import contextmanager
+from typing import Callable
+
+from ..common.tracing import trace_annotation
+
+
+class EventJournal:
+    """Append-only span/event log.
+
+    ``clock`` is the virtual clock read (``() -> float``); ``trace_id``
+    is injectable so seeded runs journal deterministically (default
+    derives from the wall clock).  ``wall`` is injectable for tests.
+    """
+
+    def __init__(
+        self,
+        path: str | None = None,
+        clock: Callable[[], float] | None = None,
+        trace_id: str | None = None,
+        wall: Callable[[], float] = time.time,
+    ):
+        self.path = str(path) if path is not None else None
+        self.clock = clock or (lambda: 0.0)
+        self.wall = wall
+        self.trace_id = trace_id or f"{int(wall() * 1e6):x}"
+        self.records: list[dict] = []
+        self._next_span = 0
+        self._open: list[int] = []  # span-id stack for parent linkage
+        self._fh = open(self.path, "a") if self.path else None
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "EventJournal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ---- emission ---------------------------------------------------
+
+    def _emit(self, record: dict) -> dict:
+        self.records.append(record)
+        if self._fh is not None:
+            self._fh.write(json.dumps(record, sort_keys=True) + "\n")
+            self._fh.flush()
+        return record
+
+    def _record(self, kind: str, name: str, **attrs) -> dict:
+        span_id = self._next_span
+        self._next_span += 1
+        record = {
+            "trace_id": self.trace_id,
+            "span_id": span_id,
+            "parent_id": self._open[-1] if self._open else None,
+            "kind": kind,
+            "name": name,
+            "t": round(float(self.clock()), 9),
+            "wall": self.wall(),
+        }
+        if attrs:
+            record["attrs"] = attrs
+        return record
+
+    def event(self, name: str, **attrs) -> dict:
+        """Point-in-time record (an injection, a retry, a salvage)."""
+        return self._emit(self._record("event", name, **attrs))
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        """Timed record bracketing a phase; nests (children link via
+        ``parent_id``) and opens a matching profiler annotation so the
+        device trace carries the same name."""
+        record = self._record("span", name, **attrs)
+        self._open.append(record["span_id"])
+        try:
+            with trace_annotation(name):
+                yield record
+        finally:
+            self._open.pop()
+            record["t_end"] = round(float(self.clock()), 9)
+            record["wall_end"] = self.wall()
+            self._emit(record)
+
+    # ---- read-back --------------------------------------------------
+
+    def by_name(self, name: str) -> list[dict]:
+        return [r for r in self.records if r["name"] == name]
+
+    @staticmethod
+    def read(path: str) -> list[dict]:
+        """Parse a journal file back into records."""
+        out = []
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    out.append(json.loads(line))
+        return out

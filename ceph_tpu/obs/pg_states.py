@@ -1,0 +1,206 @@
+"""Device-side PG-state classification for cluster-health telemetry.
+
+The peering pass (:mod:`ceph_tpu.recovery.peering`) emits per-PG flag
+bits and survivor bitmasks; operators read ``ceph -s``, which speaks in
+*states* — mutually exclusive buckets whose counts make up the PG
+histogram (``200 active+clean, 40 degraded, 16 inactive``).  This
+module maps bitmask -> state on device, vmapped over the pool, and
+reduces the per-state histogram there too, so a health snapshot costs
+one launch and one [N_STATES]-sized transfer regardless of pg_num.
+
+States, most severe first (a PG lands in the first that applies):
+
+- ``inactive``      — fewer than ``k`` surviving shards: the data
+  cannot be reconstructed, reads stall until an OSD returns.
+- ``undersized``    — the acting set has holes (fewer live members
+  than ``size``).
+- ``degraded``      — every slot is alive but some hold no data yet
+  (remap-induced survivor loss); redundancy is reduced.
+- ``backfilling``   — data complete, but the up set has new members
+  still being copied to.
+- ``active+clean``  — none of the above.
+
+Under a mesh the histogram is computed with the same shard_map + psum
+recipe as :func:`ceph_tpu.recovery.sharded.sharded_decode_step`: each
+device classifies its slice of the PG axis and ``psum`` reduces the
+counts, so every host — and every rank under multihost — observes the
+identical cluster-wide histogram.  The padded tail is masked by the
+``valid`` width, never counted.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.padding import pad_to_multiple
+from ..parallel.placement import shard_map
+from ..recovery.peering import (
+    PG_STATE_BACKFILL,
+    PG_STATE_REMAPPED,
+    PeeringResult,
+)
+
+I32 = jnp.int32
+
+STATE_ACTIVE_CLEAN = 0
+STATE_BACKFILLING = 1
+STATE_DEGRADED = 2
+STATE_UNDERSIZED = 3
+STATE_INACTIVE = 4
+N_STATES = 5
+
+#: histogram slot -> the ``ceph -s`` state string
+STATE_NAMES = (
+    "active+clean",
+    "backfilling",
+    "degraded",
+    "undersized",
+    "inactive",
+)
+
+
+def _classify_rows(mask, n_alive, flags, k, size):
+    """Per-PG state codes, vmapped.  ``mask`` u32, ``n_alive``/``flags``
+    i32, ``k``/``size`` i32 scalars (traced — a chaos run's epochs all
+    reuse one executable)."""
+
+    def one(m, alive, fl):
+        nsurv = jax.lax.population_count(m).astype(I32)
+        return jnp.where(
+            nsurv < k, STATE_INACTIVE,
+            jnp.where(
+                alive < size, STATE_UNDERSIZED,
+                jnp.where(
+                    nsurv < size, STATE_DEGRADED,
+                    jnp.where(
+                        (fl & PG_STATE_BACKFILL) != 0,
+                        STATE_BACKFILLING, STATE_ACTIVE_CLEAN,
+                    ),
+                ),
+            ),
+        ).astype(I32)
+
+    return jax.vmap(one)(mask, n_alive, flags)
+
+
+def _reduce(mask, n_alive, flags, k, size, in_range):
+    """Histogram + aux counts over the rows where ``in_range``."""
+    codes = _classify_rows(mask, n_alive, flags, k, size)
+    onehot = (
+        codes[:, None] == jnp.arange(N_STATES, dtype=I32)[None, :]
+    ) & in_range[:, None]
+    hist = jnp.sum(onehot.astype(I32), axis=0)
+    nsurv = jax.vmap(jax.lax.population_count)(mask).astype(I32)
+    # lost shard-slots across degraded PGs (the degraded-object ratio's
+    # numerator, in shard units) and remapped-but-complete PGs (the
+    # misplaced-object analog: bytes are safe, just in the wrong place)
+    degraded_slots = jnp.sum(
+        jnp.where(in_range & (nsurv < size), size - nsurv, 0)
+    )
+    misplaced = jnp.sum(
+        jnp.where(
+            in_range
+            & (nsurv >= size)
+            & ((flags & PG_STATE_REMAPPED) != 0),
+            1, 0,
+        )
+    )
+    return hist, jnp.stack([degraded_slots, misplaced]).astype(I32)
+
+
+def pg_state_step():
+    """Single-device snapshot step: ``f(mask, n_alive, flags, k, size)
+    -> (hist [N_STATES] i32, aux [2] i32)``."""
+
+    def step(mask, n_alive, flags, k, size):
+        in_range = jnp.ones(mask.shape[0], dtype=bool)
+        return _reduce(mask, n_alive, flags, k, size, in_range)
+
+    return jax.jit(step)
+
+
+def sharded_pg_state_step(mesh: Mesh, axis: str | None = None):
+    """Mesh snapshot step: the PG axis split over every device, the
+    histogram ``psum``-reduced so every device (and every host under
+    multihost) holds the identical cluster-wide counts.  ``valid`` is
+    the un-padded pg count; the padded tail never votes."""
+    axis = axis or mesh.axis_names[0]
+
+    def local(mask, n_alive, flags, k, size, valid):
+        w = mask.shape[0]
+        start = jax.lax.axis_index(axis).astype(I32) * w
+        in_range = (jnp.arange(w, dtype=I32) + start) < valid
+        hist, aux = _reduce(mask, n_alive, flags, k, size, in_range)
+        return jax.lax.psum(hist, axis), jax.lax.psum(aux, axis)
+
+    return jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis), P(), P(), P()),
+            out_specs=(P(), P()),
+        )
+    )
+
+
+class PGStateClassifier:
+    """Peering result -> (PG-state histogram, aux counts), on device.
+
+    One instance per timeline; the step compiles once per pool shape
+    (k/size/valid are traced operands, so chaos epochs never retrace).
+    Without a mesh the reduction runs on the default device; with one,
+    every chip counts its PG slice and the counts flow through a psum —
+    the operand path is :func:`jax.make_array_from_callback`, the same
+    single-/multi-process-agnostic route the sharded decoder uses.
+    """
+
+    def __init__(self, mesh: Mesh | None = None, axis: str | None = None):
+        self.mesh = mesh
+        if mesh is None:
+            self._step = pg_state_step()
+            self.n_devices = 1
+        else:
+            self.axis = axis or mesh.axis_names[0]
+            self._step = sharded_pg_state_step(mesh, self.axis)
+            self.n_devices = int(mesh.devices.size)
+
+    def _put(self, host: np.ndarray, spec: P):
+        sharding = NamedSharding(self.mesh, spec)
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx: host[idx]
+        )
+
+    def __call__(
+        self, peering: PeeringResult, k: int | None = None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Classify one peering pass.  ``k`` is the reconstruction
+        threshold (EC: the codec's k; default ``peering.min_size``).
+        Returns ``(hist [N_STATES], aux [degraded_slots, misplaced])``
+        as host i32 arrays — the one device->host transfer of the
+        snapshot path, O(1) in pg_num."""
+        k = np.int32(peering.min_size if k is None else k)
+        size = np.int32(peering.size)
+        mask = np.ascontiguousarray(peering.survivor_mask, np.uint32)
+        alive = np.ascontiguousarray(peering.n_alive, np.int32)
+        flags = np.ascontiguousarray(peering.flags, np.int32)
+        if self.mesh is None:
+            hist, aux = self._step(mask, alive, flags, k, size)
+        else:
+            valid = np.int32(len(mask))
+            mask, _ = pad_to_multiple(mask, self.n_devices, axis=0)
+            alive, _ = pad_to_multiple(alive, self.n_devices, axis=0)
+            flags, _ = pad_to_multiple(flags, self.n_devices, axis=0)
+            spec = P(self.axis)
+            hist, aux = self._step(
+                self._put(mask, spec),
+                self._put(alive, spec),
+                self._put(flags, spec),
+                self._put(k, P()),
+                self._put(size, P()),
+                self._put(valid, P()),
+            )
+        return np.asarray(hist), np.asarray(aux)

@@ -1,0 +1,194 @@
+"""Declarative availability SLOs checked against a health timeline.
+
+The reference's mgr grades the cluster with named healthchecks
+(``PG_AVAILABILITY``, ``PG_DEGRADED``, ...) rolled up into one
+``HEALTH_OK/WARN/ERR`` verdict.  Here the spec is declarative — an
+:class:`SLOSpec` names the budgets (seconds of inactivity tolerated,
+the availability floor, how fast degraded PGs must drain) — and
+:func:`evaluate` checks them against a recorded
+:class:`~ceph_tpu.obs.timeline.HealthTimeline`, producing per-check
+detail strings a chaos test (or ``bench/config6_recovery.py --chaos``)
+asserts instead of only final convergence.
+
+Grading: a check whose observed value exceeds its budget is
+``HEALTH_ERR``; past ``warn_fraction`` of the budget it is
+``HEALTH_WARN``; the report's overall status is the worst check.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .timeline import (
+    HEALTH_ERR,
+    HEALTH_OK,
+    HEALTH_WARN,
+    HealthSample,
+    HealthTimeline,
+    worst_status,
+)
+
+
+@dataclass(frozen=True)
+class SLOSpec:
+    """Budgets; ``None`` disables a check.
+
+    - ``max_inactive_seconds`` — virtual seconds any PG may sit below
+      k survivors (unable to serve I/O) over the whole timeline.
+    - ``min_availability_fraction`` — floor on the fraction of PGs able
+      to serve I/O at every sample.
+    - ``max_time_to_zero_degraded_s`` — the degraded backlog must have
+      drained (and stayed drained) by this virtual time.
+    - ``min_repair_bandwidth_bps`` — while degraded PGs remain, the
+      inter-sample repair bandwidth must reach this floor at least once
+      (arXiv:1412.3022's first-class recovery metric).
+    """
+
+    max_inactive_seconds: float | None = None
+    min_availability_fraction: float | None = None
+    max_time_to_zero_degraded_s: float | None = None
+    min_repair_bandwidth_bps: float | None = None
+    warn_fraction: float = 0.8
+
+    def sample_status(self, sample: HealthSample) -> str:
+        """Streaming per-sample grade (the timeline calls this as each
+        snapshot lands): an availability-floor breach is ERR on the
+        spot; any not-clean PG is WARN; else OK."""
+        if (
+            self.min_availability_fraction is not None
+            and sample.availability < self.min_availability_fraction
+        ):
+            return HEALTH_ERR
+        if sample.unhealthy_pgs() > 0:
+            return HEALTH_WARN
+        return HEALTH_OK
+
+
+@dataclass
+class HealthCheck:
+    """One graded check (a mgr healthcheck analog)."""
+
+    name: str
+    status: str
+    detail: str
+    observed: float
+    budget: float
+
+    def to_dict(self) -> dict:
+        return {
+            "status": self.status,
+            "detail": self.detail,
+            "observed": round(self.observed, 9),
+            "budget": self.budget,
+        }
+
+
+@dataclass
+class HealthReport:
+    """All checks plus the rolled-up verdict."""
+
+    status: str = HEALTH_OK
+    checks: list[HealthCheck] = field(default_factory=list)
+
+    def check(self, name: str) -> HealthCheck | None:
+        for c in self.checks:
+            if c.name == name:
+                return c
+        return None
+
+    def to_dict(self) -> dict:
+        return {
+            "status": self.status,
+            "checks": {c.name: c.to_dict() for c in self.checks},
+        }
+
+    def _add(self, check: HealthCheck) -> None:
+        self.checks.append(check)
+        self.status = worst_status(self.status, check.status)
+
+
+def _grade_max(observed: float, budget: float, warn_fraction: float) -> str:
+    """Smaller-is-better grading against a ceiling."""
+    if observed > budget:
+        return HEALTH_ERR
+    if budget > 0 and observed > warn_fraction * budget:
+        return HEALTH_WARN
+    return HEALTH_OK
+
+
+def evaluate(timeline: HealthTimeline, spec: SLOSpec) -> HealthReport:
+    """Grade a recorded timeline against the spec."""
+    report = HealthReport()
+    if spec.max_inactive_seconds is not None:
+        observed = timeline.inactive_seconds()
+        report._add(HealthCheck(
+            "SLO_INACTIVE",
+            _grade_max(
+                observed, spec.max_inactive_seconds, spec.warn_fraction
+            ),
+            f"PGs below k survivors for {observed:g}s of virtual time "
+            f"(budget {spec.max_inactive_seconds:g}s)",
+            observed, spec.max_inactive_seconds,
+        ))
+    if spec.min_availability_fraction is not None:
+        floor = spec.min_availability_fraction
+        observed = timeline.min_availability()
+        if observed < floor:
+            status = HEALTH_ERR
+        elif observed < 1.0:
+            status = HEALTH_WARN
+        else:
+            status = HEALTH_OK
+        report._add(HealthCheck(
+            "SLO_AVAILABILITY",
+            status,
+            f"availability dipped to {observed:.6f} "
+            f"(floor {floor:g})",
+            observed, floor,
+        ))
+    if spec.max_time_to_zero_degraded_s is not None:
+        t0 = timeline.time_to_zero_degraded()
+        last = timeline.latest
+        # never drained: pin observed past the budget
+        observed = (
+            t0 if t0 is not None
+            else (last.t if last else 0.0) + spec.max_time_to_zero_degraded_s
+        )
+        detail = (
+            f"degraded backlog drained at t={observed:g}s "
+            f"(budget {spec.max_time_to_zero_degraded_s:g}s)"
+            if t0 is not None
+            else "degraded backlog never drained"
+        )
+        report._add(HealthCheck(
+            "SLO_RECOVERY_TIME",
+            HEALTH_ERR if t0 is None else _grade_max(
+                observed, spec.max_time_to_zero_degraded_s,
+                spec.warn_fraction,
+            ),
+            detail,
+            observed, spec.max_time_to_zero_degraded_s,
+        ))
+    if spec.min_repair_bandwidth_bps is not None:
+        repairing = [
+            s.repair_bandwidth_bps
+            for prev, s in zip(timeline.samples, timeline.samples[1:])
+            if prev.unhealthy_pgs() > 0 and s.t > prev.t
+        ]
+        observed = max(repairing, default=0.0)
+        if not repairing:
+            status, detail = HEALTH_OK, "no repair intervals to grade"
+        elif observed < spec.min_repair_bandwidth_bps:
+            status = HEALTH_ERR
+            detail = (
+                f"peak repair bandwidth {observed:.0f} B/s under the "
+                f"{spec.min_repair_bandwidth_bps:.0f} B/s floor"
+            )
+        else:
+            status = HEALTH_OK
+            detail = f"peak repair bandwidth {observed:.0f} B/s"
+        report._add(HealthCheck(
+            "SLO_REPAIR_BANDWIDTH", status, detail,
+            observed, spec.min_repair_bandwidth_bps,
+        ))
+    return report

@@ -1,0 +1,117 @@
+"""``ceph -s`` analog: cluster status view + admin-socket trio.
+
+Bundles the latest :class:`~ceph_tpu.obs.timeline.HealthTimeline`
+sample, the SLO report, and the recent event journal into the three
+admin-socket commands operators poll (``status`` / ``health`` /
+``timeline``), plus the text rendering ``python -m ceph_tpu.cli.status``
+prints.
+"""
+
+from __future__ import annotations
+
+from .slo import SLOSpec, evaluate
+from .timeline import HEALTH_OK, HealthTimeline
+
+
+def status_dict(
+    timeline: HealthTimeline,
+    spec: SLOSpec | None = None,
+) -> dict:
+    """The ``status`` reply: latest histogram + rolled-up health."""
+    latest = timeline.latest
+    report = (
+        evaluate(timeline, spec).to_dict() if spec is not None else None
+    )
+    if latest is None:
+        return {
+            "health": {"status": HEALTH_OK, "checks": {}},
+            "pgmap": {"pgs": {}, "total_pgs": 0},
+            "samples": 0,
+        }
+    return {
+        "health": report or {
+            "status": latest.health,
+            "checks": {},
+        },
+        "pgmap": {
+            "pgs": dict(latest.counts),
+            "total_pgs": latest.total_pgs,
+            "degraded_objects": latest.degraded_objects,
+            "misplaced_objects": latest.misplaced_objects,
+            "availability": round(latest.availability, 9),
+            "repair_bandwidth_bps": round(
+                latest.repair_bandwidth_bps, 3
+            ),
+        },
+        "t": round(latest.t, 9),
+        "epoch": latest.epoch,
+        "samples": len(timeline),
+    }
+
+
+def render_status(status: dict) -> str:
+    """Human text for the ``status`` dict (the ``ceph -s`` shape)."""
+    lines = [
+        "  cluster:",
+        f"    health: {status['health']['status']}",
+    ]
+    for name, check in sorted(status["health"].get("checks", {}).items()):
+        lines.append(f"      {name} {check['status']}: {check['detail']}")
+    pgmap = status["pgmap"]
+    lines.append("  data:")
+    lines.append(f"    pgs: {pgmap['total_pgs']}")
+    for name, n in pgmap.get("pgs", {}).items():
+        if n:
+            lines.append(f"      {n} {name}")
+    if pgmap.get("degraded_objects"):
+        lines.append(
+            f"    degraded objects: {pgmap['degraded_objects']}"
+        )
+    if pgmap.get("misplaced_objects"):
+        lines.append(
+            f"    misplaced objects: {pgmap['misplaced_objects']}"
+        )
+    if "availability" in pgmap:
+        lines.append(f"    availability: {pgmap['availability']:.6f}")
+    if pgmap.get("repair_bandwidth_bps"):
+        lines.append(
+            "    recovery: "
+            f"{pgmap['repair_bandwidth_bps']:.0f} B/s"
+        )
+    return "\n".join(lines)
+
+
+def register_admin_hooks(
+    admin,
+    timeline: HealthTimeline,
+    spec: SLOSpec | None = None,
+    journal=None,
+) -> None:
+    """Register the ``status``/``health``/``timeline`` trio (and, with
+    a journal, ``journal dump``) on an
+    :class:`~ceph_tpu.common.admin_socket.AdminSocket`."""
+    admin.register(
+        "status", lambda cmd: status_dict(timeline, spec)
+    )
+    admin.register(
+        "health",
+        lambda cmd: (
+            evaluate(timeline, spec).to_dict()
+            if spec is not None
+            else {
+                "status": (
+                    timeline.latest.health
+                    if timeline.latest is not None
+                    else HEALTH_OK
+                ),
+                "checks": {},
+            }
+        ),
+    )
+    admin.register(
+        "timeline", lambda cmd: {"series": timeline.to_dicts()}
+    )
+    if journal is not None:
+        admin.register(
+            "journal dump", lambda cmd: {"records": journal.records}
+        )

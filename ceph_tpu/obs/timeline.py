@@ -1,0 +1,222 @@
+"""Cluster-health time series keyed on the chaos engine's virtual clock.
+
+``ceph -s`` shows a point-in-time PG histogram; what chaos scenarios
+need is the *curve* — how many PGs were degraded or inactive at every
+epoch of the timeline, how fast repair bandwidth drained the backlog —
+so availability SLOs can be asserted over the whole run, not just the
+converged end state (arXiv:1709.05365: online EC's real cost is
+system-level degraded-I/O behavior; arXiv:1412.3022: repair *bandwidth*
+is the first-class recovery metric).
+
+A :class:`HealthTimeline` snapshots the device-side PG-state histogram
+(:class:`~ceph_tpu.obs.pg_states.PGStateClassifier`) at every observed
+epoch, stamps each sample with the virtual clock, and derives the
+repair-bandwidth estimate from the byte progress between samples.
+Under a mesh the histogram is psum-aggregated, so two multihost ranks
+record bit-identical series (asserted in tests/test_observability.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+from ..recovery.peering import PeeringResult
+from .pg_states import N_STATES, STATE_NAMES, PGStateClassifier
+
+HEALTH_OK = "HEALTH_OK"
+HEALTH_WARN = "HEALTH_WARN"
+HEALTH_ERR = "HEALTH_ERR"
+
+_SEVERITY = {HEALTH_OK: 0, HEALTH_WARN: 1, HEALTH_ERR: 2}
+
+
+def worst_status(*statuses: str) -> str:
+    """The most severe of the given HEALTH_* strings."""
+    return max(statuses or (HEALTH_OK,), key=lambda s: _SEVERITY[s])
+
+
+@dataclass
+class HealthSample:
+    """One point of the cluster-health series."""
+
+    t: float  # virtual clock seconds
+    epoch: int
+    counts: dict[str, int]  # state name -> PG count
+    total_pgs: int
+    degraded_shard_slots: int  # lost shard-slots across degraded PGs
+    misplaced_pgs: int  # remapped-but-complete PGs
+    degraded_objects: int  # slot estimate x objects_per_pg
+    misplaced_objects: int
+    bytes_recovered: int  # cumulative at sample time
+    repair_bandwidth_bps: float  # since the previous sample
+    availability: float  # fraction of PGs able to serve I/O
+    health: str = HEALTH_OK  # per-sample status (streaming SLO view)
+
+    @property
+    def inactive_pgs(self) -> int:
+        return self.counts["inactive"]
+
+    def unhealthy_pgs(self) -> int:
+        """PGs in any state but active+clean."""
+        return self.total_pgs - self.counts["active+clean"]
+
+    def to_dict(self) -> dict:
+        return {
+            "t": round(self.t, 9),
+            "epoch": self.epoch,
+            "pgs": dict(self.counts),
+            "total_pgs": self.total_pgs,
+            "degraded_shard_slots": self.degraded_shard_slots,
+            "misplaced_pgs": self.misplaced_pgs,
+            "degraded_objects": self.degraded_objects,
+            "misplaced_objects": self.misplaced_objects,
+            "bytes_recovered": self.bytes_recovered,
+            "repair_bandwidth_bps": round(self.repair_bandwidth_bps, 3),
+            "availability": round(self.availability, 9),
+            "health": self.health,
+        }
+
+
+class HealthTimeline:
+    """Per-epoch PG-state series on the virtual clock.
+
+    ``clock`` is any ``() -> float`` (a
+    :class:`~ceph_tpu.recovery.chaos.VirtualClock`'s ``now``); ``k`` the
+    reconstruction threshold the ``inactive`` state keys on (the EC
+    codec's k); ``objects_per_pg`` scales shard-slot counts to the
+    degraded/misplaced *object* estimates operators read in ``ceph -s``.
+    ``sample_status`` lets an SLO spec grade each sample as it lands
+    (:meth:`ceph_tpu.obs.slo.SLOSpec.sample_status`); without one, any
+    not-clean PG makes the sample ``HEALTH_WARN``.
+    """
+
+    def __init__(
+        self,
+        clock: Callable[[], float],
+        k: int | None = None,
+        mesh=None,
+        objects_per_pg: int = 1,
+        sample_status: Callable[[HealthSample], str] | None = None,
+    ):
+        self.clock = clock
+        self.k = k
+        self.objects_per_pg = int(objects_per_pg)
+        self.sample_status = sample_status
+        self.samples: list[HealthSample] = []
+        self._classifier = PGStateClassifier(mesh)
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    @property
+    def latest(self) -> HealthSample | None:
+        return self.samples[-1] if self.samples else None
+
+    def snapshot(
+        self,
+        peering: PeeringResult,
+        epoch: int | None = None,
+        bytes_recovered: int = 0,
+    ) -> HealthSample:
+        """Record the cluster's health at the current virtual time."""
+        hist, aux = self._classifier(peering, self.k)
+        counts = {
+            name: int(hist[i]) for i, name in enumerate(STATE_NAMES)
+        }
+        total = int(hist.sum())
+        t = float(self.clock())
+        prev = self.latest
+        dt = t - prev.t if prev is not None else 0.0
+        dbytes = (
+            bytes_recovered - prev.bytes_recovered
+            if prev is not None else 0
+        )
+        sample = HealthSample(
+            t=t,
+            epoch=int(peering.epoch_cur if epoch is None else epoch),
+            counts=counts,
+            total_pgs=total,
+            degraded_shard_slots=int(aux[0]),
+            misplaced_pgs=int(aux[1]),
+            degraded_objects=int(aux[0]) * self.objects_per_pg,
+            misplaced_objects=int(aux[1]) * self.objects_per_pg,
+            bytes_recovered=int(bytes_recovered),
+            repair_bandwidth_bps=dbytes / dt if dt > 0 else 0.0,
+            availability=(
+                1.0 - counts["inactive"] / total if total else 1.0
+            ),
+        )
+        sample.health = (
+            self.sample_status(sample)
+            if self.sample_status is not None
+            else (
+                HEALTH_OK if sample.unhealthy_pgs() == 0 else HEALTH_WARN
+            )
+        )
+        self.samples.append(sample)
+        return sample
+
+    def series(self) -> dict:
+        """Column-oriented series for one JSON line: parallel lists,
+        one entry per sample."""
+        cols: dict = {
+            "t": [round(s.t, 9) for s in self.samples],
+            "epoch": [s.epoch for s in self.samples],
+            "availability": [
+                round(s.availability, 9) for s in self.samples
+            ],
+            "health": [s.health for s in self.samples],
+            "degraded_objects": [s.degraded_objects for s in self.samples],
+            "misplaced_objects": [
+                s.misplaced_objects for s in self.samples
+            ],
+            "bytes_recovered": [s.bytes_recovered for s in self.samples],
+            "repair_bandwidth_bps": [
+                round(s.repair_bandwidth_bps, 3) for s in self.samples
+            ],
+        }
+        for name in STATE_NAMES:
+            cols[name] = [s.counts[name] for s in self.samples]
+        return cols
+
+    def to_dicts(self) -> list[dict]:
+        """Row-oriented dump (the ``timeline`` admin-socket reply)."""
+        return [s.to_dict() for s in self.samples]
+
+    # ---- aggregates the SLO evaluator (and bench guards) read -------
+
+    def min_availability(self) -> float:
+        return min(
+            (s.availability for s in self.samples), default=1.0
+        )
+
+    def inactive_seconds(self) -> float:
+        """Virtual seconds any PG spent inactive: the step-function
+        integral between samples (an interval counts when the sample
+        OPENING it had inactive PGs — states only change at epochs, and
+        epochs always produce a sample)."""
+        total = 0.0
+        for a, b in zip(self.samples, self.samples[1:]):
+            if a.inactive_pgs > 0:
+                total += b.t - a.t
+        return total
+
+    def time_to_zero_degraded(self) -> float | None:
+        """Virtual time of the first sample after which the cluster
+        stayed clean of degraded/undersized/inactive PGs; None while
+        still dirty (or before any sample)."""
+        clean_since = None
+        for s in self.samples:
+            bad = (
+                s.counts["degraded"]
+                + s.counts["undersized"]
+                + s.counts["inactive"]
+            )
+            if bad:
+                clean_since = None
+            elif clean_since is None:
+                clean_since = s.t
+        return clean_since

@@ -195,10 +195,12 @@ class ChaosEngine:
         m: OSDMap,
         timeline: ChaosTimeline | None = None,
         clock: VirtualClock | None = None,
+        journal=None,
     ):
         self.osdmap = m
         self.timeline = timeline or ChaosTimeline()
         self.clock = clock or VirtualClock()
+        self.journal = journal
         self.applied: list[AppliedEvent] = []
 
     @property
@@ -218,6 +220,13 @@ class ChaosEngine:
             self.applied.append(
                 AppliedEvent(ev.t, inc.epoch, ev.specs, inc)
             )
+            if self.journal is not None:
+                self.journal.event(
+                    "chaos.inject",
+                    epoch=inc.epoch,
+                    sched_t=ev.t,
+                    specs=[str(s) for s in ev.specs],
+                )
         return incs
 
     def advance_to_next(self) -> bool:

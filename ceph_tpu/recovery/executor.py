@@ -24,6 +24,7 @@ a named profiler span (:func:`ceph_tpu.common.tracing.trace_annotation`).
 from __future__ import annotations
 
 import time
+from contextlib import nullcontext
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -499,11 +500,22 @@ class SupervisedRecovery:
         launch_duration_s: float = 0.5,
         max_items: int = 8,
         mesh=None,
+        journal=None,
+        health=None,
+        op_tracker=None,
     ):
         self.codec = codec
         self.chaos = chaos
         self.cfg = config or global_config()
         self.fault_hook = fault_hook
+        # observability seams (ceph_tpu.obs): the event journal records
+        # phase spans + launch/retry/salvage events, the health timeline
+        # snapshots the PG-state histogram at every observed epoch, and
+        # the op tracker (on the virtual clock) keeps per-launch
+        # lifecycle dumps — all optional, all no-ops when None
+        self.journal = journal
+        self.health = health
+        self.op_tracker = op_tracker
         self.launch_duration_s = float(launch_duration_s)
         self.max_items = max_items
         self._rng = np.random.default_rng(seed)
@@ -530,6 +542,23 @@ class SupervisedRecovery:
             mesh=mesh,
         )
         self.pc = self.ex.pc
+
+    def _jevent(self, name: str, **attrs) -> None:
+        if self.journal is not None:
+            self.journal.event(name, **attrs)
+
+    def _jspan(self, name: str, **attrs):
+        if self.journal is not None:
+            return self.journal.span(name, **attrs)
+        return nullcontext()
+
+    def _snapshot(self, peering: PeeringResult, bytes_recovered: int) -> None:
+        if self.health is not None:
+            self.health.snapshot(
+                peering,
+                epoch=self.chaos.epoch,
+                bytes_recovered=bytes_recovered,
+            )
 
     def _schedule(
         self, groups: list[PatternGroup], peering: PeeringResult
@@ -605,13 +634,17 @@ class SupervisedRecovery:
 
         inner = RecoveryResult(shards={})
         res = SupervisedResult(shards=inner.shards)
-        peering = engine.run(
-            state_prev, cur_state(), m_prev.epoch, chaos.epoch
-        )
+        with self._jspan(
+            "recovery.peer", epoch_prev=m_prev.epoch, epoch=chaos.epoch
+        ):
+            peering = engine.run(
+                state_prev, cur_state(), m_prev.epoch, chaos.epoch
+            )
         res.epochs.append(chaos.epoch)
         plan = build_plan(peering, self.codec)
         pending = self._schedule(plan.groups, peering)
         unrecoverable = plan.unrecoverable
+        self._snapshot(peering, 0)
         # checkpoint: pg -> acting row at completion time.  A later
         # epoch that moves/kills anything in the row voids the entry.
         completed: dict[int, np.ndarray] = {}
@@ -624,32 +657,36 @@ class SupervisedRecovery:
             nonlocal peering, pending, unrecoverable
             res.plan_revisions += 1
             self.pc.inc("plan_revisions")
-            peering, _changed = engine.repeer(
-                peering, state_prev, cur_state(), chaos.epoch
-            )
-            for pg in list(completed):
-                if not np.array_equal(peering.acting[pg], completed[pg]):
-                    del completed[pg]
-            valid, _invalid_pgs = invalidated_groups(
-                pending, peering.survivor_mask
-            )
-            for pg in list(failed):
-                if int(peering.survivor_mask[pg]) != failed[pg]:
-                    del failed[pg]  # pattern changed: worth a new try
-            covered = set(completed) | set(failed)
-            for g in valid:
-                covered.update(int(p) for p in g.pgs)
-            need = np.array(
-                sorted(
-                    int(pg)
-                    for pg in peering.pgs_with(PG_STATE_DEGRADED)
-                    if int(pg) not in covered
-                ),
-                dtype=np.int64,
-            )
-            sub = build_plan(peering, self.codec, pgs=need)
-            pending = self._schedule(valid + sub.groups, peering)
-            unrecoverable = sub.unrecoverable
+            with self._jspan("recovery.revise", epoch=chaos.epoch):
+                peering, _changed = engine.repeer(
+                    peering, state_prev, cur_state(), chaos.epoch
+                )
+                for pg in list(completed):
+                    if not np.array_equal(
+                        peering.acting[pg], completed[pg]
+                    ):
+                        del completed[pg]
+                valid, _invalid_pgs = invalidated_groups(
+                    pending, peering.survivor_mask
+                )
+                for pg in list(failed):
+                    if int(peering.survivor_mask[pg]) != failed[pg]:
+                        del failed[pg]  # pattern changed: worth a new try
+                covered = set(completed) | set(failed)
+                for g in valid:
+                    covered.update(int(p) for p in g.pgs)
+                need = np.array(
+                    sorted(
+                        int(pg)
+                        for pg in peering.pgs_with(PG_STATE_DEGRADED)
+                        if int(pg) not in covered
+                    ),
+                    dtype=np.int64,
+                )
+                sub = build_plan(peering, self.codec, pgs=need)
+                pending = self._schedule(valid + sub.groups, peering)
+                unrecoverable = sub.unrecoverable
+            self._snapshot(peering, inner.bytes_recovered)
 
         def observe(incs) -> None:
             res.epochs.extend(i.epoch for i in incs)
@@ -672,10 +709,16 @@ class SupervisedRecovery:
             # happens before anything else dispatches (matching the
             # serial loop's ordering).
             window: list[_Inflight] = []
+            ops: dict[int, object] = {}
             while pending and len(window) < self.window:
                 g = pending.pop(0)
                 attempt = 0
                 fl = None
+                op = (
+                    self.op_tracker.create_op(f"decode:{g.mask:#x}")
+                    if self.op_tracker is not None
+                    else None
+                )
                 while True:
                     try:
                         if self.fault_hook is not None and self.fault_hook(
@@ -690,9 +733,22 @@ class SupervisedRecovery:
                         if attempt > self.retry_max:
                             for pg in g.pgs:
                                 failed[int(pg)] = g.mask
+                            self._jevent(
+                                "decode.failed",
+                                mask=g.mask,
+                                pgs=sorted(int(p) for p in g.pgs),
+                            )
+                            if op is not None:
+                                op.mark_event("failed")
+                                op.finish()
                             break
                         res.retries += 1
                         self.pc.inc("launch_retries")
+                        self._jevent(
+                            "decode.retry", mask=g.mask, attempt=attempt
+                        )
+                        if op is not None:
+                            op.mark_event(f"retry:{attempt}")
                         # bounded exponential backoff + seeded jitter
                         clock.sleep(
                             self.backoff_base_s
@@ -703,6 +759,16 @@ class SupervisedRecovery:
                     break
                 if fl is None:
                     break
+                self._jevent(
+                    "decode.launch",
+                    mask=g.mask,
+                    n_pgs=g.n_pgs,
+                    attempt=attempt,
+                    sharded=fl.sharded,
+                )
+                if op is not None:
+                    op.mark_event("dispatched")
+                    ops[id(fl)] = op
                 window.append(fl)
                 if fl.sharded:
                     break
@@ -719,6 +785,7 @@ class SupervisedRecovery:
             for fl in window:
                 g = fl.group
                 out, chunk = self.ex._finalize_group(fl, inner)
+                op = ops.pop(id(fl), None)
                 stale = (
                     self._stale_pgs(g, peering, chaos.osdmap)
                     if incs
@@ -732,6 +799,11 @@ class SupervisedRecovery:
                     # same device output (byte columns are independent)
                     res.stale_launches += 1
                     self.pc.inc("stale_launches")
+                    self._jevent(
+                        "decode.stale",
+                        mask=g.mask,
+                        stale_pgs=sorted(stale),
+                    )
                     fresh = {int(pg) for pg in g.pgs} - stale
                     if fresh:
                         self.ex._commit_group(
@@ -742,6 +814,14 @@ class SupervisedRecovery:
                             failed.pop(pg, None)
                         res.salvaged_pgs += len(fresh)
                         self.pc.inc("salvaged_pgs", len(fresh))
+                        self._jevent(
+                            "decode.salvage",
+                            mask=g.mask,
+                            pgs=sorted(fresh),
+                        )
+                    if op is not None:
+                        op.mark_event("stale")
+                        op.finish()
                     continue
                 # commit against the pre-event acting rows, THEN
                 # revise: if the event touched this PG, the snapshot
@@ -750,9 +830,23 @@ class SupervisedRecovery:
                 for pg in g.pgs:
                     completed[int(pg)] = peering.acting[int(pg)].copy()
                     failed.pop(int(pg), None)
+                if op is not None:
+                    op.mark_event("committed")
+                    op.finish()
             if incs:
                 revise()
 
+        if self.health is not None:
+            last = self.health.latest
+            # close the series with the end state (skip only an exact
+            # duplicate of the sample the final revise already took)
+            if (
+                last is None
+                or clock.now() > last.t
+                or chaos.epoch != last.epoch
+                or inner.bytes_recovered != last.bytes_recovered
+            ):
+                self._snapshot(peering, inner.bytes_recovered)
         res.launches = inner.launches
         res.sharded_launches = inner.sharded_launches
         res.psum_bytes_rebuilt = inner.psum_bytes_rebuilt

@@ -56,6 +56,80 @@ def test_multichip_record_schema():
     json.dumps(rec)  # one JSON line, always serializable
 
 
+# --- config6_recovery --chaos JSON schema (obs subsystem verdict) ---
+
+
+class _FakeSupervisedResult:
+    converged = True
+    time_to_zero_degraded_s = 2.75
+    retries = 3
+    plan_revisions = 6
+    stale_launches = 1
+    unrecoverable = [(7, 0x3F)]
+
+
+class _FakeCheck:
+    def __init__(self, name, status):
+        self.name, self.status = name, status
+
+
+class _FakeReport:
+    status = "HEALTH_WARN"
+    checks = [
+        _FakeCheck("SLO_INACTIVE", "HEALTH_OK"),
+        _FakeCheck("SLO_AVAILABILITY", "HEALTH_WARN"),
+    ]
+
+
+class _FakeTimeline:
+    @staticmethod
+    def min_availability():
+        return 0.8437500013
+
+    @staticmethod
+    def inactive_seconds():
+        return 0.2500000007
+
+    @staticmethod
+    def series():
+        return {
+            "t": [0.0, 0.25],
+            "epoch": [2, 3],
+            "health": ["HEALTH_OK", "HEALTH_WARN"],
+            "active+clean": [32, 27],
+            "undersized": [0, 5],
+        }
+
+
+def test_chaos_record_schema():
+    import json
+
+    rec = config6.build_chaos_record(
+        "flap", _FakeSupervisedResult(), _FakeTimeline(), _FakeReport()
+    )
+    assert rec["chaos_scenario"] == "flap"
+    assert rec["chaos_converged"] is True
+    assert rec["chaos_time_to_zero_degraded_s"] == 2.75
+    assert rec["chaos_retries"] == 3
+    assert rec["chaos_replans"] == 6
+    assert rec["chaos_stale_launches"] == 1
+    assert rec["chaos_unrecoverable"] == 1
+    # the SLO verdict rides along for decide_defaults' guard harvest:
+    # rolled-up status, per-check grades, and the typed aggregates
+    assert rec["chaos_health_status"] == "HEALTH_WARN"
+    assert rec["chaos_slo_checks"] == {
+        "SLO_INACTIVE": "HEALTH_OK",
+        "SLO_AVAILABILITY": "HEALTH_WARN",
+    }
+    assert rec["chaos_availability_fraction"] == 0.843750001  # round(.., 9)
+    assert rec["chaos_inactive_seconds"] == 0.25
+    # the per-epoch PG-state series is one parallel-list block
+    series = rec["chaos_pg_state_series"]
+    assert series["t"] == [0.0, 0.25]
+    assert series["health"][1] == "HEALTH_WARN"
+    json.dumps(rec)  # one JSON line, always serializable
+
+
 def test_device_result_uses_headline_metric():
     out = bench.format_result({"rate": 2_000_000.0, "platform": "tpu"}, 200_000.0, [])
     assert out["metric"] == "crush_placements_per_sec"

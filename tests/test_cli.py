@@ -668,3 +668,92 @@ def test_recovery_cli_chaos_unknown_scenario():
     with pytest.raises(ValueError, match="unknown chaos scenario"):
         rcli.main(["--num-osd", "32", "--pg-num", "16",
                    "--chaos", "earthquake"])
+
+
+# ---- cli.status (the `ceph -s` analog) ----
+
+
+_STATUS_DEMO_ARGS = ["--num-osd", "64", "--pg-num", "32", "--seed", "1"]
+
+
+def test_status_cli_demo_status(capsys):
+    from ceph_tpu.cli import status as scli
+
+    assert scli.main(["status"] + _STATUS_DEMO_ARGS) == 0
+    out = capsys.readouterr().out
+    assert "cluster:" in out and "health:" in out
+    assert "pgs: 32" in out
+    # a completed flap demo ends healthy with SLO checks listed
+    assert "SLO_INACTIVE" in out
+
+
+def test_status_cli_demo_health_and_timeline_json(capsys):
+    from ceph_tpu.cli import status as scli
+
+    assert scli.main(["health", "--json"] + _STATUS_DEMO_ARGS) == 0
+    health = json.loads(capsys.readouterr().out)
+    assert health["status"] in ("HEALTH_OK", "HEALTH_WARN", "HEALTH_ERR")
+    assert set(health["checks"]) >= {"SLO_INACTIVE", "SLO_AVAILABILITY",
+                                     "SLO_RECOVERY_TIME"}
+
+    assert scli.main(["timeline", "--json"] + _STATUS_DEMO_ARGS) == 0
+    series = json.loads(capsys.readouterr().out)["series"]
+    assert len(series) >= 3
+    assert {"t", "epoch", "health", "pgs", "availability"} <= set(series[0])
+    # the flap demo produces a real curve: health leaves OK and returns
+    health_seq = [s["health"] for s in series]
+    assert health_seq[0] == "HEALTH_OK" and health_seq[-1] == "HEALTH_OK"
+    assert "HEALTH_WARN" in health_seq
+
+
+def test_status_cli_demo_journal_roundtrip(tmp_path, capsys):
+    from ceph_tpu.cli import status as scli
+    from ceph_tpu.obs import EventJournal
+
+    jpath = str(tmp_path / "journal.jsonl")
+    assert scli.main(["journal", "--json", "--journal-path", jpath]
+                     + _STATUS_DEMO_ARGS) == 0
+    records = json.loads(capsys.readouterr().out)["records"]
+    names = {r["name"] for r in records}
+    assert {"chaos.inject", "decode.launch", "recovery.revise"} <= names
+    # the on-disk journal matches what the command printed
+    assert EventJournal.read(jpath) == records
+
+
+def test_status_cli_demo_is_deterministic(capsys):
+    from ceph_tpu.cli import status as scli
+
+    args = ["timeline", "--json"] + _STATUS_DEMO_ARGS
+    assert scli.main(args) == 0
+    first = capsys.readouterr().out
+    assert scli.main(args) == 0
+    assert capsys.readouterr().out == first
+
+
+def test_status_cli_socket_mode(tmp_path, capsys):
+    from ceph_tpu.cli import status as scli
+    from ceph_tpu.common.admin_socket import AdminSocket
+    from ceph_tpu.common.config import Config
+    from ceph_tpu.obs import HealthTimeline, SLOSpec, register_admin_hooks
+    from ceph_tpu.recovery import VirtualClock
+
+    clock = VirtualClock()
+    tl = HealthTimeline(clock.now)
+    sock = str(tmp_path / "asok")
+    a = AdminSocket(sock, Config(env={}))
+    register_admin_hooks(a, tl, SLOSpec(max_inactive_seconds=10.0))
+    a.start()
+    try:
+        assert scli.main(["health", "--socket", sock, "--json"]) == 0
+        health = json.loads(capsys.readouterr().out)
+        assert health["status"] == "HEALTH_OK"
+    finally:
+        a.stop()
+
+
+def test_status_cli_socket_error(tmp_path, capsys):
+    from ceph_tpu.cli import status as scli
+
+    assert scli.main(["status", "--socket",
+                      str(tmp_path / "absent.asok")]) == 1
+    assert "status:" in capsys.readouterr().err

@@ -58,7 +58,7 @@ def test_perf_counters():
         .create_perf_counters()
     )
     pc.inc("ops", 5)
-    pc.inc("inflight", 2)
+    pc.set("inflight", 2)
     pc.dec("inflight")
     with pc.time("op_lat"):
         pass

@@ -131,6 +131,37 @@ def test_harvest_guard_collects_chaos_counters(tmp_path):
     assert "chaos_scenario" not in g and "chaos_stale_launches" not in g
 
 
+def test_harvest_guard_collects_chaos_slo_fields(tmp_path):
+    """The obs subsystem's SLO verdict rides the guard harvest with its
+    own types: float aggregates and the HEALTH_* status string."""
+    p = _log(tmp_path, [
+        {"metric": "recovery_decode_bytes_per_sec", "platform": "tpu",
+         "value": 9_000_000, "n_compiles": 5, "n_compiles_first": 5,
+         "host_transfers": 2, "chaos_scenario": "flap",
+         "chaos_converged": True, "chaos_retries": 0, "chaos_replans": 6,
+         "chaos_unrecoverable": 0,
+         "chaos_health_status": "HEALTH_OK",
+         "chaos_availability_fraction": 0.84375,
+         "chaos_inactive_seconds": 0.25,
+         "chaos_slo_checks": {"SLO_INACTIVE": "HEALTH_OK"}},
+    ])
+    g = dd.harvest_guard([p])["recovery_decode_bytes_per_sec"]
+    assert g["chaos_health_status"] == "HEALTH_OK"
+    assert g["chaos_availability_fraction"] == 0.84375
+    assert g["chaos_inactive_seconds"] == 0.25
+    assert isinstance(g["chaos_availability_fraction"], float)
+    assert isinstance(g["chaos_inactive_seconds"], float)
+    # the per-check dict and series stay in the bench line only
+    assert "chaos_slo_checks" not in g
+    # a cpu smoke line must never contribute SLO fields either
+    p2 = _log(tmp_path, [
+        {"metric": "recovery_decode_bytes_per_sec", "platform": "cpu",
+         "chaos_health_status": "HEALTH_ERR",
+         "chaos_availability_fraction": 0.0},
+    ])
+    assert dd.harvest_guard([p2]) == {}
+
+
 def test_harvest_guard_collects_multichip_counters(tmp_path):
     p = _log(tmp_path, [
         {"metric": "recovery_multichip_bytes_per_sec", "platform": "tpu",

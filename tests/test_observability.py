@@ -1,11 +1,543 @@
-"""Op tracker, tracing spans, prometheus exposition."""
+"""Observability: device-side PG-state classification, the health
+timeline, SLO evaluation, the correlated event journal, the
+``ChaosEngine.applied`` audit trail, perf-counter typing, the op
+tracker on the virtual clock, and the status admin-socket trio.  Slow
+tier: two OS processes record identical psum-aggregated health series
+through a chaos flap whose streaming SLO check transitions
+``HEALTH_OK -> HEALTH_WARN -> HEALTH_OK``."""
 
+import copy
+import json
+import os
+import socket
+import subprocess
+import sys
 import time
 
+import numpy as np
+import pytest
+
+from ceph_tpu import recovery as rec
 from ceph_tpu.common import PerfCountersBuilder
+from ceph_tpu.common.config import Config
 from ceph_tpu.common.op_tracker import OpTracker
+from ceph_tpu.common.perf_counters import registry
 from ceph_tpu.common.prometheus import render
 from ceph_tpu.common.tracing import timed_block
+from ceph_tpu.ec import gf
+from ceph_tpu.ec.backend import MatrixCodec
+from ceph_tpu.models.clusters import build_osdmap
+from ceph_tpu.obs import (
+    HEALTH_ERR,
+    HEALTH_OK,
+    HEALTH_WARN,
+    STATE_NAMES,
+    EventJournal,
+    HealthTimeline,
+    PGStateClassifier,
+    SLOSpec,
+    evaluate,
+    register_admin_hooks,
+    render_status,
+    status_dict,
+    worst_status,
+)
+from ceph_tpu.parallel.placement import make_mesh
+from ceph_tpu.recovery.peering import (
+    PG_STATE_BACKFILL,
+    PG_STATE_REMAPPED,
+    PeeringResult,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _synth(masks, alive, flags, size=4, min_size=2):
+    """Hand-built PeeringResult from raw survivor masks/alive counts."""
+    n = len(masks)
+    z = np.zeros((n, size), np.int32)
+    zp = np.zeros(n, np.int32)
+    return PeeringResult(
+        pool_id=1, epoch_prev=1, epoch_cur=2, size=size, min_size=min_size,
+        up=z, up_primary=zp, acting=z, acting_primary=zp, prev_acting=z,
+        flags=np.array(flags, np.int32),
+        survivor_mask=np.array(masks, np.uint32),
+        n_alive=np.array(alive, np.int32),
+    )
+
+
+# one PG per state, plus a misplaced (remapped-but-complete) PG:
+# clean, backfilling, degraded (all slots alive, one dataless),
+# undersized (an acting hole), inactive (<k survivors), misplaced
+_MASKS = [0b1111, 0b1111, 0b0111, 0b0111, 0b0001, 0b1111]
+_ALIVE = [4, 4, 4, 3, 1, 4]
+_FLAGS = [0, PG_STATE_BACKFILL, 0, 0, 0, PG_STATE_REMAPPED]
+
+
+# ---- PG-state classifier ---------------------------------------------
+
+
+def test_pg_state_classifier_states():
+    hist, aux = PGStateClassifier()(_synth(_MASKS, _ALIVE, _FLAGS))
+    assert dict(zip(STATE_NAMES, hist.tolist())) == {
+        "active+clean": 2, "backfilling": 1, "degraded": 1,
+        "undersized": 1, "inactive": 1,
+    }
+    # degraded shard-slots: 1 (degraded) + 1 (undersized) + 3 (inactive)
+    assert aux.tolist() == [5, 1]
+
+
+def test_pg_state_classifier_k_override():
+    # k=1: the single-survivor PG can still reconstruct -> undersized,
+    # not inactive (its acting set has holes)
+    hist, _ = PGStateClassifier()(_synth(_MASKS, _ALIVE, _FLAGS), k=1)
+    assert dict(zip(STATE_NAMES, hist.tolist()))["inactive"] == 0
+    assert dict(zip(STATE_NAMES, hist.tolist()))["undersized"] == 2
+
+
+def test_pg_state_classifier_mesh_matches_single():
+    """The psum-reduced mesh histogram equals the single-device one,
+    including when the PG axis needs padding (11 PGs on 8 devices) —
+    the padded tail must never vote."""
+    masks = (_MASKS * 2)[:11]
+    alive = (_ALIVE * 2)[:11]
+    flags = (_FLAGS * 2)[:11]
+    pr = _synth(masks, alive, flags)
+    hist1, aux1 = PGStateClassifier()(pr)
+    hist2, aux2 = PGStateClassifier(make_mesh(axis="pgs"))(pr)
+    np.testing.assert_array_equal(hist1, hist2)
+    np.testing.assert_array_equal(aux1, aux2)
+    assert int(hist2.sum()) == 11
+
+
+# ---- health timeline -------------------------------------------------
+
+
+def test_health_timeline_aggregates():
+    clock = rec.VirtualClock()
+    tl = HealthTimeline(clock.now)
+    # t=0: one inactive PG among four
+    tl.snapshot(_synth([0b0001, 0b1111, 0b1111, 0b1111],
+                       [1, 4, 4, 4], [0] * 4), epoch=2)
+    assert tl.latest.health == HEALTH_WARN
+    assert tl.latest.availability == 0.75
+    clock.advance(2.0)
+    # t=2: inactive cleared, still degraded
+    tl.snapshot(_synth([0b0111, 0b1111, 0b1111, 0b1111],
+                       [4, 4, 4, 4], [0] * 4), epoch=3,
+                bytes_recovered=1000)
+    clock.advance(1.0)
+    # t=3: all clean
+    tl.snapshot(_synth([0b1111] * 4, [4] * 4, [0] * 4), epoch=4,
+                bytes_recovered=1500)
+    assert [s.health for s in tl.samples] == [
+        HEALTH_WARN, HEALTH_WARN, HEALTH_OK,
+    ]
+    # the inactive interval is [0, 2): the sample OPENING an interval
+    # decides whether it counts
+    assert tl.inactive_seconds() == 2.0
+    assert tl.min_availability() == 0.75
+    assert tl.time_to_zero_degraded() == 3.0
+    # bandwidth is per-interval: 1000B/2s then 500B/1s
+    assert [s.repair_bandwidth_bps for s in tl.samples] == [0.0, 500.0, 500.0]
+    series = tl.series()
+    assert series["t"] == [0.0, 2.0, 3.0]
+    assert series["inactive"] == [1, 0, 0]
+    assert series["active+clean"] == [3, 3, 4]
+    assert len(tl.to_dicts()) == 3
+
+
+def test_health_timeline_dirty_end_never_drained():
+    clock = rec.VirtualClock()
+    tl = HealthTimeline(clock.now)
+    tl.snapshot(_synth([0b0111], [4], [0]), epoch=2)
+    assert tl.time_to_zero_degraded() is None
+    # a clean sample followed by a relapse resets the drain time
+    clock.advance(1.0)
+    tl.snapshot(_synth([0b1111], [4], [0]), epoch=3)
+    clock.advance(1.0)
+    tl.snapshot(_synth([0b0111], [4], [0]), epoch=4)
+    assert tl.time_to_zero_degraded() is None
+
+
+def test_health_timeline_mesh_identical_series():
+    """A mesh-backed timeline records the same series as a single-device
+    one (the psum aggregation changes where the counts are computed,
+    never what they are)."""
+    clock1, clock2 = rec.VirtualClock(), rec.VirtualClock()
+    tl1 = HealthTimeline(clock1.now)
+    tl2 = HealthTimeline(clock2.now, mesh=make_mesh(axis="pgs"))
+    for clk, tl in ((clock1, tl1), (clock2, tl2)):
+        tl.snapshot(_synth(_MASKS, _ALIVE, _FLAGS), epoch=2)
+        clk.advance(1.0)
+        tl.snapshot(_synth([0b1111] * 6, [4] * 6, [0] * 6), epoch=3,
+                    bytes_recovered=640)
+    assert tl1.series() == tl2.series()
+
+
+# ---- SLO evaluation --------------------------------------------------
+
+
+def test_worst_status():
+    assert worst_status() == HEALTH_OK
+    assert worst_status(HEALTH_OK, HEALTH_WARN) == HEALTH_WARN
+    assert worst_status(HEALTH_WARN, HEALTH_ERR, HEALTH_OK) == HEALTH_ERR
+
+
+def _timeline_with_outage(inactive_for=2.0, drain_at=3.0):
+    clock = rec.VirtualClock()
+    tl = HealthTimeline(clock.now)
+    tl.snapshot(_synth([0b0001, 0b1111], [1, 4], [0, 0]), epoch=2)
+    clock.advance(inactive_for)
+    tl.snapshot(_synth([0b0111, 0b1111], [4, 4], [0, 0]), epoch=3)
+    clock.advance(drain_at - inactive_for)
+    tl.snapshot(_synth([0b1111, 0b1111], [4, 4], [0, 0]), epoch=4)
+    return tl
+
+
+def test_slo_evaluate_all_ok():
+    report = evaluate(_timeline_with_outage(), SLOSpec(
+        max_inactive_seconds=10.0,
+        min_availability_fraction=0.25,
+        max_time_to_zero_degraded_s=10.0,
+    ))
+    assert report.status == HEALTH_WARN  # availability dipped below 1.0
+    assert report.check("SLO_INACTIVE").status == HEALTH_OK
+    assert report.check("SLO_AVAILABILITY").status == HEALTH_WARN
+    assert report.check("SLO_RECOVERY_TIME").status == HEALTH_OK
+    d = report.to_dict()
+    assert d["checks"]["SLO_INACTIVE"]["observed"] == 2.0
+    json.dumps(d)
+
+
+def test_slo_evaluate_err_when_budgets_blown():
+    report = evaluate(_timeline_with_outage(), SLOSpec(
+        max_inactive_seconds=1.0,       # 2s observed -> ERR
+        min_availability_fraction=0.75,  # dipped to 0.5 -> ERR
+        max_time_to_zero_degraded_s=2.0,  # drained at 3s -> ERR
+    ))
+    assert report.status == HEALTH_ERR
+    assert all(c.status == HEALTH_ERR for c in report.checks)
+    assert "budget 1s" in report.check("SLO_INACTIVE").detail
+
+
+def test_slo_warn_band_and_never_drained():
+    # 2s observed vs a 2.2s budget: inside the 0.8 warn fraction
+    report = evaluate(
+        _timeline_with_outage(), SLOSpec(max_inactive_seconds=2.2)
+    )
+    assert report.check("SLO_INACTIVE").status == HEALTH_WARN
+    # a timeline that never drains pins SLO_RECOVERY_TIME to ERR
+    clock = rec.VirtualClock()
+    tl = HealthTimeline(clock.now)
+    tl.snapshot(_synth([0b0111], [4], [0]), epoch=2)
+    report = evaluate(tl, SLOSpec(max_time_to_zero_degraded_s=100.0))
+    assert report.check("SLO_RECOVERY_TIME").status == HEALTH_ERR
+    assert "never drained" in report.check("SLO_RECOVERY_TIME").detail
+
+
+def test_slo_streaming_sample_status():
+    spec = SLOSpec(min_availability_fraction=0.75)
+    clock = rec.VirtualClock()
+    tl = HealthTimeline(clock.now, sample_status=spec.sample_status)
+    tl.snapshot(_synth([0b1111] * 2, [4] * 2, [0] * 2))
+    tl.snapshot(_synth([0b0001, 0b0001], [1, 1], [0, 0]))  # avail 0.0
+    tl.snapshot(_synth([0b0111, 0b1111], [4, 4], [0, 0]))  # degraded
+    assert [s.health for s in tl.samples] == [
+        HEALTH_OK, HEALTH_ERR, HEALTH_WARN,
+    ]
+
+
+# ---- event journal ---------------------------------------------------
+
+
+def test_journal_spans_events_and_roundtrip(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    clock = rec.VirtualClock()
+    with EventJournal(
+        path=path, clock=clock.now, trace_id="t0", wall=lambda: 7.0
+    ) as j:
+        with j.span("phase.outer", epoch=2) as outer:
+            j.event("point.a", n=1)
+            clock.advance(0.5)
+            with j.span("phase.inner"):
+                j.event("point.b")
+        j.event("point.c")
+    # parentage: events inside a span link to it; the span records its
+    # end time on close
+    a = j.by_name("point.a")[0]
+    b = j.by_name("point.b")[0]
+    c = j.by_name("point.c")[0]
+    inner = j.by_name("phase.inner")[0]
+    assert a["parent_id"] == outer["span_id"]
+    assert inner["parent_id"] == outer["span_id"]
+    assert b["parent_id"] == inner["span_id"]
+    assert c["parent_id"] is None
+    assert outer["t"] == 0.0 and outer["t_end"] == 0.5
+    assert a["t"] == 0.0 and b["t"] == 0.5
+    assert all(r["trace_id"] == "t0" for r in j.records)
+    assert all(r["wall"] == 7.0 for r in j.records)
+    # file round-trip preserves every record (spans are written on
+    # close, so file order is completion order)
+    back = EventJournal.read(path)
+    names = {r["name"] for r in back}
+    assert names == {
+        "phase.outer", "phase.inner", "point.a", "point.b", "point.c",
+    }
+    assert len(back) == len(j.records)
+
+
+# ---- ChaosEngine.applied audit trail (satellite) ---------------------
+
+
+def test_chaos_applied_audit_trail_orders_and_journals(tmp_path):
+    """The applied trail records events in injection order with correct
+    epoch attribution, and round-trips through the journal."""
+    path = str(tmp_path / "chaos.jsonl")
+    m = build_osdmap(64, pg_num=32, size=6, pool_kind="erasure")
+    clock = rec.VirtualClock()
+    journal = EventJournal(
+        path=path, clock=clock.now, trace_id="audit", wall=lambda: 0.0
+    )
+    timeline = rec.ChaosTimeline.from_pairs([
+        (0.5, "osd:1:down"),
+        (1.0, ["osd:2:down", "osd:3:down"]),  # one batched epoch
+        (1.5, "osd:1:up"),
+    ])
+    chaos = rec.ChaosEngine(m, timeline, clock=clock, journal=journal)
+    epoch0 = chaos.epoch
+    assert chaos.poll() == []  # nothing due at t=0
+    clock.advance(1.0)
+    incs = chaos.poll()  # both the t=0.5 and t=1.0 events, in order
+    assert len(incs) == 2
+    clock.advance(1.0)
+    chaos.poll()
+    journal.close()
+
+    trail = chaos.applied
+    assert [ev.t for ev in trail] == [0.5, 1.0, 1.5]
+    # epoch attribution: consecutive epochs, one per applied event,
+    # each matching its own incremental
+    assert [ev.epoch for ev in trail] == [epoch0 + 1, epoch0 + 2, epoch0 + 3]
+    assert all(ev.epoch == ev.incremental.epoch for ev in trail)
+    assert [len(ev.specs) for ev in trail] == [1, 2, 1]
+
+    # journal round-trip: one chaos.inject per applied event, in order,
+    # with the scheduled time, attributed epoch, and spec strings
+    back = [r for r in EventJournal.read(path) if r["name"] == "chaos.inject"]
+    assert [r["attrs"]["epoch"] for r in back] == [ev.epoch for ev in trail]
+    assert [r["attrs"]["sched_t"] for r in back] == [0.5, 1.0, 1.5]
+    assert [r["attrs"]["specs"] for r in back] == [
+        [str(s) for s in ev.specs] for ev in trail
+    ]
+    # injection wall-clock t is when poll() ran, not the scheduled t
+    assert [r["t"] for r in back] == [1.0, 1.0, 2.0]
+
+
+# ---- supervised run: correlated wiring -------------------------------
+
+
+def _flap_run(journal=None, health=None, op_tracker=None):
+    k, m_par = 4, 2
+    m = build_osdmap(64, pg_num=32, size=k + m_par, pool_kind="erasure")
+    m_prev = copy.deepcopy(m)
+    clock = journal.clock.__self__ if journal else rec.VirtualClock()
+    chaos = rec.ChaosEngine(
+        m, rec.build_scenario("flap", m, cycles=2),
+        clock=clock, journal=journal,
+    )
+    codec = MatrixCodec(gf.vandermonde_matrix(k, m_par))
+    rng = np.random.default_rng(3)
+    store = {}
+
+    def read_shard(pg, s):
+        if pg not in store:
+            data = rng.integers(0, 256, (k, 64), dtype=np.uint8)
+            store[pg] = np.vstack([data, codec.encode(data)])
+        return store[pg][s]
+
+    sup = rec.SupervisedRecovery(
+        codec, chaos, config=Config(env={}),
+        journal=journal, health=health, op_tracker=op_tracker,
+    )
+    return sup.run(m_prev, 1, read_shard)
+
+
+def test_supervised_run_correlated_observability():
+    clock = rec.VirtualClock()
+    spec = SLOSpec(min_availability_fraction=0.5)
+    journal = EventJournal(clock=clock.now, trace_id="sup", wall=lambda: 0.0)
+    health = HealthTimeline(
+        clock.now, k=4, sample_status=spec.sample_status
+    )
+    tracker = OpTracker(history_size=64, clock=clock.now)
+    res = _flap_run(journal=journal, health=health, op_tracker=tracker)
+    assert res.converged
+
+    # the health series cycles clean -> flapped -> clean: the streaming
+    # SLO check transitions OK -> WARN -> OK
+    seq = [s.health for s in health.samples]
+    assert seq[0] == HEALTH_OK and seq[-1] == HEALTH_OK
+    assert HEALTH_WARN in seq
+    i = seq.index(HEALTH_WARN)
+    assert HEALTH_OK in seq[i:]
+    assert evaluate(health, spec).status == HEALTH_OK
+    # every observed epoch got a sample; samples line up with epochs
+    assert [s.epoch for s in health.samples] == sorted(
+        {s.epoch for s in health.samples}
+    )
+
+    # the journal carries the phase spans, launch events, and the chaos
+    # injections under one trace id
+    names = {r["name"] for r in journal.records}
+    assert {"recovery.peer", "recovery.revise",
+            "decode.launch", "chaos.inject"} <= names
+    assert len(journal.by_name("chaos.inject")) == 2 * 2  # down+up per cycle
+    assert len(journal.by_name("decode.launch")) == res.launches
+    assert len(journal.by_name("recovery.revise")) == res.plan_revisions
+    assert all(r["trace_id"] == "sup" for r in journal.records)
+
+    # tracked ops ran on the virtual clock: every decode op's duration
+    # is an exact multiple of the 0.5s launch window, no wall time
+    ops = tracker.dump_historic_ops()["ops"]
+    assert len(ops) == res.launches
+    assert all(op["description"].startswith("decode:0x") for op in ops)
+    assert all(
+        (op["duration"] / 0.5) == int(op["duration"] / 0.5) for op in ops
+    )
+    assert all(
+        e["event"] in ("dispatched", "committed") or
+        e["event"].startswith(("retry", "stale", "failed"))
+        for op in ops for e in op["events"]
+    )
+
+
+def test_supervised_run_journal_is_deterministic():
+    records = []
+    for _ in range(2):
+        clock = rec.VirtualClock()
+        journal = EventJournal(
+            clock=clock.now, trace_id="det", wall=lambda: 0.0
+        )
+        _flap_run(journal=journal)
+        records.append(journal.records)
+    assert records[0] == records[1]
+
+
+# ---- status surface --------------------------------------------------
+
+
+def test_status_dict_and_render():
+    spec = SLOSpec(max_inactive_seconds=10.0)
+    tl = _timeline_with_outage()
+    d = status_dict(tl, spec)
+    assert d["pgmap"]["total_pgs"] == 2
+    assert d["health"]["status"] == evaluate(tl, spec).status
+    text = render_status(d)
+    assert "health:" in text and "pgs: 2" in text
+    assert "SLO_INACTIVE" in text
+    # empty timeline renders too
+    empty = status_dict(HealthTimeline(rec.VirtualClock().now))
+    assert empty["health"]["status"] == HEALTH_OK
+    assert "pgs: 0" in render_status(empty)
+
+
+def test_status_admin_socket_trio(tmp_path):
+    from ceph_tpu.common.admin_socket import AdminSocket, ask
+
+    spec = SLOSpec(max_inactive_seconds=10.0)
+    tl = _timeline_with_outage()
+    clock = rec.VirtualClock()
+    journal = EventJournal(clock=clock.now, trace_id="asok")
+    journal.event("ping", n=1)
+    a = AdminSocket(str(tmp_path / "asok"), Config(env={}))
+    register_admin_hooks(a, tl, spec, journal=journal)
+    a.start()
+    try:
+        path = str(tmp_path / "asok")
+        st = ask(path, "status")
+        assert st["pgmap"]["total_pgs"] == 2 and st["samples"] == 3
+        health = ask(path, "health")
+        assert health["status"] in (HEALTH_OK, HEALTH_WARN, HEALTH_ERR)
+        assert "SLO_INACTIVE" in health["checks"]
+        series = ask(path, "timeline")["series"]
+        assert [s["epoch"] for s in series] == [2, 3, 4]
+        recs = ask(path, "journal dump")["records"]
+        assert recs[0]["name"] == "ping"
+        # the trio shows up in help alongside the default hooks
+        cmds = ask(path, "help")["commands"]
+        assert {"status", "health", "timeline", "perf dump",
+                "perf schema", "perf reset"} <= set(cmds)
+    finally:
+        a.stop()
+
+
+# ---- perf counters: typing, reset, schema (satellites) ---------------
+
+
+def test_perf_counter_type_asserts():
+    pc = (
+        PerfCountersBuilder("obs_assert_test")
+        .add_u64_counter("ops")
+        .add_gauge("level")
+        .create_perf_counters()
+    )
+    pc.inc("ops")
+    pc.set("level", 5)
+    with pytest.raises(AssertionError):
+        pc.inc("level")  # gauge: must use set/dec
+    with pytest.raises(AssertionError):
+        pc.set("ops", 9)  # monotonic counter: must use inc
+
+
+def test_perf_counter_reset_and_schema():
+    pc = (
+        PerfCountersBuilder("obs_reset_test")
+        .add_u64_counter("ops", "operations")
+        .add_gauge("level", "current level")
+        .add_time_avg("lat", "latency")
+        .create_perf_counters()
+    )
+    pc.inc("ops", 3)
+    pc.set("level", 2)
+    pc.tinc("lat", 0.5)
+    pc.reset()
+    d = pc.dump()["obs_reset_test"]
+    assert d["ops"] == 0 and d["level"] == 0
+    assert d["lat"] == {"avgcount": 0, "sum": 0.0, "avgtime": 0.0}
+    schema = registry().schema()["obs_reset_test"]
+    assert schema["ops"] == {"type": "u64", "desc": "operations"}
+    assert schema["level"]["type"] == "gauge"
+    assert schema["lat"]["type"] == "time_avg"
+    # registry-wide reset covers every component
+    pc.inc("ops")
+    registry().reset()
+    assert pc.dump()["obs_reset_test"]["ops"] == 0
+
+
+def test_admin_socket_perf_reset(tmp_path):
+    from ceph_tpu.common.admin_socket import AdminSocket, ask
+
+    pc = (
+        PerfCountersBuilder("obs_asok_reset")
+        .add_u64_counter("hits", "hook hits")
+        .create_perf_counters()
+    )
+    pc.inc("hits", 7)
+    a = AdminSocket(str(tmp_path / "asok"), Config(env={}))
+    a.start()
+    try:
+        path = str(tmp_path / "asok")
+        assert ask(path, "perf dump")["obs_asok_reset"]["hits"] == 7
+        schema = ask(path, "perf schema")["obs_asok_reset"]
+        assert schema["hits"] == {"type": "u64", "desc": "hook hits"}
+        assert ask(path, "perf reset")["success"] == "reset"
+        assert ask(path, "perf dump")["obs_asok_reset"]["hits"] == 0
+    finally:
+        a.stop()
+
+
+# ---- op tracker (original coverage + virtual clock satellite) --------
 
 
 def test_op_tracker_lifecycle():
@@ -38,7 +570,6 @@ def test_op_tracker_in_flight_and_history_bound():
 
 def test_op_tracker_admin_hooks(tmp_path):
     from ceph_tpu.common.admin_socket import AdminSocket, ask
-    from ceph_tpu.common.config import Config
 
     t = OpTracker()
     a = AdminSocket(str(tmp_path / "asok"), Config(env={}))
@@ -52,20 +583,56 @@ def test_op_tracker_admin_hooks(tmp_path):
         a.stop()
 
 
+def test_op_tracker_virtual_clock_is_deterministic():
+    """On a VirtualClock the op dump carries exact virtual timestamps —
+    two identical runs dump identical JSON (no wall time leaks in)."""
+    dumps = []
+    for _ in range(2):
+        clock = rec.VirtualClock()
+        t = OpTracker(history_size=8, slow_op_threshold=2.0, clock=clock.now)
+        op = t.create_op("op_a")
+        clock.advance(0.5)
+        op.mark_event("half")
+        clock.advance(0.5)
+        op.finish()
+        with t.create_op("op_b"):
+            clock.advance(3.0)  # slow on the virtual clock
+        dumps.append(
+            (t.dump_historic_ops(), t.dump_historic_slow_ops())
+        )
+    assert dumps[0] == dumps[1]
+    hist, slow = dumps[0]
+    assert hist["ops"][0]["duration"] == 1.0
+    assert hist["ops"][0]["events"] == [{"time": 0.5, "event": "half"}]
+    assert slow["ops"][0]["description"] == "op_b"
+    assert slow["ops"][0]["duration"] == 3.0
+
+
+# ---- prometheus (satellite: counter typing + HELP) -------------------
+
+
 def test_prometheus_render():
     pc = (
         PerfCountersBuilder("prom_test")
-        .add_u64_counter("widgets")
-        .add_time_avg("lat")
+        .add_u64_counter("widgets", "widgets made")
+        .add_gauge("depth")
+        .add_time_avg("lat", "op latency")
         .create_perf_counters()
     )
     pc.inc("widgets", 3)
+    pc.set("depth", 2)
     with timed_block(pc, "lat"):
         pass
     text = render()
     assert "ceph_tpu_prom_test_widgets 3" in text
     assert "ceph_tpu_prom_test_lat_count 1" in text
-    assert "# TYPE ceph_tpu_prom_test_widgets gauge" in text
+    # monotonic u64s are counters (the rate()-able kind), gauges stay
+    # gauges, and desc surfaces as HELP
+    assert "# TYPE ceph_tpu_prom_test_widgets counter" in text
+    assert "# HELP ceph_tpu_prom_test_widgets widgets made" in text
+    assert "# TYPE ceph_tpu_prom_test_depth gauge" in text
+    assert "# TYPE ceph_tpu_prom_test_lat_sum counter" in text
+    assert "# HELP ceph_tpu_prom_test_lat_sum op latency" in text
 
 
 def test_prometheus_textfile(tmp_path):
@@ -74,3 +641,150 @@ def test_prometheus_textfile(tmp_path):
     path = tmp_path / "metrics.prom"
     write_textfile(str(path))
     assert path.exists() and path.read_text().endswith("\n")
+
+
+# ---- two-process (multihost) tier ------------------------------------
+
+
+_CHILD_OBS = r"""
+import copy, json, sys
+import numpy as np
+from ceph_tpu.parallel import multihost
+
+rank = int(sys.argv[1])
+multihost.init(coordinator=sys.argv[2], num_processes=2, process_id=rank)
+import jax
+assert jax.process_count() == 2, jax.process_count()
+
+from ceph_tpu import recovery as rec
+from ceph_tpu.common.config import Config
+from ceph_tpu.ec import gf
+from ceph_tpu.ec.backend import MatrixCodec
+from ceph_tpu.models.clusters import build_osdmap
+from ceph_tpu.obs import EventJournal, HealthTimeline, SLOSpec, evaluate
+
+mesh = multihost.global_mesh(axis="pgs")
+k, m_par = 4, 2
+m = build_osdmap(64, pg_num=32, size=k + m_par, pool_kind="erasure")
+m_prev = copy.deepcopy(m)
+clock = rec.VirtualClock()
+journal = EventJournal(clock=clock.now, trace_id="obs2", wall=lambda: 0.0)
+chaos = rec.ChaosEngine(
+    m, rec.build_scenario("flap", m, cycles=3), clock=clock,
+    journal=journal,
+)
+codec = MatrixCodec(gf.vandermonde_matrix(k, m_par))
+spec = SLOSpec(
+    max_inactive_seconds=5.0,
+    min_availability_fraction=0.5,
+    max_time_to_zero_degraded_s=30.0,
+)
+timeline = HealthTimeline(
+    clock.now, k=k, mesh=mesh, sample_status=spec.sample_status
+)
+rng = np.random.default_rng(3)
+store = {}
+
+def read_shard(pg, s):
+    if pg not in store:
+        data = rng.integers(0, 256, (k, 64), dtype=np.uint8)
+        store[pg] = np.vstack([data, codec.encode(data)])
+    return store[pg][s]
+
+sup = rec.SupervisedRecovery(
+    codec, chaos, config=Config(env={}), journal=journal,
+    health=timeline,
+)
+res = sup.run(m_prev, 1, read_shard)
+report = evaluate(timeline, spec)
+print("CHILD_RESULT " + json.dumps({
+    "rank": rank,
+    "series": timeline.series(),
+    "health_seq": [s.health for s in timeline.samples],
+    "status": report.status,
+    "converged": bool(res.converged),
+    "journal_names": sorted({r["name"] for r in journal.records}),
+}), flush=True)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_pair(child_src: str) -> dict:
+    """Launch two ranks of ``child_src``, return rank -> CHILD_RESULT."""
+    from ceph_tpu.common.hermetic import scrubbed_env
+
+    coord = f"127.0.0.1:{_free_port()}"
+    env = scrubbed_env(_REPO, n_devices=4)
+    # file-backed output: PIPE could deadlock the collective if one
+    # child fills its pipe while the other blocks in a psum
+    import tempfile
+
+    outs = []
+    with tempfile.TemporaryDirectory() as td:
+        files = [open(os.path.join(td, f"r{r}.out"), "w+") for r in (0, 1)]
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", child_src, str(rank), coord],
+                env=env,
+                cwd=_REPO,
+                stdout=files[rank],
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+            for rank in range(2)
+        ]
+        rcs = []
+        try:
+            for p in procs:
+                rcs.append(p.wait(timeout=300))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            for f in files:
+                f.seek(0)
+                outs.append(f.read())
+                f.close()
+            if rcs != [0, 0]:
+                print("child logs:\n" + "\n".join(o[-2000:] for o in outs))
+        assert rcs == [0, 0], f"children failed {rcs}"
+
+    recs = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("CHILD_RESULT "):
+                d = json.loads(line[len("CHILD_RESULT "):])
+                recs[d["rank"]] = d
+    assert set(recs) == {0, 1}
+    return recs
+
+
+@pytest.mark.slow
+def test_two_process_identical_health_series_with_slo_transition():
+    """Two OS processes, one 8-device global mesh: both ranks record the
+    identical psum-aggregated HealthTimeline series through a chaos
+    flap, the streaming SLO check transitions OK -> WARN -> OK
+    mid-repair, and the final verdict is HEALTH_OK on both."""
+    recs = _run_pair(_CHILD_OBS)
+    r0, r1 = recs[0], recs[1]
+    assert r0["series"] == r1["series"]
+    assert r0["health_seq"] == r1["health_seq"]
+    seq = r0["health_seq"]
+    assert seq[0] == HEALTH_OK and seq[-1] == HEALTH_OK
+    i = seq.index(HEALTH_WARN)  # the flap degrades the pool...
+    assert HEALTH_OK in seq[i:]  # ...and repair drains it back to OK
+    assert r0["status"] == r1["status"] == HEALTH_OK
+    assert r0["converged"] and r1["converged"]
+    assert "chaos.inject" in r0["journal_names"]
+    assert "decode.launch" in r0["journal_names"]
+    # the series is a real curve, not a constant: the degraded count
+    # moves and returns to zero
+    undersized = r0["series"]["undersized"]
+    assert max(undersized) > 0 and undersized[-1] == 0
