@@ -27,6 +27,14 @@ count, time-to-zero-degraded) — the guard surface for
 ``decide_defaults`` (a regression that starts retrying or re-planning
 more under the same seeded timeline is a robustness bug even when the
 decode rate looks fine).
+
+``--traffic`` adds a third pass: the same seeded chaos timeline with a
+:class:`ceph_tpu.workload.TrafficEngine` routing a client op batch at
+every health sample, run twice — with and without the mclock QoS
+arbiter — and closed by an induced capacity overload on the converged
+cluster.  The ``traffic_*`` fields carry the wall-clock routing
+throughput, the worst per-sample p99 under each policy, outcome
+fractions, the slow-op SLO verdicts, and the per-class QoS grants.
 """
 
 import json
@@ -233,6 +241,188 @@ def run_chaos(scenario: str) -> dict:
     return build_chaos_record(scenario, res, timeline, report)
 
 
+#: foreground-traffic pass tuning (virtual-time QoS figures)
+TRAFFIC_OPS = 65536
+TRAFFIC_OP_BYTES = 64
+TRAFFIC_SERVICE_MS = 0.5
+TRAFFIC_OSD_CAP_OPS = 6000.0
+TRAFFIC_REC_CAP_BPS = 4e6  # repair bandwidth that saturates the fabric
+TRAFFIC_ARBITER_CAP_BPS = 8e6
+TRAFFIC_SLOW_MS = 10.0
+OVERLOAD_FACTOR = 40.0
+OVERLOAD_START_S = 3.0  # after convergence
+OVERLOAD_END_S = 6.0
+POST_STEPS = 10  # 1 s post-convergence pure-traffic steps
+TRAFFIC_SLO = dict(
+    max_p99_latency_ms=8.0,
+    max_slow_op_fraction=0.02,
+)
+
+
+def build_traffic_record(
+    scenario: str,
+    res_arb,
+    res_noarb,
+    eng_arb,
+    eng_noarb,
+    timeline,
+    report,
+    qos: dict,
+) -> dict:
+    """The ``traffic_*`` JSON fields (pure: schema-tested without
+    running the bench).  ``res_*`` are SupervisedResults and ``eng_*``
+    TrafficEngines from the arbiter / no-arbiter passes; ``timeline``
+    and ``report`` come from the arbiter pass; ``qos`` is the
+    arbiter's per-class summary."""
+    def recovery_p99(eng) -> float:
+        # the pre-overload samples: where QoS policy, not the induced
+        # incident, sets the tail
+        rec = eng.samples[:max(len(eng.samples) - POST_STEPS, 0)]
+        return max((t.p99_ms for t in rec), default=0.0)
+
+    s = eng_arb.summary()
+    return {
+        "traffic_scenario": scenario,
+        "traffic_ops": s["ops"],
+        "traffic_ops_per_sec": s["ops_per_sec_wall"],
+        "traffic_p99_ms": round(timeline.max_traffic_p99_ms(), 6),
+        "traffic_recovery_p99_ms": round(recovery_p99(eng_arb), 6),
+        "traffic_recovery_p99_ms_no_arbiter": round(
+            recovery_p99(eng_noarb), 6
+        ),
+        "traffic_degraded_fraction": s["degraded_fraction"],
+        "traffic_blocked_fraction": s["blocked_fraction"],
+        "traffic_slow_ops": s["slow_ops"],
+        "traffic_slow_fraction": round(s["slow_ops"] / max(s["ops"], 1), 9),
+        "traffic_health_status": report.status,
+        "traffic_slo_checks": {c.name: c.status for c in report.checks},
+        "traffic_health_series": timeline.series(),
+        "traffic_time_to_zero_degraded_s": round(
+            res_arb.time_to_zero_degraded_s, 6
+        ),
+        "traffic_time_to_zero_degraded_s_no_arbiter": round(
+            res_noarb.time_to_zero_degraded_s, 6
+        ),
+        "traffic_qos": qos,
+    }
+
+
+def _traffic_pass(scenario: str, use_arbiter: bool):
+    """One seeded chaos run with a traffic engine riding every health
+    sample; with ``use_arbiter`` the mclock arbiter gates both classes.
+    After convergence, a capacity overload is induced on the clean
+    cluster so the slow-op SLO grades an OK -> WARN -> OK incident."""
+    import copy
+
+    from ceph_tpu import recovery as rec
+    from ceph_tpu.common.config import Config
+    from ceph_tpu.ec.backend import MatrixCodec
+    from ceph_tpu.ec.gf import vandermonde_matrix
+    from ceph_tpu.models.clusters import build_osdmap
+    from ceph_tpu.obs import EventJournal, HealthTimeline, SLOSpec, evaluate
+    from ceph_tpu.workload import MClockArbiter, TrafficEngine
+
+    m = build_osdmap(N_OSDS, pg_num=PG_NUM, size=K + M, pool_kind="erasure")
+    m_prev = copy.deepcopy(m)
+    clock = rec.VirtualClock()
+    journal = EventJournal(
+        clock=clock.now, trace_id=f"bench6-traffic-{scenario}"
+    )
+    chaos = rec.ChaosEngine(
+        m, rec.build_scenario(scenario, m), clock=clock, journal=journal
+    )
+    codec = MatrixCodec(vandermonde_matrix(K, M))
+    spec = SLOSpec(**TRAFFIC_SLO)
+    timeline = HealthTimeline(
+        clock.now, k=K, sample_status=spec.sample_status
+    )
+    arbiter = None
+    if use_arbiter:
+        cfg = Config()
+        cfg.set("osd_mclock_client_res_bps", TRAFFIC_ARBITER_CAP_BPS / 2)
+        cfg.set("osd_mclock_recovery_res_bps", TRAFFIC_ARBITER_CAP_BPS / 8)
+        cfg.set("osd_mclock_recovery_lim_bps", TRAFFIC_ARBITER_CAP_BPS / 4)
+        arbiter = MClockArbiter.from_config(
+            TRAFFIC_ARBITER_CAP_BPS, cfg,
+            clock=clock.now, sleep=clock.sleep,
+        )
+    traffic = TrafficEngine(
+        clock.now, N_OSDS, PG_NUM, K, K + M, K + 1,
+        ops_per_step=TRAFFIC_OPS,
+        service_ms=TRAFFIC_SERVICE_MS,
+        osd_capacity_ops_per_s=TRAFFIC_OSD_CAP_OPS,
+        recovery_capacity_bps=TRAFFIC_REC_CAP_BPS,
+        op_bytes=TRAFFIC_OP_BYTES,
+        slow_ms=TRAFFIC_SLOW_MS,
+        seed=6,
+        arbiter=arbiter,
+        journal=journal,
+    )
+    rng = np.random.default_rng(6)
+    chunks: dict[tuple[int, int], np.ndarray] = {}
+
+    def read_shard(pg, s):
+        key = (int(pg), int(s))
+        if key not in chunks:
+            chunks[key] = rng.integers(0, 256, CHAOS_CHUNK, dtype=np.uint8)
+        return chunks[key]
+
+    sup = rec.SupervisedRecovery(
+        codec, chaos, seed=0, journal=journal, health=timeline,
+        traffic=traffic, arbiter=arbiter,
+    )
+    res = sup.run(m_prev, 1, read_shard)
+    # induced overload on the converged (clean) cluster: the health
+    # grade during these samples is traffic's alone, so the series
+    # must read OK -> WARN -> OK around the window
+    clean = rec.peer_pool(chaos.osdmap, chaos.osdmap, 1)
+    t0 = clock.now()
+    traffic.set_overload(
+        t0 + OVERLOAD_START_S, t0 + OVERLOAD_END_S, OVERLOAD_FACTOR
+    )
+    for _ in range(POST_STEPS):
+        clock.advance(1.0)
+        sample = traffic.observe(
+            clean, epoch=chaos.epoch, bytes_recovered=res.bytes_recovered
+        )
+        timeline.snapshot(
+            clean, epoch=chaos.epoch,
+            bytes_recovered=res.bytes_recovered, traffic=sample,
+        )
+    report = evaluate(timeline, spec)
+    return res, traffic, timeline, report, arbiter
+
+
+def run_traffic(scenario: str) -> dict:
+    """Foreground-traffic pass -> ``traffic_*`` JSON fields: the same
+    seeded chaos timeline run twice — once with the mclock arbiter,
+    once without — so the line carries the p99 and time-to-zero
+    deltas the QoS policy is supposed to buy."""
+    res_no, eng_no, _tl_no, _rep_no, _ = _traffic_pass(scenario, False)
+    res_arb, eng_arb, timeline, report, arbiter = _traffic_pass(
+        scenario, True
+    )
+    healths = [
+        s.health for s in timeline.samples if s.traffic is not None
+    ][-POST_STEPS:]
+    print(
+        f"traffic {scenario}: {eng_arb.total_ops} ops at "
+        f"{eng_arb.ops_per_sec_wall:,.0f} op/s wall; "
+        f"recovery-phase p99 "
+        f"{max((t.p99_ms for t in eng_arb.samples[:-POST_STEPS]), default=0.0):.2f} ms "
+        f"with arbiter vs "
+        f"{max((t.p99_ms for t in eng_no.samples[:-POST_STEPS]), default=0.0):.2f} ms "
+        f"without; t_zero_degraded {res_arb.time_to_zero_degraded_s:g}s "
+        f"vs {res_no.time_to_zero_degraded_s:g}s; "
+        f"overload healths {healths}; SLO {report.status}",
+        file=sys.stderr,
+    )
+    return build_traffic_record(
+        scenario, res_arb, res_no, eng_arb, eng_no, timeline, report,
+        arbiter.summary(),
+    )
+
+
 def main() -> None:
     from ceph_tpu.common.compile_cache import enable_persistent_cache
 
@@ -317,6 +507,9 @@ def main() -> None:
     if "--chaos" in sys.argv:
         scenario = sys.argv[sys.argv.index("--chaos") + 1]
     chaos_fields = run_chaos(scenario)
+    traffic_fields = (
+        run_traffic(scenario) if "--traffic" in sys.argv else {}
+    )
 
     import jax
 
@@ -330,6 +523,7 @@ def main() -> None:
         "n_compiles_first": warm["n_compiles"],
         "host_transfers": guard.host_transfers,
         **chaos_fields,
+        **traffic_fields,
     }))
 
 

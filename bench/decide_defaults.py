@@ -72,6 +72,21 @@ CHAOS_SLO_FLOAT_FIELDS = ("chaos_availability_fraction",
                           "chaos_inactive_seconds")
 CHAOS_SLO_STR_FIELDS = ("chaos_health_status",)
 
+# Foreground-traffic fields (config6_recovery --traffic): the seeded
+# client-workload pass's real-op verdicts.  The fractions and p99s are
+# exact under the virtual clock (same discipline as the chaos
+# counters); ops/s is the wall-clock routing throughput and rides
+# along as a trend metric.
+TRAFFIC_FLOAT_FIELDS = ("traffic_ops_per_sec", "traffic_p99_ms",
+                        "traffic_recovery_p99_ms",
+                        "traffic_recovery_p99_ms_no_arbiter",
+                        "traffic_degraded_fraction",
+                        "traffic_blocked_fraction",
+                        "traffic_slow_fraction",
+                        "traffic_time_to_zero_degraded_s",
+                        "traffic_time_to_zero_degraded_s_no_arbiter")
+TRAFFIC_STR_FIELDS = ("traffic_health_status",)
+
 # Multichip recovery counters (config6_recovery --multichip): the
 # device count the rate was measured on, how many launches actually
 # routed through the mesh-sharded step, and the psum-reduced byte/
@@ -144,6 +159,12 @@ def harvest_guard(paths: list[str]) -> dict[str, dict]:
             )
             fields.update(
                 {f: str(d[f]) for f in CHAOS_SLO_STR_FIELDS if f in d}
+            )
+            fields.update(
+                {f: float(d[f]) for f in TRAFFIC_FLOAT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: str(d[f]) for f in TRAFFIC_STR_FIELDS if f in d}
             )
             fields.update(
                 {f: int(d[f]) for f in MULTICHIP_GUARD_FIELDS if f in d}

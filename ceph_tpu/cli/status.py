@@ -46,11 +46,17 @@ def _render(cmd: str, reply: dict, as_json: bool, out) -> None:
             states = " ".join(
                 f"{n}={c}" for n, c in s["pgs"].items() if c
             )
+            tr = s.get("traffic")
+            io = (
+                f" p99={tr['p99_ms']:g}ms "
+                f"blocked={tr['blocked_fraction']:.4f}"
+                if tr else ""
+            )
             print(
                 f"t={s['t']:g} epoch={s['epoch']} {s['health']} "
                 f"avail={s['availability']:.4f} "
                 f"degraded_objs={s['degraded_objects']} "
-                f"bw={s['repair_bandwidth_bps']:.0f}B/s  {states}",
+                f"bw={s['repair_bandwidth_bps']:.0f}B/s{io}  {states}",
                 file=out,
             )
     else:  # journal
@@ -101,10 +107,29 @@ def _demo(args, out) -> tuple[dict, dict]:
         max_inactive_seconds=args.max_inactive_seconds,
         min_availability_fraction=args.min_availability,
         max_time_to_zero_degraded_s=args.max_recovery_seconds,
+        max_p99_latency_ms=args.max_p99_ms if args.traffic else None,
+        max_slow_op_fraction=(
+            args.max_slow_fraction if args.traffic else None
+        ),
     )
     timeline = HealthTimeline(
         clock.now, k=args.ec_k, sample_status=spec.sample_status
     )
+    traffic = None
+    if args.traffic:
+        from ..workload import TrafficEngine
+
+        traffic = TrafficEngine(
+            clock.now,
+            args.num_osd,
+            args.pg_num,
+            args.ec_k,
+            args.ec_k + args.ec_m,
+            args.ec_k + 1,
+            ops_per_step=args.ops_per_step,
+            seed=args.seed,
+            journal=journal,
+        )
     codec = MatrixCodec(vandermonde_matrix(args.ec_k, args.ec_m))
     rng = np.random.default_rng(args.seed)
     chunks: dict[tuple[int, int], np.ndarray] = {}
@@ -116,7 +141,8 @@ def _demo(args, out) -> tuple[dict, dict]:
         return chunks[key]
 
     sup = SupervisedRecovery(
-        codec, chaos, seed=args.seed, journal=journal, health=timeline
+        codec, chaos, seed=args.seed, journal=journal, health=timeline,
+        traffic=traffic,
     )
     res = sup.run(m_prev, 1, read_shard)
     journal.close()
@@ -157,6 +183,13 @@ def main(argv=None) -> int:
     p.add_argument("--max-inactive-seconds", type=float, default=30.0)
     p.add_argument("--min-availability", type=float, default=0.75)
     p.add_argument("--max-recovery-seconds", type=float, default=30.0)
+    p.add_argument("--traffic", action="store_true",
+                   help="ride a client-traffic engine on the demo run: "
+                        "per-sample latency percentiles, outcome "
+                        "fractions, and the client-io panel")
+    p.add_argument("--ops-per-step", type=int, default=65536)
+    p.add_argument("--max-p99-ms", type=float, default=50.0)
+    p.add_argument("--max-slow-fraction", type=float, default=0.02)
     args = p.parse_args(argv)
     out = sys.stdout
 

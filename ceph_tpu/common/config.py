@@ -124,6 +124,34 @@ SCHEMA: list[Option] = [
            "supervised scheduling window when a mesh is attached "
            "(async launches round-robined over local devices); 1 "
            "serializes launches as before", min=1),
+    Option("osd_op_complaint_time", OPT_FLOAT, 30.0, LEVEL_ADVANCED,
+           "an op in flight (or completed) at least this old (seconds) "
+           "is a slow op: counted, kept in the slow-op history, and "
+           "surfaced by dump_slow_ops_in_flight / "
+           "dump_historic_slow_ops (reference analog of the same name)",
+           min=0.0),
+    Option("osd_mclock_client_res_bps", OPT_FLOAT, 0.0, LEVEL_ADVANCED,
+           "mclock reservation for client traffic (bytes/s guaranteed); "
+           "0 disables the reservation term",
+           min=0.0, see_also=("osd_mclock_client_wgt",
+                              "osd_mclock_client_lim_bps")),
+    Option("osd_mclock_client_wgt", OPT_FLOAT, 1.0, LEVEL_ADVANCED,
+           "mclock weight for client traffic (relative share of "
+           "capacity past reservations)", min=0.0),
+    Option("osd_mclock_client_lim_bps", OPT_FLOAT, 0.0, LEVEL_ADVANCED,
+           "mclock limit for client traffic (bytes/s hard cap); 0 "
+           "means uncapped", min=0.0),
+    Option("osd_mclock_recovery_res_bps", OPT_FLOAT, 0.0, LEVEL_ADVANCED,
+           "mclock reservation for recovery (bytes/s guaranteed so "
+           "client load can never starve repair); 0 disables",
+           min=0.0, see_also=("osd_mclock_recovery_wgt",
+                              "osd_mclock_recovery_lim_bps")),
+    Option("osd_mclock_recovery_wgt", OPT_FLOAT, 1.0, LEVEL_ADVANCED,
+           "mclock weight for recovery traffic", min=0.0),
+    Option("osd_mclock_recovery_lim_bps", OPT_FLOAT, 0.0, LEVEL_ADVANCED,
+           "mclock limit for recovery (bytes/s hard cap bounding its "
+           "interference with client tail latency); 0 means uncapped",
+           min=0.0),
     Option("osd_max_backfills", OPT_INT, 1, LEVEL_ADVANCED,
            "backfill pattern groups admitted per repair group in the "
            "supervised scheduler (the reference's backfill reservation "

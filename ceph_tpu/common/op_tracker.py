@@ -4,7 +4,14 @@ Parity with the reference's ``src/common/TrackedOp.{h,cc}``: each
 tracked op records named lifecycle events with timestamps; the tracker
 keeps in-flight ops, a bounded history of completed ops, flags slow
 ops, and answers the admin-socket queries ``dump_ops_in_flight`` /
-``dump_historic_ops`` / ``dump_historic_slow_ops``.
+``dump_historic_ops`` / ``dump_historic_slow_ops`` /
+``dump_slow_ops_in_flight``.
+
+The slow threshold is the reference's ``osd_op_complaint_time``
+(:mod:`ceph_tpu.common.config`): a completed op at least that old goes
+to the slow history, and an op still in flight past it is reported as
+slow *now* — the source of the mgr's ``N slow ops, oldest one blocked
+for ...`` line, which the traffic SLO layer grades.
 
 For device work, an op's events typically bracket trace/compile/
 execute/transfer stages; pair with ``jax.profiler`` for in-kernel
@@ -19,6 +26,8 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable
+
+from .config import Config, global_config
 
 
 @dataclass
@@ -72,11 +81,17 @@ class OpTracker:
     def __init__(
         self,
         history_size: int = 20,
-        slow_op_threshold: float = 1.0,
+        slow_op_threshold: float | None = None,
         clock: Callable[[], float] = time.perf_counter,
+        config: Config | None = None,
     ):
         self.history_size = history_size
-        self.slow_op_threshold = slow_op_threshold
+        # default follows the reference's osd_op_complaint_time option
+        self.slow_op_threshold = float(
+            slow_op_threshold
+            if slow_op_threshold is not None
+            else (config or global_config()).get("osd_op_complaint_time")
+        )
         self.clock = clock
         self._lock = threading.Lock()
         self._in_flight: dict[int, TrackedOp] = {}
@@ -113,9 +128,35 @@ class OpTracker:
             ops = [op.dump() for op in self._slow]
         return {"num_slow_ops_found": self.num_slow, "ops": ops}
 
+    def slow_ops_in_flight(self) -> list[TrackedOp]:
+        """In-flight ops older than the complaint time — slow *right
+        now*, before they ever complete (a blocked op may never)."""
+        now = self.clock()
+        with self._lock:
+            return [
+                op for op in self._in_flight.values()
+                if now - op.start >= self.slow_op_threshold
+            ]
+
+    def dump_slow_ops_in_flight(self) -> dict:
+        """The ``N slow ops, oldest one blocked for X sec`` feed."""
+        slow = self.slow_ops_in_flight()
+        now = self.clock()
+        oldest = max((now - op.start for op in slow), default=0.0)
+        return {
+            "num_slow_ops": len(slow),
+            "complaint_time": self.slow_op_threshold,
+            "oldest_blocked_for": round(oldest, 6),
+            "ops": [op.dump() for op in slow],
+        }
+
     def register_admin_hooks(self, admin) -> None:
         admin.register("dump_ops_in_flight", lambda c: self.dump_ops_in_flight())
         admin.register("dump_historic_ops", lambda c: self.dump_historic_ops())
         admin.register(
             "dump_historic_slow_ops", lambda c: self.dump_historic_slow_ops()
+        )
+        admin.register(
+            "dump_slow_ops_in_flight",
+            lambda c: self.dump_slow_ops_in_flight(),
         )

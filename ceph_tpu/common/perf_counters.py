@@ -13,11 +13,12 @@ from __future__ import annotations
 import json
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 TYPE_U64 = "u64"
 TYPE_GAUGE = "gauge"
 TYPE_TIME_AVG = "time_avg"
+TYPE_HISTOGRAM = "histogram"
 
 
 @dataclass
@@ -29,6 +30,10 @@ class _Counter:
     # time_avg: accumulating sum + count
     total: float = 0.0
     count: int = 0
+    # histogram: finite upper bounds plus one implicit +Inf overflow
+    # slot at the end of bucket_counts
+    buckets: tuple = ()
+    bucket_counts: list = field(default_factory=list)
 
 
 class PerfCounters:
@@ -59,6 +64,43 @@ class PerfCounters:
         )
         c.value = value
 
+    def hobserve(self, name: str, value: float) -> None:
+        """Histogram: drop one observation into its bucket (first
+        upper bound >= value; past the last bound, the +Inf slot)."""
+        c = self._counters[name]
+        assert c.type == TYPE_HISTOGRAM, (
+            f"hobserve() on non-histogram {self.name}.{name} ({c.type})"
+        )
+        with self._lock:
+            i = len(c.buckets)
+            for j, le in enumerate(c.buckets):
+                if value <= le:
+                    i = j
+                    break
+            c.bucket_counts[i] += 1
+            c.total += value
+            c.count += 1
+
+    def hset(self, name: str, counts, total: float | None = None) -> None:
+        """Histogram: wholesale-replace the bucket counts from a
+        device-resident histogram (len(buckets) + 1 entries, the last
+        being the +Inf overflow slot).  ``total`` is the sum of the
+        observed values when known (the Prometheus ``_sum``)."""
+        c = self._counters[name]
+        assert c.type == TYPE_HISTOGRAM, (
+            f"hset() on non-histogram {self.name}.{name} ({c.type})"
+        )
+        counts = [int(v) for v in counts]
+        assert len(counts) == len(c.buckets) + 1, (
+            f"{self.name}.{name}: got {len(counts)} bucket counts, "
+            f"want {len(c.buckets) + 1}"
+        )
+        with self._lock:
+            c.bucket_counts = counts
+            c.count = sum(counts)
+            if total is not None:
+                c.total = float(total)
+
     def tinc(self, name: str, seconds: float) -> None:
         c = self._counters[name]
         assert c.type == TYPE_TIME_AVG
@@ -88,6 +130,8 @@ class PerfCounters:
                 c.value = 0
                 c.total = 0.0
                 c.count = 0
+                if c.type == TYPE_HISTOGRAM:
+                    c.bucket_counts = [0] * (len(c.buckets) + 1)
 
     def counters(self) -> list[_Counter]:
         """The typed counter records (the prometheus renderer reads
@@ -113,6 +157,16 @@ class PerfCounters:
                     "sum": round(c.total, 9),
                     "avgtime": round(c.total / c.count, 9) if c.count else 0.0,
                 }
+            elif c.type == TYPE_HISTOGRAM:
+                out[c.name] = {
+                    "buckets": {
+                        f"{le:g}": n
+                        for le, n in zip(c.buckets, c.bucket_counts)
+                    },
+                    "overflow": c.bucket_counts[-1],
+                    "sum": round(c.total, 9),
+                    "count": c.count,
+                }
             else:
                 out[c.name] = c.value
         return {self.name: out}
@@ -137,6 +191,20 @@ class PerfCountersBuilder:
 
     def add_time_avg(self, name: str, desc: str = "") -> "PerfCountersBuilder":
         self._pc._add(name, TYPE_TIME_AVG, desc)
+        return self
+
+    def add_histogram(
+        self, name: str, desc: str = "", buckets=()
+    ) -> "PerfCountersBuilder":
+        """``buckets`` are the finite upper bounds (``le`` values),
+        strictly increasing; one +Inf overflow slot is implicit."""
+        self._pc._add(name, TYPE_HISTOGRAM, desc)
+        c = self._pc._counters[name]
+        c.buckets = tuple(float(b) for b in buckets)
+        assert all(
+            a < b for a, b in zip(c.buckets, c.buckets[1:])
+        ), f"histogram {name}: bucket bounds must be increasing"
+        c.bucket_counts = [0] * (len(c.buckets) + 1)
         return self
 
     def create_perf_counters(self) -> PerfCounters:

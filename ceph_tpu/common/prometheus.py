@@ -10,7 +10,12 @@ from __future__ import annotations
 
 import re
 
-from .perf_counters import TYPE_TIME_AVG, TYPE_U64, registry
+from .perf_counters import (
+    TYPE_HISTOGRAM,
+    TYPE_TIME_AVG,
+    TYPE_U64,
+    registry,
+)
 
 
 def _sanitize(name: str) -> str:
@@ -23,7 +28,11 @@ def render() -> str:
     Counter types carry through from the registry: monotonic ``u64``
     counters emit ``# TYPE ... counter`` (Prometheus semantics — a
     ``rate()`` over a gauge is meaningless), gauges stay ``gauge``,
-    ``time_avg`` splits into ``_sum``/``_count`` counters; ``desc``
+    ``time_avg`` splits into ``_sum``/``_count`` counters, and
+    ``histogram`` renders natively (``# TYPE ... histogram``:
+    *cumulative* ``_bucket{le="..."}`` series closed by
+    ``le="+Inf"``, plus ``_sum``/``_count``) so latency distributions
+    export as one scrape-able histogram instead of N gauges; ``desc``
     becomes the ``# HELP`` line.
     """
     lines: list[str] = []
@@ -31,7 +40,21 @@ def render() -> str:
         comp = _sanitize(pc.name)
         for c in sorted(pc.counters(), key=lambda c: c.name):
             metric = f"ceph_tpu_{comp}_{_sanitize(c.name)}"
-            if c.type == TYPE_TIME_AVG:
+            if c.type == TYPE_HISTOGRAM:
+                if c.desc:
+                    lines.append(f"# HELP {metric} {c.desc}")
+                lines.append(f"# TYPE {metric} histogram")
+                cum = 0
+                for le, n in zip(c.buckets, c.bucket_counts):
+                    cum += int(n)
+                    lines.append(
+                        f'{metric}_bucket{{le="{le:g}"}} {cum}'
+                    )
+                cum += int(c.bucket_counts[-1])
+                lines.append(f'{metric}_bucket{{le="+Inf"}} {cum}')
+                lines.append(f"{metric}_sum {round(c.total, 9)}")
+                lines.append(f"{metric}_count {c.count}")
+            elif c.type == TYPE_TIME_AVG:
                 for suffix, value in (
                     ("_sum", round(c.total, 9)),
                     ("_count", c.count),

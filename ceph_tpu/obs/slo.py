@@ -42,12 +42,20 @@ class SLOSpec:
     - ``min_repair_bandwidth_bps`` — while degraded PGs remain, the
       inter-sample repair bandwidth must reach this floor at least once
       (arXiv:1412.3022's first-class recovery metric).
+    - ``max_p99_latency_ms`` — ceiling on the per-sample client p99
+      latency estimate, graded on real routed ops when a traffic
+      engine rode the run (``SLO_P99_LATENCY``).
+    - ``max_slow_op_fraction`` — ceiling on the per-sample fraction of
+      client ops past the complaint time (``SLO_SLOW_OPS``, the ``N
+      slow ops`` healthcheck analog).
     """
 
     max_inactive_seconds: float | None = None
     min_availability_fraction: float | None = None
     max_time_to_zero_degraded_s: float | None = None
     min_repair_bandwidth_bps: float | None = None
+    max_p99_latency_ms: float | None = None
+    max_slow_op_fraction: float | None = None
     warn_fraction: float = 0.8
 
     def sample_status(self, sample: HealthSample) -> str:
@@ -61,6 +69,20 @@ class SLOSpec:
             return HEALTH_ERR
         if sample.unhealthy_pgs() > 0:
             return HEALTH_WARN
+        tr = sample.traffic
+        if tr is not None:
+            # traffic breaches grade WARN, like the reference's slow-op
+            # healthchecks: the cluster still serves, it serves badly
+            if (
+                self.max_p99_latency_ms is not None
+                and tr.p99_ms > self.max_p99_latency_ms
+            ):
+                return HEALTH_WARN
+            if (
+                self.max_slow_op_fraction is not None
+                and tr.slow_fraction > self.max_slow_op_fraction
+            ):
+                return HEALTH_WARN
         return HEALTH_OK
 
 
@@ -190,5 +212,31 @@ def evaluate(timeline: HealthTimeline, spec: SLOSpec) -> HealthReport:
         report._add(HealthCheck(
             "SLO_REPAIR_BANDWIDTH", status, detail,
             observed, spec.min_repair_bandwidth_bps,
+        ))
+    traffic = timeline.traffic_samples()
+    if spec.max_p99_latency_ms is not None and traffic:
+        observed = timeline.max_traffic_p99_ms()
+        report._add(HealthCheck(
+            "SLO_P99_LATENCY",
+            _grade_max(
+                observed, spec.max_p99_latency_ms, spec.warn_fraction
+            ),
+            f"worst client p99 {observed:g} ms over "
+            f"{len(traffic)} traffic samples "
+            f"(budget {spec.max_p99_latency_ms:g} ms)",
+            observed, spec.max_p99_latency_ms,
+        ))
+    if spec.max_slow_op_fraction is not None and traffic:
+        observed = timeline.max_slow_op_fraction()
+        slow_total = sum(tr.slow_ops for tr in traffic)
+        report._add(HealthCheck(
+            "SLO_SLOW_OPS",
+            _grade_max(
+                observed, spec.max_slow_op_fraction, spec.warn_fraction
+            ),
+            f"{slow_total} client ops past the complaint time; worst "
+            f"per-sample slow fraction {observed:g} "
+            f"(budget {spec.max_slow_op_fraction:g})",
+            observed, spec.max_slow_op_fraction,
         ))
     return report

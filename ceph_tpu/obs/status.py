@@ -28,7 +28,7 @@ def status_dict(
             "pgmap": {"pgs": {}, "total_pgs": 0},
             "samples": 0,
         }
-    return {
+    out = {
         "health": report or {
             "status": latest.health,
             "checks": {},
@@ -47,6 +47,25 @@ def status_dict(
         "epoch": latest.epoch,
         "samples": len(timeline),
     }
+    # the ``io:`` block — newest traffic sample riding the timeline
+    tr = next(
+        (s.traffic for s in reversed(timeline.samples)
+         if s.traffic is not None),
+        None,
+    )
+    if tr is not None:
+        out["client_io"] = {
+            "ops_per_sec": round(tr.ops_per_sec, 3),
+            "p50_ms": tr.p50_ms,
+            "p95_ms": tr.p95_ms,
+            "p99_ms": tr.p99_ms,
+            "served_fraction": round(tr.served_fraction, 9),
+            "degraded_fraction": round(tr.degraded_fraction, 9),
+            "blocked_fraction": round(tr.blocked_fraction, 9),
+            "slow_ops": tr.slow_ops,
+            "max_osd_utilization": round(tr.max_osd_utilization, 9),
+        }
+    return out
 
 
 def render_status(status: dict) -> str:
@@ -78,6 +97,21 @@ def render_status(status: dict) -> str:
             "    recovery: "
             f"{pgmap['repair_bandwidth_bps']:.0f} B/s"
         )
+    io = status.get("client_io")
+    if io is not None:
+        lines.append("  io:")
+        lines.append(
+            f"    client: {io['ops_per_sec']:.0f} op/s, "
+            f"p50/p95/p99 {io['p50_ms']:g}/{io['p95_ms']:g}/"
+            f"{io['p99_ms']:g} ms"
+        )
+        lines.append(
+            f"    outcomes: {io['served_fraction']:.4f} served, "
+            f"{io['degraded_fraction']:.4f} degraded, "
+            f"{io['blocked_fraction']:.4f} blocked"
+        )
+        if io.get("slow_ops"):
+            lines.append(f"    slow ops: {io['slow_ops']}")
     return "\n".join(lines)
 
 

@@ -54,6 +54,10 @@ class HealthSample:
     repair_bandwidth_bps: float  # since the previous sample
     availability: float  # fraction of PGs able to serve I/O
     health: str = HEALTH_OK  # per-sample status (streaming SLO view)
+    # foreground-traffic sample taken against the same epoch (a
+    # ceph_tpu.workload.TrafficSample), when a traffic engine rode the
+    # run; None for pure-recovery timelines
+    traffic: object | None = None
 
     @property
     def inactive_pgs(self) -> int:
@@ -77,6 +81,9 @@ class HealthSample:
             "repair_bandwidth_bps": round(self.repair_bandwidth_bps, 3),
             "availability": round(self.availability, 9),
             "health": self.health,
+            "traffic": (
+                self.traffic.to_dict() if self.traffic is not None else None
+            ),
         }
 
 
@@ -120,6 +127,7 @@ class HealthTimeline:
         peering: PeeringResult,
         epoch: int | None = None,
         bytes_recovered: int = 0,
+        traffic=None,
     ) -> HealthSample:
         """Record the cluster's health at the current virtual time."""
         hist, aux = self._classifier(peering, self.k)
@@ -148,6 +156,7 @@ class HealthTimeline:
             availability=(
                 1.0 - counts["inactive"] / total if total else 1.0
             ),
+            traffic=traffic,
         )
         sample.health = (
             self.sample_status(sample)
@@ -180,6 +189,27 @@ class HealthTimeline:
         }
         for name in STATE_NAMES:
             cols[name] = [s.counts[name] for s in self.samples]
+        if any(s.traffic is not None for s in self.samples):
+            def _tcol(fn):
+                return [
+                    fn(s.traffic) if s.traffic is not None else None
+                    for s in self.samples
+                ]
+
+            cols["traffic_p50_ms"] = _tcol(lambda tr: tr.p50_ms)
+            cols["traffic_p99_ms"] = _tcol(lambda tr: tr.p99_ms)
+            cols["traffic_served_fraction"] = _tcol(
+                lambda tr: round(tr.served_fraction, 9)
+            )
+            cols["traffic_degraded_fraction"] = _tcol(
+                lambda tr: round(tr.degraded_fraction, 9)
+            )
+            cols["traffic_blocked_fraction"] = _tcol(
+                lambda tr: round(tr.blocked_fraction, 9)
+            )
+            cols["traffic_slow_fraction"] = _tcol(
+                lambda tr: round(tr.slow_fraction, 9)
+            )
         return cols
 
     def to_dicts(self) -> list[dict]:
@@ -191,6 +221,21 @@ class HealthTimeline:
     def min_availability(self) -> float:
         return min(
             (s.availability for s in self.samples), default=1.0
+        )
+
+    def traffic_samples(self) -> list:
+        """The foreground-traffic samples riding this timeline."""
+        return [s.traffic for s in self.samples if s.traffic is not None]
+
+    def max_traffic_p99_ms(self) -> float:
+        return max(
+            (tr.p99_ms for tr in self.traffic_samples()), default=0.0
+        )
+
+    def max_slow_op_fraction(self) -> float:
+        return max(
+            (tr.slow_fraction for tr in self.traffic_samples()),
+            default=0.0,
         )
 
     def inactive_seconds(self) -> float:

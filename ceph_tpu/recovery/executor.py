@@ -219,6 +219,7 @@ class RecoveryExecutor:
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
         mesh=None,
+        arbiter=None,
     ):
         self.codec = codec
         cfg = config or global_config()
@@ -229,6 +230,10 @@ class RecoveryExecutor:
             sleep=sleep,
             max_debt=cfg.get("recovery_max_debt_bytes"),
         )
+        # mclock QoS: when an arbiter is attached, recovery bytes are
+        # admitted through its "recovery" class (reservation/weight/
+        # limit against client traffic) instead of the solo bucket
+        self.arbiter = arbiter
         self.on_decode_launch = on_decode_launch
         self.pc = recovery_counters()
         # one encoder per erasure pattern, reused across runs
@@ -268,7 +273,10 @@ class RecoveryExecutor:
         )
         chunk = src.shape[1] // g.n_pgs
         nbytes = (len(g.rows) + len(g.missing)) * g.n_pgs * chunk
-        if self.throttle.take(nbytes):
+        if self.arbiter is not None:
+            if self.arbiter.request("recovery", nbytes) > 0:
+                self.pc.inc("throttle_waits")
+        elif self.throttle.take(nbytes):
             self.pc.inc("throttle_waits")
         if self.on_decode_launch is not None:
             self.on_decode_launch(g, nbytes)
@@ -503,6 +511,8 @@ class SupervisedRecovery:
         journal=None,
         health=None,
         op_tracker=None,
+        traffic=None,
+        arbiter=None,
     ):
         self.codec = codec
         self.chaos = chaos
@@ -512,10 +522,17 @@ class SupervisedRecovery:
         # phase spans + launch/retry/salvage events, the health timeline
         # snapshots the PG-state histogram at every observed epoch, and
         # the op tracker (on the virtual clock) keeps per-launch
-        # lifecycle dumps — all optional, all no-ops when None
+        # lifecycle dumps — all optional, all no-ops when None.  With a
+        # traffic engine (ceph_tpu.workload.TrafficEngine) attached,
+        # every health snapshot ALSO drives a foreground-traffic step
+        # against the live degraded state and records the resulting
+        # latency/outcome sample; an mclock arbiter makes recovery and
+        # that client traffic share bandwidth under policy.
         self.journal = journal
         self.health = health
         self.op_tracker = op_tracker
+        self.traffic = traffic
+        self.arbiter = arbiter
         self.launch_duration_s = float(launch_duration_s)
         self.max_items = max_items
         self._rng = np.random.default_rng(seed)
@@ -540,6 +557,7 @@ class SupervisedRecovery:
             clock=chaos.clock.now,
             sleep=chaos.clock.sleep,
             mesh=mesh,
+            arbiter=arbiter,
         )
         self.pc = self.ex.pc
 
@@ -553,11 +571,19 @@ class SupervisedRecovery:
         return nullcontext()
 
     def _snapshot(self, peering: PeeringResult, bytes_recovered: int) -> None:
+        sample = None
+        if self.traffic is not None:
+            sample = self.traffic.observe(
+                peering,
+                epoch=self.chaos.epoch,
+                bytes_recovered=bytes_recovered,
+            )
         if self.health is not None:
             self.health.snapshot(
                 peering,
                 epoch=self.chaos.epoch,
                 bytes_recovered=bytes_recovered,
+                traffic=sample,
             )
 
     def _schedule(
@@ -835,6 +861,11 @@ class SupervisedRecovery:
                     op.finish()
             if incs:
                 revise()
+            elif self.traffic is not None:
+                # no epoch advance, but the window still carried client
+                # load: sample traffic every scheduling window so the
+                # series is dense enough to catch transient overload
+                self._snapshot(peering, inner.bytes_recovered)
 
         if self.health is not None:
             last = self.health.latest
@@ -854,6 +885,8 @@ class SupervisedRecovery:
         res.shards_rebuilt = inner.shards_rebuilt
         res.decode_s = inner.decode_s
         res.throttle_wait_s = self.ex.throttle.waited_s
+        if self.arbiter is not None:
+            res.throttle_wait_s += self.arbiter.waited("recovery")
         res.completed_pgs = set(completed)
         res.failed_pgs = sorted(failed)
         res.unrecoverable = unrecoverable

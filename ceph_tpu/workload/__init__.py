@@ -1,0 +1,46 @@
+"""Foreground client traffic: vmapped workload generation, per-op
+outcome classification, device-resident latency percentiles, and the
+mclock QoS arbiter that shares bandwidth between clients and recovery.
+
+- :mod:`~ceph_tpu.workload.traffic` — the device traffic step (route
+  via CRUSH hash -> classify from survivor bitmasks -> queue model ->
+  log-bucket histograms, psum'd under a mesh) and the
+  :class:`TrafficEngine` that drives it per health sample.
+- :mod:`~ceph_tpu.workload.qos` — :class:`MClockArbiter`, the
+  reservation/weight/limit admission gate (dmClock analog).
+- :mod:`~ceph_tpu.workload.histogram` — the log2 bucket ladder and the
+  host-side percentile merge.
+"""
+
+from .histogram import (
+    LAT_MIN_MS,
+    N_BUCKETS,
+    bucket_edges,
+    count_at_least,
+    percentile,
+    percentiles,
+)
+from .qos import MClockArbiter, QoSClass
+from .traffic import (
+    TrafficEngine,
+    TrafficSample,
+    sharded_traffic_step,
+    traffic_step,
+    workload_counters,
+)
+
+__all__ = [
+    "LAT_MIN_MS",
+    "MClockArbiter",
+    "N_BUCKETS",
+    "QoSClass",
+    "TrafficEngine",
+    "TrafficSample",
+    "bucket_edges",
+    "count_at_least",
+    "percentile",
+    "percentiles",
+    "sharded_traffic_step",
+    "traffic_step",
+    "workload_counters",
+]

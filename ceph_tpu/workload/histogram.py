@@ -1,0 +1,85 @@
+"""Device-resident log-bucketed histograms for latency percentiles.
+
+Estimating tail latency over millions of ops per step rules out
+sorting or host round-trips: the device step scatter-adds each op into
+a power-of-two bucket ladder (``edge[i] = lat_min * 2**i``), the
+[n_buckets] count vector is psum'd across the mesh so every rank holds
+the identical distribution, and the host merges counts into
+p50/p95/p99 with one O(n_buckets) pass.  Relative error is bounded by
+the bucket ratio (2x worst case, halved by the in-bucket
+interpolation below) — the same trade HDR-style histograms make.
+
+The ladder doubles as the Prometheus histogram schema: ``edges()``
+are the ``le`` upper bounds the perf-counter registry's
+``TYPE_HISTOGRAM`` renders cumulatively.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+I32 = jnp.int32
+F32 = jnp.float32
+
+#: default ladder: 24 buckets from 0.0625 ms, topping out ~9 minutes
+N_BUCKETS = 24
+LAT_MIN_MS = 0.0625
+
+
+def bucket_edges(
+    n_buckets: int = N_BUCKETS, lat_min: float = LAT_MIN_MS
+) -> np.ndarray:
+    """Upper bounds of the log2 ladder (host float64, ``le`` values)."""
+    return lat_min * np.exp2(np.arange(1, n_buckets + 1, dtype=np.float64))
+
+
+def bucketize(values, n_buckets: int = N_BUCKETS, lat_min: float = LAT_MIN_MS):
+    """Traced: value -> bucket index.  Values at or below ``lat_min``
+    land in bucket 0; anything past the top edge clips into the last
+    bucket (the overflow slot)."""
+    v = jnp.maximum(values.astype(F32), jnp.float32(lat_min))
+    idx = jnp.floor(jnp.log2(v / jnp.float32(lat_min))).astype(I32)
+    return jnp.clip(idx, 0, n_buckets - 1)
+
+
+def scatter_hist(idx, weight, n_buckets: int = N_BUCKETS):
+    """Traced: scatter-add ``weight`` (i32, 0 to drop an op) into the
+    [n_buckets] count vector."""
+    return jnp.zeros(n_buckets, I32).at[idx].add(weight)
+
+
+def percentile(counts: np.ndarray, edges: np.ndarray, q: float) -> float:
+    """Host-side merge: the ``q``-quantile (0..1) of a bucketed
+    distribution, linearly interpolated inside the bucket.  Zero-total
+    histograms report 0.0."""
+    counts = np.asarray(counts, np.int64)
+    total = int(counts.sum())
+    if total == 0:
+        return 0.0
+    rank = q * total
+    cum = np.cumsum(counts)
+    i = int(np.searchsorted(cum, rank, side="left"))
+    i = min(i, len(counts) - 1)
+    lo = float(edges[i - 1]) if i > 0 else float(edges[0]) / 2.0
+    hi = float(edges[i])
+    before = int(cum[i - 1]) if i > 0 else 0
+    inside = int(counts[i])
+    frac = (rank - before) / inside if inside else 1.0
+    return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+
+
+def percentiles(
+    counts: np.ndarray, edges: np.ndarray, qs=(0.5, 0.95, 0.99)
+) -> tuple[float, ...]:
+    return tuple(percentile(counts, edges, q) for q in qs)
+
+
+def count_at_least(counts: np.ndarray, edges: np.ndarray, floor: float) -> int:
+    """Ops in buckets whose *lower* edge is >= ``floor`` — the
+    conservative (never over-counting) slow-op estimate the SLO layer
+    grades."""
+    counts = np.asarray(counts, np.int64)
+    lowers = np.concatenate(([0.0], np.asarray(edges)[:-1]))
+    return int(counts[lowers >= floor].sum())

@@ -1,0 +1,603 @@
+"""Vmapped client workload generator against the live degraded map.
+
+The paper's north star is a cluster *serving* millions of ops/s while
+chaos and recovery run — so health must be judged on what clients
+experience, not a PG-serviceability proxy (arXiv:1709.05365: the
+dominant production cost of online EC is foreground/recovery
+interference).  One device step routes a fixed-shape batch of object
+reads/writes end to end:
+
+- **route**: object id -> ``crush_hash32_2`` -> ``ceph_stable_mod`` ->
+  PG (the client-side ``ceph_object_locator_to_pg``), then a gather
+  against the peering pass's per-PG survivor mask / acting primary —
+  the same compiled CRUSH/OSDMap state recovery works from, at the
+  epoch chaos last touched.
+- **classify**: every op lands in exactly one outcome from the
+  survivor bitmask — *served* (full redundancy), *degraded-served*
+  (readable, but below ``size`` survivors: EC reconstruct on the read
+  path), or *blocked-on-inactive* (reads below ``k`` survivors, writes
+  below ``min_size`` live acting members — the reference stalls both).
+- **queue model**: per-OSD load is scatter-added at the acting primary
+  (reads 1 unit, degraded reads ``k`` — the reconstruct fan-in — and
+  writes ``size``), normalized to per-OSD capacity, plus a uniform
+  recovery-utilization term derived from the observed inter-sample
+  repair bandwidth (rateless-style load accounting, arXiv:1804.10331).
+  Latency is M/D/1-shaped: ``service * amp * (1 + rho/(1-rho))`` with
+  rho clipped below saturation.
+- **aggregate**: outcome counts, latency and queue-depth log-bucket
+  histograms (:mod:`ceph_tpu.workload.histogram`), sums, and the peak
+  OSD utilization — O(n_buckets) outputs regardless of batch size.
+
+Under a mesh the op axis splits across devices (each chip generates
+its id slice from ``axis_index``, exactly the placement-sim recipe)
+and every output is psum'd, so all ranks agree bit-exactly on the
+histograms (asserted by the two-process test).  All per-step inputs
+are traced scalars — chaos epochs, overload windows, and recovery
+interference never retrace the step.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import nullcontext
+from dataclasses import dataclass
+from typing import Callable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..common.config import Config, global_config
+from ..common.perf_counters import PerfCounters, PerfCountersBuilder, registry
+from ..core.hashes import ceph_stable_mod, crush_hash32_2
+from ..parallel.placement import shard_map
+from ..recovery.peering import PeeringResult
+from .histogram import (
+    LAT_MIN_MS,
+    N_BUCKETS,
+    bucket_edges,
+    bucketize,
+    count_at_least,
+    percentiles,
+    scatter_hist,
+)
+from .qos import MClockArbiter
+
+I32 = jnp.int32
+U32 = jnp.uint32
+F32 = jnp.float32
+
+#: clip utilization below saturation so the M/D/1 delay stays finite
+RHO_MAX = 0.97
+
+_SALT2 = np.uint32(0x9E3779B9)  # decorrelates the read/write coin
+
+
+def _traffic_reduce(
+    mask, n_alive, acting_primary, ids, in_range, load_total,
+    salt, pg_b, pg_bmask, k, size, min_size, write_permille,
+    service_ms, cap_ops, rho_recovery, n_buckets, lat_min,
+):
+    """Outcome counts + histograms for one op batch, given the cluster-
+    wide per-OSD load (psum'd by the sharded wrapper)."""
+    pg, prim, is_write, blocked, degraded, _w = _route(
+        mask, n_alive, acting_primary, ids, salt, pg_b, pg_bmask,
+        k, size, min_size, write_permille,
+    )
+    del pg
+    ok = in_range & ~blocked
+    rho = jnp.clip(
+        load_total[prim] / jnp.maximum(cap_ops, jnp.float32(1e-6))
+        + rho_recovery,
+        0.0, RHO_MAX,
+    )
+    qd = rho / (1.0 - rho)
+    amp = jnp.where(degraded & ~is_write, k.astype(F32), jnp.float32(1.0))
+    lat = service_ms * amp * (1.0 + qd)
+    okw = ok.astype(I32)
+    counts = jnp.stack([
+        jnp.sum(jnp.where(ok & ~degraded, 1, 0)),
+        jnp.sum(jnp.where(ok & degraded, 1, 0)),
+        jnp.sum(jnp.where(in_range & blocked, 1, 0)),
+    ]).astype(I32)
+    lat_hist = scatter_hist(
+        bucketize(lat, n_buckets, lat_min), okw, n_buckets
+    )
+    qd_hist = scatter_hist(
+        bucketize(qd, n_buckets, lat_min), okw, n_buckets
+    )
+    sums = jnp.stack([
+        jnp.sum(jnp.where(ok, lat, 0.0)),
+        jnp.sum(jnp.where(ok, qd, 0.0)),
+    ]).astype(F32)
+    max_rho = jnp.max(jnp.where(in_range, rho, 0.0)).astype(F32)
+    return counts, lat_hist, qd_hist, sums, max_rho
+
+
+def _route(
+    mask, n_alive, acting_primary, ids, salt, pg_b, pg_bmask,
+    k, size, min_size, write_permille,
+):
+    """Object ids -> (pg, primary, is_write, blocked, degraded, cost)."""
+    h = crush_hash32_2(ids, salt)
+    pg = ceph_stable_mod(h, pg_b, pg_bmask).astype(I32)
+    coin = crush_hash32_2(h, salt ^ _SALT2)
+    is_write = (coin % jnp.uint32(1000)).astype(I32) < write_permille
+    nsurv = jax.lax.population_count(mask[pg]).astype(I32)
+    alive = n_alive[pg]
+    blocked = jnp.where(is_write, alive < min_size, nsurv < k)
+    degraded = ~blocked & (nsurv < size)
+    # primary-side op cost: a degraded read fans in k shard reads, a
+    # write touches all size slots, a clean read is one unit
+    cost = jnp.where(
+        is_write, size, jnp.where(degraded, k, jnp.int32(1))
+    ).astype(F32)
+    return pg, acting_primary[pg], is_write, blocked, degraded, cost
+
+
+def _scatter_load(
+    mask, n_alive, acting_primary, ids, in_range,
+    salt, pg_b, pg_bmask, k, size, min_size, write_permille, n_osds,
+):
+    """Per-OSD demand from this batch slice (blocked ops never load)."""
+    _pg, prim, _w, blocked, _d, cost = _route(
+        mask, n_alive, acting_primary, ids, salt, pg_b, pg_bmask,
+        k, size, min_size, write_permille,
+    )
+    w = jnp.where(in_range & ~blocked, cost, 0.0)
+    return jnp.zeros(n_osds, F32).at[prim].add(w)
+
+
+def traffic_step(
+    n_ops: int,
+    n_osds: int,
+    n_buckets: int = N_BUCKETS,
+    lat_min: float = LAT_MIN_MS,
+):
+    """Single-device step: ``f(mask, n_alive, acting_primary, salt,
+    pg_b, pg_bmask, k, size, min_size, write_permille, service_ms,
+    cap_ops, rho_recovery) -> (counts [3], lat_hist, qd_hist,
+    sums [2], max_rho)``.  Everything but the shapes is traced."""
+
+    def step(
+        mask, n_alive, acting_primary, salt, pg_b, pg_bmask,
+        k, size, min_size, write_permille,
+        service_ms, cap_ops, rho_recovery,
+    ):
+        ids = jnp.arange(n_ops, dtype=U32)
+        in_range = jnp.ones(n_ops, dtype=bool)
+        load = _scatter_load(
+            mask, n_alive, acting_primary, ids, in_range,
+            salt, pg_b, pg_bmask, k, size, min_size, write_permille,
+            n_osds,
+        )
+        return _traffic_reduce(
+            mask, n_alive, acting_primary, ids, in_range, load,
+            salt, pg_b, pg_bmask, k, size, min_size, write_permille,
+            service_ms, cap_ops, rho_recovery, n_buckets, lat_min,
+        )
+
+    return jax.jit(step)
+
+
+def sharded_traffic_step(
+    mesh: Mesh,
+    ops_per_device: int,
+    n_osds: int,
+    axis: str | None = None,
+    n_buckets: int = N_BUCKETS,
+    lat_min: float = LAT_MIN_MS,
+):
+    """Mesh step: each device generates its op-id slice from
+    ``axis_index`` (no op-axis input to shard), the per-OSD load is
+    psum'd *before* the queue model so every op sees the cluster-wide
+    utilization, and counts/histograms/sums are psum'd so every device
+    — and every rank under multihost — holds identical outputs.
+    ``valid`` masks the padded id tail."""
+    axis = axis or mesh.axis_names[0]
+
+    def local(
+        mask, n_alive, acting_primary, salt, pg_b, pg_bmask,
+        k, size, min_size, write_permille,
+        service_ms, cap_ops, rho_recovery, valid,
+    ):
+        start = jax.lax.axis_index(axis).astype(U32) * jnp.uint32(
+            ops_per_device
+        )
+        ids = start + jnp.arange(ops_per_device, dtype=U32)
+        in_range = ids.astype(I32) < valid
+        load = jax.lax.psum(
+            _scatter_load(
+                mask, n_alive, acting_primary, ids, in_range,
+                salt, pg_b, pg_bmask, k, size, min_size,
+                write_permille, n_osds,
+            ),
+            axis,
+        )
+        counts, lat_hist, qd_hist, sums, max_rho = _traffic_reduce(
+            mask, n_alive, acting_primary, ids, in_range, load,
+            salt, pg_b, pg_bmask, k, size, min_size, write_permille,
+            service_ms, cap_ops, rho_recovery, n_buckets, lat_min,
+        )
+        return (
+            jax.lax.psum(counts, axis),
+            jax.lax.psum(lat_hist, axis),
+            jax.lax.psum(qd_hist, axis),
+            jax.lax.psum(sums, axis),
+            jax.lax.pmax(max_rho, axis),
+        )
+
+    n_in = 14
+    return jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=tuple(P() for _ in range(n_in)),
+            out_specs=tuple(P() for _ in range(5)),
+        )
+    )
+
+
+@dataclass
+class TrafficSample:
+    """One epoch's client-traffic telemetry (host-side)."""
+
+    t: float
+    epoch: int
+    ops: int
+    served: int
+    degraded: int
+    blocked: int
+    p50_ms: float
+    p95_ms: float
+    p99_ms: float
+    mean_ms: float
+    qd_p50: float
+    qd_p99: float
+    slow_ops: int
+    slow_fraction: float
+    max_osd_utilization: float
+    rho_recovery: float
+    ops_per_sec: float  # virtual: completed ops / inter-sample dt
+    ops_per_sec_wall: float  # device throughput of the step itself
+
+    @property
+    def completed(self) -> int:
+        return self.served + self.degraded
+
+    @property
+    def served_fraction(self) -> float:
+        return self.served / self.ops if self.ops else 1.0
+
+    @property
+    def degraded_fraction(self) -> float:
+        return self.degraded / self.ops if self.ops else 0.0
+
+    @property
+    def blocked_fraction(self) -> float:
+        return self.blocked / self.ops if self.ops else 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "t": round(self.t, 9),
+            "epoch": self.epoch,
+            "ops": self.ops,
+            "served": self.served,
+            "degraded": self.degraded,
+            "blocked": self.blocked,
+            "served_fraction": round(self.served_fraction, 9),
+            "degraded_fraction": round(self.degraded_fraction, 9),
+            "blocked_fraction": round(self.blocked_fraction, 9),
+            "p50_ms": round(self.p50_ms, 6),
+            "p95_ms": round(self.p95_ms, 6),
+            "p99_ms": round(self.p99_ms, 6),
+            "mean_ms": round(self.mean_ms, 6),
+            "qd_p50": round(self.qd_p50, 6),
+            "qd_p99": round(self.qd_p99, 6),
+            "slow_ops": self.slow_ops,
+            "slow_fraction": round(self.slow_fraction, 9),
+            "max_osd_utilization": round(self.max_osd_utilization, 6),
+            "rho_recovery": round(self.rho_recovery, 6),
+            "ops_per_sec": round(self.ops_per_sec, 3),
+            "ops_per_sec_wall": round(self.ops_per_sec_wall, 3),
+        }
+
+
+def _build_counters(edges: np.ndarray) -> PerfCounters:
+    return (
+        PerfCountersBuilder("workload")
+        .add_u64_counter("ops_served", "client ops served clean")
+        .add_u64_counter("ops_degraded",
+                         "client ops served from a degraded PG")
+        .add_u64_counter("ops_blocked",
+                         "client ops blocked on an inactive PG")
+        .add_u64_counter("slow_ops",
+                         "ops past the slow-op latency threshold")
+        .add_gauge("p99_ms", "latest per-epoch p99 op latency (ms)")
+        .add_gauge("max_osd_utilization",
+                   "latest peak per-OSD utilization (rho)")
+        .add_histogram("op_latency_ms",
+                       "client op latency distribution (ms)",
+                       [float(e) for e in edges[:-1]])
+        .create_perf_counters()
+    )
+
+
+def workload_counters(edges: np.ndarray | None = None) -> PerfCounters:
+    """The process-wide ``workload`` perf-counter component."""
+    return registry().get("workload") or _build_counters(
+        bucket_edges() if edges is None else edges
+    )
+
+
+class TrafficEngine:
+    """Drive the traffic step per health sample and fold the results
+    into the observability stack.
+
+    One engine owns one compiled step (fixed ``ops_per_step`` batch, so
+    chaos epochs and overload windows never retrace), the virtual
+    clock, the latency ladder, and the cumulative totals.  Call
+    :meth:`observe` with the live peering result at every health
+    snapshot; the returned :class:`TrafficSample` is what
+    :class:`~ceph_tpu.obs.timeline.HealthTimeline` attaches to its
+    sample and the SLO layer grades.
+
+    ``arbiter`` (an :class:`~ceph_tpu.workload.qos.MClockArbiter`)
+    makes client traffic a first-class QoS citizen: each step's bytes
+    are admitted through the ``client`` class before the device launch,
+    sharing policy with recovery.  ``recovery_capacity_bps`` converts
+    observed inter-sample repair bandwidth into the uniform recovery-
+    utilization term; an arbiter that caps recovery bandwidth therefore
+    visibly caps client tail latency.
+
+    ``overload`` (set via :meth:`set_overload`) divides per-OSD
+    capacity by ``factor`` inside a virtual-time window — the induced
+    incident the slow-op SLO must grade OK -> WARN -> OK across.
+    """
+
+    def __init__(
+        self,
+        clock: Callable[[], float],
+        n_osds: int,
+        pg_num: int,
+        k: int,
+        size: int,
+        min_size: int,
+        *,
+        ops_per_step: int = 65536,
+        write_fraction: float = 0.25,
+        service_ms: float = 0.5,
+        osd_capacity_ops_per_s: float | None = None,
+        recovery_capacity_bps: float | None = None,
+        op_bytes: int = 4096,
+        slow_ms: float | None = None,
+        seed: int = 0,
+        mesh: Mesh | None = None,
+        axis: str | None = None,
+        arbiter: MClockArbiter | None = None,
+        journal=None,
+        config: Config | None = None,
+        n_buckets: int = N_BUCKETS,
+        lat_min: float = LAT_MIN_MS,
+    ):
+        cfg = config or global_config()
+        self.clock = clock
+        self.n_osds = int(n_osds)
+        self.pg_num = int(pg_num)
+        self.pg_bmask = (1 << max(int(pg_num) - 1, 1).bit_length()) - 1
+        self.k = int(k)
+        self.size = int(size)
+        self.min_size = int(min_size)
+        self.ops_per_step = int(ops_per_step)
+        self.write_permille = int(round(float(write_fraction) * 1000))
+        self.service_ms = float(service_ms)
+        # default capacity: 2x a uniform spread of one batch per second
+        self.osd_capacity_ops_per_s = float(
+            osd_capacity_ops_per_s
+            if osd_capacity_ops_per_s is not None
+            else 2.0 * self.ops_per_step / self.n_osds
+        )
+        self.recovery_capacity_bps = (
+            float(recovery_capacity_bps)
+            if recovery_capacity_bps is not None
+            else 0.0
+        )
+        self.op_bytes = int(op_bytes)
+        self.slow_ms = float(
+            slow_ms if slow_ms is not None
+            else float(cfg.get("osd_op_complaint_time")) * 1000.0
+        )
+        self.seed = int(seed)
+        self.arbiter = arbiter
+        self.journal = journal
+        self.n_buckets = int(n_buckets)
+        self.lat_min = float(lat_min)
+        self.edges = bucket_edges(self.n_buckets, self.lat_min)
+        self.pc = workload_counters(self.edges)
+        self.mesh = mesh
+        if mesh is None:
+            self._step = traffic_step(
+                self.ops_per_step, self.n_osds, self.n_buckets,
+                self.lat_min,
+            )
+            self.n_devices = 1
+            self._ops_local = self.ops_per_step
+        else:
+            self.axis = axis or mesh.axis_names[0]
+            self.n_devices = int(mesh.devices.size)
+            self._ops_local = -(-self.ops_per_step // self.n_devices)
+            self._step = sharded_traffic_step(
+                mesh, self._ops_local, self.n_osds, self.axis,
+                self.n_buckets, self.lat_min,
+            )
+        self._steps = 0
+        self._last_t: float | None = None
+        self._last_bytes = 0
+        self._overload: tuple[float, float, float] | None = None
+        # cumulative totals (the headline ops/s and the Prometheus
+        # histogram are cluster-lifetime aggregates)
+        self.total_ops = 0
+        self.total_served = 0
+        self.total_degraded = 0
+        self.total_blocked = 0
+        self.total_slow = 0
+        self.total_wall_s = 0.0
+        self._cum_lat_hist = np.zeros(self.n_buckets, np.int64)
+        self._cum_lat_sum_ms = 0.0
+        self.samples: list[TrafficSample] = []
+
+    def set_overload(self, t0: float, t1: float, factor: float) -> None:
+        """Divide per-OSD capacity by ``factor`` while virtual time is
+        inside ``[t0, t1)`` (the induced-incident knob)."""
+        self._overload = (float(t0), float(t1), float(factor))
+
+    def _overload_factor(self, t: float) -> float:
+        if self._overload is None:
+            return 1.0
+        t0, t1, f = self._overload
+        return f if t0 <= t < t1 else 1.0
+
+    def _put(self, host: np.ndarray):
+        sharding = NamedSharding(self.mesh, P())
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx: host[idx]
+        )
+
+    def observe(
+        self,
+        peering: PeeringResult,
+        epoch: int | None = None,
+        bytes_recovered: int = 0,
+    ) -> TrafficSample:
+        """Route one op batch against the current cluster state and
+        fold it into the telemetry.  ``bytes_recovered`` is cumulative
+        (the same figure the health timeline records) — the delta since
+        the last observation becomes the recovery-utilization term."""
+        if self.arbiter is not None:
+            self.arbiter.request(
+                "client", self.ops_per_step * self.op_bytes
+            )
+        t = float(self.clock())
+        dt = (t - self._last_t) if self._last_t is not None else 0.0
+        # the batch is modeled as arriving over the inter-sample
+        # interval; floor it (and default the first, interval-less
+        # sample to a nominal second) so back-to-back snapshots — a
+        # revise landing right after a window — don't read a full
+        # batch as an instantaneous demand spike
+        dt_eff = max(dt, 0.25) if self._last_t is not None else 1.0
+        rec_bps = max(bytes_recovered - self._last_bytes, 0) / dt_eff
+        rho_recovery = (
+            min(rec_bps / self.recovery_capacity_bps, 0.9)
+            if self.recovery_capacity_bps > 0
+            else 0.0
+        )
+        cap_ops = (
+            self.osd_capacity_ops_per_s * dt_eff
+            / self._overload_factor(t)
+        )
+        salt = np.uint32(
+            (self.seed * 2654435761 + self._steps * 40503) & 0xFFFFFFFF
+        )
+        args = [
+            np.ascontiguousarray(peering.survivor_mask, np.uint32),
+            np.ascontiguousarray(peering.n_alive, np.int32),
+            np.ascontiguousarray(peering.acting_primary, np.int32),
+            salt,
+            np.uint32(self.pg_num),
+            np.uint32(self.pg_bmask),
+            np.int32(self.k),
+            np.int32(self.size),
+            np.int32(self.min_size),
+            np.int32(self.write_permille),
+            np.float32(self.service_ms),
+            np.float32(cap_ops),
+            np.float32(rho_recovery),
+        ]
+        if self.mesh is not None:
+            args.append(np.int32(self.ops_per_step))
+            args = [self._put(np.asarray(a)) for a in args]
+        ep = int(peering.epoch_cur if epoch is None else epoch)
+        with self._jspan("traffic.step", epoch=ep, ops=self.ops_per_step):
+            t0 = time.perf_counter()
+            counts, lat_hist, qd_hist, sums, max_rho = self._step(*args)
+            counts = np.asarray(counts)
+            lat_hist = np.asarray(lat_hist)
+            qd_hist = np.asarray(qd_hist)
+            sums = np.asarray(sums)
+            wall = time.perf_counter() - t0
+        served, degraded, blocked = (int(c) for c in counts)
+        ok = served + degraded
+        p50, p95, p99 = percentiles(lat_hist, self.edges)
+        qd_p50, _qd_p95, qd_p99 = percentiles(qd_hist, self.edges)
+        slow = count_at_least(lat_hist, self.edges, self.slow_ms)
+        sample = TrafficSample(
+            t=t,
+            epoch=ep,
+            ops=self.ops_per_step,
+            served=served,
+            degraded=degraded,
+            blocked=blocked,
+            p50_ms=p50,
+            p95_ms=p95,
+            p99_ms=p99,
+            mean_ms=float(sums[0]) / ok if ok else 0.0,
+            qd_p50=qd_p50,
+            qd_p99=qd_p99,
+            slow_ops=slow,
+            slow_fraction=slow / self.ops_per_step,
+            max_osd_utilization=float(max_rho),
+            rho_recovery=rho_recovery,
+            ops_per_sec=ok / dt if dt > 0 else 0.0,
+            ops_per_sec_wall=self.ops_per_step / wall if wall > 0 else 0.0,
+        )
+        self._steps += 1
+        self._last_t = t
+        self._last_bytes = int(bytes_recovered)
+        self.total_ops += sample.ops
+        self.total_served += served
+        self.total_degraded += degraded
+        self.total_blocked += blocked
+        self.total_slow += slow
+        self.total_wall_s += wall
+        self._cum_lat_hist += lat_hist.astype(np.int64)
+        self._cum_lat_sum_ms += float(sums[0])
+        self.pc.inc("ops_served", served)
+        self.pc.inc("ops_degraded", degraded)
+        self.pc.inc("ops_blocked", blocked)
+        self.pc.inc("slow_ops", slow)
+        self.pc.set("p99_ms", p99)
+        self.pc.set("max_osd_utilization", float(max_rho))
+        self.pc.hset(
+            "op_latency_ms",
+            [int(c) for c in self._cum_lat_hist],
+            self._cum_lat_sum_ms,
+        )
+        self.samples.append(sample)
+        return sample
+
+    def _jspan(self, name: str, **attrs):
+        if self.journal is not None:
+            return self.journal.span(name, **attrs)
+        return nullcontext()
+
+    @property
+    def ops_per_sec_wall(self) -> float:
+        """Lifetime device throughput: routed ops per wall second."""
+        return self.total_ops / self.total_wall_s if self.total_wall_s else 0.0
+
+    def summary(self) -> dict:
+        """Cumulative totals (the bench JSON / client-io panel feed)."""
+        total = self.total_ops or 1
+        return {
+            "steps": self._steps,
+            "ops": self.total_ops,
+            "served": self.total_served,
+            "degraded": self.total_degraded,
+            "blocked": self.total_blocked,
+            "slow_ops": self.total_slow,
+            "degraded_fraction": round(self.total_degraded / total, 9),
+            "blocked_fraction": round(self.total_blocked / total, 9),
+            "ops_per_sec_wall": round(self.ops_per_sec_wall, 3),
+        }

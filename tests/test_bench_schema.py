@@ -130,6 +130,104 @@ def test_chaos_record_schema():
     json.dumps(rec)  # one JSON line, always serializable
 
 
+# --- config6_recovery --traffic JSON schema (workload subsystem) ------
+
+
+class _FakeTrafficSample:
+    def __init__(self, p99):
+        self.p99_ms = p99
+
+
+class _FakeTrafficEngine:
+    def __init__(self, p99s):
+        # recovery-phase samples first, then POST_STEPS overload samples
+        self.samples = [_FakeTrafficSample(p) for p in p99s]
+
+    def summary(self):
+        return {
+            "steps": len(self.samples), "ops": 786432, "served": 700000,
+            "degraded": 80000, "blocked": 6432, "slow_ops": 1966,
+            "degraded_fraction": 0.101725261, "blocked_fraction": 0.00817871,
+            "ops_per_sec_wall": 2_072_736.5,
+        }
+
+
+class _FakeTrafficResult:
+    def __init__(self, t):
+        self.time_to_zero_degraded_s = t
+
+
+class _FakeTrafficTimeline:
+    @staticmethod
+    def max_traffic_p99_ms():
+        return 31.84
+
+    @staticmethod
+    def series():
+        return {"t": [0.0, 1.0], "health": ["HEALTH_OK", "HEALTH_WARN"],
+                "traffic_p99_ms": [2.1, 31.84]}
+
+
+class _FakeTrafficReport:
+    status = "HEALTH_WARN"
+    checks = [
+        _FakeCheck("SLO_P99_LATENCY", "HEALTH_WARN"),
+        _FakeCheck("SLO_SLOW_OPS", "HEALTH_WARN"),
+    ]
+
+
+def test_traffic_record_schema():
+    import json
+
+    # 2 recovery-phase samples + POST_STEPS overload samples: the
+    # recovery-phase p99 must exclude the induced incident's tail
+    post = [50.0] * config6.POST_STEPS
+    rec = config6.build_traffic_record(
+        "mid-repair-loss",
+        _FakeTrafficResult(29.36),
+        _FakeTrafficResult(13.75),
+        _FakeTrafficEngine([21.31, 4.0] + post),
+        _FakeTrafficEngine([226.44, 8.0] + post),
+        _FakeTrafficTimeline(),
+        _FakeTrafficReport(),
+        {"client": {"granted_bytes": 163_000_000}},
+    )
+    assert rec["traffic_scenario"] == "mid-repair-loss"
+    assert rec["traffic_ops"] == 786432
+    assert rec["traffic_ops_per_sec"] == 2_072_736.5
+    # whole-run worst p99 (the SLO figure) vs the recovery-phase pair
+    # (the arbiter-vs-no-arbiter comparison)
+    assert rec["traffic_p99_ms"] == 31.84
+    assert rec["traffic_recovery_p99_ms"] == 21.31
+    assert rec["traffic_recovery_p99_ms_no_arbiter"] == 226.44
+    assert rec["traffic_degraded_fraction"] == 0.101725261
+    assert rec["traffic_blocked_fraction"] == 0.00817871
+    assert rec["traffic_slow_ops"] == 1966
+    assert rec["traffic_slow_fraction"] == round(1966 / 786432, 9)
+    assert rec["traffic_health_status"] == "HEALTH_WARN"
+    assert rec["traffic_slo_checks"] == {
+        "SLO_P99_LATENCY": "HEALTH_WARN",
+        "SLO_SLOW_OPS": "HEALTH_WARN",
+    }
+    assert rec["traffic_health_series"]["traffic_p99_ms"] == [2.1, 31.84]
+    assert rec["traffic_time_to_zero_degraded_s"] == 29.36
+    assert rec["traffic_time_to_zero_degraded_s_no_arbiter"] == 13.75
+    assert rec["traffic_qos"]["client"]["granted_bytes"] == 163_000_000
+    json.dumps(rec)  # one JSON line, always serializable
+
+
+def test_traffic_record_fewer_samples_than_post_steps():
+    # a pass that ends inside the overload window still emits a schema
+    rec = config6.build_traffic_record(
+        "flap",
+        _FakeTrafficResult(1.0), _FakeTrafficResult(1.0),
+        _FakeTrafficEngine([5.0]), _FakeTrafficEngine([6.0]),
+        _FakeTrafficTimeline(), _FakeTrafficReport(), {},
+    )
+    assert rec["traffic_recovery_p99_ms"] == 0.0
+    assert rec["traffic_recovery_p99_ms_no_arbiter"] == 0.0
+
+
 def test_device_result_uses_headline_metric():
     out = bench.format_result({"rate": 2_000_000.0, "platform": "tpu"}, 200_000.0, [])
     assert out["metric"] == "crush_placements_per_sec"

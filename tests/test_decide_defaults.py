@@ -162,6 +162,57 @@ def test_harvest_guard_collects_chaos_slo_fields(tmp_path):
     assert dd.harvest_guard([p2]) == {}
 
 
+def test_harvest_guard_collects_traffic_fields(tmp_path):
+    """The foreground-traffic verdict rides the guard harvest: float
+    aggregates (p99s, fractions, drain times) plus the HEALTH_*
+    status string; the per-check dict and series stay bench-only."""
+    p = _log(tmp_path, [
+        {"metric": "recovery_decode_bytes_per_sec", "platform": "tpu",
+         "value": 9_000_000, "n_compiles": 5, "n_compiles_first": 5,
+         "host_transfers": 2,
+         "traffic_ops_per_sec": 2_072_736.5,
+         "traffic_p99_ms": 31.84,
+         "traffic_recovery_p99_ms": 21.31,
+         "traffic_recovery_p99_ms_no_arbiter": 226.44,
+         "traffic_degraded_fraction": 0.207,
+         "traffic_blocked_fraction": 0.0,
+         "traffic_slow_fraction": 0.083,
+         "traffic_time_to_zero_degraded_s": 29.36,
+         "traffic_time_to_zero_degraded_s_no_arbiter": 13.75,
+         "traffic_health_status": "HEALTH_ERR",
+         "traffic_slo_checks": {"SLO_SLOW_OPS": "HEALTH_ERR"},
+         "traffic_health_series": {"t": [0.0]},
+         "traffic_qos": {"client": {"granted_bytes": 1}}},
+    ])
+    g = dd.harvest_guard([p])["recovery_decode_bytes_per_sec"]
+    assert g["traffic_recovery_p99_ms"] == 21.31
+    assert g["traffic_recovery_p99_ms_no_arbiter"] == 226.44
+    assert g["traffic_time_to_zero_degraded_s"] == 29.36
+    assert g["traffic_blocked_fraction"] == 0.0
+    assert isinstance(g["traffic_ops_per_sec"], float)
+    assert isinstance(g["traffic_p99_ms"], float)
+    assert g["traffic_health_status"] == "HEALTH_ERR"
+    assert "traffic_slo_checks" not in g
+    assert "traffic_health_series" not in g
+    assert "traffic_qos" not in g
+    # a cpu smoke line never contributes traffic fields
+    p2 = _log(tmp_path, [
+        {"metric": "recovery_decode_bytes_per_sec", "platform": "cpu",
+         "traffic_p99_ms": 1.0, "traffic_health_status": "HEALTH_OK"},
+    ])
+    assert dd.harvest_guard([p2]) == {}
+
+
+def test_harvest_guard_traffic_fields_absent_when_not_emitted(tmp_path):
+    p = _log(tmp_path, [
+        {"metric": "recovery_decode_bytes_per_sec", "platform": "tpu",
+         "value": 9_000_000, "n_compiles": 5, "n_compiles_first": 5,
+         "host_transfers": 2},
+    ])
+    g = dd.harvest_guard([p])["recovery_decode_bytes_per_sec"]
+    assert not any(k.startswith("traffic_") for k in g)
+
+
 def test_harvest_guard_collects_multichip_counters(tmp_path):
     p = _log(tmp_path, [
         {"metric": "recovery_multichip_bytes_per_sec", "platform": "tpu",
