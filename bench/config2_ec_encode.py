@@ -5,6 +5,11 @@ config grid) and the RS(8,3) north-star profile on large batches,
 against the single-core C++ GF reference (`gfref_matrix_encode`, the
 jerasure-semantics CPU baseline).  Emits one JSON line for the headline
 RS(8,3) number; detail lines (one per profile) go to stderr.
+
+``--xor-schedule`` instead times the CSE-shrunk XOR schedule
+(ceph_tpu.ec.schedule) against the dense bit-matrix product on the
+cauchy_good(8,3) encode bitmatrix, emitting the compile-time XOR
+counts alongside both rates.
 """
 
 import json
@@ -93,7 +98,120 @@ def bench_profile(k, m, chunk, batch_mb, technique="reed_sol_van", packetsize=20
     return rate, cpu_rate, stats
 
 
+def build_xor_encode_record(platform, technique, schedule, sched_rate,
+                            dense_rate, stats):
+    """One JSON line for the schedule-vs-dense encode comparison —
+    same shape discipline as config4's decode record (compile-time XOR
+    counts are exact; the rates carry the runtime-guard fields)."""
+    ratio = round(sched_rate / dense_rate, 3) if dense_rate else 0.0
+    return {
+        "metric": "ec_encode_xor_schedule_bytes_per_sec",
+        "value": round(sched_rate),
+        "unit": "B/s",
+        "vs_baseline": ratio,
+        "platform": platform,
+        "xor_technique": technique,
+        "xor_count": int(schedule.xor_count),
+        "xor_naive_count": int(schedule.naive_xor_count),
+        "xor_reduction_fraction": round(schedule.reduction_fraction, 9),
+        "schedule_bytes_per_sec": round(sched_rate),
+        "dense_bytes_per_sec": round(dense_rate),
+        "schedule_vs_dense": ratio,
+        **stats,
+    }
+
+
+def bench_xor_schedule(k=8, m=3, batch_mb=128, packetsize=2048):
+    """Time the XOR-schedule encode vs the dense bitmatrix product on
+    the cauchy_good(k,m) coding rows, chained per bench/_timing.py."""
+    import jax
+    import jax.numpy as jnp
+
+    from _timing import chained_rate
+
+    from ceph_tpu.analysis.runtime_guard import track
+    from ceph_tpu.ec import gf
+    from ceph_tpu.ec.backend import BitmatrixEncoder
+    from ceph_tpu.ec.schedule import XorScheduleEncoder, _xla_apply
+
+    bm = gf.matrix_to_bitmatrix(gf.cauchy_good_matrix(k, m))
+    size = batch_mb * (1 << 20) // k
+    size -= size % (8 * packetsize)
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 256, (k, size), dtype=np.uint8)
+
+    enc_s = XorScheduleEncoder(bm, layout="packet", w=8,
+                               packetsize=packetsize)
+    sched = enc_s.schedule
+    words = enc_s._pack(data)
+    if enc_s._use_pallas:
+        from ceph_tpu.ec import pallas_kernels as pk
+
+        tile = pk.LANES * 4
+        nw_pad = pk._pad_to(max(words.shape[1], tile), tile)
+        if nw_pad != words.shape[1]:
+            words = np.pad(words, ((0, 0), (0, nw_pad - words.shape[1])))
+
+        def apply_sched(dw):
+            with pk._enable_x64(False):
+                return pk._schedule_padded_jit(
+                    enc_s._steps, dw, n_out=sched.n_out,
+                    n_bufs=sched.n_bufs, interpret=enc_s._interpret,
+                )
+    else:
+        def apply_sched(dw):
+            return _xla_apply(enc_s._steps, dw, sched.n_out, sched.n_bufs)
+
+    def step_sched(dw):
+        out = apply_sched(dw)
+        return dw ^ out[0:1, :]
+
+    warm: dict = {}
+    with track() as guard:
+        dt_s, _ = chained_rate(
+            step_sched, jnp.asarray(words), iters=5, reps=3,
+            on_warm=lambda: warm.update(guard.snapshot()),
+        )
+    stats = {
+        "n_compiles": guard.n_compiles,
+        "n_compiles_first": warm.get("n_compiles", 0),
+        "host_transfers": guard.host_transfers,
+    }
+
+    dense = BitmatrixEncoder(bm, packetsize)
+
+    def step_dense(dev):
+        out = dense._encode(dev)
+        return dev ^ out[0:1, :]
+
+    dt_d, _ = chained_rate(step_dense, jnp.asarray(data), iters=5, reps=3)
+    return build_xor_encode_record(
+        jax.default_backend(), "cauchy_good", sched,
+        k * size / dt_s, k * size / dt_d, stats,
+    )
+
+
+def xor_schedule_main() -> None:
+    from ceph_tpu.common.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    rec = bench_xor_schedule()
+    print(
+        f"xor-schedule {rec['xor_technique']}: "
+        f"{rec['schedule_bytes_per_sec'] / 1e9:.2f} GB/s schedule vs "
+        f"{rec['dense_bytes_per_sec'] / 1e9:.2f} GB/s dense "
+        f"(x{rec['schedule_vs_dense']:.2f}), "
+        f"{rec['xor_count']} XORs vs {rec['xor_naive_count']} naive "
+        f"(-{rec['xor_reduction_fraction'] * 100:.1f}%)",
+        file=sys.stderr,
+    )
+    print(json.dumps(rec))
+
+
 def main() -> None:
+    if "--xor-schedule" in sys.argv:
+        xor_schedule_main()
+        return
     from ceph_tpu.common.compile_cache import enable_persistent_cache
 
     enable_persistent_cache()

@@ -3,6 +3,12 @@
 Times single-chunk recovery through the locality-aware paths and
 reports the read amplification win vs naive k-chunk reconstruction.
 Emits one JSON line (CLAY repair decode B/s of recovered data).
+
+``--xor-schedule`` runs the pattern-group decode comparison instead:
+the CSE-shrunk XOR schedule (ceph_tpu.ec.schedule) vs the dense
+bit-matrix product on the same double-failure repair bitmatrix, at a
+group size past the sharding threshold (8 MiB+ read), emitting the
+compile-time XOR counts alongside both rates.
 """
 
 import json
@@ -12,9 +18,147 @@ import time
 import numpy as np
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+
+def build_xor_schedule_record(platform, technique, group_bytes, schedule,
+                              sched_rate, dense_rate, stats):
+    """One JSON line for the schedule-vs-dense decode comparison.
+
+    ``xor_count`` / ``xor_naive_count`` / ``xor_reduction_fraction``
+    are exact compile-time properties of the schedule (no timing
+    noise); the two rates and their ratio are the measured verdict the
+    acceptance bar reads (``schedule_vs_dense >= 1`` at 8 MiB+
+    groups).  decide_defaults harvests every field as a typed guard
+    metric.
+    """
+    ratio = round(sched_rate / dense_rate, 3) if dense_rate else 0.0
+    return {
+        "metric": "repair_xor_schedule_bytes_per_sec",
+        "value": round(sched_rate),
+        "unit": "B/s",
+        "vs_baseline": ratio,
+        "platform": platform,
+        "xor_technique": technique,
+        "group_bytes": int(group_bytes),
+        "xor_count": int(schedule.xor_count),
+        "xor_naive_count": int(schedule.naive_xor_count),
+        "xor_reduction_fraction": round(schedule.reduction_fraction, 9),
+        "schedule_bytes_per_sec": round(sched_rate),
+        "dense_bytes_per_sec": round(dense_rate),
+        "schedule_vs_dense": ratio,
+        **stats,
+    }
+
+
+def bench_xor_schedule(technique="blaum_roth", k=4, m=2, w=6,
+                       packetsize=2048, group_mb=16):
+    """Time schedule vs dense decode of one pattern group.
+
+    Builds the double-failure repair bitmatrix (data shard 0 + coding
+    shard k lost — the RAID-6 worst case) exactly the way the planner
+    does, then times both engines on the same survivor bytes with the
+    chained-dependency discipline from bench/_timing.py.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from _timing import chained_rate
+
+    from ceph_tpu.analysis.runtime_guard import track
+    from ceph_tpu.ec import create, gf
+    from ceph_tpu.ec.schedule import DenseBitmatrixAdapter, XorScheduleEncoder, _xla_apply
+
+    ec = create({"plugin": "jerasure", "technique": technique,
+                 "k": str(k), "m": str(m), "w": str(w),
+                 "packetsize": str(packetsize)})
+    codec = ec.codec
+    w = codec.w
+    gen_bits = codec.generator_bits()
+    missing = (0, k)
+    rows = [s for s in range(k + m) if s not in missing][:k]
+    sub = np.vstack([gen_bits[r * w:(r + 1) * w] for r in rows])
+    need = np.vstack([gen_bits[s * w:(s + 1) * w] for s in missing])
+    repair_bits = gf.bitmatrix_multiply(need, gf.invert_bitmatrix(sub))
+
+    group = w * packetsize
+    chunk = (group_mb << 20) // k // group * group
+    rng = np.random.default_rng(4)
+    data = rng.integers(0, 256, (k, chunk), dtype=np.uint8)
+    rebuilt = len(missing) * chunk  # bytes recovered per decode
+
+    enc_s = XorScheduleEncoder(repair_bits, layout="packet", w=w,
+                               packetsize=packetsize)
+    sched = enc_s.schedule
+    words = enc_s._pack(data)
+    if enc_s._use_pallas:
+        from ceph_tpu.ec import pallas_kernels as pk
+
+        tile = pk.LANES * 4
+        nw_pad = pk._pad_to(max(words.shape[1], tile), tile)
+        if nw_pad != words.shape[1]:
+            words = np.pad(words, ((0, 0), (0, nw_pad - words.shape[1])))
+
+        def apply_sched(dw):
+            with pk._enable_x64(False):
+                return pk._schedule_padded_jit(
+                    enc_s._steps, dw, n_out=sched.n_out,
+                    n_bufs=sched.n_bufs, interpret=enc_s._interpret,
+                )
+    else:
+        def apply_sched(dw):
+            return _xla_apply(enc_s._steps, dw, sched.n_out, sched.n_bufs)
+
+    def step_sched(dw):
+        out = apply_sched(dw)
+        return dw ^ out[0:1, :]  # fold one output row back: dependency
+
+    warm: dict = {}
+    with track() as guard:
+        dt_s, _ = chained_rate(
+            step_sched, jnp.asarray(words), iters=5, reps=3,
+            on_warm=lambda: warm.update(guard.snapshot()),
+        )
+    stats = {
+        "n_compiles": guard.n_compiles,
+        "n_compiles_first": warm.get("n_compiles", 0),
+        "host_transfers": guard.host_transfers,
+    }
+
+    dense = DenseBitmatrixAdapter(repair_bits, w, packetsize)._enc
+
+    def step_dense(dev):
+        out = dense._encode(dev)
+        return dev ^ out[0:1, :]
+
+    dt_d, _ = chained_rate(step_dense, jnp.asarray(data), iters=5, reps=3)
+    return build_xor_schedule_record(
+        jax.default_backend(), technique, k * chunk, sched,
+        rebuilt / dt_s, rebuilt / dt_d, stats,
+    )
+
+
+def xor_schedule_main() -> None:
+    from ceph_tpu.common.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    rec = bench_xor_schedule()
+    print(
+        f"xor-schedule {rec['xor_technique']}: "
+        f"{rec['schedule_bytes_per_sec'] / 1e9:.2f} GB/s schedule vs "
+        f"{rec['dense_bytes_per_sec'] / 1e9:.2f} GB/s dense "
+        f"(x{rec['schedule_vs_dense']:.2f}), "
+        f"{rec['xor_count']} XORs vs {rec['xor_naive_count']} naive "
+        f"(-{rec['xor_reduction_fraction'] * 100:.1f}%)",
+        file=sys.stderr,
+    )
+    print(json.dumps(rec))
 
 
 def main() -> None:
+    if "--xor-schedule" in sys.argv:
+        xor_schedule_main()
+        return
     from ceph_tpu.common.compile_cache import enable_persistent_cache
 
     enable_persistent_cache()

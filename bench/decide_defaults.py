@@ -96,6 +96,18 @@ TRAFFIC_STR_FIELDS = ("traffic_health_status",)
 MULTICHIP_GUARD_FIELDS = ("n_devices", "sharded_launches",
                           "psum_bytes_rebuilt", "psum_shards_rebuilt")
 
+# XOR-schedule fields (config2/config4 --xor-schedule): the XOR counts
+# and reduction fraction are exact compile-time properties of the
+# CSE-shrunk schedule (noise-free — a diff means the compiler or the
+# codec's bitmatrix changed); the schedule/dense rate pair and their
+# ratio are the measured verdict the acceptance bar reads
+# (schedule_vs_dense >= 1.0 at 8 MiB+ pattern groups).
+XOR_SCHEDULE_INT_FIELDS = ("xor_count", "xor_naive_count", "group_bytes")
+XOR_SCHEDULE_FLOAT_FIELDS = ("xor_reduction_fraction",
+                             "schedule_bytes_per_sec",
+                             "dense_bytes_per_sec",
+                             "schedule_vs_dense")
+
 
 def harvest_aux(paths: list[str]) -> dict[str, int]:
     """Collect auxiliary metric -> best value from the logs.
@@ -168,6 +180,12 @@ def harvest_guard(paths: list[str]) -> dict[str, dict]:
             )
             fields.update(
                 {f: int(d[f]) for f in MULTICHIP_GUARD_FIELDS if f in d}
+            )
+            fields.update(
+                {f: int(d[f]) for f in XOR_SCHEDULE_INT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: float(d[f]) for f in XOR_SCHEDULE_FLOAT_FIELDS if f in d}
             )
             if not fields:
                 continue

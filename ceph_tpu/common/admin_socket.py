@@ -34,7 +34,16 @@ class AdminSocket:
         )
         self.register("config show", lambda cmd: self.config.show())
         self.register("config set", self._config_set)
+        self.register("dump_ec_schedules", self._dump_ec_schedules)
         self.register("help", lambda cmd: {"commands": sorted(self._hooks)})
+
+    @staticmethod
+    def _dump_ec_schedules(cmd: dict) -> dict:
+        # lazy import: the hook must not pull jax into processes that
+        # only poke config/perf over the socket
+        from ..ec.schedule import dump_ec_schedules
+
+        return dump_ec_schedules()
 
     def _config_set(self, cmd: dict) -> dict:
         self.config.set(cmd["key"], cmd["value"])

@@ -119,6 +119,14 @@ SCHEMA: list[Option] = [
            "wins >= ~8 MiB moved); real multi-chip meshes should set "
            "this lower (~1 MiB) since their devices are genuinely "
            "parallel", min=0, see_also=("recovery_shard_groups",)),
+    Option("recovery_xor_schedule", OPT_STR, "auto", LEVEL_ADVANCED,
+           "batched-repair decode engine for pattern groups: 'auto' "
+           "runs CSE-shrunk XOR schedules for bit-level (bitmatrix/"
+           "cauchy) groups and keeps the GF(2^8) LUT decode for table "
+           "codecs; 'on' forces XOR schedules for every group "
+           "(bit-plane layout for table codecs); 'off' decodes "
+           "bit-level groups with the dense bit-matrix product",
+           enum_allowed=("auto", "on", "off")),
     Option("recovery_coschedule_max", OPT_INT, 4, LEVEL_ADVANCED,
            "small pattern groups dispatched back-to-back per "
            "supervised scheduling window when a mesh is attached "

@@ -211,6 +211,17 @@ def matrix_to_bitmatrix(matrix: np.ndarray) -> np.ndarray:
     return out
 
 
+def bitmatrix_multiply(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """GF(2) matrix product: exact integer matmul, parity = & 1.
+
+    Because ``matrix_to_bitmatrix`` is a ring homomorphism (companion-
+    matrix representation of GF(2^8)), composing repair matrices here
+    is byte-identical to composing them over GF(2^8) and expanding.
+    """
+    prod = (a & 1).astype(np.int64) @ (b & 1).astype(np.int64)
+    return (prod & 1).astype(np.uint8)
+
+
 def invert_bitmatrix(mat: np.ndarray) -> np.ndarray:
     """Gauss-Jordan inverse over GF(2); raises on singular."""
     n = mat.shape[0]

@@ -15,6 +15,12 @@ B is precompiled to full-width masks so selection is an AND.
 
 Exposed as :func:`xor_bitmatrix_encode`; falls back to the XLA path on
 non-TPU backends (Mosaic interpret mode is used in tests).
+
+:func:`schedule_apply` is the second kernel: it interprets a compiled
+XOR schedule (:mod:`ceph_tpu.ec.schedule` — CSE-shrunk step table) as
+one ``fori_loop`` scan over SMEM steps with VMEM scratch accumulator
+rows, so a whole pattern-group decode is a single launch whose XOR
+count the compiler already minimized.
 """
 
 from __future__ import annotations
@@ -101,9 +107,97 @@ def _encode_padded_jit(masks, d_words, interpret=False):
     )(masks, d_words)
 
 
+def _schedule_kernel(steps_ref, d_ref, out_ref, scratch_ref):
+    """One N-tile of the XOR-schedule interpreter.
+
+    ``steps_ref`` [n_steps, 2] i32 lives in SMEM (scalar loads drive
+    control flow); ``scratch_ref`` [n_bufs, TN] u32 VMEM holds the
+    schedule's buffers ``[inputs | outputs | derived]``.  Step (dst,
+    src) is ``scratch[dst] ^= scratch[src]`` — the dynamic index is on
+    the SUBLANE dim only (``pl.ds`` over rows), the pattern Mosaic
+    accepts; a lane-dim dynamic offset is what the encode kernel's mask
+    layout already dodges (see :func:`_kernel`).
+    """
+    from jax.experimental import pallas as pl
+
+    n_in = d_ref.shape[0]
+    n_out = out_ref.shape[0]
+    n_bufs, tn = scratch_ref.shape
+    scratch_ref[0:n_in, :] = d_ref[:, :]
+    scratch_ref[n_in:, :] = jnp.zeros((n_bufs - n_in, tn), jnp.uint32)
+
+    def body(i, carry):
+        dst = steps_ref[i, 0]
+        src = steps_ref[i, 1]
+        scratch_ref[pl.ds(dst, 1), :] = (
+            scratch_ref[pl.ds(dst, 1), :] ^ scratch_ref[pl.ds(src, 1), :]
+        )
+        return carry
+
+    jax.lax.fori_loop(
+        jnp.int32(0), jnp.int32(steps_ref.shape[0]), body, jnp.int32(0)
+    )
+    out_ref[:, :] = scratch_ref[n_in:n_in + n_out, :]
+
+
+def schedule_apply(steps, d_words, n_out: int, n_bufs: int,
+                   interpret: bool = False, device=None):
+    """Run a compiled XOR schedule on device.
+
+    ``steps`` [n_steps, 2] i32; ``d_words`` [n_in, NW] u32 — the word
+    axis is padded here to the kernel's LANES*4 tile (callers trim with
+    the layout's word count).  Traced with x64 scoped off like
+    :func:`_encode_padded` (i64 BlockSpec index maps are a Mosaic
+    rejection).  Returns the in-flight [n_out, NWpad] u32 array.
+    """
+    d_words = np.asarray(d_words)
+    nw = d_words.shape[1]
+    nw_pad = _pad_to(max(nw, LANES * 4), LANES * 4)
+    if nw_pad != nw:
+        d_words = np.pad(d_words, ((0, 0), (0, nw_pad - nw)))
+    if device is not None:
+        d_words = jax.device_put(d_words, device)
+    with _enable_x64(False):
+        return _schedule_padded_jit(
+            jnp.asarray(steps), jnp.asarray(d_words),
+            n_out=n_out, n_bufs=n_bufs, interpret=interpret,
+        )
+
+
+@partial(jax.jit, static_argnames=("n_out", "n_bufs", "interpret"))
+def _schedule_padded_jit(steps, d_words, n_out, n_bufs, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_in, nw = d_words.shape
+    n_steps = steps.shape[0]
+    tile = LANES * 4
+    if nw % tile:
+        raise ValueError(f"word count {nw} must be a multiple of {tile}")
+    grid = (nw // tile,)
+    return pl.pallas_call(
+        _schedule_kernel,
+        out_shape=jax.ShapeDtypeStruct((n_out, nw), jnp.uint32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((n_steps, 2), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((n_in, tile), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((n_out, tile), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((n_bufs, tile), jnp.uint32)],
+        interpret=interpret,
+    )(steps, d_words)
+
+
 class PallasBitmatrixEncoder:
     """Drop-in engine for BitmatrixEncoder's inner product (same packet
-    layout contract as ``gfref_bitmatrix_encode``)."""
+    layout contract as ``gfref_bitmatrix_encode``).  Packet sizes that
+    are not a word multiple are handled by tail-padding each packet to
+    a whole u32 in :meth:`_pack_words` (XOR of zero-padded packets is
+    the zero-padded XOR) and trimming the tail on output."""
 
     def __init__(self, bitmatrix: np.ndarray, packetsize: int,
                  interpret: bool | None = None):
@@ -111,8 +205,6 @@ class PallasBitmatrixEncoder:
         self.mw, self.kw = self.bitmatrix.shape
         self.k, self.m = self.kw // W, self.mw // W
         self.packetsize = packetsize
-        if packetsize % 4:
-            raise ValueError("pallas path needs packetsize % 4 == 0")
         self.mw_pad = _pad_to(self.mw, 8)
         masks = np.zeros((self.kw, self.mw_pad, 1), np.uint32)
         masks[:, : self.mw, 0] = np.where(
@@ -134,9 +226,13 @@ class PallasBitmatrixEncoder:
         if size % group:
             raise ValueError(f"chunk size {size} % {group} != 0")
         g = size // group
+        pb = _pad_to(p, 4)
         d = np.ascontiguousarray(data).reshape(k, g, W, p)
-        d = d.transpose(0, 2, 1, 3).reshape(k * W, g * p)
-        d_words = d.view(np.uint32)  # [KW, g*p/4]
+        d = d.transpose(0, 2, 1, 3).reshape(k * W, g, p)
+        if pb != p:
+            d = np.pad(d, ((0, 0), (0, 0), (0, pb - p)))
+        d_words = np.ascontiguousarray(d).view(np.uint32)
+        d_words = d_words.reshape(k * W, g * (pb // 4))
         nw = d_words.shape[1]
         nw_pad = _pad_to(max(nw, LANES * 4), LANES * 4)
         if nw_pad != nw:
@@ -148,6 +244,7 @@ class PallasBitmatrixEncoder:
         k, m, p = self.k, self.m, self.packetsize
         size = data.shape[1]
         g = size // (W * p)
+        pb = _pad_to(p, 4)
         d_words, nw = self._pack_words(data)
         out = np.asarray(
             _encode_padded(
@@ -155,5 +252,6 @@ class PallasBitmatrixEncoder:
                 interpret=self._interpret,
             )
         )[: self.mw, :nw]
-        c = out.view(np.uint8).reshape(m, W, g, p).transpose(0, 2, 1, 3)
+        c = out.view(np.uint8).reshape(m, W, g, pb)[..., :p]
+        c = c.transpose(0, 2, 1, 3)
         return np.ascontiguousarray(c.reshape(m, size))

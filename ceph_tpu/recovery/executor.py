@@ -36,6 +36,7 @@ from ..common.config import Config, global_config
 from ..common.perf_counters import PerfCounters, PerfCountersBuilder, registry
 from ..common.tracing import timed_block, trace_annotation
 from ..ec.backend import TableEncoder
+from ..ec.schedule import ScheduleCache, encoder_for_group
 from ..osdmap.map import OSDMap
 from .peering import (
     PG_STATE_BACKFILL,
@@ -137,6 +138,9 @@ def _build_counters() -> PerfCounters:
         .add_u64_counter("salvaged_pgs",
                          "PGs committed from a stale launch because "
                          "their own sources all survived the epoch")
+        .add_u64_counter("schedule_launches",
+                         "decode launches executed as CSE-shrunk XOR "
+                         "schedules (bit-level pattern groups)")
         .add_gauge("degraded_pgs", "degraded PGs in the last plan")
         .add_gauge("unrecoverable_pgs", "PGs below k survivors")
         .add_gauge("failed_pgs",
@@ -169,6 +173,8 @@ class RecoveryResult:
     sharded_launches: int = 0
     psum_bytes_rebuilt: int = 0
     psum_shards_rebuilt: int = 0
+    # launches that ran as CSE-shrunk XOR schedules (bit-level groups)
+    schedule_launches: int = 0
 
     @property
     def bytes_per_sec(self) -> float:
@@ -192,6 +198,9 @@ class _Inflight:
     valid: int | None  # un-padded width (sharded path only)
     counters: tuple | None  # psum'd (bytes, shards) arrays, sharded only
     t_dispatch: float
+    # schedule/bit-level launches: host-side materializer (unpack u32
+    # word rows + trim padding back to [n_missing, width] bytes)
+    post: Callable | None = None
 
 
 class RecoveryExecutor:
@@ -238,6 +247,12 @@ class RecoveryExecutor:
         self.pc = recovery_counters()
         # one encoder per erasure pattern, reused across runs
         self._encoders: dict[int, TableEncoder] = {}
+        # bit-level pattern groups: compiled XOR schedules (or the
+        # dense bitmatrix product when the knob is "off"), cached per
+        # pattern like the sharded LUTs; "on" forces table groups onto
+        # the schedule path too (bit-plane layout)
+        self.xor_mode = str(cfg.get("recovery_xor_schedule"))
+        self._schedules = ScheduleCache()
         self.mesh = mesh
         self.shard_min_bytes = int(cfg.get("recovery_shard_min_bytes"))
         self._sharded: ShardedDecoder | None = None
@@ -281,8 +296,14 @@ class RecoveryExecutor:
         if self.on_decode_launch is not None:
             self.on_decode_launch(g, nbytes)
         t0 = time.perf_counter()
+        # bit-level groups decode over GF(2) bit rows (their chunks are
+        # packet-interleaved, so the byte-wise LUT/sharded paths would
+        # corrupt them); "on" forces table groups bit-level too
+        bit_level = g.repair_matrix is None or self.xor_mode == "on"
         sharded = (
-            self._sharded is not None and nbytes >= self.shard_min_bytes
+            self._sharded is not None
+            and nbytes >= self.shard_min_bytes
+            and not bit_level
         )
         with trace_annotation(f"recovery:decode:{g.mask:#x}"):
             if sharded:
@@ -292,6 +313,21 @@ class RecoveryExecutor:
                 self.pc.inc("sharded_launches")
                 result.sharded_launches += 1
                 fl = _Inflight(g, out, chunk, True, valid, (nb, sh), t0)
+            elif bit_level:
+                enc = encoder_for_group(self._schedules, g, self.xor_mode)
+                dev = None
+                if self._devices:
+                    dev = self._devices[self._rr % len(self._devices)]
+                    self._rr += 1
+                width = src.shape[1]
+                if getattr(enc, "schedule", None) is not None:
+                    self.pc.inc("schedule_launches")
+                    result.schedule_launches += 1
+                fl = _Inflight(
+                    g, enc.encode_async(src, device=dev), chunk,
+                    False, None, None, t0,
+                    post=lambda o, _e=enc, _w=width: _e.finalize(o, _w),
+                )
             else:
                 enc = self._encoders.get(g.mask)
                 if enc is None:
@@ -319,7 +355,10 @@ class RecoveryExecutor:
     ) -> tuple[np.ndarray, int]:
         """Materialize one in-flight launch's output on the host."""
         with timed_block(self.pc, "l_decode"):
-            out = np.asarray(fl.out)  # [n_missing, width (padded)]
+            if fl.post is not None:
+                out = fl.post(fl.out)  # schedule path: unpack + trim
+            else:
+                out = np.asarray(fl.out)  # [n_missing, width (padded)]
         if fl.sharded:
             out = out[:, : fl.valid]
             nb, sh = fl.counters
@@ -429,6 +468,7 @@ class SupervisedResult:
     stale_launches: int = 0  # discarded: epoch killed a source mid-flight
     salvaged_pgs: int = 0  # committed out of a stale launch anyway
     sharded_launches: int = 0  # routed through the mesh-sharded step
+    schedule_launches: int = 0  # executed as CSE-shrunk XOR schedules
     coscheduled_windows: int = 0  # windows that dispatched >1 group
     psum_bytes_rebuilt: int = 0  # collective-reduced byte progress
     plan_revisions: int = 0
@@ -460,6 +500,7 @@ class SupervisedResult:
             "stale_launches": self.stale_launches,
             "salvaged_pgs": self.salvaged_pgs,
             "sharded_launches": self.sharded_launches,
+            "schedule_launches": self.schedule_launches,
             "plan_revisions": self.plan_revisions,
             "completed_pgs": len(self.completed_pgs),
             "failed_pgs": sorted(self.failed_pgs),
@@ -880,6 +921,7 @@ class SupervisedRecovery:
                 self._snapshot(peering, inner.bytes_recovered)
         res.launches = inner.launches
         res.sharded_launches = inner.sharded_launches
+        res.schedule_launches = inner.schedule_launches
         res.psum_bytes_rebuilt = inner.psum_bytes_rebuilt
         res.bytes_recovered = inner.bytes_recovered
         res.shards_rebuilt = inner.shards_rebuilt

@@ -41,17 +41,36 @@ def mask_to_shards(mask: int, size: int) -> tuple[int, ...]:
     return tuple(s for s in range(size) if (mask >> s) & 1)
 
 
-def _matrix_codec(codec):
-    """Accept a :class:`~ceph_tpu.ec.backend.MatrixCodec` or any plugin
-    wrapper (``ceph_tpu.ec.registry.create`` output) carrying one as
-    ``.codec``.  Bit-matrix-native codes have no GF(2^8) generator to
-    pattern-group over; that's the CLAY/repair-locality follow-on."""
+def _planning_codec(codec):
+    """Accept a :class:`~ceph_tpu.ec.backend.MatrixCodec` /
+    :class:`~ceph_tpu.ec.backend.BitmatrixCodec` or any plugin wrapper
+    (``ceph_tpu.ec.registry.create`` output) carrying one as
+    ``.codec``.  Returns ``(codec, bit_level)`` — bit-level codecs
+    (``generator_bits()``) pattern-group at the bit-row level.
+
+    Locality-aware plugins (LRC / SHEC / CLAY) expose no single
+    generator; their sub-chunk/local-group planning is the CLAY
+    repair-locality follow-on (ROADMAP).
+    """
     for c in (codec, getattr(codec, "codec", None)):
-        if c is not None and hasattr(c, "generator"):
-            return c
+        if c is None:
+            continue
+        if hasattr(c, "generator_bits"):
+            return c, True
+        if hasattr(c, "generator"):
+            return c, False
+    technique = getattr(codec, "technique", None) or getattr(
+        getattr(codec, "codec", None), "technique", None
+    )
     raise TypeError(
-        f"{type(codec).__name__} exposes no GF(2^8) generator(); "
-        "pattern-grouped repair needs a matrix codec"
+        f"{type(codec).__name__}"
+        f"{f' (technique={technique!r})' if technique else ''} exposes "
+        "neither a GF(2^8) generator() nor a GF(2) generator_bits(); "
+        "pattern-grouped repair supports matrix codecs (reed_sol_*, "
+        "cauchy_*) and bitmatrix-native codecs (liberation, blaum_roth, "
+        "liber8tion, w>8 expansions).  Locality-aware plugins (LRC, "
+        "SHEC, CLAY) need the sub-chunk planner (ROADMAP: CLAY "
+        "repair-locality)."
     )
 
 
@@ -66,6 +85,14 @@ class PatternGroup:
     data and coding alike (recovery restores full redundancy).
     ``repair_matrix`` maps the k source chunks straight to the missing
     chunks: one device launch per group.
+
+    Bit-level groups (bitmatrix-native codecs, and cauchy-technique
+    matrix codecs whose chunks are packet-interleaved rather than
+    byte-element) carry ``repair_bitmatrix`` instead — a
+    ``[len(missing)*w, k*w]`` GF(2) matrix the executor lowers to a
+    CSE-shrunk XOR schedule (:mod:`ceph_tpu.ec.schedule`).
+    ``repair_matrix`` is ``None`` for those groups so nothing byte-wise
+    (TableEncoder, the sharded LUT path) can touch them by mistake.
     """
 
     mask: int
@@ -73,7 +100,10 @@ class PatternGroup:
     rows: tuple[int, ...]
     missing: tuple[int, ...]
     pgs: np.ndarray  # PG seeds in this pattern group
-    repair_matrix: np.ndarray  # [len(missing), k] u8 over GF(2^8)
+    repair_matrix: np.ndarray | None  # [len(missing), k] u8 over GF(2^8)
+    repair_bitmatrix: np.ndarray | None = None  # [n_miss*w, k*w] GF(2)
+    w: int = 8  # bit rows per chunk (bit-level groups)
+    packetsize: int = 0  # packet bytes (bit-level groups)
 
     @property
     def n_pgs(self) -> int:
@@ -128,20 +158,31 @@ def build_plan(
 ) -> RecoveryPlan:
     """Group the peering pass's degraded PGs into pattern groups.
 
-    ``codec`` is any systematic GF(2^8) codec exposing ``k``, ``m`` and
-    ``generator()`` (:class:`ceph_tpu.ec.backend.MatrixCodec`); the
-    pool's ``size`` must equal k+m (EC pools are positional: acting
-    slot == shard id).  ``pgs`` restricts planning to a PG subset —
-    the mid-flight re-plan path, where only the epoch delta's
-    invalidated PGs need fresh groups.
+    ``codec`` is any systematic codec exposing ``k``, ``m`` and either
+    ``generator()`` (:class:`ceph_tpu.ec.backend.MatrixCodec`) or
+    ``generator_bits()`` (:class:`ceph_tpu.ec.backend.BitmatrixCodec`
+    — liberation / blaum_roth / liber8tion / w>8 expansions, which
+    pattern-group at the bit-row level); the pool's ``size`` must equal
+    k+m (EC pools are positional: acting slot == shard id).  ``pgs``
+    restricts planning to a PG subset — the mid-flight re-plan path,
+    where only the epoch delta's invalidated PGs need fresh groups.
     """
-    codec = _matrix_codec(codec)
+    codec, bit_level = _planning_codec(codec)
     k, m = codec.k, codec.m
     if k + m != peering.size:
         raise ValueError(
             f"codec k+m={k + m} != pool size {peering.size}"
         )
-    gen = codec.generator()  # [(k+m), k] identity top block
+    if bit_level:
+        gen_bits = codec.generator_bits()  # [(k+m)*w, k*w] GF(2)
+        w = codec.w
+        packetsize = codec.packetsize
+    else:
+        gen = codec.generator()  # [(k+m), k] identity top block
+        # cauchy-technique chunks are packet-interleaved GF(2) regions,
+        # not byte-element streams: their repair must stay bit-level
+        # (a byte-wise LUT product over them would be garbage)
+        bit_technique = getattr(codec, "technique", "table") == "bitmatrix"
     degraded = peering.pgs_with(PG_STATE_DEGRADED)
     if pgs is not None:
         degraded = np.intersect1d(
@@ -160,18 +201,49 @@ def build_plan(
         missing = tuple(
             s for s in range(peering.size) if s not in survivors
         )
-        inv = gf.invert_matrix(gen[list(rows)])
-        repair = gf.matrix_encode(gen[list(missing)], inv)
-        plan.groups.append(
-            PatternGroup(
+        if bit_level:
+            # bit-row block selection: survivor s contributes rows
+            # [s*w, (s+1)*w) of the bit generator; one (k*w)^2 GF(2)
+            # inversion per pattern, exactly BitmatrixCodec's decode
+            # algebra so batch and serial decode agree bit-for-bit
+            sub = np.vstack([gen_bits[r * w:(r + 1) * w] for r in rows])
+            inv = gf.invert_bitmatrix(sub)
+            need = np.vstack(
+                [gen_bits[s * w:(s + 1) * w] for s in missing]
+            )
+            group = PatternGroup(
                 mask=int(mask),
                 survivors=survivors,
                 rows=rows,
                 missing=missing,
                 pgs=pgs,
-                repair_matrix=repair,
+                repair_matrix=None,
+                repair_bitmatrix=gf.bitmatrix_multiply(need, inv),
+                w=w,
+                packetsize=packetsize,
             )
-        )
+        else:
+            inv = gf.invert_matrix(gen[list(rows)])
+            repair = gf.matrix_encode(gen[list(missing)], inv)
+            group = PatternGroup(
+                mask=int(mask),
+                survivors=survivors,
+                rows=rows,
+                missing=missing,
+                pgs=pgs,
+                # expanding the GF(2^8) repair matrix commutes with
+                # composing it (matrix_to_bitmatrix is a homomorphism),
+                # so the bit-level product is byte-identical
+                repair_matrix=None if bit_technique else repair,
+                repair_bitmatrix=(
+                    gf.matrix_to_bitmatrix(repair) if bit_technique else None
+                ),
+                w=8,
+                packetsize=getattr(codec, "packetsize", 0)
+                if bit_technique
+                else 0,
+            )
+        plan.groups.append(group)
     # most shards lost first (the reference recovers the PGs nearest
     # data loss ahead of singly-degraded ones)
     plan.groups.sort(key=lambda g: (-len(g.missing), g.mask))

@@ -108,6 +108,11 @@ def compile_once_cases() -> dict[str, dict]:
     - ``pattern_decode``: :class:`~ceph_tpu.recovery.executor
       .RecoveryExecutor` ``.run()`` on the same plan with fresh chunk
       data — config6's timed region.
+    - ``schedule_decode``: the same second-run contract for a
+      bitmatrix-native codec (liberation), whose pattern groups route
+      through the cached XOR schedules of :mod:`ceph_tpu.ec.schedule`
+      — the schedule cache plus the per-shape jit of the apply step
+      must make repeated same-pattern decodes compile-free.
 
     Raises ``AssertionError`` (from
     :func:`ceph_tpu.analysis.runtime_guard.assert_no_recompile`) if
@@ -185,6 +190,44 @@ def compile_once_cases() -> dict[str, dict]:
         ex.run(plan, lambda pg, s: s2[pg][s])
     report["pattern_decode"] = {
         "warm_compiles": warm.n_compiles, "second_compiles": 0,
+    }
+
+    # ---- XOR-schedule decode: bit-level groups, same second-run bar ----
+    from ..ec import gfw
+    from ..ec.backend import BitmatrixCodec
+
+    w, packetsize = 7, 8
+    bcodec = BitmatrixCodec(gfw.liberation_bitmatrix(k, w), w, packetsize)
+    chunk_b = 2 * w * packetsize
+    masks_b = [0b011110, 0b111100]
+    mask_arr_b = np.asarray(masks_b, np.uint32)
+    peering_b = PeeringResult(
+        pool_id=2, epoch_prev=1, epoch_cur=2, size=size, min_size=k,
+        up=acting.copy(), up_primary=acting[:, 0].copy(),
+        acting=acting, acting_primary=acting[:, 0].copy(),
+        prev_acting=prev, flags=flags, survivor_mask=mask_arr_b,
+        n_alive=(acting != PEER_NONE).sum(axis=1).astype(np.int32),
+    )
+    plan_b = build_plan(peering_b, bcodec)
+
+    def store_for_b(seed: int) -> dict[int, np.ndarray]:
+        rng = np.random.default_rng(seed)
+        out = {}
+        for g in plan_b.groups:
+            for pg in g.pgs:
+                data = rng.integers(0, 256, (k, chunk_b), dtype=np.uint8)
+                out[int(pg)] = np.vstack([data, bcodec.encoder.encode(data)])
+        return out
+
+    ex_b = RecoveryExecutor(bcodec)
+    b1 = store_for_b(1)
+    with CompileCounter() as warm_b:
+        ex_b.run(plan_b, lambda pg, s: b1[pg][s])  # compiles per pattern
+    b2 = store_for_b(2)  # fresh values, identical shapes
+    with assert_no_recompile("XOR-schedule decode second run"):
+        ex_b.run(plan_b, lambda pg, s: b2[pg][s])
+    report["schedule_decode"] = {
+        "warm_compiles": warm_b.n_compiles, "second_compiles": 0,
     }
     return report
 

@@ -77,6 +77,70 @@ def gen_profile(rng) -> dict:
     return prof
 
 
+def gen_bitmatrix(rng):
+    """Random (bitmatrix, w) coding block across every family the XOR
+    schedule compiler claims: native minimal-density codes, cauchy
+    expansions, and w in {8, 16} RS expansions."""
+    from ceph_tpu.ec import gf, gfw
+
+    fam = rng.choice(
+        ["liberation", "blaum_roth", "liber8tion", "cauchy", "rs_w16"])
+    if fam == "liberation":
+        w = int(rng.choice([5, 7, 11]))
+        return gfw.liberation_bitmatrix(int(rng.integers(2, w + 1)), w), w
+    if fam == "blaum_roth":
+        w = int(rng.choice([4, 6, 10]))
+        return gfw.blaum_roth_bitmatrix(int(rng.integers(2, w + 1)), w), w
+    if fam == "liber8tion":
+        return gfw.liber8tion_bitmatrix(int(rng.integers(2, 9))), 8
+    if fam == "cauchy":
+        k, m = int(rng.integers(2, 9)), int(rng.integers(1, 5))
+        return gf.matrix_to_bitmatrix(gf.cauchy_good_matrix(k, m)), 8
+    k, m = int(rng.integers(2, 9)), int(rng.integers(1, 5))
+    return gfw.matrix_to_bitmatrix(
+        gfw.vandermonde_matrix(k, m, 16), 16), 16
+
+
+def schedule_trial(rng) -> tuple:
+    """Property: the CSE-shrunk XOR schedule's decode is byte-identical
+    to the dense BitmatrixEncoder product on a random (codec family,
+    k, m, w, erasure pattern, packetsize) draw — including packet sizes
+    that are not a u32 multiple (the word-pad path)."""
+    from ceph_tpu.ec import gf
+    from ceph_tpu.ec.backend import BitmatrixEncoder
+    from ceph_tpu.ec.schedule import XorScheduleEncoder
+
+    bits, w = gen_bitmatrix(rng)
+    kw = bits.shape[1]
+    k, m = kw // w, bits.shape[0] // w
+    size_ids = k + m
+    gen_bits = np.vstack([np.eye(kw, dtype=np.uint8), bits])
+    n_lost = int(rng.integers(1, m + 1))
+    missing = tuple(
+        sorted(rng.choice(size_ids, n_lost, replace=False).tolist())
+    )
+    rows = [s for s in range(size_ids) if s not in missing][:k]
+    sub = np.vstack([gen_bits[r * w:(r + 1) * w] for r in rows])
+    need = np.vstack([gen_bits[s * w:(s + 1) * w] for s in missing])
+    repair = gf.bitmatrix_multiply(need, gf.invert_bitmatrix(sub))
+
+    ps = int(rng.choice([3, 4, 5, 8, 9, 16]))
+    chunk = int(rng.integers(1, 4)) * w * ps
+    data = rng.integers(0, 256, (k, chunk), dtype=np.uint8)
+    coding = BitmatrixEncoder(bits, ps, w).encode(data)
+    shards = np.vstack([data, coding])
+
+    sched = XorScheduleEncoder(repair, layout="packet", w=w, packetsize=ps)
+    got = sched.encode(shards[rows])
+    want = BitmatrixEncoder(repair, ps, w).encode(shards[rows])
+    key = (k, m, w, ps, missing)
+    assert np.array_equal(got, want), key
+    for i, s in enumerate(missing):
+        assert np.array_equal(got[i], shards[s]), (key, s)
+    assert sched.schedule.xor_count <= sched.schedule.naive_xor_count, key
+    return key
+
+
 def main() -> int:
     seed = int(time.time())
     rng = np.random.default_rng(seed)
@@ -126,6 +190,8 @@ def main() -> int:
             out = ec.decode_concat(dict(avail))
             assert out[: len(obj)] == obj.tobytes(), \
                 (profile, sorted(erased))
+        # schedule-vs-dense property draw rides every trial
+        schedule_trial(rng)
         if trial % 20 == 0:
             print(f"trial {trial} ok ({time.time() - t0:.0f}s) "
                   f"last: {profile}", flush=True)

@@ -228,6 +228,81 @@ def test_traffic_record_fewer_samples_than_post_steps():
     assert rec["traffic_recovery_p99_ms_no_arbiter"] == 0.0
 
 
+# --- config2/config4 --xor-schedule JSON schema (ec schedule compiler) ---
+
+_CONFIG2 = os.path.join(os.path.dirname(_BENCH), "bench", "config2_ec_encode.py")
+_spec2 = importlib.util.spec_from_file_location("bench_config2", _CONFIG2)
+config2 = importlib.util.module_from_spec(_spec2)
+_spec2.loader.exec_module(config2)
+
+_CONFIG4 = os.path.join(os.path.dirname(_BENCH), "bench", "config4_repair_decode.py")
+_spec4 = importlib.util.spec_from_file_location("bench_config4", _CONFIG4)
+config4 = importlib.util.module_from_spec(_spec4)
+_spec4.loader.exec_module(config4)
+
+
+class _FakeSchedule:
+    xor_count = 43
+    naive_xor_count = 78
+    reduction_fraction = 1.0 - 43 / 78
+
+
+_XOR_STATS = {"n_compiles": 4, "n_compiles_first": 4, "host_transfers": 0}
+
+
+def test_xor_schedule_decode_record_schema():
+    import json
+
+    rec = config4.build_xor_schedule_record(
+        "tpu", "blaum_roth", 16_760_832, _FakeSchedule(),
+        231_191_798.4, 12_710_846.2, _XOR_STATS,
+    )
+    assert rec["metric"] == "repair_xor_schedule_bytes_per_sec"
+    assert rec["value"] == 231_191_798 and rec["unit"] == "B/s"
+    assert rec["platform"] == "tpu"
+    assert rec["xor_technique"] == "blaum_roth"
+    assert rec["group_bytes"] == 16_760_832
+    # compile-time XOR accounting: exact, and internally consistent
+    assert rec["xor_count"] == 43 and rec["xor_naive_count"] == 78
+    assert rec["xor_reduction_fraction"] == round(1.0 - 43 / 78, 9)
+    # the schedule-vs-dense verdict the acceptance bar reads
+    assert rec["schedule_bytes_per_sec"] == 231_191_798
+    assert rec["dense_bytes_per_sec"] == 12_710_846
+    assert rec["schedule_vs_dense"] == rec["vs_baseline"] == round(
+        231_191_798.4 / 12_710_846.2, 3
+    )
+    # runtime-guard fields ride along for decide_defaults
+    assert rec["n_compiles"] == 4 and rec["n_compiles_first"] == 4
+    assert rec["host_transfers"] == 0
+    json.dumps(rec)  # one JSON line, always serializable
+
+
+def test_xor_schedule_decode_record_zero_dense_rate():
+    # a failed dense pass must not divide by zero or fake a win
+    rec = config4.build_xor_schedule_record(
+        "cpu", "liberation", 1 << 23, _FakeSchedule(), 1e9, 0.0, _XOR_STATS
+    )
+    assert rec["schedule_vs_dense"] == 0.0 and rec["vs_baseline"] == 0.0
+
+
+def test_xor_schedule_encode_record_schema():
+    import json
+
+    rec = config2.build_xor_encode_record(
+        "tpu", "cauchy_good", _FakeSchedule(), 3.2e9, 2.5e9, _XOR_STATS
+    )
+    assert rec["metric"] == "ec_encode_xor_schedule_bytes_per_sec"
+    assert rec["value"] == 3_200_000_000 and rec["unit"] == "B/s"
+    assert rec["xor_technique"] == "cauchy_good"
+    assert rec["xor_count"] == 43 and rec["xor_naive_count"] == 78
+    assert rec["xor_reduction_fraction"] == round(1.0 - 43 / 78, 9)
+    assert rec["schedule_bytes_per_sec"] == 3_200_000_000
+    assert rec["dense_bytes_per_sec"] == 2_500_000_000
+    assert rec["schedule_vs_dense"] == rec["vs_baseline"] == 1.28
+    assert rec["n_compiles"] == 4
+    json.dumps(rec)  # one JSON line, always serializable
+
+
 def test_device_result_uses_headline_metric():
     out = bench.format_result({"rate": 2_000_000.0, "platform": "tpu"}, 200_000.0, [])
     assert out["metric"] == "crush_placements_per_sec"

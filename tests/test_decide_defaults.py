@@ -231,6 +231,32 @@ def test_harvest_guard_collects_multichip_counters(tmp_path):
     assert aux["recovery_multichip_bytes_per_sec"] == 23_000_000
 
 
+def test_harvest_guard_collects_xor_schedule_fields(tmp_path):
+    """config2/config4 --xor-schedule lines carry the compile-time XOR
+    counts (int) and the schedule-vs-dense rates (float) into the
+    guard harvest."""
+    p = _log(tmp_path, [
+        {"metric": "repair_xor_schedule_bytes_per_sec", "platform": "tpu",
+         "value": 231_191_798, "n_compiles": 4, "n_compiles_first": 4,
+         "host_transfers": 0, "xor_technique": "blaum_roth",
+         "group_bytes": 16_760_832, "xor_count": 43,
+         "xor_naive_count": 78, "xor_reduction_fraction": 0.448717949,
+         "schedule_bytes_per_sec": 231_191_798,
+         "dense_bytes_per_sec": 12_710_846, "schedule_vs_dense": 18.189},
+    ])
+    g = dd.harvest_guard([p])["repair_xor_schedule_bytes_per_sec"]
+    assert g["xor_count"] == 43 and g["xor_naive_count"] == 78
+    assert g["group_bytes"] == 16_760_832
+    assert g["xor_reduction_fraction"] == 0.448717949
+    assert g["schedule_bytes_per_sec"] == 231_191_798.0
+    assert g["dense_bytes_per_sec"] == 12_710_846.0
+    assert g["schedule_vs_dense"] == 18.189
+    assert isinstance(g["schedule_vs_dense"], float)
+    assert g["steady_state_clean"] is True
+    # the label string stays in the bench line only
+    assert "xor_technique" not in g
+
+
 def test_harvest_guard_chaos_fields_absent_when_not_emitted(tmp_path):
     p = _log(tmp_path, [
         {"metric": "recovery_decode_bytes_per_sec", "platform": "tpu",

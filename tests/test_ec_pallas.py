@@ -24,8 +24,19 @@ def test_pallas_matches_host_bitmatrix(k, m, p):
     assert np.array_equal(got, want)
 
 
-def test_pallas_rejects_unaligned_packetsize():
+@pytest.mark.parametrize("p", [2, 3, 5, 7])
+def test_pallas_unaligned_packetsize_matches_host(p):
+    """Packet sizes that are not a u32 multiple used to be rejected;
+    now each packet is tail-padded to a whole word (XOR of zero-padded
+    packets is the zero-padded XOR) and trimmed on output."""
+    rng = random.Random(p)
     mat = gf.cauchy_matrix(4, 2)
     bm = gf.matrix_to_bitmatrix(mat)
-    with pytest.raises(ValueError):
-        PallasBitmatrixEncoder(bm, 2, interpret=True)
+    size = 8 * p * 3
+    data = np.frombuffer(
+        rng.randbytes(4 * size), np.uint8
+    ).reshape(4, size).copy()
+    enc = PallasBitmatrixEncoder(bm, p, interpret=True)
+    got = enc.encode(data)
+    want = gf.bitmatrix_encode(bm, data, p)
+    assert np.array_equal(got, want)

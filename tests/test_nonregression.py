@@ -41,7 +41,9 @@ def test_hot_paths_compile_once():
     a value-only change (reweight / fresh chunk bytes) that recompiles
     is the J004 bug class at runtime and would gut the bench rates."""
     report = nonregression.compile_once_cases()  # raises on recompile
-    assert set(report) == {"pool_mapping", "pattern_decode"}
+    assert set(report) == {
+        "pool_mapping", "pattern_decode", "schedule_decode"
+    }
     for name, counts in report.items():
         assert counts["warm_compiles"] > 0, (name, counts)
         assert counts["second_compiles"] == 0

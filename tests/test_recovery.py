@@ -307,6 +307,60 @@ def test_plan_unwraps_plugin_codec():
     assert plan.n_patterns == 1
 
 
+@pytest.mark.parametrize("profile,masks", [
+    ({"plugin": "jerasure", "technique": "liberation", "k": "4", "m": "2",
+      "w": "5", "packetsize": "8"}, [0b011110, 0b110011]),
+    ({"plugin": "jerasure", "technique": "blaum_roth", "k": "4", "m": "2",
+      "w": "6", "packetsize": "8"}, [0b011110, 0b111001]),
+    ({"plugin": "jerasure", "technique": "liber8tion", "k": "4", "m": "2",
+      "packetsize": "8"}, [0b011110, 0b101101]),
+])
+def test_plan_builds_for_bitmatrix_native_codecs(profile, masks):
+    """Regression: liberation / blaum_roth / liber8tion used to be
+    rejected by the planner (no GF(2^8) generator); they now
+    pattern-group at the bit-row level and decode end-to-end through
+    the executor's XOR-schedule path, byte-identically."""
+    from ceph_tpu.ec.registry import create
+
+    plugin = create(profile)
+    codec = plugin.codec
+    k, m_par, w = codec.k, codec.m, codec.w
+    plan = rec.build_plan(_synth_peering(k, m_par, masks), plugin)
+    assert plan.n_patterns == len(masks)
+    for g in plan.groups:
+        # bit-level groups: no GF(2^8) repair matrix to misuse
+        assert g.repair_matrix is None
+        assert g.repair_bitmatrix is not None
+        assert g.repair_bitmatrix.shape == (len(g.missing) * w, k * w)
+        assert (g.w, g.packetsize) == (w, codec.packetsize)
+    chunk = 2 * w * codec.packetsize
+    rng = np.random.default_rng(3)
+    store = {}
+    for g in plan.groups:
+        for pg in g.pgs:
+            data = rng.integers(0, 256, (k, chunk), dtype=np.uint8)
+            store[int(pg)] = np.vstack([data, codec.encoder.encode(data)])
+    ex = rec.RecoveryExecutor(plugin)
+    res = ex.run(plan, lambda pg, s: store[pg][s])
+    assert res.schedule_launches == plan.n_patterns
+    for g in plan.groups:
+        for pg in g.pgs:
+            for s in g.missing:
+                np.testing.assert_array_equal(
+                    res.shards[int(pg)][s], store[int(pg)][s]
+                )
+
+
+def test_plan_error_names_locality_plugins():
+    """The unsupported-codec failure mode must say what the codec is
+    and where its support lives, not just throw a bare TypeError."""
+    from ceph_tpu.ec.registry import create
+
+    lrc = create({"plugin": "lrc", "k": "4", "m": "2", "l": "3"})
+    with pytest.raises(TypeError, match="LRC, SHEC, CLAY"):
+        rec.build_plan(_synth_peering(4, 2, [0b001111]), lrc)
+
+
 # ---- throttle + executor ---------------------------------------------
 
 

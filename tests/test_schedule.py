@@ -1,0 +1,288 @@
+"""XOR-schedule compiler (ceph_tpu.ec.schedule): CSE correctness on
+random GF(2) matrices, both data layouts byte-identical to the dense
+references, the >= 20% reduction bar on the minimal-density decode
+patterns, schedule-cache counters, and the admin-socket dump hook."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import gf, gfw
+from ceph_tpu.ec.backend import BitmatrixCodec, BitmatrixEncoder, TableEncoder
+from ceph_tpu.ec.schedule import (
+    DenseBitmatrixAdapter,
+    ScheduleCache,
+    XorScheduleEncoder,
+    compile_schedule,
+    dump_ec_schedules,
+    encoder_for_group,
+    pack_bitplanes,
+    pack_packet_rows,
+    schedule_counters,
+    unpack_bitplanes,
+    unpack_packet_rows,
+)
+
+
+def _dense_gf2(bm, words):
+    """Reference product: out[i] = XOR of words[j] where bm[i, j]."""
+    out = np.zeros((bm.shape[0], words.shape[1]), np.uint32)
+    for i in range(bm.shape[0]):
+        for j in np.flatnonzero(bm[i]):
+            out[i] ^= words[j]
+    return out
+
+
+# ---- compiler --------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_compiled_schedule_matches_dense_product(seed):
+    rng = np.random.default_rng(seed)
+    n_out, n_in = rng.integers(2, 20, 2)
+    bm = (rng.random((n_out, n_in)) < 0.45).astype(np.uint8)
+    sched = compile_schedule(bm)
+    words = rng.integers(0, 1 << 32, (n_in, 17), dtype=np.uint64).astype(
+        np.uint32
+    )
+    np.testing.assert_array_equal(
+        sched.execute_host(words), _dense_gf2(bm, words)
+    )
+    # XOR accounting: CSE only ever removes XORs, and the metric is the
+    # literature's (an r-term sum costs r-1; a move is free)
+    assert sched.xor_count <= sched.naive_xor_count
+    assert sched.naive_xor_count == sum(
+        max(int(r.sum()) - 1, 0) for r in bm
+    )
+
+
+def test_empty_and_singleton_rows():
+    # an all-zero output row and a move-only row both cost 0 XORs
+    bm = np.array([[0, 0, 0], [1, 0, 0]], np.uint8)
+    sched = compile_schedule(bm)
+    assert sched.xor_count == sched.naive_xor_count == 0
+    words = np.arange(3, dtype=np.uint32)[:, None]
+    out = sched.execute_host(words)
+    assert out[0, 0] == 0 and out[1, 0] == 0
+
+
+def test_max_derived_caps_scratch_but_stays_correct():
+    rng = np.random.default_rng(9)
+    bm = (rng.random((24, 32)) < 0.5).astype(np.uint8)
+    full = compile_schedule(bm)
+    capped = compile_schedule(bm, max_derived=2)
+    assert capped.n_bufs <= bm.shape[1] + bm.shape[0] + 2
+    assert capped.xor_count >= full.xor_count
+    words = rng.integers(0, 1 << 16, (32, 9)).astype(np.uint32)
+    np.testing.assert_array_equal(
+        capped.execute_host(words), full.execute_host(words)
+    )
+
+
+@pytest.mark.parametrize("name,bits,w", [
+    ("liberation", gfw.liberation_bitmatrix(4, 7), 7),
+    ("blaum_roth", gfw.blaum_roth_bitmatrix(4, 6), 6),
+    ("liber8tion", gfw.liber8tion_bitmatrix(4), 8),
+])
+def test_decode_pattern_reduction_clears_20_percent(name, bits, w):
+    """The acceptance bar: on the minimal-density codes' double-failure
+    repair bitmatrices (data shard 0 + coding shard k lost), CSE must
+    remove >= 20% of the dense product's XORs."""
+    k = 4
+    gen_bits = np.vstack([np.eye(k * w, dtype=np.uint8), bits])
+    missing = (0, k)
+    rows = [s for s in range(k + 2) if s not in missing][:k]
+    sub = np.vstack([gen_bits[r * w:(r + 1) * w] for r in rows])
+    need = np.vstack([gen_bits[s * w:(s + 1) * w] for s in missing])
+    repair = gf.bitmatrix_multiply(need, gf.invert_bitmatrix(sub))
+    sched = compile_schedule(repair)
+    assert sched.reduction_fraction >= 0.20, (
+        name, sched.xor_count, sched.naive_xor_count
+    )
+
+
+# ---- layouts ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("packetsize", [4, 8, 3, 5])
+def test_packet_layout_roundtrip(packetsize):
+    w, k = 6, 3
+    size = 2 * w * packetsize
+    rng = np.random.default_rng(packetsize)
+    data = rng.integers(0, 256, (k, size), dtype=np.uint8)
+    words = pack_packet_rows(data, w, packetsize)
+    back = unpack_packet_rows(words, k, w, packetsize, size)
+    np.testing.assert_array_equal(back, data)
+
+
+def test_bitplane_layout_roundtrip_unaligned_size():
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, (2, 999), dtype=np.uint8)
+    words = pack_bitplanes(data)
+    back = unpack_bitplanes(words, 2, 999)
+    np.testing.assert_array_equal(back, data)
+
+
+@pytest.mark.parametrize("packetsize", [8, 6])
+def test_packet_schedule_matches_dense_bitmatrix(packetsize):
+    """XorScheduleEncoder (packet layout) vs BitmatrixEncoder on the
+    liberation coding bitmatrix — byte-identical, including an odd
+    packetsize that exercises the word-pad path."""
+    k, w = 4, 5
+    bits = gfw.liberation_bitmatrix(k, w)
+    size = 3 * w * packetsize
+    rng = np.random.default_rng(packetsize)
+    data = rng.integers(0, 256, (k, size), dtype=np.uint8)
+    enc = XorScheduleEncoder(bits, layout="packet", w=w,
+                             packetsize=packetsize)
+    want = BitmatrixEncoder(bits, packetsize, w).encode(data)
+    np.testing.assert_array_equal(enc.encode(data), want)
+
+
+def test_bitplane_schedule_matches_table_encoder():
+    """Bit-plane layout on matrix_to_bitmatrix(R) == the byte-wise
+    GF(2^8) LUT product, on an unaligned chunk size."""
+    k, m = 4, 2
+    mat = gf.vandermonde_matrix(k, m)
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, (k, 1000), dtype=np.uint8)
+    enc = XorScheduleEncoder(gf.matrix_to_bitmatrix(mat), layout="bitplane")
+    want = TableEncoder(mat).encode(data)
+    np.testing.assert_array_equal(enc.encode(data), want)
+
+
+def test_unknown_layout_rejected():
+    with pytest.raises(ValueError):
+        XorScheduleEncoder(np.eye(8, dtype=np.uint8), layout="words")
+
+
+# ---- cache + counters + admin hook -----------------------------------
+
+
+def _liberation_group(mask=0b011110):
+    """A minimal bit-level PatternGroup stand-in."""
+    from ceph_tpu.recovery.planner import PatternGroup
+
+    k, w, ps = 4, 5, 8
+    bits = gfw.liberation_bitmatrix(k, w)
+    gen_bits = np.vstack([np.eye(k * w, dtype=np.uint8), bits])
+    survivors = tuple(s for s in range(k + 2) if (mask >> s) & 1)
+    rows = survivors[:k]
+    missing = tuple(s for s in range(k + 2) if s not in survivors)
+    sub = np.vstack([gen_bits[r * w:(r + 1) * w] for r in rows])
+    need = np.vstack([gen_bits[s * w:(s + 1) * w] for s in missing])
+    return PatternGroup(
+        mask=mask, survivors=survivors, rows=rows, missing=missing,
+        pgs=np.array([0]), repair_matrix=None,
+        repair_bitmatrix=gf.bitmatrix_multiply(
+            need, gf.invert_bitmatrix(sub)
+        ),
+        w=w, packetsize=ps,
+    )
+
+
+def _counters():
+    return dict(schedule_counters().dump()["ec_schedule"])
+
+
+def test_schedule_cache_counts_compiles_and_hits():
+    cache = ScheduleCache(name="t1")
+    g = _liberation_group()
+    before = _counters()
+    enc = encoder_for_group(cache, g, "auto")
+    assert isinstance(enc, XorScheduleEncoder)
+    mid = _counters()
+    assert mid["schedules_compiled"] == before["schedules_compiled"] + 1
+    assert mid["schedule_xor_count"] == (
+        before["schedule_xor_count"] + enc.schedule.xor_count
+    )
+    assert mid["schedule_xor_naive"] == (
+        before["schedule_xor_naive"] + enc.schedule.naive_xor_count
+    )
+    # second fetch: same engine, a hit, no new compile
+    assert encoder_for_group(cache, g, "auto") is enc
+    after = _counters()
+    assert after["schedule_cache_hits"] == mid["schedule_cache_hits"] + 1
+    assert after["schedules_compiled"] == mid["schedules_compiled"]
+    assert len(cache) == 1
+
+
+def test_mode_off_builds_dense_adapter_without_xor_counters():
+    cache = ScheduleCache(name="t2")
+    before = _counters()
+    enc = encoder_for_group(cache, _liberation_group(), "off")
+    assert isinstance(enc, DenseBitmatrixAdapter)
+    after = _counters()
+    # dense engines compile no schedule, so the XOR counters stay put
+    assert after["schedules_compiled"] == before["schedules_compiled"]
+    assert after["schedule_xor_count"] == before["schedule_xor_count"]
+
+
+def test_mode_on_expands_table_group_to_bitplane():
+    from ceph_tpu.recovery.planner import PatternGroup
+
+    k, m = 4, 2
+    repair = gf.vandermonde_matrix(k, m)[[0]]  # any [1, k] GF(2^8) row
+    g = PatternGroup(
+        mask=0b011110, survivors=(1, 2, 3, 4), rows=(1, 2, 3, 4),
+        missing=(0,), pgs=np.array([0]), repair_matrix=repair,
+    )
+    enc = encoder_for_group(ScheduleCache(name="t3"), g, "on")
+    assert isinstance(enc, XorScheduleEncoder) and enc.layout == "bitplane"
+
+
+def test_dump_ec_schedules_reports_caches_and_counters():
+    cache = ScheduleCache(name="t4")
+    encoder_for_group(cache, _liberation_group(), "auto")
+    encoder_for_group(cache, _liberation_group(0b111100), "off")
+    dump = dump_ec_schedules()
+    mine = [c for c in dump["caches"] if c["name"] == "t4"]
+    assert len(mine) == 1
+    engines = {e["key"]: e for e in mine[0]["entries"]}
+    sched_entry = engines[str(("packet", 0b011110))]
+    assert sched_entry["engine"] == "schedule"
+    assert sched_entry["xor_count"] <= sched_entry["naive_xor_count"]
+    assert 0.0 <= sched_entry["reduction_fraction"] <= 1.0
+    assert engines[str(("dense", 0b111100))]["engine"] == "dense"
+    assert dump["counters"]["ec_schedule"]["schedules_compiled"] >= 1
+
+
+def test_admin_socket_dump_ec_schedules_hook(tmp_path):
+    from ceph_tpu.common.admin_socket import AdminSocket, ask
+
+    cache = ScheduleCache(name="sock")
+    encoder_for_group(cache, _liberation_group(), "auto")
+    sock = AdminSocket(str(tmp_path / "asok"))
+    sock.start()
+    try:
+        reply = ask(str(tmp_path / "asok"), "dump_ec_schedules")
+    finally:
+        sock.stop()
+    assert any(c["name"] == "sock" for c in reply["caches"])
+    assert "ec_schedule" in reply["counters"]
+
+
+# ---- end-to-end vs BitmatrixCodec decode -----------------------------
+
+
+def test_schedule_decode_matches_codec_decode():
+    """Full repair through the schedule == BitmatrixCodec.decode."""
+    k, w, ps = 4, 6, 8
+    codec = BitmatrixCodec(gfw.blaum_roth_bitmatrix(k, w), w, ps)
+    size = 2 * w * ps
+    rng = np.random.default_rng(21)
+    data = rng.integers(0, 256, (k, size), dtype=np.uint8)
+    shards = np.vstack([data, codec.encoder.encode(data)])
+    missing = (0, k)
+    gen_bits = codec.generator_bits()
+    rows = [s for s in range(k + 2) if s not in missing][:k]
+    sub = np.vstack([gen_bits[r * w:(r + 1) * w] for r in rows])
+    need = np.vstack([gen_bits[s * w:(s + 1) * w] for s in missing])
+    repair = gf.bitmatrix_multiply(need, gf.invert_bitmatrix(sub))
+    enc = XorScheduleEncoder(repair, layout="packet", w=w, packetsize=ps)
+    got = enc.encode(shards[rows])
+    serial = codec.decode(
+        {s: shards[s] for s in rows}, set(missing)
+    )
+    for i, s in enumerate(missing):
+        np.testing.assert_array_equal(got[i], serial[s])
+        np.testing.assert_array_equal(got[i], shards[s])
