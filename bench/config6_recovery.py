@@ -35,6 +35,16 @@ arbiter — and closed by an induced capacity overload on the converged
 cluster.  The ``traffic_*`` fields carry the wall-clock routing
 throughput, the worst per-sample p99 under each policy, outcome
 fractions, the slow-op SLO verdicts, and the per-class QoS grants.
+
+``--scrub`` runs the data-integrity variant instead: the device
+CRC32C scrub rate over an EC-consistent store (compile guarded), then
+the seeded bitrot chaos scenario (default ``scrub-storm``) through the
+supervised executor with a :class:`ceph_tpu.recovery.Scrubber` riding
+it, twice — with and without the mclock ``scrub`` QoS class.  The
+``scrub_*`` fields carry pass/byte/inconsistency counts, verify
+retries, and the time-to-zero-inconsistent and client-p99 deltas the
+scrub class buys — the guard surface ``decide_defaults`` watches for
+integrity regressions.
 """
 
 import json
@@ -423,6 +433,208 @@ def run_traffic(scenario: str) -> dict:
     )
 
 
+#: scrub-pass tuning (virtual-time QoS figures)
+SCRUB_ARBITER_CAP_BPS = 8e6
+SCRUB_OPS = 16384
+SCRUB_SLO = dict(
+    max_inconsistent_seconds=60.0,
+    max_scrub_age_s=120.0,
+    # looser than TRAFFIC_SLO: the storm phase legitimately runs a
+    # ~13 ms p99 (scrub + repair + client contending); the budget
+    # catches regressions, not the baseline
+    max_p99_latency_ms=20.0,
+)
+
+
+def build_scrub_record(
+    scenario: str,
+    res_arb,
+    res_noarb,
+    timeline,
+    report,
+    rate: float,
+    platform: str,
+    guard: dict,
+    warm: dict,
+    qos: dict,
+) -> dict:
+    """The ``--scrub`` JSON line (pure: schema-tested without running
+    the bench).  ``res_*`` are SupervisedResults from the arbiter /
+    no-arbiter chaos passes; ``rate`` is the standalone device CRC32C
+    scrub rate; ``guard``/``warm`` its runtime-guard snapshots."""
+    return {
+        "metric": "scrub_crc32c_bytes_per_sec",
+        "value": round(rate),
+        "unit": "B/s",
+        "platform": platform,
+        "n_compiles": int(guard["n_compiles"]),
+        "n_compiles_first": int(warm["n_compiles"]),
+        "host_transfers": int(guard["host_transfers"]),
+        "scrub_scenario": scenario,
+        "scrub_converged": res_arb.converged,
+        "scrub_passes": int(res_arb.scrub_passes),
+        "scrub_scrubbed_bytes": int(res_arb.scrubbed_bytes),
+        "scrub_inconsistencies_found": int(res_arb.inconsistencies_found),
+        "scrub_verify_retries": int(res_arb.verify_retries),
+        "scrub_unrecoverable": int(len(res_arb.inconsistent_unrecoverable)),
+        "scrub_time_to_zero_inconsistent_s": round(
+            res_arb.time_to_zero_inconsistent_s, 6
+        ),
+        "scrub_time_to_zero_inconsistent_s_no_arbiter": round(
+            res_noarb.time_to_zero_inconsistent_s, 6
+        ),
+        "scrub_p99_ms": round(timeline.max_traffic_p99_ms(), 6),
+        "scrub_health_status": report.status,
+        "scrub_slo_checks": {c.name: c.status for c in report.checks},
+        "scrub_qos": qos,
+    }
+
+
+def _consistent_store(pg_num: int, chunk: int, codec, seed: int = 6):
+    """A verified store must be EC-consistent (decode-verify recomputes
+    write-time checksums): every stripe is k random data shards plus
+    their actual parity."""
+    rng = np.random.default_rng(seed)
+    chunks: dict[tuple[int, int], np.ndarray] = {}
+    for pg in range(pg_num):
+        data = rng.integers(0, 256, (K, chunk), dtype=np.uint8)
+        parity = np.asarray(codec.encode(data), np.uint8)
+        for s in range(K):
+            chunks[(pg, s)] = data[s].copy()
+        for j in range(M):
+            chunks[(pg, K + j)] = parity[j].copy()
+
+    def read_shard(pg, s):
+        return chunks[(int(pg), int(s))]
+
+    def write_shard(pg, s, buf):
+        chunks[(int(pg), int(s))] = np.asarray(buf, np.uint8).copy()
+
+    return chunks, read_shard, write_shard
+
+
+def _scrub_pass(scenario: str, use_arbiter: bool):
+    """One seeded bitrot chaos run with a CRC32C scrubber (and a
+    traffic engine, so the client p99 under scrub load is measured);
+    with ``use_arbiter`` the mclock trio gates the ``scrub`` class."""
+    import copy
+
+    from ceph_tpu import recovery as rec
+    from ceph_tpu.common.config import Config
+    from ceph_tpu.ec.backend import MatrixCodec
+    from ceph_tpu.ec.gf import vandermonde_matrix
+    from ceph_tpu.models.clusters import build_osdmap
+    from ceph_tpu.obs import EventJournal, HealthTimeline, SLOSpec, evaluate
+    from ceph_tpu.workload import MClockArbiter, TrafficEngine
+
+    m = build_osdmap(N_OSDS, pg_num=PG_NUM, size=K + M, pool_kind="erasure")
+    m_prev = copy.deepcopy(m)
+    clock = rec.VirtualClock()
+    journal = EventJournal(
+        clock=clock.now, trace_id=f"bench6-scrub-{scenario}"
+    )
+    chaos = rec.ChaosEngine(
+        m, rec.build_scenario(scenario, m), clock=clock, journal=journal
+    )
+    codec = MatrixCodec(vandermonde_matrix(K, M))
+    spec = SLOSpec(**SCRUB_SLO)
+    timeline = HealthTimeline(
+        clock.now, k=K, sample_status=spec.sample_status
+    )
+    arbiter = None
+    if use_arbiter:
+        cfg = Config()
+        cfg.set("osd_mclock_client_res_bps", SCRUB_ARBITER_CAP_BPS / 2)
+        cfg.set("osd_mclock_recovery_res_bps", SCRUB_ARBITER_CAP_BPS / 8)
+        cfg.set("osd_mclock_scrub_res_bps", SCRUB_ARBITER_CAP_BPS / 16)
+        cfg.set("osd_mclock_scrub_lim_bps", SCRUB_ARBITER_CAP_BPS / 4)
+        arbiter = MClockArbiter.from_config(
+            SCRUB_ARBITER_CAP_BPS, cfg,
+            clock=clock.now, sleep=clock.sleep,
+        )
+    traffic = TrafficEngine(
+        clock.now, N_OSDS, PG_NUM, K, K + M, K + 1,
+        ops_per_step=SCRUB_OPS,
+        service_ms=TRAFFIC_SERVICE_MS,
+        osd_capacity_ops_per_s=TRAFFIC_OSD_CAP_OPS,
+        recovery_capacity_bps=TRAFFIC_REC_CAP_BPS,
+        op_bytes=TRAFFIC_OP_BYTES,
+        slow_ms=TRAFFIC_SLOW_MS,
+        seed=6,
+        arbiter=arbiter,
+        journal=journal,
+    )
+    _chunks, read_shard, write_shard = _consistent_store(
+        PG_NUM, CHAOS_CHUNK, codec
+    )
+    scrubber = rec.Scrubber(
+        PG_NUM, K + M, arbiter=arbiter, journal=journal, clock=clock.now
+    )
+
+    def corrupt(pg, s, off, mask):
+        rec.apply_bitrot(read_shard(pg, s), off, mask)
+
+    chaos.corrupt = corrupt
+    sup = rec.SupervisedRecovery(
+        codec, chaos, seed=0, journal=journal, health=timeline,
+        traffic=traffic, arbiter=arbiter, scrubber=scrubber,
+        write_shard=write_shard,
+    )
+    res = sup.run(m_prev, 1, read_shard)
+    report = evaluate(timeline, spec)
+    return res, timeline, report, arbiter
+
+
+def run_scrub(scenario: str) -> None:
+    """The ``--scrub`` bench: standalone device scrub rate (compile
+    guarded), then the seeded bitrot chaos pass twice — with and
+    without the mclock ``scrub`` QoS class — so the line carries the
+    time-to-zero-inconsistent and client-p99 deltas the scrub class is
+    supposed to buy.  One JSON line."""
+    from ceph_tpu.common.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    import jax
+
+    from ceph_tpu import recovery as rec
+    from ceph_tpu.analysis.runtime_guard import track
+    from ceph_tpu.ec.backend import MatrixCodec
+    from ceph_tpu.ec.gf import vandermonde_matrix
+
+    codec = MatrixCodec(vandermonde_matrix(K, M))
+    _chunks, read_shard, _write = _consistent_store(PG_NUM, CHUNK, codec)
+    scrubber = rec.Scrubber(PG_NUM, K + M)
+    with track() as guard:
+        scrubber.build_checksums(read_shard)
+        scrubber.scrub(read_shard)  # warm (one compile per pool shape)
+        warm = guard.snapshot()
+        t0 = time.perf_counter()
+        sr = scrubber.scrub(read_shard)
+        t_scrub = time.perf_counter() - t0
+    rate = sr.scrubbed_bytes / t_scrub
+    assert sr.n_inconsistent == 0, sr.n_inconsistent  # clean store
+
+    res_no, _tl_no, _rep_no, _ = _scrub_pass(scenario, False)
+    res_arb, timeline, report, arbiter = _scrub_pass(scenario, True)
+    print(
+        f"scrub {scenario}: device CRC32C {rate / 1e6:.1f} MB/s "
+        f"({sr.scrubbed_bytes} B/pass); chaos "
+        f"{'converged' if res_arb.converged else 'DIVERGED'}, "
+        f"{res_arb.scrub_passes} passes / "
+        f"{res_arb.inconsistencies_found} inconsistencies / "
+        f"{res_arb.verify_retries} verify retries, "
+        f"t_zero_inconsistent {res_arb.time_to_zero_inconsistent_s:g}s "
+        f"with arbiter vs {res_no.time_to_zero_inconsistent_s:g}s "
+        f"without; SLO {report.status}",
+        file=sys.stderr,
+    )
+    print(json.dumps(build_scrub_record(
+        scenario, res_arb, res_no, timeline, report, rate,
+        jax.default_backend(), guard.snapshot(), warm,
+        arbiter.summary(),
+    )))
+
+
 def main() -> None:
     from ceph_tpu.common.compile_cache import enable_persistent_cache
 
@@ -539,5 +751,10 @@ if __name__ == "__main__":
                 flags + " --xla_force_host_platform_device_count=8"
             ).strip()
         run_multichip()
+    elif "--scrub" in sys.argv:
+        scenario = "scrub-storm"
+        if "--chaos" in sys.argv:
+            scenario = sys.argv[sys.argv.index("--chaos") + 1]
+        run_scrub(scenario)
     else:
         main()

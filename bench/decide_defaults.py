@@ -46,7 +46,8 @@ MODES = {
 # tracking (they never vote on the kernel-mode winner): currently the
 # recovery subsystem's batched repair-decode rate (config6_recovery).
 AUX_METRICS = ("recovery_decode_bytes_per_sec",
-               "recovery_multichip_bytes_per_sec")
+               "recovery_multichip_bytes_per_sec",
+               "scrub_crc32c_bytes_per_sec")
 
 # Runtime-guard fields the bench configs attach to their JSON lines
 # (ceph_tpu.analysis.runtime_guard): compile and device->host transfer
@@ -107,6 +108,21 @@ XOR_SCHEDULE_FLOAT_FIELDS = ("xor_reduction_fraction",
                              "schedule_bytes_per_sec",
                              "dense_bytes_per_sec",
                              "schedule_vs_dense")
+
+# Data-integrity fields (config6_recovery --scrub): the seeded bitrot
+# pass's scrub/verify counters are exact under the virtual clock (a
+# diff means detection or verified repair changed behavior — more
+# verify retries or any unrecoverable PG under the same timeline is an
+# integrity regression); time-to-zero-inconsistent with vs without the
+# mclock scrub class and the client p99 under scrub load are the QoS
+# verdict.
+SCRUB_INT_FIELDS = ("scrub_passes", "scrub_scrubbed_bytes",
+                    "scrub_inconsistencies_found", "scrub_verify_retries",
+                    "scrub_unrecoverable")
+SCRUB_FLOAT_FIELDS = ("scrub_time_to_zero_inconsistent_s",
+                      "scrub_time_to_zero_inconsistent_s_no_arbiter",
+                      "scrub_p99_ms")
+SCRUB_STR_FIELDS = ("scrub_health_status",)
 
 
 def harvest_aux(paths: list[str]) -> dict[str, int]:
@@ -187,6 +203,15 @@ def harvest_guard(paths: list[str]) -> dict[str, dict]:
             fields.update(
                 {f: float(d[f]) for f in XOR_SCHEDULE_FLOAT_FIELDS if f in d}
             )
+            fields.update(
+                {f: int(d[f]) for f in SCRUB_INT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: float(d[f]) for f in SCRUB_FLOAT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: str(d[f]) for f in SCRUB_STR_FIELDS if f in d}
+            )
             if not fields:
                 continue
             if "n_compiles" in fields and "n_compiles_first" in fields:
@@ -195,6 +220,8 @@ def harvest_guard(paths: list[str]) -> dict[str, dict]:
                 )
             if "chaos_converged" in d:
                 fields["chaos_converged"] = bool(d["chaos_converged"])
+            if "scrub_converged" in d:
+                fields["scrub_converged"] = bool(d["scrub_converged"])
             guard[d["metric"]] = fields
     return guard
 

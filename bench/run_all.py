@@ -40,6 +40,8 @@ CONFIGS = [
     ("config6_recovery", "bench/config6_recovery.py"),
     ("config6_recovery_multichip", "bench/config6_recovery.py",
      ("--multichip",)),
+    ("config6_recovery_scrub", "bench/config6_recovery.py",
+     ("--scrub",)),
     ("tpu_tier", "bench/tpu_tier.py"),
 ]
 

@@ -103,6 +103,9 @@ def _demo(args, out) -> tuple[dict, dict]:
     chaos = ChaosEngine(
         m, build_scenario(args.scenario, m), clock=clock, journal=journal
     )
+    scrub_on = args.scrub or args.scenario in (
+        "silent-bitrot", "scrub-storm"
+    )
     spec = SLOSpec(
         max_inactive_seconds=args.max_inactive_seconds,
         min_availability_fraction=args.min_availability,
@@ -111,6 +114,10 @@ def _demo(args, out) -> tuple[dict, dict]:
         max_slow_op_fraction=(
             args.max_slow_fraction if args.traffic else None
         ),
+        max_inconsistent_seconds=(
+            args.max_inconsistent_seconds if scrub_on else None
+        ),
+        max_scrub_age_s=args.max_scrub_age if scrub_on else None,
     )
     timeline = HealthTimeline(
         clock.now, k=args.ec_k, sample_status=spec.sample_status
@@ -140,9 +147,41 @@ def _demo(args, out) -> tuple[dict, dict]:
             chunks[key] = rng.integers(0, 256, 1024, dtype=np.uint8)
         return chunks[key]
 
+    scrubber = None
+    write_shard = None
+    if scrub_on:
+        from ..recovery import Scrubber, apply_bitrot
+
+        # a verified store must be EC-consistent (decode-verify
+        # recomputes write-time checksums, so parity has to actually
+        # encode the data): materialize every stripe up front instead
+        # of lazily minting independent random chunks
+        for pg in range(args.pg_num):
+            data = rng.integers(
+                0, 256, (args.ec_k, 1024), dtype=np.uint8
+            )
+            parity = np.asarray(codec.encode(data), np.uint8)
+            for s in range(args.ec_k):
+                chunks[(pg, s)] = data[s].copy()
+            for j in range(args.ec_m):
+                chunks[(pg, args.ec_k + j)] = parity[j].copy()
+
+        scrubber = Scrubber(
+            args.pg_num, args.ec_k + args.ec_m,
+            journal=journal, clock=clock.now,
+        )
+        # bitrot events flip real bytes in the demo's host shard store;
+        # verified repair writes the decoded chunks back through it
+        chaos.corrupt = lambda pg, s, off, mask: apply_bitrot(
+            read_shard(pg, s), off, mask
+        )
+
+        def write_shard(pg: int, s: int, buf) -> None:
+            chunks[(int(pg), int(s))] = np.asarray(buf, np.uint8).copy()
+
     sup = SupervisedRecovery(
         codec, chaos, seed=args.seed, journal=journal, health=timeline,
-        traffic=traffic,
+        traffic=traffic, scrubber=scrubber, write_shard=write_shard,
     )
     res = sup.run(m_prev, 1, read_shard)
     journal.close()
@@ -152,8 +191,22 @@ def _demo(args, out) -> tuple[dict, dict]:
         f"{len(timeline)} samples, {len(journal.records)} journal records",
         file=sys.stderr,
     )
+    scrub_panel = None
+    if scrub_on:
+        scrub_panel = {
+            "passes": res.scrub_passes,
+            "scrubbed_bytes": res.scrubbed_bytes,
+            "inconsistencies_found": res.inconsistencies_found,
+            "verify_retries": res.verify_retries,
+            "inconsistent_unrecoverable": sorted(
+                res.inconsistent_unrecoverable
+            ),
+            "time_to_zero_inconsistent_s": round(
+                res.time_to_zero_inconsistent_s, 6
+            ),
+        }
     return {
-        "status": status_dict(timeline, spec),
+        "status": status_dict(timeline, spec, scrub=scrub_panel),
         "health": evaluate(timeline, spec).to_dict(),
         "timeline": {"series": timeline.to_dicts()},
         "journal": {"records": journal.records},
@@ -183,6 +236,13 @@ def main(argv=None) -> int:
     p.add_argument("--max-inactive-seconds", type=float, default=30.0)
     p.add_argument("--min-availability", type=float, default=0.75)
     p.add_argument("--max-recovery-seconds", type=float, default=30.0)
+    p.add_argument("--scrub", action="store_true",
+                   help="ride a CRC32C scrubber on the demo run (on by "
+                        "default for the bitrot scenarios): checksum "
+                        "the store, verify repairs, and render the "
+                        "scrub panel")
+    p.add_argument("--max-inconsistent-seconds", type=float, default=30.0)
+    p.add_argument("--max-scrub-age", type=float, default=60.0)
     p.add_argument("--traffic", action="store_true",
                    help="ride a client-traffic engine on the demo run: "
                         "per-sample latency percentiles, outcome "

@@ -127,6 +127,13 @@ SCHEMA: list[Option] = [
            "(bit-plane layout for table codecs); 'off' decodes "
            "bit-level groups with the dense bit-matrix product",
            enum_allowed=("auto", "on", "off")),
+    Option("recovery_schedule_cache_max", OPT_INT, 64, LEVEL_ADVANCED,
+           "bound on cached decode engines per ScheduleCache (compiled "
+           "XOR schedules + dense adapters), evicted LRU; 0 removes "
+           "the bound.  Long chaos timelines visit many erasure "
+           "patterns — without a bound the cache grows for the life "
+           "of the run", min=0,
+           see_also=("recovery_xor_schedule",)),
     Option("recovery_coschedule_max", OPT_INT, 4, LEVEL_ADVANCED,
            "small pattern groups dispatched back-to-back per "
            "supervised scheduling window when a mesh is attached "
@@ -160,6 +167,19 @@ SCHEMA: list[Option] = [
            "mclock limit for recovery (bytes/s hard cap bounding its "
            "interference with client tail latency); 0 means uncapped",
            min=0.0),
+    Option("osd_mclock_scrub_res_bps", OPT_FLOAT, 0.0, LEVEL_ADVANCED,
+           "mclock reservation for scrub traffic (bytes/s guaranteed "
+           "so client/recovery load can never starve integrity "
+           "checking); 0 disables",
+           min=0.0, see_also=("osd_mclock_scrub_wgt",
+                              "osd_mclock_scrub_lim_bps")),
+    Option("osd_mclock_scrub_wgt", OPT_FLOAT, 0.5, LEVEL_ADVANCED,
+           "mclock weight for scrub traffic (background work: half a "
+           "client share by default)", min=0.0),
+    Option("osd_mclock_scrub_lim_bps", OPT_FLOAT, 0.0, LEVEL_ADVANCED,
+           "mclock limit for scrub traffic (bytes/s hard cap bounding "
+           "a scrub storm's interference with client tail latency); 0 "
+           "means uncapped", min=0.0),
     Option("osd_max_backfills", OPT_INT, 1, LEVEL_ADVANCED,
            "backfill pattern groups admitted per repair group in the "
            "supervised scheduler (the reference's backfill reservation "

@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import heapq
 import weakref
+from collections import OrderedDict
 from dataclasses import dataclass
 from functools import partial
 
@@ -426,6 +427,16 @@ def _build_counters() -> PerfCounters:
             "schedule_cache_hits",
             "schedule-cache lookups served without recompiling",
         )
+        .add_u64_counter(
+            "schedule_cache_evictions",
+            "cached engines evicted by the LRU bound "
+            "(recovery_schedule_cache_max)",
+        )
+        .add_u64_counter(
+            "schedules_quarantined",
+            "compiled engines evicted + blacklisted after their output "
+            "failed decode-verify (miscompiled XOR schedules)",
+        )
         .create_perf_counters()
     )
 
@@ -445,11 +456,23 @@ class ScheduleCache:
     pattern applied to XOR schedules.  Hits and compile-time XOR
     counters land in the ``ec_schedule`` perf component (Prometheus
     scrapes it through the shared registry); live caches self-register
-    for the ``dump_ec_schedules`` admin hook."""
+    for the ``dump_ec_schedules`` admin hook.
 
-    def __init__(self, name: str = "recovery"):
+    ``max_entries`` bounds the cache LRU (``recovery_schedule_cache_max``
+    at the executor surface; 0 = unbounded): a long chaos timeline
+    visits many erasure patterns and must not grow device executables
+    without limit.  :meth:`quarantine` is the decode-verify eviction
+    path — an engine whose output failed CRC verification is dropped
+    AND blacklisted, so :func:`encoder_for_group` reroutes that pattern
+    to the dense reference engine instead of recompiling the same bad
+    schedule.
+    """
+
+    def __init__(self, name: str = "recovery", max_entries: int = 0):
         self.name = name
-        self._entries: dict = {}
+        self.max_entries = int(max_entries)
+        self._entries: OrderedDict = OrderedDict()
+        self._quarantined: set = set()
         self.pc = schedule_counters()
         _LIVE_CACHES.add(self)
 
@@ -457,9 +480,11 @@ class ScheduleCache:
         return len(self._entries)
 
     def get(self, key, build):
-        """Fetch the engine for ``key``, building (and counting) once."""
+        """Fetch the engine for ``key``, building (and counting) once;
+        refreshes the key's LRU position and evicts past the bound."""
         enc = self._entries.get(key)
         if enc is not None:
+            self._entries.move_to_end(key)
             self.pc.inc("schedule_cache_hits")
             return enc
         enc = self._entries[key] = build()
@@ -468,7 +493,25 @@ class ScheduleCache:
             self.pc.inc("schedules_compiled")
             self.pc.inc("schedule_xor_count", sched.xor_count)
             self.pc.inc("schedule_xor_naive", sched.naive_xor_count)
+        if self.max_entries > 0:
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+                self.pc.inc("schedule_cache_evictions")
         return enc
+
+    def quarantine(self, key) -> bool:
+        """Evict AND blacklist ``key`` (decode-verify caught its engine
+        shipping wrong bytes).  Returns True the first time — callers
+        journal ``scrub.schedule_quarantined`` exactly once per key."""
+        self._entries.pop(key, None)
+        if key in self._quarantined:
+            return False
+        self._quarantined.add(key)
+        self.pc.inc("schedules_quarantined")
+        return True
+
+    def is_quarantined(self, key) -> bool:
+        return key in self._quarantined
 
     def dump(self) -> dict:
         entries = []
@@ -490,7 +533,12 @@ class ScheduleCache:
                     reduction_fraction=round(sched.reduction_fraction, 4),
                 )
             entries.append(e)
-        return {"name": self.name, "entries": entries}
+        return {
+            "name": self.name,
+            "entries": entries,
+            "max_entries": self.max_entries,
+            "quarantined": sorted(str(k) for k in self._quarantined),
+        }
 
 
 def dump_ec_schedules() -> dict:
@@ -514,9 +562,13 @@ def encoder_for_group(cache: ScheduleCache, group, mode: str):
     path: their repair matrix expands through
     :func:`gf.matrix_to_bitmatrix` and executes in bit-plane layout,
     byte-identical to the LUT product.
+
+    A pattern whose schedule was quarantined (decode-verify caught it
+    shipping wrong bytes) permanently reroutes to the dense reference
+    engine — same repair bitmatrix, independent execution path.
     """
     if group.repair_bitmatrix is not None:
-        if mode == "off":
+        if mode == "off" or cache.is_quarantined(("packet", group.mask)):
             return cache.get(
                 ("dense", group.mask),
                 lambda: DenseBitmatrixAdapter(

@@ -114,11 +114,31 @@ class EventJournal:
 
     @staticmethod
     def read(path: str) -> list[dict]:
-        """Parse a journal file back into records."""
+        """Parse a journal file back into records — crash-tolerant.
+
+        Every record is flushed as it is emitted, so the only damage a
+        crash (or a full disk) can leave is a torn FINAL line.  That
+        tail is skipped, not raised: post-mortem replay of everything
+        that made it to disk is exactly the journal's job.  A
+        malformed line with valid records AFTER it is real corruption
+        and still raises, with the line number."""
         out = []
         with open(path) as fh:
-            for line in fh:
-                line = line.strip()
-                if line:
-                    out.append(json.loads(line))
+            lines = fh.readlines()
+        torn_at: int | None = None
+        for i, line in enumerate(lines):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError:
+                torn_at = i
+                continue
+            if torn_at is not None:
+                raise ValueError(
+                    f"{path}:{torn_at + 1}: corrupt journal line "
+                    "followed by valid records (not a torn tail)"
+                )
+            out.append(record)
         return out

@@ -14,8 +14,14 @@ States, most severe first (a PG lands in the first that applies):
   cannot be reconstructed, reads stall until an OSD returns.
 - ``undersized``    — the acting set has holes (fewer live members
   than ``size``).
+- ``inconsistent``  — a scrub pass found shard bytes whose CRC32C
+  disagrees with the stored checksum (silent corruption); repair must
+  rebuild them.  Flag-driven: only the scrubber can see shard bytes,
+  so the supervised loop annotates the peering flags host-side.
 - ``degraded``      — every slot is alive but some hold no data yet
   (remap-induced survivor loss); redundancy is reduced.
+- ``scrubbing``     — a scrub pass is running over the PG (also
+  flag-driven).
 - ``backfilling``   — data complete, but the up set has new members
   still being copied to.
 - ``active+clean``  — none of the above.
@@ -40,7 +46,9 @@ from ..parallel.padding import pad_to_multiple
 from ..parallel.placement import shard_map
 from ..recovery.peering import (
     PG_STATE_BACKFILL,
+    PG_STATE_INCONSISTENT,
     PG_STATE_REMAPPED,
+    PG_STATE_SCRUBBING,
     PeeringResult,
 )
 
@@ -51,15 +59,21 @@ STATE_BACKFILLING = 1
 STATE_DEGRADED = 2
 STATE_UNDERSIZED = 3
 STATE_INACTIVE = 4
-N_STATES = 5
+STATE_INCONSISTENT = 5
+STATE_SCRUBBING = 6
+N_STATES = 7
 
-#: histogram slot -> the ``ceph -s`` state string
+#: histogram slot -> the ``ceph -s`` state string (indices are
+#: append-only: recorded series/goldens keyed on the first five slots
+#: stay valid)
 STATE_NAMES = (
     "active+clean",
     "backfilling",
     "degraded",
     "undersized",
     "inactive",
+    "inconsistent",
+    "scrubbing",
 )
 
 
@@ -75,10 +89,17 @@ def _classify_rows(mask, n_alive, flags, k, size):
             jnp.where(
                 alive < size, STATE_UNDERSIZED,
                 jnp.where(
-                    nsurv < size, STATE_DEGRADED,
+                    (fl & PG_STATE_INCONSISTENT) != 0, STATE_INCONSISTENT,
                     jnp.where(
-                        (fl & PG_STATE_BACKFILL) != 0,
-                        STATE_BACKFILLING, STATE_ACTIVE_CLEAN,
+                        nsurv < size, STATE_DEGRADED,
+                        jnp.where(
+                            (fl & PG_STATE_SCRUBBING) != 0,
+                            STATE_SCRUBBING,
+                            jnp.where(
+                                (fl & PG_STATE_BACKFILL) != 0,
+                                STATE_BACKFILLING, STATE_ACTIVE_CLEAN,
+                            ),
+                        ),
                     ),
                 ),
             ),

@@ -48,6 +48,13 @@ class SLOSpec:
     - ``max_slow_op_fraction`` — ceiling on the per-sample fraction of
       client ops past the complaint time (``SLO_SLOW_OPS``, the ``N
       slow ops`` healthcheck analog).
+    - ``max_inconsistent_seconds`` — virtual seconds any PG may sit
+      scrub-flagged inconsistent (detected corruption awaiting
+      verified repair) over the whole timeline
+      (``SLO_DATA_INTEGRITY``, the ``PG_DAMAGED`` analog).
+    - ``max_scrub_age_s`` — the longest interval the run may go
+      without a completed scrub pass (``SLO_SCRUB_AGE``, the
+      ``PG_NOT_SCRUBBED`` analog).
     """
 
     max_inactive_seconds: float | None = None
@@ -56,6 +63,8 @@ class SLOSpec:
     min_repair_bandwidth_bps: float | None = None
     max_p99_latency_ms: float | None = None
     max_slow_op_fraction: float | None = None
+    max_inconsistent_seconds: float | None = None
+    max_scrub_age_s: float | None = None
     warn_fraction: float = 0.8
 
     def sample_status(self, sample: HealthSample) -> str:
@@ -238,5 +247,28 @@ def evaluate(timeline: HealthTimeline, spec: SLOSpec) -> HealthReport:
             f"per-sample slow fraction {observed:g} "
             f"(budget {spec.max_slow_op_fraction:g})",
             observed, spec.max_slow_op_fraction,
+        ))
+    if spec.max_inconsistent_seconds is not None:
+        observed = timeline.inconsistent_seconds()
+        report._add(HealthCheck(
+            "SLO_DATA_INTEGRITY",
+            _grade_max(
+                observed, spec.max_inconsistent_seconds,
+                spec.warn_fraction,
+            ),
+            f"PGs scrub-flagged inconsistent for {observed:g}s of "
+            f"virtual time (budget {spec.max_inconsistent_seconds:g}s)",
+            observed, spec.max_inconsistent_seconds,
+        ))
+    if spec.max_scrub_age_s is not None:
+        observed = timeline.max_scrub_age()
+        report._add(HealthCheck(
+            "SLO_SCRUB_AGE",
+            _grade_max(
+                observed, spec.max_scrub_age_s, spec.warn_fraction
+            ),
+            f"longest interval without a completed scrub pass "
+            f"{observed:g}s (budget {spec.max_scrub_age_s:g}s)",
+            observed, spec.max_scrub_age_s,
         ))
     return report

@@ -16,8 +16,14 @@ from .timeline import HEALTH_OK, HealthTimeline
 def status_dict(
     timeline: HealthTimeline,
     spec: SLOSpec | None = None,
+    scrub: dict | None = None,
 ) -> dict:
-    """The ``status`` reply: latest histogram + rolled-up health."""
+    """The ``status`` reply: latest histogram + rolled-up health.
+
+    ``scrub`` is an optional data-integrity panel (pass counts, bytes
+    verified, inconsistencies, verify retries — the shape
+    ``cli.status`` builds from a
+    :class:`~ceph_tpu.recovery.executor.SupervisedResult`)."""
     latest = timeline.latest
     report = (
         evaluate(timeline, spec).to_dict() if spec is not None else None
@@ -65,6 +71,8 @@ def status_dict(
             "slow_ops": tr.slow_ops,
             "max_osd_utilization": round(tr.max_osd_utilization, 9),
         }
+    if scrub is not None:
+        out["scrub"] = dict(scrub)
     return out
 
 
@@ -112,6 +120,27 @@ def render_status(status: dict) -> str:
         )
         if io.get("slow_ops"):
             lines.append(f"    slow ops: {io['slow_ops']}")
+    scrub = status.get("scrub")
+    if scrub is not None:
+        lines.append("  scrub:")
+        lines.append(
+            f"    {scrub.get('passes', 0)} passes, "
+            f"{scrub.get('scrubbed_bytes', 0)} bytes verified"
+        )
+        if scrub.get("inconsistencies_found") or scrub.get("verify_retries"):
+            lines.append(
+                f"    inconsistencies: {scrub.get('inconsistencies_found', 0)}"
+                f" found, {scrub.get('verify_retries', 0)} verify retries"
+            )
+        unrec = scrub.get("inconsistent_unrecoverable") or ()
+        if unrec:
+            lines.append(
+                "    inconsistent-unrecoverable pgs: "
+                + ", ".join(str(p) for p in unrec)
+            )
+        ttz = scrub.get("time_to_zero_inconsistent_s")
+        if ttz:
+            lines.append(f"    time to zero inconsistent: {ttz:g}s")
     return "\n".join(lines)
 
 

@@ -113,6 +113,9 @@ class HealthTimeline:
         self.objects_per_pg = int(objects_per_pg)
         self.sample_status = sample_status
         self.samples: list[HealthSample] = []
+        # virtual times of completed scrub passes (note_scrub); the
+        # SLO_SCRUB_AGE budget grades the largest gap between them
+        self.scrub_times: list[float] = []
         self._classifier = PGStateClassifier(mesh)
 
     def __len__(self) -> int:
@@ -237,6 +240,33 @@ class HealthTimeline:
             (tr.slow_fraction for tr in self.traffic_samples()),
             default=0.0,
         )
+
+    def note_scrub(self) -> None:
+        """Mark a completed scrub pass at the current virtual time."""
+        self.scrub_times.append(float(self.clock()))
+
+    def inconsistent_seconds(self) -> float:
+        """Virtual seconds any PG spent scrub-flagged inconsistent:
+        the same step-function integral as :meth:`inactive_seconds`."""
+        total = 0.0
+        for a, b in zip(self.samples, self.samples[1:]):
+            if a.counts.get("inconsistent", 0) > 0:
+                total += b.t - a.t
+        return total
+
+    def max_scrub_age(self) -> float:
+        """The longest virtual-time interval the run went without a
+        completed scrub pass — run start to first scrub, between
+        scrubs, and last scrub to the final sample.  With no scrubs at
+        all this is the whole run."""
+        if not self.samples:
+            return 0.0
+        pts = [
+            self.samples[0].t,
+            *sorted(self.scrub_times),
+            self.samples[-1].t,
+        ]
+        return max(b - a for a, b in zip(pts, pts[1:]))
 
     def inactive_seconds(self) -> float:
         """Virtual seconds any PG spent inactive: the step-function

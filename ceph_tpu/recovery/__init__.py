@@ -16,12 +16,16 @@ The subsystem that closes the loop the standalone workloads left open
   (:class:`~ceph_tpu.recovery.executor.SupervisedRecovery`) survives
   epochs advancing mid-plan.
 - :mod:`~ceph_tpu.recovery.chaos`    — timeline engine driving
-  multi-epoch failure schedules (flapping, cascades, mid-repair loss)
-  on a seeded virtual clock.
+  multi-epoch failure schedules (flapping, cascades, mid-repair loss,
+  silent bit rot) on a seeded virtual clock.
+- :mod:`~ceph_tpu.recovery.scrub`    — device-side batched CRC32C
+  scrub (inconsistent-PG detection) and decode-verify (checksums
+  recomputed before any repair commits).
 """
 
 from .chaos import (
     SCENARIOS,
+    AppliedCorruption,
     AppliedEvent,
     ChaosEngine,
     ChaosEvent,
@@ -32,8 +36,10 @@ from .chaos import (
 from .failure import (
     ACTIONS,
     KNOWN_SCOPES,
+    BitrotEvent,
     FailureSpec,
     FlapRecord,
+    UnknownSpecKeyError,
     build_incremental,
     flap,
     inject,
@@ -48,11 +54,24 @@ from .peering import (
     PG_STATE_CLEAN,
     PG_STATE_DEGRADED,
     PG_STATE_INACTIVE,
+    PG_STATE_INCONSISTENT,
     PG_STATE_REMAPPED,
+    PG_STATE_SCRUBBING,
     PG_STATE_UNDERSIZED,
     PeeringEngine,
     PeeringResult,
     peer_pool,
+)
+from .scrub import (
+    DecodeVerifier,
+    ScrubResult,
+    Scrubber,
+    apply_bitrot,
+    crc32c,
+    crc32c_rows,
+    scrub_counters,
+    scrub_step,
+    sharded_scrub_step,
 )
 from .planner import (
     PatternGroup,
@@ -77,7 +96,21 @@ __all__ = [
     "ACTIONS",
     "KNOWN_SCOPES",
     "SCENARIOS",
+    "AppliedCorruption",
     "AppliedEvent",
+    "BitrotEvent",
+    "DecodeVerifier",
+    "ScrubResult",
+    "Scrubber",
+    "UnknownSpecKeyError",
+    "apply_bitrot",
+    "crc32c",
+    "crc32c_rows",
+    "scrub_counters",
+    "scrub_step",
+    "sharded_scrub_step",
+    "PG_STATE_INCONSISTENT",
+    "PG_STATE_SCRUBBING",
     "ChaosEngine",
     "ChaosEvent",
     "ChaosTimeline",

@@ -34,7 +34,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ..osdmap.map import Incremental, OSDMap
-from .failure import FailureSpec, inject, parse_spec
+from .failure import BitrotEvent, FailureSpec, inject, parse_spec
 
 
 class VirtualClock:
@@ -108,7 +108,19 @@ class ChaosTimeline:
         return out
 
 
-SCENARIOS = ("flap", "rack-cascade", "mid-repair-loss")
+SCENARIOS = (
+    "flap", "rack-cascade", "mid-repair-loss", "silent-bitrot",
+    "scrub-storm",
+)
+
+
+def _pool_geometry(m: OSDMap) -> tuple[int, int]:
+    """(pg_num, size) of the lowest-id pool — the PG space the bitrot
+    scenarios corrupt into."""
+    if not m.pools:
+        raise ValueError("map has no pools")
+    pool = m.pools[min(m.pools)]
+    return int(pool.pg_num), int(pool.size)
 
 
 def _rack_and_hosts(m: OSDMap, rack_name: str | None) -> tuple[str, list[str]]:
@@ -168,6 +180,49 @@ def build_scenario(
             # flight (already-down OSDs contribute nothing: xor-safe)
             (start_s + period_s, FailureSpec("rack", rname, "down_out")),
         ])
+    if name == "silent-bitrot":
+        # no map events at all: `cycles` corruption events trickle in
+        # across distinct PGs/shards, invisible to peering — only a
+        # scrub pass can find them.  Offsets/masks are index-derived
+        # so the scenario is deterministic without an RNG.
+        pg_num, size = _pool_geometry(m)
+        pairs = []
+        for i in range(cycles):
+            ev = BitrotEvent(
+                pg=(7 * i + 3) % pg_num,
+                shard=i % size,
+                offset=11 * i,
+                mask=1 + (37 * i) % 255,
+            )
+            pairs.append((
+                start_s + i * period_s,
+                FailureSpec("bitrot", str(ev), "corrupt"),
+            ))
+        return ChaosTimeline.from_pairs(pairs)
+    if name == "scrub-storm":
+        # a burst of corruption lands across many PGs in one event
+        # (so one scrub pass floods the "scrub" QoS class with repair
+        # demand), then a host dies mid-scrub: scrub-triggered repair
+        # and failure-triggered repair contend for bandwidth.
+        pg_num, size = _pool_geometry(m)
+        _, hosts = _rack_and_hosts(m, rack)
+        burst = [
+            FailureSpec(
+                "bitrot",
+                str(BitrotEvent(
+                    pg=(5 * i + 1) % pg_num,
+                    shard=(3 * i) % size,
+                    offset=13 * i,
+                    mask=1 + (91 * i) % 255,
+                )),
+                "corrupt",
+            )
+            for i in range(max(4 * cycles, 8))
+        ]
+        return ChaosTimeline.from_pairs([
+            (start_s, burst),
+            (start_s + period_s, FailureSpec("host", hosts[0], "down_out")),
+        ])
     raise ValueError(f"unknown chaos scenario {name!r}; one of {SCENARIOS}")
 
 
@@ -181,13 +236,29 @@ class AppliedEvent:
     incremental: Incremental
 
 
+@dataclass
+class AppliedCorruption:
+    """Audit-trail entry for one applied bitrot event, stamped with the
+    map epoch it landed under (the epoch does NOT advance — silent
+    corruption is invisible to the mon)."""
+
+    t: float
+    epoch: int
+    event: BitrotEvent
+
+
 class ChaosEngine:
     """Owns the live map, the timeline, and the virtual clock.
 
     The supervised executor calls :meth:`poll` between phases; every
-    due event becomes an ordinary epoch through the normal
+    due map event becomes an ordinary epoch through the normal
     ``Incremental`` machinery, so nothing downstream can tell a chaos
-    event from an organic mon update.
+    event from an organic mon update.  ``bitrot`` specs take the other
+    channel: they never touch the map — :meth:`poll` hands each decoded
+    :class:`BitrotEvent` to the ``corrupt(pg, shard, offset, mask)``
+    callback (the shard store's mutator; offsets wrap modulo the
+    shard's chunk length there) and records it, epoch-stamped, in
+    :attr:`corruptions`.
     """
 
     def __init__(
@@ -196,12 +267,15 @@ class ChaosEngine:
         timeline: ChaosTimeline | None = None,
         clock: VirtualClock | None = None,
         journal=None,
+        corrupt=None,
     ):
         self.osdmap = m
         self.timeline = timeline or ChaosTimeline()
         self.clock = clock or VirtualClock()
         self.journal = journal
+        self.corrupt = corrupt
         self.applied: list[AppliedEvent] = []
+        self.corruptions: list[AppliedCorruption] = []
 
     @property
     def epoch(self) -> int:
@@ -212,21 +286,45 @@ class ChaosEngine:
 
     def poll(self) -> list[Incremental]:
         """Inject every event due at the current virtual time; returns
-        the applied incrementals (empty list = no epoch advance)."""
+        the applied incrementals (empty list = no epoch advance).
+        Bitrot specs in due events are applied through the ``corrupt``
+        callback and appended to :attr:`corruptions` — callers that
+        care about silent damage compare ``len(engine.corruptions)``
+        across the poll, since no incremental marks it."""
         incs = []
         for ev in self.timeline.due(self.clock.now()):
-            inc = inject(self.osdmap, list(ev.specs))
-            incs.append(inc)
-            self.applied.append(
-                AppliedEvent(ev.t, inc.epoch, ev.specs, inc)
-            )
-            if self.journal is not None:
-                self.journal.event(
-                    "chaos.inject",
-                    epoch=inc.epoch,
-                    sched_t=ev.t,
-                    specs=[str(s) for s in ev.specs],
+            rot = [s for s in ev.specs if s.is_bitrot]
+            fail = tuple(s for s in ev.specs if not s.is_bitrot)
+            if fail:
+                inc = inject(self.osdmap, list(fail))
+                incs.append(inc)
+                self.applied.append(AppliedEvent(ev.t, inc.epoch, fail, inc))
+                if self.journal is not None:
+                    self.journal.event(
+                        "chaos.inject",
+                        epoch=inc.epoch,
+                        sched_t=ev.t,
+                        specs=[str(s) for s in fail],
+                    )
+            for spec in rot:
+                rot_ev = spec.bitrot()
+                if self.corrupt is not None:
+                    self.corrupt(
+                        rot_ev.pg, rot_ev.shard, rot_ev.offset, rot_ev.mask
+                    )
+                self.corruptions.append(
+                    AppliedCorruption(ev.t, self.osdmap.epoch, rot_ev)
                 )
+                if self.journal is not None:
+                    self.journal.event(
+                        "chaos.bitrot",
+                        epoch=self.osdmap.epoch,
+                        sched_t=ev.t,
+                        pg=rot_ev.pg,
+                        shard=rot_ev.shard,
+                        offset=rot_ev.offset,
+                        mask=rot_ev.mask,
+                    )
         return incs
 
     def advance_to_next(self) -> bool:

@@ -41,6 +41,8 @@ from ..osdmap.map import OSDMap
 from .peering import (
     PG_STATE_BACKFILL,
     PG_STATE_DEGRADED,
+    PG_STATE_INCONSISTENT,
+    PG_STATE_SCRUBBING,
     PeeringEngine,
     PeeringResult,
     peer_pool,
@@ -141,6 +143,10 @@ def _build_counters() -> PerfCounters:
         .add_u64_counter("schedule_launches",
                          "decode launches executed as CSE-shrunk XOR "
                          "schedules (bit-level pattern groups)")
+        .add_u64_counter("verify_retries",
+                         "decode outputs re-derived through the dense "
+                         "reference path after checksum verification "
+                         "rejected a compiled-schedule launch")
         .add_gauge("degraded_pgs", "degraded PGs in the last plan")
         .add_gauge("unrecoverable_pgs", "PGs below k survivors")
         .add_gauge("failed_pgs",
@@ -175,6 +181,12 @@ class RecoveryResult:
     psum_shards_rebuilt: int = 0
     # launches that ran as CSE-shrunk XOR schedules (bit-level groups)
     schedule_launches: int = 0
+    # decode-verify: launches re-derived through the dense reference
+    # path after the compiled schedule's output failed checksum, and
+    # PGs whose rebuilt bytes failed verification on EVERY engine —
+    # those are reported, never committed (bad bytes must not land)
+    verify_retries: int = 0
+    inconsistent_unrecoverable: set[int] = field(default_factory=set)
 
     @property
     def bytes_per_sec(self) -> float:
@@ -201,6 +213,11 @@ class _Inflight:
     # schedule/bit-level launches: host-side materializer (unpack u32
     # word rows + trim padding back to [n_missing, width] bytes)
     post: Callable | None = None
+    # which decode engine produced the output: "schedule" (compiled
+    # XOR), "dense" (bitmatrix reference), "table" (byte LUT),
+    # "sharded" (mesh).  Decode-verify keys its retry policy on this:
+    # only a "schedule" miss is a compiler bug worth a quarantine.
+    engine: str = "table"
 
 
 class RecoveryExecutor:
@@ -252,7 +269,15 @@ class RecoveryExecutor:
         # pattern like the sharded LUTs; "on" forces table groups onto
         # the schedule path too (bit-plane layout)
         self.xor_mode = str(cfg.get("recovery_xor_schedule"))
-        self._schedules = ScheduleCache()
+        self._schedules = ScheduleCache(
+            max_entries=int(cfg.get("recovery_schedule_cache_max"))
+        )
+        # decode-verify seam: a ceph_tpu.recovery.scrub.DecodeVerifier
+        # (attached by SupervisedRecovery when a Scrubber is wired in,
+        # or directly by tests).  None keeps commits unverified and the
+        # executor byte-identical to its pre-scrub behavior.
+        self.verifier = None
+        self.retry_max = int(cfg.get("recovery_retry_max"))
         self.mesh = mesh
         self.shard_min_bytes = int(cfg.get("recovery_shard_min_bytes"))
         self._sharded: ShardedDecoder | None = None
@@ -298,8 +323,13 @@ class RecoveryExecutor:
         t0 = time.perf_counter()
         # bit-level groups decode over GF(2) bit rows (their chunks are
         # packet-interleaved, so the byte-wise LUT/sharded paths would
-        # corrupt them); "on" forces table groups bit-level too
-        bit_level = g.repair_matrix is None or self.xor_mode == "on"
+        # corrupt them); "on" forces table groups bit-level too — unless
+        # decode-verify quarantined this pattern's bit-plane schedule,
+        # in which case the byte LUT reference path takes over
+        bit_level = g.repair_matrix is None or (
+            self.xor_mode == "on"
+            and not self._schedules.is_quarantined(("bitplane", g.mask))
+        )
         sharded = (
             self._sharded is not None
             and nbytes >= self.shard_min_bytes
@@ -312,7 +342,10 @@ class RecoveryExecutor:
                 )
                 self.pc.inc("sharded_launches")
                 result.sharded_launches += 1
-                fl = _Inflight(g, out, chunk, True, valid, (nb, sh), t0)
+                fl = _Inflight(
+                    g, out, chunk, True, valid, (nb, sh), t0,
+                    engine="sharded",
+                )
             elif bit_level:
                 enc = encoder_for_group(self._schedules, g, self.xor_mode)
                 dev = None
@@ -320,13 +353,16 @@ class RecoveryExecutor:
                     dev = self._devices[self._rr % len(self._devices)]
                     self._rr += 1
                 width = src.shape[1]
+                engine = "dense"
                 if getattr(enc, "schedule", None) is not None:
                     self.pc.inc("schedule_launches")
                     result.schedule_launches += 1
+                    engine = "schedule"
                 fl = _Inflight(
                     g, enc.encode_async(src, device=dev), chunk,
                     False, None, None, t0,
                     post=lambda o, _e=enc, _w=width: _e.finalize(o, _w),
+                    engine=engine,
                 )
             else:
                 enc = self._encoders.get(g.mask)
@@ -409,6 +445,78 @@ class RecoveryExecutor:
         self.pc.inc("pgs_recovered", committed)
         return committed
 
+    def _verified_commit(
+        self,
+        g: PatternGroup,
+        out: np.ndarray,
+        chunk: int,
+        engine: str,
+        result: RecoveryResult,
+        read_shard: Callable[[int, int], np.ndarray],
+        only_pgs: set[int] | None = None,
+        jevent: Callable | None = None,
+    ) -> tuple[set[int], set[int]]:
+        """Commit a launch's output AFTER checksum verification.
+
+        The reference verifies every recovered object's
+        ``ceph_crc32c`` before writing it back; here the whole group's
+        rebuilt rows are checked against the scrub checksum table (and
+        EC parity re-encoded, :class:`~ceph_tpu.recovery.scrub.
+        DecodeVerifier`).  A mismatch from a compiled XOR schedule is
+        treated as a schedule-compiler bug: the pattern's cached
+        schedule is quarantined (journaled ``scrub.schedule_quarantined``
+        exactly once) and the decode re-derived through the dense /
+        byte-LUT reference engines, bounded by ``recovery_retry_max``.
+        PGs that still fail on a reference engine are reported
+        ``inconsistent-unrecoverable`` and NEVER committed — wrong
+        bytes never land silently.  With no verifier attached this is
+        exactly :meth:`_commit_group`.
+
+        Returns ``(committed_pgs, bad_pgs)``.
+        """
+        want = {int(p) for p in g.pgs}
+        if only_pgs is not None:
+            want &= only_pgs
+        if self.verifier is None:
+            self._commit_group(g, out, chunk, result, only_pgs=only_pgs)
+            return want, set()
+        bad = self.verifier.bad_pgs(g, out, chunk, read_shard=read_shard)
+        attempt = 0
+        while bad and engine == "schedule" and attempt < self.retry_max:
+            attempt += 1
+            result.verify_retries += 1
+            self.pc.inc("verify_retries")
+            first = self._schedules.quarantine(("packet", g.mask))
+            first |= self._schedules.quarantine(("bitplane", g.mask))
+            if first and jevent is not None:
+                jevent(
+                    "scrub.schedule_quarantined",
+                    mask=g.mask,
+                    attempt=attempt,
+                )
+            fl = self._dispatch_group(g, read_shard, result)
+            out, chunk = self._finalize_group(fl, result)
+            engine = fl.engine
+            bad = self.verifier.bad_pgs(
+                g, out, chunk, read_shard=read_shard
+            )
+        if not bad:
+            self._commit_group(g, out, chunk, result, only_pgs=only_pgs)
+            return want, set()
+        newly_bad = bad & want
+        result.inconsistent_unrecoverable.update(newly_bad)
+        if jevent is not None and newly_bad:
+            jevent(
+                "scrub.verify_failed",
+                mask=g.mask,
+                engine=engine,
+                pgs=sorted(newly_bad),
+            )
+        ok = want - bad
+        if ok:
+            self._commit_group(g, out, chunk, result, only_pgs=ok)
+        return ok, newly_bad
+
     def run(
         self,
         plan: RecoveryPlan,
@@ -420,8 +528,11 @@ class RecoveryExecutor:
         property, constant per pool)."""
         result = RecoveryResult(shards={}, unrecoverable=plan.unrecoverable)
         for g in plan.groups:
-            out, chunk = self._launch_group(g, read_shard, result)
-            self._commit_group(g, out, chunk, result)
+            fl = self._dispatch_group(g, read_shard, result)
+            out, chunk = self._finalize_group(fl, result)
+            self._verified_commit(
+                g, out, chunk, fl.engine, result, read_shard
+            )
         result.throttle_wait_s = self.throttle.waited_s
         return result
 
@@ -484,6 +595,13 @@ class SupervisedResult:
     decode_s: float = 0.0
     throttle_wait_s: float = 0.0
     final_counts: dict[str, int] = field(default_factory=dict)
+    # data-integrity loop (zero unless a Scrubber is attached)
+    scrub_passes: int = 0
+    scrubbed_bytes: int = 0
+    inconsistencies_found: int = 0  # PG damage detections (cumulative)
+    verify_retries: int = 0  # schedule outputs re-derived via dense
+    inconsistent_unrecoverable: set[int] = field(default_factory=set)
+    time_to_zero_inconsistent_s: float = 0.0
 
     def summary(self) -> dict:
         """Structured run report (the ``ceph status`` analog for a
@@ -506,6 +624,16 @@ class SupervisedResult:
             "failed_pgs": sorted(self.failed_pgs),
             "unrecoverable_pgs": sorted(int(p) for p in self.unrecoverable),
             "bytes_recovered": self.bytes_recovered,
+            "scrub_passes": self.scrub_passes,
+            "scrubbed_bytes": self.scrubbed_bytes,
+            "inconsistencies_found": self.inconsistencies_found,
+            "verify_retries": self.verify_retries,
+            "inconsistent_unrecoverable_pgs": sorted(
+                self.inconsistent_unrecoverable
+            ),
+            "time_to_zero_inconsistent_s": round(
+                self.time_to_zero_inconsistent_s, 6
+            ),
         }
 
 
@@ -554,11 +682,23 @@ class SupervisedRecovery:
         op_tracker=None,
         traffic=None,
         arbiter=None,
+        scrubber=None,
+        write_shard=None,
     ):
         self.codec = codec
         self.chaos = chaos
         self.cfg = config or global_config()
         self.fault_hook = fault_hook
+        # data-integrity loop (ceph_tpu.recovery.scrub): with a Scrubber
+        # attached, every chaos bit-rot burst triggers a device scrub
+        # pass, inconsistent PGs re-enter planning with their damaged
+        # shards struck from the survivor mask, and EVERY commit is
+        # checksum-verified (DecodeVerifier) before it lands.
+        # ``write_shard(pg, shard, bytes)`` writes verified repairs back
+        # to the shard store so the closing scrub pass can confirm the
+        # cluster converged to zero inconsistencies.
+        self.scrubber = scrubber
+        self.write_shard = write_shard
         # observability seams (ceph_tpu.obs): the event journal records
         # phase spans + launch/retry/salvage events, the health timeline
         # snapshots the PG-state histogram at every observed epoch, and
@@ -701,6 +841,18 @@ class SupervisedRecovery:
 
         inner = RecoveryResult(shards={})
         res = SupervisedResult(shards=inner.shards)
+        scrubber = self.scrubber
+        if scrubber is not None:
+            from .scrub import DecodeVerifier
+
+            # checksums must come from a clean store — build them now
+            # (pre-corruption: chaos bit-rot only lands via poll())
+            # unless the caller already did
+            if scrubber.checksums is None:
+                scrubber.build_checksums(read_shard)
+            self.ex.verifier = DecodeVerifier(
+                scrubber.checksums, codec=self.codec
+            )
         with self._jspan(
             "recovery.peer", epoch_prev=m_prev.epoch, epoch=chaos.epoch
         ):
@@ -708,10 +860,10 @@ class SupervisedRecovery:
                 state_prev, cur_state(), m_prev.epoch, chaos.epoch
             )
         res.epochs.append(chaos.epoch)
-        plan = build_plan(peering, self.codec)
-        pending = self._schedule(plan.groups, peering)
-        unrecoverable = plan.unrecoverable
-        self._snapshot(peering, 0)
+        # per-PG damage bitmask from the last scrub pass (bit s = shard
+        # s failed its checksum); all-zero until bit rot lands
+        inconsistent = np.zeros(peering.pg_num, np.uint32)
+        seen_rot = len(getattr(chaos, "corruptions", ()))
         # checkpoint: pg -> acting row at completion time.  A later
         # epoch that moves/kills anything in the row voids the entry.
         completed: dict[int, np.ndarray] = {}
@@ -719,6 +871,119 @@ class SupervisedRecovery:
         # only if a later epoch changes the pattern (a fresh chance),
         # never retried identically forever.
         failed: dict[int, int] = {}
+
+        def eff_mask() -> np.ndarray:
+            """Survivor mask with corrupt shards struck: a shard that
+            failed its checksum can never be a decode source."""
+            if scrubber is None:
+                return peering.survivor_mask
+            return peering.survivor_mask & ~inconsistent
+
+        def flags() -> np.ndarray:
+            """``peering.flags``, made writable — peering hands back a
+            read-only view of the device array, and the integrity bits
+            are host-annotated on top of it."""
+            if not peering.flags.flags.writeable:
+                peering.flags = peering.flags.copy()
+            return peering.flags
+
+        def annotate() -> None:
+            # integrity flags are host-annotated (the device classifier
+            # sees placement, never shard bytes); re-applied after
+            # every re-peer replaces the flags array
+            if scrubber is not None:
+                flags()[np.flatnonzero(inconsistent)] |= (
+                    PG_STATE_INCONSISTENT
+                )
+
+        def note_unrecoverable(unrec: np.ndarray) -> None:
+            """A below-k PG whose damage contributed: explicit
+            ``inconsistent-unrecoverable`` — reported, never silent."""
+            if scrubber is None:
+                return
+            for p in unrec:
+                p = int(p)
+                if inconsistent[p] and (
+                    p not in inner.inconsistent_unrecoverable
+                ):
+                    inner.inconsistent_unrecoverable.add(p)
+                    self._jevent(
+                        "scrub.unrecoverable",
+                        pg=p,
+                        clean_survivors=int(eff_mask()[p]),
+                    )
+
+        def scrub_now(final: bool = False) -> bool:
+            """One device scrub pass; True if the damage map changed."""
+            nonlocal inconsistent
+            flags()[:] |= PG_STATE_SCRUBBING
+            sr = scrubber.scrub(read_shard)
+            res.scrub_passes += 1
+            res.scrubbed_bytes += sr.scrubbed_bytes
+            new = np.asarray(sr.inconsistent_mask, np.uint32).copy()
+            fresh = np.flatnonzero(new & ~inconsistent)
+            res.inconsistencies_found += int(len(fresh))
+            changed = not np.array_equal(new, inconsistent)
+            inconsistent = new
+            for p in sr.pgs:
+                # damage voids the checkpoint: the PG must re-plan
+                completed.pop(int(p), None)
+                # ...and a retry-exhausted PG gets a fresh chance — but
+                # only mid-run: the CLOSING pass has no re-plan after
+                # it, so clearing ``failed`` there would erase the
+                # report's accounting of the still-damaged PG
+                if not final:
+                    failed.pop(int(p), None)
+            annotate()
+            if self.health is not None and hasattr(
+                self.health, "note_scrub"
+            ):
+                self.health.note_scrub()
+            self._snapshot(peering, inner.bytes_recovered)
+            flags()[:] &= ~np.int32(PG_STATE_SCRUBBING)
+            if len(fresh):
+                res.time_to_zero_inconsistent_s = 0.0
+            return changed
+
+        def poll_rot() -> bool:
+            """Scrub iff the chaos engine corrupted anything new."""
+            nonlocal seen_rot
+            if scrubber is None:
+                return False
+            n = len(getattr(chaos, "corruptions", ()))
+            if n == seen_rot:
+                return False
+            seen_rot = n
+            return scrub_now()
+
+        def commit(
+            g: PatternGroup, out, chunk: int, engine: str,
+            only_pgs: set[int] | None = None,
+        ) -> set[int]:
+            """Verified commit + write-back + damage-bit clearing."""
+            ok, _bad = self.ex._verified_commit(
+                g, out, chunk, engine, inner, read_shard,
+                only_pgs=only_pgs, jevent=self._jevent,
+            )
+            for p in ok:
+                completed[p] = peering.acting[p].copy()
+                failed.pop(p, None)
+                if scrubber is not None:
+                    if self.write_shard is not None:
+                        for s, buf in inner.shards[p].items():
+                            self.write_shard(p, int(s), buf)
+                    inconsistent[p] = 0
+                    flags()[p] &= ~np.int32(PG_STATE_INCONSISTENT)
+            return ok
+
+        plan = build_plan(
+            peering, self.codec,
+            inconsistent=inconsistent if scrubber is not None else None,
+        )
+        pending = self._schedule(plan.groups, peering)
+        unrecoverable = plan.unrecoverable
+        note_unrecoverable(unrecoverable)
+        self._snapshot(peering, 0)
 
         def revise() -> None:
             nonlocal peering, pending, unrecoverable
@@ -728,31 +993,46 @@ class SupervisedRecovery:
                 peering, _changed = engine.repeer(
                     peering, state_prev, cur_state(), chaos.epoch
                 )
+                annotate()
                 for pg in list(completed):
                     if not np.array_equal(
                         peering.acting[pg], completed[pg]
                     ):
                         del completed[pg]
-                valid, _invalid_pgs = invalidated_groups(
-                    pending, peering.survivor_mask
-                )
+                # groups stay valid against the EFFECTIVE mask: a scrub
+                # hit strikes a planned source shard exactly like an
+                # epoch advance killing it would
+                eff = eff_mask()
+                valid, _invalid_pgs = invalidated_groups(pending, eff)
                 for pg in list(failed):
-                    if int(peering.survivor_mask[pg]) != failed[pg]:
+                    if int(eff[pg]) != failed[pg]:
                         del failed[pg]  # pattern changed: worth a new try
                 covered = set(completed) | set(failed)
                 for g in valid:
                     covered.update(int(p) for p in g.pgs)
+                degraded_set = {
+                    int(pg)
+                    for pg in peering.pgs_with(PG_STATE_DEGRADED)
+                }
+                if scrubber is not None:
+                    degraded_set |= {
+                        int(p) for p in np.flatnonzero(inconsistent)
+                    }
                 need = np.array(
                     sorted(
-                        int(pg)
-                        for pg in peering.pgs_with(PG_STATE_DEGRADED)
-                        if int(pg) not in covered
+                        pg for pg in degraded_set if pg not in covered
                     ),
                     dtype=np.int64,
                 )
-                sub = build_plan(peering, self.codec, pgs=need)
+                sub = build_plan(
+                    peering, self.codec, pgs=need,
+                    inconsistent=(
+                        inconsistent if scrubber is not None else None
+                    ),
+                )
                 pending = self._schedule(valid + sub.groups, peering)
                 unrecoverable = sub.unrecoverable
+                note_unrecoverable(unrecoverable)
             self._snapshot(peering, inner.bytes_recovered)
 
         def observe(incs) -> None:
@@ -761,11 +1041,20 @@ class SupervisedRecovery:
 
         while True:
             incs = chaos.poll()
+            rot = poll_rot()
             if incs:
                 observe(incs)
+            if incs or rot:
                 revise()
             if not pending:
                 res.time_to_zero_degraded_s = clock.now()
+                if (
+                    scrubber is not None
+                    and res.time_to_zero_inconsistent_s == 0.0
+                ):
+                    live = {int(p) for p in np.flatnonzero(inconsistent)}
+                    if live <= inner.inconsistent_unrecoverable:
+                        res.time_to_zero_inconsistent_s = clock.now()
                 if chaos.advance_to_next():
                     continue
                 break
@@ -873,19 +1162,17 @@ class SupervisedRecovery:
                     )
                     fresh = {int(pg) for pg in g.pgs} - stale
                     if fresh:
-                        self.ex._commit_group(
-                            g, out, chunk, inner, only_pgs=fresh
+                        ok = commit(
+                            g, out, chunk, fl.engine, only_pgs=fresh
                         )
-                        for pg in fresh:
-                            completed[pg] = peering.acting[pg].copy()
-                            failed.pop(pg, None)
-                        res.salvaged_pgs += len(fresh)
-                        self.pc.inc("salvaged_pgs", len(fresh))
-                        self._jevent(
-                            "decode.salvage",
-                            mask=g.mask,
-                            pgs=sorted(fresh),
-                        )
+                        res.salvaged_pgs += len(ok)
+                        self.pc.inc("salvaged_pgs", len(ok))
+                        if ok:
+                            self._jevent(
+                                "decode.salvage",
+                                mask=g.mask,
+                                pgs=sorted(ok),
+                            )
                     if op is not None:
                         op.mark_event("stale")
                         op.finish()
@@ -893,14 +1180,12 @@ class SupervisedRecovery:
                 # commit against the pre-event acting rows, THEN
                 # revise: if the event touched this PG, the snapshot
                 # mismatch un-checkpoints it right there
-                self.ex._commit_group(g, out, chunk, inner)
-                for pg in g.pgs:
-                    completed[int(pg)] = peering.acting[int(pg)].copy()
-                    failed.pop(int(pg), None)
+                commit(g, out, chunk, fl.engine)
                 if op is not None:
                     op.mark_event("committed")
                     op.finish()
-            if incs:
+            rot = poll_rot()
+            if incs or rot:
                 revise()
             elif self.traffic is not None:
                 # no epoch advance, but the window still carried client
@@ -908,6 +1193,21 @@ class SupervisedRecovery:
                 # series is dense enough to catch transient overload
                 self._snapshot(peering, inner.bytes_recovered)
 
+        if scrubber is not None:
+            # closing pass: confirm the STORE (not just the in-memory
+            # result) converged — verified write-backs must scrub clean,
+            # and anything still damaged is surfaced, never dropped
+            with self._jspan("scrub.final", epoch=chaos.epoch):
+                scrub_now(final=True)
+            live = {int(p) for p in np.flatnonzero(inconsistent)}
+            accounted = inner.inconsistent_unrecoverable | {
+                int(p) for p in unrecoverable
+            }
+            if not (live - accounted):
+                if res.time_to_zero_inconsistent_s == 0.0:
+                    res.time_to_zero_inconsistent_s = clock.now()
+            else:
+                res.time_to_zero_inconsistent_s = 0.0
         if self.health is not None:
             last = self.health.latest
             # close the series with the end state (skip only an exact
@@ -917,6 +1217,9 @@ class SupervisedRecovery:
                 or clock.now() > last.t
                 or chaos.epoch != last.epoch
                 or inner.bytes_recovered != last.bytes_recovered
+                # a scrub pass snapshots mid-scrub; close with the
+                # settled (scrubbing-flag-cleared) state
+                or last.counts.get("scrubbing", 0)
             ):
                 self._snapshot(peering, inner.bytes_recovered)
         res.launches = inner.launches
@@ -929,6 +1232,10 @@ class SupervisedRecovery:
         res.throttle_wait_s = self.ex.throttle.waited_s
         if self.arbiter is not None:
             res.throttle_wait_s += self.arbiter.waited("recovery")
+        res.verify_retries = inner.verify_retries
+        res.inconsistent_unrecoverable = set(
+            inner.inconsistent_unrecoverable
+        )
         res.completed_pgs = set(completed)
         res.failed_pgs = sorted(failed)
         res.unrecoverable = unrecoverable
@@ -940,6 +1247,16 @@ class SupervisedRecovery:
             - set(failed)
             - {int(p) for p in unrecoverable}
         )
+        if scrubber is not None:
+            # a PG still scrubbing dirty is outstanding unless it is
+            # explicitly accounted unrecoverable — damage is NEVER
+            # silently dropped from the report
+            outstanding |= (
+                {int(p) for p in np.flatnonzero(inconsistent)}
+                - inner.inconsistent_unrecoverable
+                - set(failed)
+                - {int(p) for p in unrecoverable}
+            )
         res.converged = not failed and not outstanding
         self.pc.set("degraded_pgs", len(outstanding))
         self.pc.set("unrecoverable_pgs", int(len(unrecoverable)))

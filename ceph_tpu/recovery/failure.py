@@ -31,13 +31,68 @@ from ..osdmap.map import Incremental, OSDMap, UP
 
 ACTIONS = ("down", "out", "down_out", "up", "in")
 
+# The one action the ``bitrot`` scope supports: flip bits in a shard
+# buffer (no map edit, no epoch — the whole point is that the failure
+# is *silent* until a scrub pass finds it).
+BITROT_ACTION = "corrupt"
+
 # The scopes a spec may name: ``osd`` plus the reference's stock CRUSH
-# bucket types (``src/crush/CrushWrapper.cc`` default type set).  Maps
-# with exotic custom type names can pass ``scopes=`` to parse_spec.
+# bucket types (``src/crush/CrushWrapper.cc`` default type set), plus
+# ``bitrot`` — silent shard corruption, which is not a map edit at all
+# (see :class:`BitrotEvent`).  Maps with exotic custom type names can
+# pass ``scopes=`` to parse_spec.
 KNOWN_SCOPES = (
     "osd", "host", "chassis", "rack", "row", "pdu", "pod", "room",
-    "datacenter", "dc", "zone", "region", "root",
+    "datacenter", "dc", "zone", "region", "root", "bitrot",
 )
+
+# The keys a dict-form spec may carry (the JSON timeline surface).
+SPEC_KEYS = ("scope", "target", "action")
+
+
+class UnknownSpecKeyError(ValueError):
+    """A dict-form failure spec carried a key outside
+    :data:`SPEC_KEYS` — rejected loudly (a typo like ``"scop"`` must
+    not silently produce a default event)."""
+
+
+@dataclass(frozen=True)
+class BitrotEvent:
+    """One silent-corruption event: XOR ``mask`` into byte ``offset``
+    of shard ``shard`` of PG ``pg``.
+
+    Encoded in a :class:`FailureSpec` as ``bitrot:PG.SHARD.OFF.MASK``
+    (four dot-separated non-negative integers; mask 1..255 so the
+    corruption is never a no-op), action ``corrupt`` — e.g.
+    ``bitrot:12.3.77.255:corrupt``.  Unlike every other scope this is
+    NOT an :class:`~ceph_tpu.osdmap.map.Incremental`: nothing in the
+    map changes, no epoch advances, and peering cannot see it — only a
+    scrub pass (:mod:`ceph_tpu.recovery.scrub`) can.
+    """
+
+    pg: int
+    shard: int
+    offset: int
+    mask: int
+
+    def __str__(self) -> str:
+        return f"{self.pg}.{self.shard}.{self.offset}.{self.mask}"
+
+    @classmethod
+    def from_target(cls, target: str) -> "BitrotEvent":
+        parts = target.split(".")
+        if len(parts) != 4 or not all(p.isdigit() for p in parts):
+            raise ValueError(
+                f"bad bitrot target {target!r} "
+                "(want PG.SHARD.BYTE_OFFSET.XOR_MASK, four non-negative "
+                "integers)"
+            )
+        pg, shard, offset, mask = (int(p) for p in parts)
+        if not 1 <= mask <= 255:
+            raise ValueError(
+                f"bitrot xor mask must be 1..255, got {mask} in {target!r}"
+            )
+        return cls(pg, shard, offset, mask)
 
 
 @dataclass(frozen=True)
@@ -52,21 +107,52 @@ class FailureSpec:
     def __str__(self) -> str:
         return f"{self.scope}:{self.target}:{self.action}"
 
+    @property
+    def is_bitrot(self) -> bool:
+        return self.scope == "bitrot"
 
-def parse_spec(text: str, scopes: tuple[str, ...] = KNOWN_SCOPES) -> FailureSpec:
-    """``scope:target[:action]`` -> :class:`FailureSpec`.
+    def bitrot(self) -> BitrotEvent:
+        """Decode a ``bitrot`` spec's target (raises for map scopes)."""
+        if not self.is_bitrot:
+            raise ValueError(f"{self} is not a bitrot spec")
+        return BitrotEvent.from_target(self.target)
+
+
+def parse_spec(text, scopes: tuple[str, ...] = KNOWN_SCOPES) -> FailureSpec:
+    """``scope:target[:action]`` string OR ``{"scope": ..., "target":
+    ..., "action": ...}`` dict -> :class:`FailureSpec`.
 
     Validates eagerly — a bad spec must die at the CLI/timeline surface
     with a clear message, not deep inside map application: the scope
-    must be ``osd`` or a known bucket type, the target non-empty (and a
-    non-negative integer for ``osd``, normalized so ``osd:007`` and
-    ``osd:7`` are the same event), and the action one of
-    :data:`ACTIONS`.
+    must be ``osd``, ``bitrot``, or a known bucket type, the target
+    non-empty (a non-negative integer for ``osd``, normalized so
+    ``osd:007`` and ``osd:7`` are the same event;
+    ``PG.SHARD.OFFSET.MASK`` for ``bitrot``), and the action one of
+    :data:`ACTIONS` (``corrupt``, and only ``corrupt``, for
+    ``bitrot``).  Dict-form specs reject unknown keys with
+    :class:`UnknownSpecKeyError` — silently ignoring a typoed key would
+    inject a default event the author never scheduled.
     """
+    if isinstance(text, dict):
+        extra = sorted(set(text) - set(SPEC_KEYS))
+        if extra:
+            raise UnknownSpecKeyError(
+                f"unknown key(s) {extra} in failure spec dict {text!r}; "
+                f"allowed keys {SPEC_KEYS}, scopes one of {KNOWN_SCOPES}"
+            )
+        if "scope" not in text or "target" not in text:
+            raise ValueError(
+                f"failure spec dict {text!r} needs 'scope' and 'target'"
+            )
+        scope = str(text["scope"])
+        parts = [scope, str(text["target"])]
+        if "action" in text:
+            parts.append(str(text["action"]))
+        return parse_spec(":".join(parts), scopes)
     parts = text.split(":")
     if len(parts) == 2:
         scope, target = parts
-        action = "down"
+        action = BITROT_ACTION if scope == "bitrot" else "down"
     elif len(parts) == 3:
         scope, target, action = parts
     else:
@@ -83,6 +169,15 @@ def parse_spec(text: str, scopes: tuple[str, ...] = KNOWN_SCOPES) -> FailureSpec
                 f"osd target must be a non-negative integer, got {target!r}"
             )
         target = str(int(target))  # canonical: no leading zeros
+    if scope == "bitrot":
+        if action != BITROT_ACTION:
+            raise ValueError(
+                f"bitrot specs only support action {BITROT_ACTION!r}, "
+                f"got {action!r}"
+            )
+        # canonical: no leading zeros in any component
+        target = str(BitrotEvent.from_target(target))
+        return FailureSpec(scope, target, action)
     if action not in ACTIONS:
         raise ValueError(f"bad action {action!r}; one of {ACTIONS}")
     return FailureSpec(scope, target, action)
@@ -119,6 +214,8 @@ def resolve_targets(m: OSDMap, spec: FailureSpec) -> list[int]:
     """OSD ids a spec touches.  ``osd`` scope is the id itself; bucket
     scopes resolve the bucket by name (bare indices get the scope
     prefixed: ``rack:0`` -> ``rack0``) and collect its subtree."""
+    if spec.is_bitrot:
+        raise ValueError(f"{spec} targets shard bytes, not OSDs")
     if spec.scope == "osd":
         osd = int(spec.target)
         if not m.exists(osd):
@@ -155,6 +252,12 @@ def build_incremental(m: OSDMap, specs) -> Incremental:
     for spec in specs:
         if isinstance(spec, str):
             spec = parse_spec(spec)
+        if spec.is_bitrot:
+            raise ValueError(
+                f"{spec} is silent corruption, not a map edit; route it "
+                "through ChaosEngine (corrupt= callback), not "
+                "build_incremental/inject"
+            )
         for osd in resolve_targets(m, spec):
             if spec.action in ("down", "down_out") and m.is_up(osd):
                 inc.new_state[osd] = inc.new_state.get(osd, 0) | UP

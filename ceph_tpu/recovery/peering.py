@@ -61,6 +61,11 @@ PG_STATE_DEGRADED = 4
 PG_STATE_UNDERSIZED = 8
 PG_STATE_BACKFILL = 16
 PG_STATE_INACTIVE = 32
+# data-integrity flags: not emitted by the device classifier (only the
+# scrubber can see shard BYTES), host-annotated onto ``flags`` by the
+# supervised loop so timelines/status render them like any other state
+PG_STATE_INCONSISTENT = 64
+PG_STATE_SCRUBBING = 128
 
 FLAG_NAMES = {
     PG_STATE_CLEAN: "clean",
@@ -69,6 +74,8 @@ FLAG_NAMES = {
     PG_STATE_UNDERSIZED: "undersized",
     PG_STATE_BACKFILL: "backfill",
     PG_STATE_INACTIVE: "inactive",
+    PG_STATE_INCONSISTENT: "inconsistent",
+    PG_STATE_SCRUBBING: "scrubbing",
 }
 
 I32 = jnp.int32

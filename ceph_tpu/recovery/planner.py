@@ -154,7 +154,10 @@ class RecoveryPlan:
 
 
 def build_plan(
-    peering: PeeringResult, codec, pgs: np.ndarray | None = None
+    peering: PeeringResult,
+    codec,
+    pgs: np.ndarray | None = None,
+    inconsistent: np.ndarray | None = None,
 ) -> RecoveryPlan:
     """Group the peering pass's degraded PGs into pattern groups.
 
@@ -166,6 +169,15 @@ def build_plan(
     k+m (EC pools are positional: acting slot == shard id).  ``pgs``
     restricts planning to a PG subset — the mid-flight re-plan path,
     where only the epoch delta's invalidated PGs need fresh groups.
+
+    ``inconsistent`` is a scrub pass's per-PG damage bitmask
+    (:class:`ceph_tpu.recovery.scrub.ScrubResult`): inconsistent PGs
+    join the degraded set, and a damaged shard is struck from its PG's
+    survivor mask — it can never be a decode source, and it lands in
+    the group's ``missing`` set so the same batched launch that heals
+    erasure also heals corruption.  A PG left with fewer than k CLEAN
+    shards is unrecoverable (the caller reports it
+    ``inconsistent-unrecoverable`` — bad bytes are never committed).
     """
     codec, bit_level = _planning_codec(codec)
     k, m = codec.k, codec.m
@@ -184,11 +196,26 @@ def build_plan(
         # (a byte-wise LUT product over them would be garbage)
         bit_technique = getattr(codec, "technique", "table") == "bitmatrix"
     degraded = peering.pgs_with(PG_STATE_DEGRADED)
+    inc = None
+    if inconsistent is not None:
+        inc = np.asarray(inconsistent, dtype=np.uint32)
+        if inc.shape != peering.survivor_mask.shape:
+            raise ValueError(
+                f"inconsistent mask shape {inc.shape} != "
+                f"per-PG {peering.survivor_mask.shape}"
+            )
+        degraded = np.union1d(
+            degraded, np.flatnonzero(inc).astype(np.int64)
+        )
     if pgs is not None:
         degraded = np.intersect1d(
             degraded, np.asarray(pgs, dtype=np.int64)
         )
     masks = peering.survivor_mask[degraded]
+    if inc is not None:
+        # a corrupt shard is not a survivor: strike it so it can only
+        # ever appear on the decode's OUTPUT side
+        masks = masks & ~inc[degraded]
     plan = RecoveryPlan(k=k, m=m)
     unrecoverable: list[np.ndarray] = []
     for mask in np.unique(masks):

@@ -113,6 +113,10 @@ def compile_once_cases() -> dict[str, dict]:
       through the cached XOR schedules of :mod:`ceph_tpu.ec.schedule`
       — the schedule cache plus the per-shape jit of the apply step
       must make repeated same-pattern decodes compile-free.
+    - ``scrub_pass``: a second whole-pool CRC32C scrub
+      (:class:`~ceph_tpu.recovery.scrub.Scrubber`) after a byte of the
+      store rots — corruption changes values, never shapes, so the
+      periodic background scrub must reuse the one compiled step.
 
     Raises ``AssertionError`` (from
     :func:`ceph_tpu.analysis.runtime_guard.assert_no_recompile`) if
@@ -228,6 +232,27 @@ def compile_once_cases() -> dict[str, dict]:
         ex_b.run(plan_b, lambda pg, s: b2[pg][s])
     report["schedule_decode"] = {
         "warm_compiles": warm_b.n_compiles, "second_compiles": 0,
+    }
+
+    # ---- CRC32C scrub: pass -> bit rot -> pass --------------------------
+    from ..recovery.scrub import Scrubber, apply_bitrot
+
+    n_pgs, n_shards, chunk_s = 8, size, 64
+    rng = np.random.default_rng(3)
+    store_s = {
+        (pg, s): rng.integers(0, 256, chunk_s, dtype=np.uint8)
+        for pg in range(n_pgs) for s in range(n_shards)
+    }
+    scrubber = Scrubber(n_pgs, n_shards)
+    with CompileCounter() as warm_s:
+        scrubber.build_checksums(lambda pg, s: store_s[(pg, s)])
+        scrubber.scrub(lambda pg, s: store_s[(pg, s)])
+    apply_bitrot(store_s[(3, 1)], 17, 0x40)  # value-only: same shapes
+    with assert_no_recompile("scrub second pass"):
+        sr = scrubber.scrub(lambda pg, s: store_s[(pg, s)])
+    assert sr.n_inconsistent == 1, sr.n_inconsistent
+    report["scrub_pass"] = {
+        "warm_compiles": warm_s.n_compiles, "second_compiles": 0,
     }
     return report
 

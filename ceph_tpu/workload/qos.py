@@ -93,8 +93,10 @@ class MClockArbiter:
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
     ) -> "MClockArbiter":
-        """The standard client/recovery pair from the
-        ``osd_mclock_*`` options."""
+        """The standard client/recovery/scrub trio from the
+        ``osd_mclock_*`` options (scrub is the background integrity
+        class: it shares the same tag algebra, so a scrub storm admits
+        by weight and can never starve the other two)."""
         cfg = config or global_config()
         return cls(
             [
@@ -109,6 +111,12 @@ class MClockArbiter:
                     reservation=float(cfg.get("osd_mclock_recovery_res_bps")),
                     weight=float(cfg.get("osd_mclock_recovery_wgt")),
                     limit=float(cfg.get("osd_mclock_recovery_lim_bps")),
+                ),
+                QoSClass(
+                    "scrub",
+                    reservation=float(cfg.get("osd_mclock_scrub_res_bps")),
+                    weight=float(cfg.get("osd_mclock_scrub_wgt")),
+                    limit=float(cfg.get("osd_mclock_scrub_lim_bps")),
                 ),
             ],
             capacity_bps,

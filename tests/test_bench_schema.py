@@ -228,6 +228,77 @@ def test_traffic_record_fewer_samples_than_post_steps():
     assert rec["traffic_recovery_p99_ms_no_arbiter"] == 0.0
 
 
+# --- config6_recovery --scrub JSON schema (data-integrity loop) -------
+
+
+class _FakeScrubResult:
+    converged = True
+    scrub_passes = 4
+    scrubbed_bytes = 786_432
+    inconsistencies_found = 12
+    verify_retries = 2
+    inconsistent_unrecoverable = {9, 17}
+    time_to_zero_inconsistent_s = 10.5218754
+
+
+class _FakeScrubResultNoArb:
+    time_to_zero_inconsistent_s = 10.2500009
+
+
+class _FakeScrubTimeline:
+    @staticmethod
+    def max_traffic_p99_ms():
+        return 13.0912345678
+
+
+class _FakeScrubReport:
+    status = "HEALTH_OK"
+    checks = [
+        _FakeCheck("SLO_DATA_INTEGRITY", "HEALTH_OK"),
+        _FakeCheck("SLO_SCRUB_AGE", "HEALTH_OK"),
+    ]
+
+
+def test_scrub_record_schema():
+    import json
+
+    rec = config6.build_scrub_record(
+        "scrub-storm",
+        _FakeScrubResult(),
+        _FakeScrubResultNoArb(),
+        _FakeScrubTimeline(),
+        _FakeScrubReport(),
+        88_123_456.7,
+        "tpu",
+        {"n_compiles": 3, "host_transfers": 5},
+        {"n_compiles": 3},
+        {"scrub": {"granted_bytes": 1_000_000}},
+    )
+    assert rec["metric"] == "scrub_crc32c_bytes_per_sec"
+    assert rec["value"] == 88_123_457 and rec["unit"] == "B/s"
+    assert rec["platform"] == "tpu"
+    # compile-once guard: warm-run compiles == total compiles
+    assert rec["n_compiles"] == 3 and rec["n_compiles_first"] == 3
+    assert rec["host_transfers"] == 5
+    assert rec["scrub_scenario"] == "scrub-storm"
+    assert rec["scrub_converged"] is True
+    assert rec["scrub_passes"] == 4
+    assert rec["scrub_scrubbed_bytes"] == 786_432
+    assert rec["scrub_inconsistencies_found"] == 12
+    assert rec["scrub_verify_retries"] == 2
+    assert rec["scrub_unrecoverable"] == 2
+    assert rec["scrub_time_to_zero_inconsistent_s"] == 10.521875
+    assert rec["scrub_time_to_zero_inconsistent_s_no_arbiter"] == 10.250001
+    assert rec["scrub_p99_ms"] == 13.091235
+    assert rec["scrub_health_status"] == "HEALTH_OK"
+    assert rec["scrub_slo_checks"] == {
+        "SLO_DATA_INTEGRITY": "HEALTH_OK",
+        "SLO_SCRUB_AGE": "HEALTH_OK",
+    }
+    assert rec["scrub_qos"]["scrub"]["granted_bytes"] == 1_000_000
+    json.dumps(rec)  # one JSON line, always serializable
+
+
 # --- config2/config4 --xor-schedule JSON schema (ec schedule compiler) ---
 
 _CONFIG2 = os.path.join(os.path.dirname(_BENCH), "bench", "config2_ec_encode.py")

@@ -257,6 +257,61 @@ def test_harvest_guard_collects_xor_schedule_fields(tmp_path):
     assert "xor_technique" not in g
 
 
+def test_harvest_guard_collects_scrub_fields(tmp_path):
+    """config6 --scrub lines carry the integrity verdict into the guard
+    harvest: exact counters (int), time-to-zero / p99 aggregates
+    (float), the HEALTH_* status string, and the convergence bool; the
+    per-check dict and QoS snapshot stay bench-only."""
+    p = _log(tmp_path, [
+        {"metric": "scrub_crc32c_bytes_per_sec", "platform": "tpu",
+         "value": 88_123_457, "n_compiles": 3, "n_compiles_first": 3,
+         "host_transfers": 5, "scrub_scenario": "scrub-storm",
+         "scrub_converged": True, "scrub_passes": 4,
+         "scrub_scrubbed_bytes": 786_432,
+         "scrub_inconsistencies_found": 12, "scrub_verify_retries": 2,
+         "scrub_unrecoverable": 0,
+         "scrub_time_to_zero_inconsistent_s": 10.521875,
+         "scrub_time_to_zero_inconsistent_s_no_arbiter": 10.250001,
+         "scrub_p99_ms": 13.091235,
+         "scrub_health_status": "HEALTH_OK",
+         "scrub_slo_checks": {"SLO_DATA_INTEGRITY": "HEALTH_OK"},
+         "scrub_qos": {"scrub": {"granted_bytes": 1}}},
+    ])
+    g = dd.harvest_guard([p])["scrub_crc32c_bytes_per_sec"]
+    assert g["scrub_passes"] == 4
+    assert g["scrub_scrubbed_bytes"] == 786_432
+    assert g["scrub_inconsistencies_found"] == 12
+    assert g["scrub_verify_retries"] == 2
+    assert g["scrub_unrecoverable"] == 0
+    assert g["scrub_time_to_zero_inconsistent_s"] == 10.521875
+    assert g["scrub_time_to_zero_inconsistent_s_no_arbiter"] == 10.250001
+    assert g["scrub_p99_ms"] == 13.091235
+    assert isinstance(g["scrub_time_to_zero_inconsistent_s"], float)
+    assert g["scrub_health_status"] == "HEALTH_OK"
+    assert g["scrub_converged"] is True
+    assert g["steady_state_clean"] is True
+    # the label, per-check dict and QoS snapshot stay in the bench line
+    assert "scrub_scenario" not in g
+    assert "scrub_slo_checks" not in g
+    assert "scrub_qos" not in g
+    # a cpu smoke line never contributes scrub fields
+    p2 = _log(tmp_path, [
+        {"metric": "scrub_crc32c_bytes_per_sec", "platform": "cpu",
+         "scrub_passes": 9, "scrub_health_status": "HEALTH_ERR"},
+    ])
+    assert dd.harvest_guard([p2]) == {}
+
+
+def test_harvest_guard_scrub_fields_absent_when_not_emitted(tmp_path):
+    p = _log(tmp_path, [
+        {"metric": "recovery_decode_bytes_per_sec", "platform": "tpu",
+         "value": 9_000_000, "n_compiles": 5, "n_compiles_first": 5,
+         "host_transfers": 2},
+    ])
+    g = dd.harvest_guard([p])["recovery_decode_bytes_per_sec"]
+    assert not any(k.startswith("scrub_") for k in g)
+
+
 def test_harvest_guard_chaos_fields_absent_when_not_emitted(tmp_path):
     p = _log(tmp_path, [
         {"metric": "recovery_decode_bytes_per_sec", "platform": "tpu",

@@ -82,6 +82,7 @@ def test_pg_state_classifier_states():
     assert dict(zip(STATE_NAMES, hist.tolist())) == {
         "active+clean": 2, "backfilling": 1, "degraded": 1,
         "undersized": 1, "inactive": 1,
+        "inconsistent": 0, "scrubbing": 0,
     }
     # degraded shard-slots: 1 (degraded) + 1 (undersized) + 3 (inactive)
     assert aux.tolist() == [5, 1]

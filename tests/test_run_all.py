@@ -212,13 +212,18 @@ def test_unfiltered_configs_cover_all_baseline_configs():
     assert names == [
         "config1_crush", "config2_ec_encode", "config3_upmap",
         "config4_repair_decode", "config5_rebalance_sim",
-        "config6_recovery", "config6_recovery_multichip", "tpu_tier",
+        "config6_recovery", "config6_recovery_multichip",
+        "config6_recovery_scrub", "tpu_tier",
     ]
-    # the multichip entry re-uses the config6 file in --multichip mode
+    # the multichip/scrub entries re-use the config6 file in flag modes
     multi = next(c for c in run_all.CONFIGS
                  if c[0] == "config6_recovery_multichip")
     assert multi[1] == "bench/config6_recovery.py"
     assert tuple(multi[2]) == ("--multichip",)
+    scrub = next(c for c in run_all.CONFIGS
+                 if c[0] == "config6_recovery_scrub")
+    assert scrub[1] == "bench/config6_recovery.py"
+    assert tuple(scrub[2]) == ("--scrub",)
 
 
 if __name__ == "__main__":

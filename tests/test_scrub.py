@@ -1,0 +1,763 @@
+"""End-to-end data integrity: CRC32C primitives, the device-side
+batched scrubber, decode-verify (including the deliberately
+miscompiled XOR schedule regression), bitrot failure specs, the
+schedule-cache LRU/quarantine, journal crash-tolerance, retry/backoff
+determinism, and the supervised silent-bitrot loop."""
+
+import copy
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+from ceph_tpu import recovery as rec
+from ceph_tpu.common.config import Config
+from ceph_tpu.crush.map import ITEM_NONE as PEER_NONE
+from ceph_tpu.ec import gf, gfw
+from ceph_tpu.ec.backend import BitmatrixCodec, MatrixCodec
+from ceph_tpu.ec.schedule import (
+    DenseBitmatrixAdapter,
+    ScheduleCache,
+    XorScheduleEncoder,
+    encoder_for_group,
+)
+from ceph_tpu.models.clusters import build_osdmap
+from ceph_tpu.obs.journal import EventJournal
+from ceph_tpu.recovery import RecoveryExecutor, build_plan
+from ceph_tpu.recovery.failure import (
+    BitrotEvent,
+    FailureSpec,
+    UnknownSpecKeyError,
+    build_incremental,
+    normalize,
+    parse_spec,
+    resolve_targets,
+)
+from ceph_tpu.recovery.peering import (
+    PG_STATE_CLEAN,
+    PG_STATE_DEGRADED,
+    PeeringResult,
+)
+from ceph_tpu.recovery import scrub
+from ceph_tpu.recovery.scrub import (
+    DecodeVerifier,
+    Scrubber,
+    apply_bitrot,
+    crc32c,
+    crc32c_rows,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# ---- CRC32C primitives -----------------------------------------------
+
+
+def test_crc32c_known_vectors():
+    # the Castagnoli check value (iSCSI/ext4/ceph_crc32c agree on it)
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"") == 0
+    # rows path agrees with the scalar path on every row
+    rng = np.random.default_rng(0)
+    rows = rng.integers(0, 256, (5, 37), dtype=np.uint8)
+    got = crc32c_rows(rows)
+    assert got.dtype == np.uint32
+    for i in range(rows.shape[0]):
+        assert int(got[i]) == crc32c(rows[i].tobytes())
+
+
+def test_apply_bitrot_wraps_and_inverts():
+    buf = np.zeros(8, np.uint8)
+    apply_bitrot(buf, 10, 0x41)  # wraps to offset 2
+    assert buf[2] == 0x41 and buf.sum() == 0x41
+    apply_bitrot(buf, 10, 0x41)  # XOR is its own inverse
+    assert buf.sum() == 0
+
+
+# ---- device scrubber -------------------------------------------------
+
+
+def _flat_store(n_pgs, n_shards, chunk, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        (pg, s): rng.integers(0, 256, chunk, dtype=np.uint8)
+        for pg in range(n_pgs) for s in range(n_shards)
+    }
+
+
+def test_scrubber_finds_exact_damage():
+    n_pgs, n_shards, chunk = 6, 4, 48
+    store = _flat_store(n_pgs, n_shards, chunk)
+    read = lambda pg, s: store[(pg, s)]  # noqa: E731
+    scrubber = Scrubber(n_pgs, n_shards)
+    scrubber.build_checksums(read)
+    clean = scrubber.scrub(read)
+    assert clean.n_inconsistent == 0
+    assert not clean.pgs.size and not clean.inconsistent_mask.any()
+
+    apply_bitrot(store[(2, 1)], 7, 0x01)
+    apply_bitrot(store[(2, 3)], 0, 0xFF)
+    apply_bitrot(store[(5, 0)], 47, 0x80)
+    sr = scrubber.scrub(read)
+    assert sr.n_inconsistent == 3
+    assert sr.pgs.tolist() == [2, 5]
+    assert int(sr.inconsistent_mask[2]) == (1 << 1) | (1 << 3)
+    assert int(sr.inconsistent_mask[5]) == 1 << 0
+    assert sr.hist.tolist() == [1, 1, 0, 1]
+    assert sr.scrubbed_bytes == n_pgs * n_shards * chunk
+
+    # healing the bytes heals the verdict (same checksum table)
+    apply_bitrot(store[(2, 1)], 7, 0x01)
+    apply_bitrot(store[(2, 3)], 0, 0xFF)
+    apply_bitrot(store[(5, 0)], 47, 0x80)
+    assert scrubber.scrub(read).n_inconsistent == 0
+
+
+def test_scrubber_requires_checksums():
+    scrubber = Scrubber(2, 2)
+    with pytest.raises(RuntimeError, match="build_checksums"):
+        scrubber.scrub(lambda pg, s: np.zeros(8, np.uint8))
+
+
+# ---- peering fixtures for executor-level tests -----------------------
+
+
+def _degraded_peering(masks, size, k, pool_id=1):
+    """One degraded PG per survivor mask (the nonregression fixture)."""
+    prev = np.arange(len(masks) * size, dtype=np.int32).reshape(-1, size)
+    acting = prev.copy()
+    flags = np.full(len(masks), PG_STATE_CLEAN, np.int32)
+    mask_arr = np.full(len(masks), (1 << size) - 1, np.uint32)
+    for i, mask in enumerate(masks):
+        for s in range(size):
+            if not (mask >> s) & 1:
+                acting[i, s] = PEER_NONE
+        flags[i] = PG_STATE_DEGRADED
+        mask_arr[i] = mask
+    return PeeringResult(
+        pool_id=pool_id, epoch_prev=1, epoch_cur=2, size=size, min_size=k,
+        up=acting.copy(), up_primary=acting[:, 0].copy(),
+        acting=acting, acting_primary=acting[:, 0].copy(),
+        prev_acting=prev, flags=flags, survivor_mask=mask_arr,
+        n_alive=(acting != PEER_NONE).sum(axis=1).astype(np.int32),
+    )
+
+
+def _checksum_table(store, n_pgs, size):
+    stacked = np.stack([
+        np.stack([store[pg][s] for s in range(size)]) for pg in range(n_pgs)
+    ])
+    return crc32c_rows(
+        stacked.reshape(n_pgs * size, -1)
+    ).reshape(n_pgs, size)
+
+
+# ---- decode-verify ---------------------------------------------------
+
+
+def _matrix_fixture(masks, chunk=64, k=4, m_par=2, seed=1):
+    size = k + m_par
+    codec = MatrixCodec(gf.vandermonde_matrix(k, m_par))
+    peering = _degraded_peering(masks, size, k)
+    plan = build_plan(peering, codec)
+    rng = np.random.default_rng(seed)
+    store = {}
+    for pg in range(len(masks)):
+        data = rng.integers(0, 256, (k, chunk), dtype=np.uint8)
+        store[pg] = np.vstack([data, codec.encode(data)])
+    return codec, plan, store, size, chunk
+
+
+def test_decode_verifier_flags_exact_pgs():
+    codec, plan, store, size, chunk = _matrix_fixture([0b111100, 0b110011])
+    checks = _checksum_table(store, len(store), size)
+    verifier = DecodeVerifier(checks, codec=codec)
+    read = lambda pg, s: store[pg][s]  # noqa: E731
+    for g in plan.groups:
+        out = np.stack([
+            np.concatenate([store[int(pg)][s] for pg in g.pgs])
+            for s in g.missing
+        ])
+        assert verifier.bad_pgs(g, out, chunk, read_shard=read) == set()
+        bad = out.copy()
+        bad[0, 3] ^= 0x10  # damage the first PG's first rebuilt row
+        assert verifier.bad_pgs(g, bad, chunk, read_shard=read) == {
+            int(g.pgs[0])
+        }
+
+
+def test_decode_verifier_parity_recheck_catches_bad_table():
+    """The algebraic backstop: a corrupted CHECKSUM TABLE could bless
+    wrong parity bytes via CRC alone — re-encoding the data rows
+    through the codec still catches them."""
+    codec, plan, store, size, chunk = _matrix_fixture([0b011110])
+    (g,) = plan.groups
+    assert list(g.missing) == [0, 5]  # one data + one parity shard
+    out = np.stack([
+        np.concatenate([store[int(pg)][s] for pg in g.pgs])
+        for s in g.missing
+    ])
+    bad_out = out.copy()
+    bad_out[1, 5] ^= 0x20  # tamper the rebuilt PARITY row...
+    checks = _checksum_table(store, len(store), size)
+    checks[0, 5] = crc32c(bad_out[1])  # ...and "bless" it in the table
+    read = lambda pg, s: store[pg][s]  # noqa: E731
+    assert DecodeVerifier(checks, codec=codec).bad_pgs(
+        g, bad_out, chunk, read_shard=read
+    ) == {0}
+    # CRC alone would have passed it
+    assert DecodeVerifier(checks, codec=None).bad_pgs(
+        g, bad_out, chunk, read_shard=read
+    ) == set()
+
+
+def test_verified_run_commits_byte_exact():
+    codec, plan, store, size, chunk = _matrix_fixture([0b111100, 0b001111])
+    ex = RecoveryExecutor(codec, config=Config(env={}))
+    ex.verifier = DecodeVerifier(
+        _checksum_table(store, len(store), size), codec=codec
+    )
+    res = ex.run(plan, lambda pg, s: store[pg][s])
+    assert res.verify_retries == 0
+    assert not res.inconsistent_unrecoverable
+    for pg, shards in res.shards.items():
+        for s, got in shards.items():
+            np.testing.assert_array_equal(got, store[pg][s])
+
+
+def test_verify_failure_is_reported_never_silent():
+    """Wrong decode INPUTS (a survivor rotted after checksum time) make
+    every engine's output fail verification: the PG must land in
+    ``inconsistent_unrecoverable`` and must not be committed."""
+    codec, plan, store, size, chunk = _matrix_fixture([0b111100])
+    checks = _checksum_table(store, len(store), size)
+    apply_bitrot(store[0][2], 5, 0x55)  # shard 2 is a decode source
+    ex = RecoveryExecutor(codec, config=Config(env={}))
+    ex.verifier = DecodeVerifier(checks, codec=codec)
+    res = ex.run(plan, lambda pg, s: store[pg][s])
+    assert res.inconsistent_unrecoverable == {0}
+    assert 0 not in res.shards
+    assert res.shards_rebuilt == 0
+
+
+# ---- the miscompiled-schedule regression -----------------------------
+
+
+def _liberation_fixture(masks, k=4, w=7, packetsize=8, seed=1):
+    size = k + 2
+    bcodec = BitmatrixCodec(gfw.liberation_bitmatrix(k, w), w, packetsize)
+    chunk = 2 * w * packetsize
+    peering = _degraded_peering(masks, size, k, pool_id=2)
+    plan = build_plan(peering, bcodec)
+    rng = np.random.default_rng(seed)
+    store = {}
+    for pg in range(len(masks)):
+        data = rng.integers(0, 256, (k, chunk), dtype=np.uint8)
+        store[pg] = np.vstack([data, bcodec.encoder.encode(data)])
+    return bcodec, plan, store, size, chunk
+
+
+def _tampered_encoder(g):
+    """A genuinely compiled schedule with one extra bogus step: XOR
+    input row 0 into output row 0 — wrong bytes, right shapes."""
+    import jax.numpy as jnp
+
+    enc = XorScheduleEncoder(
+        g.repair_bitmatrix, layout="packet", w=g.w, packetsize=g.packetsize
+    )
+    bogus = np.vstack([
+        enc.schedule.steps, [[enc.schedule.n_in, 0]]
+    ]).astype(np.int32)
+    enc._steps = jnp.asarray(bogus)
+    return enc
+
+
+def test_miscompiled_schedule_quarantined_then_dense():
+    """The acceptance regression: a deliberately miscompiled XOR
+    schedule is caught by decode-verify, its pattern quarantined, and
+    the decode re-derived through the dense bit-matrix engine within
+    ``recovery_retry_max`` — final bytes exact, nothing silent."""
+    bcodec, plan, store, size, chunk = _liberation_fixture(
+        [0b011110, 0b111100]
+    )
+    cfg = Config(env={})
+    ex = RecoveryExecutor(bcodec, config=cfg)
+    ex.verifier = DecodeVerifier(
+        _checksum_table(store, len(store), size), codec=bcodec
+    )
+    for g in plan.groups:
+        enc = _tampered_encoder(g)
+        ex._schedules.get(("packet", g.mask), lambda enc=enc: enc)
+
+    res = ex.run(plan, lambda pg, s: store[pg][s])
+    # exactly one verify retry per group: the first dense re-derive
+    # passes, well inside the recovery_retry_max bound
+    assert res.verify_retries == len(plan.groups)
+    assert res.verify_retries <= int(cfg.get("recovery_retry_max")) * len(
+        plan.groups
+    )
+    assert not res.inconsistent_unrecoverable
+    for g in plan.groups:
+        assert ex._schedules.is_quarantined(("packet", g.mask))
+        assert ex._schedules.is_quarantined(("bitplane", g.mask))
+    for pg, shards in res.shards.items():
+        for s, got in shards.items():
+            np.testing.assert_array_equal(got, store[pg][s])
+
+    # the quarantine is sticky: a fresh run of the same plan routes
+    # straight to the dense engine — no schedule launch, no retry
+    res2 = ex.run(plan, lambda pg, s: store[pg][s])
+    assert res2.schedule_launches == 0
+    assert res2.verify_retries == 0
+    for pg, shards in res2.shards.items():
+        for s, got in shards.items():
+            np.testing.assert_array_equal(got, store[pg][s])
+
+
+def test_schedule_quarantine_journaled_once():
+    """``scrub.schedule_quarantined`` is journaled exactly once per
+    pattern even when the same group re-verifies again later."""
+    bcodec, plan, store, size, chunk = _liberation_fixture([0b011110])
+    ex = RecoveryExecutor(bcodec, config=Config(env={}))
+    ex.verifier = DecodeVerifier(
+        _checksum_table(store, len(store), size), codec=bcodec
+    )
+    (g,) = plan.groups
+    enc = _tampered_encoder(g)
+    ex._schedules.get(("packet", g.mask), lambda: enc)
+    journal = EventJournal()
+    read = lambda pg, s: store[pg][s]  # noqa: E731
+    from ceph_tpu.recovery.executor import RecoveryResult
+
+    inner = RecoveryResult(shards={})
+    fl = ex._dispatch_group(g, read, inner)
+    out, chunk_got = ex._finalize_group(fl, inner)
+    ok, bad = ex._verified_commit(
+        g, out, chunk_got, fl.engine, inner, read, jevent=journal.event
+    )
+    assert ok == {int(p) for p in g.pgs} and not bad
+    quar = journal.by_name("scrub.schedule_quarantined")
+    assert len(quar) == 1
+    assert quar[0]["attrs"]["mask"] == g.mask
+
+
+# ---- bitrot failure specs --------------------------------------------
+
+
+def test_parse_spec_bitrot_roundtrip():
+    spec = parse_spec("bitrot:12.3.77.255:corrupt")
+    assert spec.is_bitrot and spec.action == "corrupt"
+    ev = spec.bitrot()
+    assert ev == BitrotEvent(pg=12, shard=3, offset=77, mask=255)
+    assert str(spec) == "bitrot:12.3.77.255:corrupt"
+    # the action defaults for the 2-part form; leading zeros normalize
+    assert normalize("bitrot:007.01.005.010") == "bitrot:7.1.5.10:corrupt"
+    # dict form round-trips to the same spec
+    assert parse_spec(
+        {"scope": "bitrot", "target": "12.3.77.255", "action": "corrupt"}
+    ) == spec
+    # invalid targets and actions die loudly at the surface
+    with pytest.raises(ValueError, match="mask"):
+        parse_spec("bitrot:1.2.3.0")
+    with pytest.raises(ValueError, match="mask"):
+        parse_spec("bitrot:1.2.3.256")
+    with pytest.raises(ValueError, match="four non-negative"):
+        parse_spec("bitrot:1.2.3")
+    with pytest.raises(ValueError, match="only support action"):
+        parse_spec("bitrot:1.2.3.4:down")
+
+
+def test_parse_spec_rejects_unknown_dict_keys():
+    with pytest.raises(UnknownSpecKeyError, match="scop"):
+        parse_spec({"scop": "osd", "target": "5"})
+    with pytest.raises(UnknownSpecKeyError, match="masK"):
+        parse_spec({"scope": "bitrot", "target": "1.2.3.4", "masK": 9})
+    assert issubclass(UnknownSpecKeyError, ValueError)
+
+
+def test_bitrot_specs_never_reach_the_map():
+    m = build_osdmap(8, pg_num=8)
+    spec = parse_spec("bitrot:1.2.3.4")
+    with pytest.raises(ValueError, match="shard bytes"):
+        resolve_targets(m, spec)
+    with pytest.raises(ValueError):
+        build_incremental(m, [spec])
+
+
+# ---- schedule cache: LRU bound + quarantine --------------------------
+
+
+class _StubEngine:
+    schedule = None
+
+
+def test_schedule_cache_lru_bound():
+    cache = ScheduleCache(name="t", max_entries=2)
+    builds = []
+
+    def build(key):
+        def _b():
+            builds.append(key)
+            return _StubEngine()
+        return _b
+
+    a = cache.get("a", build("a"))
+    cache.get("b", build("b"))
+    assert cache.get("a", build("a")) is a  # hit refreshes LRU position
+    cache.get("c", build("c"))  # evicts "b" (LRU), not "a"
+    assert len(cache) == 2
+    assert cache.get("a", build("a")) is a
+    cache.get("b", build("b"))  # rebuilt after eviction
+    assert builds == ["a", "b", "c", "b"]
+
+
+def test_schedule_cache_unbounded_by_default():
+    cache = ScheduleCache(name="t0")
+    for i in range(100):
+        cache.get(i, lambda: _StubEngine())
+    assert len(cache) == 100
+
+
+def test_schedule_cache_quarantine_reroutes_to_dense():
+    bcodec, plan, store, size, chunk = _liberation_fixture([0b011110])
+    (g,) = plan.groups
+    cache = ScheduleCache(name="tq")
+    enc = encoder_for_group(cache, g, "auto")
+    assert isinstance(enc, XorScheduleEncoder)
+    assert cache.quarantine(("packet", g.mask)) is True
+    assert cache.quarantine(("packet", g.mask)) is False  # journal-once
+    assert cache.is_quarantined(("packet", g.mask))
+    assert ("packet", g.mask) not in cache._entries  # evicted
+    dense = encoder_for_group(cache, g, "auto")
+    assert isinstance(dense, DenseBitmatrixAdapter)
+    dump = cache.dump()
+    assert dump["quarantined"] == [str(("packet", g.mask))]
+    assert [e["engine"] for e in dump["entries"]] == ["dense"]
+    # the two engines agree on the clean store (independent paths)
+    src = np.stack([store[0][s] for s in g.rows])
+    np.testing.assert_array_equal(
+        XorScheduleEncoder(
+            g.repair_bitmatrix, layout="packet",
+            w=g.w, packetsize=g.packetsize,
+        ).encode(src),
+        dense.finalize(dense.encode_async(src), chunk),
+    )
+
+
+# ---- journal crash tolerance -----------------------------------------
+
+
+def test_journal_torn_tail_skipped(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    with EventJournal(path=path) as j:
+        j.event("a", x=1)
+        j.event("b", x=2)
+    with open(path, "a") as fh:
+        fh.write('{"trace_id": "dead", "span')  # torn mid-record
+    records = EventJournal.read(path)
+    assert [r["name"] for r in records] == ["a", "b"]
+
+
+def test_journal_mid_file_corruption_raises(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    with EventJournal(path=path) as j:
+        j.event("a")
+    with open(path, "a") as fh:
+        fh.write("NOT JSON\n")
+    with EventJournal(path=path, trace_id="t2") as j:
+        j.event("b")
+    with pytest.raises(ValueError, match=r"j\.jsonl:2"):
+        EventJournal.read(path)
+
+
+# ---- supervised loop: silent bitrot end to end -----------------------
+
+
+def _supervised_bitrot(timeline, seed=0, fault_hook=None, cfg=None,
+                       n_osds=64, pg_num=32, clock=None, journal=None):
+    """A supervised run over an EC-consistent store with the full
+    integrity loop wired: scrubber, corrupt callback, write-back."""
+    k, m_par, chunk = 4, 2, 64
+    m = build_osdmap(n_osds, pg_num=pg_num, size=k + m_par,
+                     pool_kind="erasure")
+    m_prev = copy.deepcopy(m)
+    if isinstance(timeline, str):
+        timeline = rec.build_scenario(timeline, m, cycles=3)
+    codec = MatrixCodec(gf.vandermonde_matrix(k, m_par))
+    rng = np.random.default_rng(3)
+    store = {}
+    for pg in range(pg_num):
+        data = rng.integers(0, 256, (k, chunk), dtype=np.uint8)
+        store[pg] = np.vstack([data, codec.encode(data)])
+    pristine = {pg: arr.copy() for pg, arr in store.items()}
+
+    def read_shard(pg, s):
+        return store[pg][s]
+
+    def write_shard(pg, s, buf):
+        store[pg][s] = np.asarray(buf, np.uint8)
+
+    chaos = rec.ChaosEngine(
+        m, timeline,
+        clock=clock,
+        journal=journal,
+        corrupt=lambda pg, s, off, mask: apply_bitrot(
+            store[pg][s], off, mask
+        ),
+    )
+    scrubber = Scrubber(pg_num, k + m_par, journal=journal,
+                        clock=chaos.clock.now)
+    sup = rec.SupervisedRecovery(
+        codec, chaos, config=cfg or Config(env={}), seed=seed,
+        fault_hook=fault_hook, scrubber=scrubber,
+        write_shard=write_shard, journal=journal,
+    )
+    res = sup.run(m_prev, 1, read_shard)
+    return res, store, pristine, chaos, scrubber, k
+
+
+def test_supervised_silent_bitrot_repairs_store():
+    """The tentpole loop: chaos rots bytes no epoch ever records, the
+    scrub pass finds them, verified repair writes them back, and the
+    closing scrub confirms the STORE is byte-identical to pristine."""
+    journal = EventJournal()
+    res, store, pristine, chaos, scrubber, k = _supervised_bitrot(
+        "silent-bitrot", journal=journal
+    )
+    assert res.converged
+    assert len(chaos.corruptions) == 3
+    assert res.scrub_passes >= 2  # per-burst passes + the closing pass
+    assert res.inconsistencies_found >= 3
+    assert not res.inconsistent_unrecoverable
+    assert res.scrubbed_bytes > 0
+    assert res.time_to_zero_inconsistent_s > 0.0
+    for pg in store:
+        np.testing.assert_array_equal(store[pg], pristine[pg])
+    # the closing pass scrubbed the repaired store clean
+    assert scrubber.scrub(lambda pg, s: store[pg][s]).n_inconsistent == 0
+    # journal carries the whole causal chain
+    assert len(journal.by_name("chaos.bitrot")) == 3
+    assert journal.by_name("scrub.inconsistent")
+    assert journal.by_name("scrub.final")
+    assert not journal.by_name("scrub.verify_failed")
+    s = res.summary()
+    assert s["inconsistent_unrecoverable_pgs"] == []
+    assert s["scrub_passes"] == res.scrub_passes
+
+
+def test_supervised_bitrot_below_k_is_unrecoverable_never_silent():
+    """More damaged shards than parity can absorb: the PG is reported
+    ``inconsistent-unrecoverable`` (journaled, summarized) and its
+    bytes are NEVER silently rewritten."""
+    journal = EventJournal()
+    timeline = rec.ChaosTimeline.from_pairs([
+        (1.0, [f"bitrot:5.{s}.{3 + s}.7" for s in range(3)]),
+    ])
+    res, store, pristine, chaos, scrubber, k = _supervised_bitrot(
+        timeline, journal=journal
+    )
+    assert res.converged  # accounted-for damage still converges
+    assert res.inconsistent_unrecoverable == {5}
+    assert res.summary()["inconsistent_unrecoverable_pgs"] == [5]
+    assert journal.by_name("scrub.unrecoverable")
+    # the three rotted shards keep their damage — no fabricated repair
+    for s in range(3):
+        assert not np.array_equal(store[5][s], pristine[5][s])
+    # every OTHER pg is untouched
+    for pg in store:
+        if pg != 5:
+            np.testing.assert_array_equal(store[pg], pristine[pg])
+
+
+def test_scrub_storm_converges_with_map_failures():
+    """Bitrot burst + a host death: integrity repair and availability
+    repair interleave; both account for every PG."""
+    res, store, pristine, chaos, scrubber, k = _supervised_bitrot(
+        "scrub-storm"
+    )
+    assert res.converged
+    assert res.inconsistencies_found >= 8
+    assert not res.inconsistent_unrecoverable
+    assert res.epochs[-1] == chaos.epoch  # the host event was observed
+    # integrity repairs restored every rotted byte in the store
+    final = scrubber.scrub(lambda pg, s: store[pg][s])
+    assert final.n_inconsistent == 0
+
+
+# ---- retry/backoff determinism ---------------------------------------
+
+
+class _RecordingClock(rec.VirtualClock):
+    """Record ``sleep`` calls.  ``VirtualClock.advance`` aliases the
+    PARENT's ``sleep`` at class-definition time, so window advances do
+    not land here — only throttle waits and retry backoff do."""
+
+    def __init__(self):
+        super().__init__()
+        self.sleeps: list[float] = []
+
+    def sleep(self, dt):
+        self.sleeps.append(float(dt))
+        super().sleep(dt)
+
+
+def _backoff_run(seed):
+    clock = _RecordingClock()
+    res, *_ = _supervised_bitrot(
+        "flap", seed=seed, clock=clock,
+        fault_hook=lambda g, attempt: attempt == 0,
+    )
+    return res, clock.sleeps
+
+
+def test_retry_backoff_is_seed_deterministic():
+    """The only randomness in a supervised run is the seeded backoff
+    jitter: same seed -> bit-identical sleep sequence (and results);
+    different seed -> different jitter."""
+    res_a, sleeps_a = _backoff_run(seed=1)
+    res_b, sleeps_b = _backoff_run(seed=1)
+    assert res_a.retries > 0 and res_a.converged
+    assert sleeps_a  # the injected failures actually backed off
+    assert sleeps_a == sleeps_b
+    assert res_a.summary() == res_b.summary()
+    _, sleeps_c = _backoff_run(seed=2)
+    assert sleeps_a != sleeps_c
+
+
+def test_backoff_grows_exponentially():
+    """With jitter in [1, 2), attempt n's backoff is base * 2^(n-1) *
+    (1 + u): consecutive retries of one group at least hold their
+    lower bound."""
+    cfg = Config(env={})
+    base = float(cfg.get("recovery_backoff_base_ms")) / 1000.0
+    clock = _RecordingClock()
+    res, *_ = _supervised_bitrot(
+        # one failure event so exactly one group exists per plan
+        rec.ChaosTimeline.from_pairs([(1.0, "osd:3:down_out")]),
+        seed=0, clock=clock, cfg=cfg,
+        fault_hook=lambda g, attempt: attempt < 3,
+    )
+    assert res.converged and res.retries >= 3
+    backoffs = [s for s in clock.sleeps if s >= base]
+    assert len(backoffs) >= 3
+    for i, s in enumerate(backoffs[:3]):
+        lo = base * (2 ** i)
+        assert lo <= s < lo * 2
+
+
+# --- two-process mesh scrub: every rank sees the same damage ---------
+
+_SCRUB_CHILD = r"""
+import json, sys
+import numpy as np
+from ceph_tpu.parallel import multihost
+from ceph_tpu.recovery.scrub import Scrubber, apply_bitrot
+
+rank = int(sys.argv[1])
+multihost.init(coordinator=sys.argv[2], num_processes=2, process_id=rank)
+import jax
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+
+PG_NUM, SHARDS, CHUNK = 37, 6, 64  # 37: pad path exercised
+rng = np.random.default_rng(7)
+store = {
+    pg: rng.integers(0, 256, (SHARDS, CHUNK), dtype=np.uint8)
+    for pg in range(PG_NUM)
+}
+sc = Scrubber(PG_NUM, SHARDS, mesh=multihost.global_mesh())
+sc.build_checksums(lambda pg, s: store[pg][s])
+# deterministic rot AFTER checksumming — both ranks flip identical bits
+for pg, s, off, mask in [(3, 1, 10, 0x40), (3, 4, 0, 0x01),
+                         (18, 0, 63, 0x80), (36, 5, 7, 0x22)]:
+    apply_bitrot(store[pg][s], off, mask)
+res = sc.scrub(lambda pg, s: store[pg][s])
+print("CHILD_RESULT " + json.dumps({
+    "rank": rank,
+    "hist": res.hist.tolist(),
+    "n_bad": int(res.n_inconsistent),
+    "mask": [int(m) for m in res.inconsistent_mask],
+}), flush=True)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_mesh_scrub_identical_histograms():
+    """Two OS processes (4 virtual CPU devices each) join one
+    jax.distributed group and scrub the SAME deterministically-rotted
+    store through the psum-reduced mesh step: both ranks must hold the
+    identical inconsistency histogram and (all-gathered) per-PG
+    bitmask, and both must equal the single-process ground truth."""
+    from ceph_tpu.common.hermetic import scrubbed_env
+
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    env = scrubbed_env(_REPO, n_devices=4)
+    outs = []
+    with tempfile.TemporaryDirectory() as td:
+        files = [open(os.path.join(td, f"r{r}.out"), "w+") for r in (0, 1)]
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", _SCRUB_CHILD, str(rank), coord],
+                env=env,
+                cwd=_REPO,
+                stdout=files[rank],
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+            for rank in range(2)
+        ]
+        rcs = []
+        try:
+            for p in procs:
+                rcs.append(p.wait(timeout=300))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            for f in files:
+                f.seek(0)
+                outs.append(f.read())
+                f.close()
+            if rcs != [0, 0]:
+                print("child logs:\n" + "\n".join(o[-2000:] for o in outs))
+        assert rcs == [0, 0], f"children failed {rcs}"
+
+    recs = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("CHILD_RESULT "):
+                r = json.loads(line[len("CHILD_RESULT "):])
+                recs[r["rank"]] = r
+    assert set(recs) == {0, 1}
+    np.testing.assert_array_equal(recs[0]["hist"], recs[1]["hist"])
+    assert recs[0]["mask"] == recs[1]["mask"]
+    assert recs[0]["n_bad"] == recs[1]["n_bad"] == 4
+
+    # ground truth: the single-device step over the identical store
+    rng = np.random.default_rng(7)
+    store = {
+        pg: rng.integers(0, 256, (6, 64), dtype=np.uint8)
+        for pg in range(37)
+    }
+    sc = scrub.Scrubber(37, 6)
+    sc.build_checksums(lambda pg, s: store[pg][s])
+    for pg, s, off, mask in [(3, 1, 10, 0x40), (3, 4, 0, 0x01),
+                             (18, 0, 63, 0x80), (36, 5, 7, 0x22)]:
+        scrub.apply_bitrot(store[pg][s], off, mask)
+    want = sc.scrub(lambda pg, s: store[pg][s])
+    np.testing.assert_array_equal(recs[0]["hist"], want.hist)
+    assert recs[0]["mask"] == [int(m) for m in want.inconsistent_mask]
+    assert sorted(want.pgs) == [3, 18, 36]

@@ -183,12 +183,14 @@ def test_mclock_from_config_and_summary():
     )
     arb.request("client", 4096)
     arb.request("recovery", 1024)
+    arb.request("scrub", 512)
     s = arb.summary()
-    assert set(s) == {"client", "recovery"}
+    assert set(s) == {"client", "recovery", "scrub"}
     assert s["client"]["reservation_bps"] == 4e6
     assert s["client"]["granted_bytes"] == 4096
     assert s["recovery"]["limit_bps"] == 1e5
     assert s["recovery"]["requests"] == 1
+    assert s["scrub"]["granted_bytes"] == 512
     json.dumps(s)
 
 
