@@ -45,6 +45,14 @@ it, twice — with and without the mclock ``scrub`` QoS class.  The
 retries, and the time-to-zero-inconsistent and client-p99 deltas the
 scrub class buys — the guard surface ``decide_defaults`` watches for
 integrity regressions.
+
+``--liveness`` runs the failure-detection variant: the standalone
+vmapped heartbeat tick rate (compile guarded), then the seeded
+``flapping-osd`` scenario — whose only events are heartbeat
+suppressions, so EVERY map epoch comes from the detector — twice, with
+and without the markdown-log flap damper.  The ``liveness_*`` fields
+carry the detection latency, the damped vs undamped map-epoch churn,
+and the flap-damper/auto-out counters ``decide_defaults`` guards.
 """
 
 import json
@@ -635,6 +643,180 @@ def run_scrub(scenario: str) -> None:
     )))
 
 
+#: liveness-pass tuning: grace chosen against the flapping-osd window
+#: (0.75 s drop per 1 s cycle) so the undamped detector fires every
+#: cycle while one markdown doubling (2 x 0.5 = 1.0 s > 0.75 s) mutes
+#: the rest
+LIVENESS_GRACE_S = 0.5
+LIVENESS_TICKS = 200
+LIVENESS_SLO = dict(
+    max_detection_latency_s=2.0,
+    max_time_to_zero_degraded_s=60.0,
+)
+
+
+def build_liveness_record(
+    scenario: str,
+    res_damped,
+    res_undamped,
+    timeline,
+    report,
+    liveness_damped,
+    epochs_damped: int,
+    epochs_undamped: int,
+    rate: float,
+    platform: str,
+    guard: dict,
+    warm: dict,
+) -> dict:
+    """The ``--liveness`` JSON line (pure: schema-tested without
+    running the bench).  ``res_*`` are SupervisedResults from the
+    damped / undamped flapping passes; ``liveness_damped`` the damped
+    pass's LivenessDetector; ``epochs_*`` the map-epoch churn each
+    policy produced on the SAME seeded timeline; ``rate`` the
+    standalone vmapped heartbeat tick rate."""
+    return {
+        "metric": "liveness_heartbeat_ticks_per_sec",
+        "value": round(rate),
+        "unit": "ticks/s",
+        "platform": platform,
+        "n_compiles": int(guard["n_compiles"]),
+        "n_compiles_first": int(warm["n_compiles"]),
+        "host_transfers": int(guard["host_transfers"]),
+        "liveness_scenario": scenario,
+        "liveness_converged": res_damped.converged,
+        "liveness_detections": int(len(liveness_damped.detections)),
+        "liveness_detection_latency_s": round(
+            timeline.max_detection_latency(), 6
+        ),
+        "liveness_map_epochs_damped": int(epochs_damped),
+        "liveness_map_epochs_undamped": int(epochs_undamped),
+        "liveness_epoch_churn_ratio": round(
+            epochs_damped / max(epochs_undamped, 1), 9
+        ),
+        "liveness_flap_damped_events": int(
+            liveness_damped.flap_damped_events
+        ),
+        "liveness_auto_out_events": int(liveness_damped.auto_out_events),
+        "liveness_time_to_zero_degraded_s": round(
+            res_damped.time_to_zero_degraded_s, 6
+        ),
+        "liveness_health_status": report.status,
+        "liveness_slo_checks": {c.name: c.status for c in report.checks},
+        "liveness_health_series": timeline.series(),
+    }
+
+
+def _liveness_pass(scenario: str, damped: bool):
+    """One seeded flapping run through the supervised executor with the
+    failure detector producing EVERY map epoch (the scenario schedules
+    no map events — only heartbeat suppressions).  ``damped`` toggles
+    the markdown-log grace damper on the same timeline."""
+    import copy
+
+    from ceph_tpu import recovery as rec
+    from ceph_tpu.common.config import Config
+    from ceph_tpu.ec.backend import MatrixCodec
+    from ceph_tpu.ec.gf import vandermonde_matrix
+    from ceph_tpu.models.clusters import build_osdmap
+    from ceph_tpu.obs import EventJournal, HealthTimeline, SLOSpec, evaluate
+
+    cfg = Config(env={})
+    cfg.set("osd_heartbeat_grace", LIVENESS_GRACE_S)
+    cfg.set("mon_osd_adjust_heartbeat_grace", damped)
+    cfg.set("mon_osd_min_down_reporters", 1)
+    m = build_osdmap(N_OSDS, pg_num=PG_NUM, size=K + M, pool_kind="erasure")
+    m_prev = copy.deepcopy(m)
+    clock = rec.VirtualClock()
+    journal = EventJournal(
+        clock=clock.now, trace_id=f"bench6-liveness-{scenario}"
+    )
+    chaos = rec.ChaosEngine(
+        m, rec.build_scenario(scenario, m), clock=clock, journal=journal,
+        config=cfg,
+    )
+    codec = MatrixCodec(vandermonde_matrix(K, M))
+    spec = SLOSpec(**LIVENESS_SLO)
+    timeline = HealthTimeline(
+        clock.now, k=K, sample_status=spec.sample_status
+    )
+    rng = np.random.default_rng(6)
+    chunks: dict[tuple[int, int], np.ndarray] = {}
+
+    def read_shard(pg, s):
+        key = (int(pg), int(s))
+        if key not in chunks:
+            chunks[key] = rng.integers(0, 256, CHAOS_CHUNK, dtype=np.uint8)
+        return chunks[key]
+
+    e0 = chaos.epoch
+    sup = rec.SupervisedRecovery(
+        codec, chaos, seed=0, journal=journal, health=timeline, config=cfg
+    )
+    res = sup.run(m_prev, 1, read_shard)
+    report = evaluate(timeline, spec)
+    return res, timeline, report, chaos, chaos.epoch - e0
+
+
+def run_liveness(scenario: str) -> None:
+    """The ``--liveness`` bench: standalone vmapped heartbeat tick rate
+    (compile guarded), then the seeded flapping scenario twice — with
+    and without the markdown-log flap damper — so the line carries the
+    detection latency and the map-epoch churn the damper saves.  One
+    JSON line."""
+    from ceph_tpu.common.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    import jax
+
+    from ceph_tpu import recovery as rec
+    from ceph_tpu.analysis.runtime_guard import track
+    from ceph_tpu.common.config import Config
+    from ceph_tpu.recovery.failure import parse_spec
+
+    # standalone tick rate: one suppressed OSD defeats the idle fast
+    # path, one slow OSD keeps the laggy EWMA lane live; the grace is
+    # huge so no transition churns host-side bookkeeping mid-measure
+    cfg = Config(env={})
+    cfg.set("osd_heartbeat_grace", 1e9)
+    clock = rec.VirtualClock()
+    det = rec.LivenessDetector(N_OSDS, clock, config=cfg)
+    det.apply(parse_spec("netsplit:0"))
+    det.apply(parse_spec("slow:1"))
+    with track() as guard:
+        clock.advance(0.1)
+        det.tick()  # warm (one compile for the whole run)
+        warm = guard.snapshot()
+        t0 = time.perf_counter()
+        for _ in range(LIVENESS_TICKS):
+            clock.advance(0.1)
+            det.tick()
+        t_tick = time.perf_counter() - t0
+    rate = LIVENESS_TICKS / t_tick
+
+    res_un, _tl_un, _rep_un, chaos_un, epochs_un = _liveness_pass(
+        scenario, damped=False
+    )
+    res_d, timeline, report, chaos_d, epochs_d = _liveness_pass(
+        scenario, damped=True
+    )
+    print(
+        f"liveness {scenario}: {rate:,.0f} heartbeat ticks/s over "
+        f"{N_OSDS} osds; detection latency "
+        f"{timeline.max_detection_latency():g}s; map epochs "
+        f"{epochs_d} damped vs {epochs_un} undamped "
+        f"({chaos_d.liveness.flap_damped_events} flap-damped events); "
+        f"{'converged' if res_d.converged else 'DIVERGED'} at "
+        f"t={res_d.time_to_zero_degraded_s:g}s; SLO {report.status}",
+        file=sys.stderr,
+    )
+    print(json.dumps(build_liveness_record(
+        scenario, res_d, res_un, timeline, report, chaos_d.liveness,
+        epochs_d, epochs_un, rate, jax.default_backend(),
+        guard.snapshot(), warm,
+    )))
+
+
 def main() -> None:
     from ceph_tpu.common.compile_cache import enable_persistent_cache
 
@@ -756,5 +938,10 @@ if __name__ == "__main__":
         if "--chaos" in sys.argv:
             scenario = sys.argv[sys.argv.index("--chaos") + 1]
         run_scrub(scenario)
+    elif "--liveness" in sys.argv:
+        scenario = "flapping-osd"
+        if "--chaos" in sys.argv:
+            scenario = sys.argv[sys.argv.index("--chaos") + 1]
+        run_liveness(scenario)
     else:
         main()

@@ -47,7 +47,8 @@ MODES = {
 # recovery subsystem's batched repair-decode rate (config6_recovery).
 AUX_METRICS = ("recovery_decode_bytes_per_sec",
                "recovery_multichip_bytes_per_sec",
-               "scrub_crc32c_bytes_per_sec")
+               "scrub_crc32c_bytes_per_sec",
+               "liveness_heartbeat_ticks_per_sec")
 
 # Runtime-guard fields the bench configs attach to their JSON lines
 # (ceph_tpu.analysis.runtime_guard): compile and device->host transfer
@@ -123,6 +124,21 @@ SCRUB_FLOAT_FIELDS = ("scrub_time_to_zero_inconsistent_s",
                       "scrub_time_to_zero_inconsistent_s_no_arbiter",
                       "scrub_p99_ms")
 SCRUB_STR_FIELDS = ("scrub_health_status",)
+
+# Failure-detection fields (config6_recovery --liveness): the damped /
+# undamped flapping passes run on the same seeded timeline, so every
+# count is an exact expectation — more map epochs under damping, a
+# worse detection latency, or a non-converged damped pass under the
+# same scenario is a control-plane regression, not noise.
+LIVENESS_INT_FIELDS = ("liveness_detections",
+                       "liveness_map_epochs_damped",
+                       "liveness_map_epochs_undamped",
+                       "liveness_flap_damped_events",
+                       "liveness_auto_out_events")
+LIVENESS_FLOAT_FIELDS = ("liveness_detection_latency_s",
+                         "liveness_time_to_zero_degraded_s",
+                         "liveness_epoch_churn_ratio")
+LIVENESS_STR_FIELDS = ("liveness_health_status",)
 
 
 def harvest_aux(paths: list[str]) -> dict[str, int]:
@@ -212,6 +228,15 @@ def harvest_guard(paths: list[str]) -> dict[str, dict]:
             fields.update(
                 {f: str(d[f]) for f in SCRUB_STR_FIELDS if f in d}
             )
+            fields.update(
+                {f: int(d[f]) for f in LIVENESS_INT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: float(d[f]) for f in LIVENESS_FLOAT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: str(d[f]) for f in LIVENESS_STR_FIELDS if f in d}
+            )
             if not fields:
                 continue
             if "n_compiles" in fields and "n_compiles_first" in fields:
@@ -222,6 +247,8 @@ def harvest_guard(paths: list[str]) -> dict[str, dict]:
                 fields["chaos_converged"] = bool(d["chaos_converged"])
             if "scrub_converged" in d:
                 fields["scrub_converged"] = bool(d["scrub_converged"])
+            if "liveness_converged" in d:
+                fields["liveness_converged"] = bool(d["liveness_converged"])
             guard[d["metric"]] = fields
     return guard
 

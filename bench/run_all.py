@@ -42,6 +42,8 @@ CONFIGS = [
      ("--multichip",)),
     ("config6_recovery_scrub", "bench/config6_recovery.py",
      ("--scrub",)),
+    ("config6_recovery_liveness", "bench/config6_recovery.py",
+     ("--liveness",)),
     ("tpu_tier", "bench/tpu_tier.py"),
 ]
 

@@ -20,7 +20,7 @@ from .findings import Finding, Suppressions
 #: the CLI progress paths that drive it)
 HOT_SEGMENTS = frozenset(
     {"crush", "ec", "recovery", "osdmap", "balancer", "cli", "core",
-     "parallel", "obs", "workload"}
+     "parallel", "obs", "workload", "liveness"}
 )
 
 

@@ -100,8 +100,14 @@ def _demo(args, out) -> tuple[dict, dict]:
         clock=clock.now,
         trace_id=f"status-demo-{args.scenario}",
     )
+    flags = None
+    if args.flag:
+        from ..recovery import ClusterFlags
+
+        flags = ClusterFlags(*args.flag)
     chaos = ChaosEngine(
-        m, build_scenario(args.scenario, m), clock=clock, journal=journal
+        m, build_scenario(args.scenario, m), clock=clock, journal=journal,
+        flags=flags,
     )
     scrub_on = args.scrub or args.scenario in (
         "silent-bitrot", "scrub-storm"
@@ -118,6 +124,7 @@ def _demo(args, out) -> tuple[dict, dict]:
             args.max_inconsistent_seconds if scrub_on else None
         ),
         max_scrub_age_s=args.max_scrub_age if scrub_on else None,
+        max_detection_latency_s=args.max_detection_latency,
     )
     timeline = HealthTimeline(
         clock.now, k=args.ec_k, sample_status=spec.sample_status
@@ -136,6 +143,7 @@ def _demo(args, out) -> tuple[dict, dict]:
             ops_per_step=args.ops_per_step,
             seed=args.seed,
             journal=journal,
+            flags=chaos.flags,
         )
     codec = MatrixCodec(vandermonde_matrix(args.ec_k, args.ec_m))
     rng = np.random.default_rng(args.seed)
@@ -179,6 +187,13 @@ def _demo(args, out) -> tuple[dict, dict]:
         def write_shard(pg: int, s: int, buf) -> None:
             chunks[(int(pg), int(s))] = np.asarray(buf, np.uint8).copy()
 
+        if traffic is not None:
+            # checksum-at-write + degraded-read verification: client
+            # writes refresh the scrubber's table, degraded reads
+            # CRC-check the surviving shards they serve from
+            traffic.scrubber = scrubber
+            traffic.read_shard = read_shard
+
     sup = SupervisedRecovery(
         codec, chaos, seed=args.seed, journal=journal, health=timeline,
         traffic=traffic, scrubber=scrubber, write_shard=write_shard,
@@ -205,8 +220,11 @@ def _demo(args, out) -> tuple[dict, dict]:
                 res.time_to_zero_inconsistent_s, 6
             ),
         }
+    liveness_panel = chaos.liveness.summary()
     return {
-        "status": status_dict(timeline, spec, scrub=scrub_panel),
+        "status": status_dict(
+            timeline, spec, scrub=scrub_panel, liveness=liveness_panel
+        ),
         "health": evaluate(timeline, spec).to_dict(),
         "timeline": {"series": timeline.to_dicts()},
         "journal": {"records": journal.records},
@@ -250,6 +268,14 @@ def main(argv=None) -> int:
     p.add_argument("--ops-per-step", type=int, default=65536)
     p.add_argument("--max-p99-ms", type=float, default=50.0)
     p.add_argument("--max-slow-fraction", type=float, default=0.02)
+    p.add_argument("--flag", action="append", default=[],
+                   metavar="NAME",
+                   help="raise a cluster flag on the demo run "
+                        "(noout/norecover/nobackfill/norebalance/pause; "
+                        "repeatable)")
+    p.add_argument("--max-detection-latency", type=float, default=None,
+                   help="SLO budget on failure-to-mark-down latency "
+                        "(virtual seconds); default: check disabled")
     args = p.parse_args(argv)
     out = sys.stdout
 

@@ -180,6 +180,47 @@ SCHEMA: list[Option] = [
            "mclock limit for scrub traffic (bytes/s hard cap bounding "
            "a scrub storm's interference with client tail latency); 0 "
            "means uncapped", min=0.0),
+    Option("osd_heartbeat_interval", OPT_FLOAT, 6.0, LEVEL_ADVANCED,
+           "seconds between OSD heartbeat pings (drives the liveness "
+           "detector's polling cadence when nothing else advances the "
+           "virtual clock)", min=0.001,
+           see_also=("osd_heartbeat_grace",)),
+    Option("osd_heartbeat_grace", OPT_FLOAT, 20.0, LEVEL_ADVANCED,
+           "seconds without an ack before the detector may mark an "
+           "OSD down (the mon/OSD heartbeat grace of the same name)",
+           min=0.0, see_also=("mon_osd_adjust_heartbeat_grace",)),
+    Option("mon_osd_down_out_interval", OPT_FLOAT, 600.0, LEVEL_ADVANCED,
+           "seconds a detector-marked-down OSD stays down before it "
+           "is automatically marked out (0 disables auto-out); "
+           "map-event downs are never auto-outed", min=0.0,
+           see_also=("mon_osd_min_in_ratio",)),
+    Option("mon_osd_min_in_ratio", OPT_FLOAT, 0.75, LEVEL_ADVANCED,
+           "auto-out stops once it would push the in-OSD fraction "
+           "below this floor (reference analog of the same name)",
+           min=0.0, max=1.0),
+    Option("mon_osd_min_down_reporters", OPT_INT, 2, LEVEL_ADVANCED,
+           "distinct peer failure reports required before a "
+           "heartbeat-silent OSD can be marked down", min=1),
+    Option("mon_osd_laggy_halflife", OPT_FLOAT, 3600.0, LEVEL_ADVANCED,
+           "decay halflife (seconds) for the per-OSD laggy score and "
+           "the markdown (flap) count", min=0.001),
+    Option("mon_osd_laggy_weight", OPT_FLOAT, 0.3, LEVEL_ADVANCED,
+           "EWMA weight a slow-but-acking OSD's laggy score gains per "
+           "heartbeat tick", min=0.0, max=1.0),
+    Option("mon_osd_adjust_heartbeat_grace", OPT_BOOL, True,
+           LEVEL_ADVANCED,
+           "scale the effective heartbeat grace by 2^markdowns for "
+           "repeat offenders (the markdown-log flap damper); off = "
+           "flat grace",
+           see_also=("mon_osd_grace_doublings_max",)),
+    Option("mon_osd_grace_doublings_max", OPT_FLOAT, 5.0, LEVEL_ADVANCED,
+           "cap on markdown-log grace doublings (effective grace <= "
+           "grace * 2^cap)", min=0.0),
+    Option("osd_scrub_stagger_period", OPT_FLOAT, 0.0, LEVEL_ADVANCED,
+           "deep-scrub stagger period (seconds): each PG scrubs in a "
+           "hashed phase window inside the period so pool-wide scrub "
+           "bandwidth is flat instead of one burst; 0 scrubs the "
+           "whole pool every pass", min=0.0),
     Option("osd_max_backfills", OPT_INT, 1, LEVEL_ADVANCED,
            "backfill pattern groups admitted per repair group in the "
            "supervised scheduler (the reference's backfill reservation "

@@ -55,6 +55,10 @@ class SLOSpec:
     - ``max_scrub_age_s`` — the longest interval the run may go
       without a completed scrub pass (``SLO_SCRUB_AGE``, the
       ``PG_NOT_SCRUBBED`` analog).
+    - ``max_detection_latency_s`` — ceiling on the virtual time between
+      an OSD going silent and the failure detector marking it down
+      (``SLO_DETECTION_LATENCY``, the ``osd_heartbeat_grace`` +
+      reporter-quorum delay an operator actually waits through).
     """
 
     max_inactive_seconds: float | None = None
@@ -65,6 +69,7 @@ class SLOSpec:
     max_slow_op_fraction: float | None = None
     max_inconsistent_seconds: float | None = None
     max_scrub_age_s: float | None = None
+    max_detection_latency_s: float | None = None
     warn_fraction: float = 0.8
 
     def sample_status(self, sample: HealthSample) -> str:
@@ -270,5 +275,23 @@ def evaluate(timeline: HealthTimeline, spec: SLOSpec) -> HealthReport:
             f"longest interval without a completed scrub pass "
             f"{observed:g}s (budget {spec.max_scrub_age_s:g}s)",
             observed, spec.max_scrub_age_s,
+        ))
+    if spec.max_detection_latency_s is not None:
+        lats = timeline.detection_latencies
+        observed = timeline.max_detection_latency()
+        if not lats:
+            status, detail = HEALTH_OK, "no failures to detect"
+        else:
+            status = _grade_max(
+                observed, spec.max_detection_latency_s, spec.warn_fraction
+            )
+            detail = (
+                f"worst failure-to-mark-down latency {observed:g}s over "
+                f"{len(lats)} detections "
+                f"(budget {spec.max_detection_latency_s:g}s)"
+            )
+        report._add(HealthCheck(
+            "SLO_DETECTION_LATENCY", status, detail,
+            observed, spec.max_detection_latency_s,
         ))
     return report

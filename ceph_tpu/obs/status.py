@@ -17,13 +17,18 @@ def status_dict(
     timeline: HealthTimeline,
     spec: SLOSpec | None = None,
     scrub: dict | None = None,
+    liveness: dict | None = None,
 ) -> dict:
     """The ``status`` reply: latest histogram + rolled-up health.
 
     ``scrub`` is an optional data-integrity panel (pass counts, bytes
     verified, inconsistencies, verify retries — the shape
     ``cli.status`` builds from a
-    :class:`~ceph_tpu.recovery.executor.SupervisedResult`)."""
+    :class:`~ceph_tpu.recovery.executor.SupervisedResult`).
+    ``liveness`` is an optional failure-detection panel — a
+    :meth:`~ceph_tpu.recovery.liveness.LivenessDetector.summary` dict,
+    optionally extended with a ``flags`` list of raised cluster
+    flags."""
     latest = timeline.latest
     report = (
         evaluate(timeline, spec).to_dict() if spec is not None else None
@@ -73,6 +78,8 @@ def status_dict(
         }
     if scrub is not None:
         out["scrub"] = dict(scrub)
+    if liveness is not None:
+        out["liveness"] = dict(liveness)
     return out
 
 
@@ -141,6 +148,24 @@ def render_status(status: dict) -> str:
         ttz = scrub.get("time_to_zero_inconsistent_s")
         if ttz:
             lines.append(f"    time to zero inconsistent: {ttz:g}s")
+    lv = status.get("liveness")
+    if lv is not None:
+        lines.append("  osd:")
+        n = lv.get("n_osds", 0)
+        down = lv.get("osds_down", 0)
+        lines.append(f"    {n - down} up, {down} down ({n} total)")
+        if lv.get("osds_laggy"):
+            lines.append(f"    laggy: {lv['osds_laggy']}")
+        if lv.get("flags"):
+            lines.append(
+                "    flags: " + ",".join(sorted(lv["flags"]))
+            )
+        if lv.get("auto_out_events") or lv.get("flap_damped_events"):
+            lines.append(
+                f"    detector: {lv.get('detections', 0)} detections, "
+                f"{lv.get('auto_out_events', 0)} auto-out, "
+                f"{lv.get('flap_damped_events', 0)} flap-damped"
+            )
     return "\n".join(lines)
 
 

@@ -58,6 +58,11 @@ class HealthSample:
     # ceph_tpu.workload.TrafficSample), when a traffic engine rode the
     # run; None for pure-recovery timelines
     traffic: object | None = None
+    # failure-detector view at sample time (0 when no detector rode
+    # the run): OSDs the detector holds down, OSDs over the laggy
+    # probability threshold
+    osds_down: int = 0
+    osds_laggy: int = 0
 
     @property
     def inactive_pgs(self) -> int:
@@ -84,6 +89,8 @@ class HealthSample:
             "traffic": (
                 self.traffic.to_dict() if self.traffic is not None else None
             ),
+            "osds_down": self.osds_down,
+            "osds_laggy": self.osds_laggy,
         }
 
 
@@ -116,6 +123,9 @@ class HealthTimeline:
         # virtual times of completed scrub passes (note_scrub); the
         # SLO_SCRUB_AGE budget grades the largest gap between them
         self.scrub_times: list[float] = []
+        # failure-to-mark-down latencies (note_detection); the
+        # SLO_DETECTION_LATENCY budget grades the worst one
+        self.detection_latencies: list[float] = []
         self._classifier = PGStateClassifier(mesh)
 
     def __len__(self) -> int:
@@ -131,8 +141,12 @@ class HealthTimeline:
         epoch: int | None = None,
         bytes_recovered: int = 0,
         traffic=None,
+        liveness=None,
     ) -> HealthSample:
-        """Record the cluster's health at the current virtual time."""
+        """Record the cluster's health at the current virtual time.
+        ``liveness`` is a
+        :class:`~ceph_tpu.recovery.liveness.LivenessDetector` whose
+        down/laggy view stamps the sample."""
         hist, aux = self._classifier(peering, self.k)
         counts = {
             name: int(hist[i]) for i, name in enumerate(STATE_NAMES)
@@ -160,6 +174,12 @@ class HealthTimeline:
                 1.0 - counts["inactive"] / total if total else 1.0
             ),
             traffic=traffic,
+            osds_down=(
+                int(liveness.osds_down) if liveness is not None else 0
+            ),
+            osds_laggy=(
+                int(liveness.osds_laggy) if liveness is not None else 0
+            ),
         )
         sample.health = (
             self.sample_status(sample)
@@ -192,6 +212,9 @@ class HealthTimeline:
         }
         for name in STATE_NAMES:
             cols[name] = [s.counts[name] for s in self.samples]
+        if any(s.osds_down or s.osds_laggy for s in self.samples):
+            cols["osds_down"] = [s.osds_down for s in self.samples]
+            cols["osds_laggy"] = [s.osds_laggy for s in self.samples]
         if any(s.traffic is not None for s in self.samples):
             def _tcol(fn):
                 return [
@@ -244,6 +267,17 @@ class HealthTimeline:
     def note_scrub(self) -> None:
         """Mark a completed scrub pass at the current virtual time."""
         self.scrub_times.append(float(self.clock()))
+
+    def note_detection(self, latency_s: float) -> None:
+        """Record one failure-detection latency (virtual seconds from
+        heartbeat silence to the detector marking the OSD down)."""
+        self.detection_latencies.append(float(latency_s))
+
+    def max_detection_latency(self) -> float:
+        """The worst failure-to-mark-down latency of the run (0 when
+        nothing was detected — an undetected failure shows up as
+        degraded PGs, not here)."""
+        return max(self.detection_latencies, default=0.0)
 
     def inconsistent_seconds(self) -> float:
         """Virtual seconds any PG spent scrub-flagged inconsistent:

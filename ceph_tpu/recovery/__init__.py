@@ -21,6 +21,10 @@ The subsystem that closes the loop the standalone workloads left open
 - :mod:`~ceph_tpu.recovery.scrub`    — device-side batched CRC32C
   scrub (inconsistent-PG detection) and decode-verify (checksums
   recomputed before any repair commits).
+- :mod:`~ceph_tpu.recovery.liveness` — mon-style failure detection on
+  the virtual clock: heartbeat grace, the markdown flap damper,
+  down→out policy, and the cluster flag set
+  (``noout``/``norecover``/``nobackfill``/``norebalance``/``pause``).
 """
 
 from .chaos import (
@@ -36,6 +40,8 @@ from .chaos import (
 from .failure import (
     ACTIONS,
     KNOWN_SCOPES,
+    NET_ACTIONS,
+    NET_SCOPES,
     BitrotEvent,
     FailureSpec,
     FlapRecord,
@@ -47,6 +53,13 @@ from .failure import (
     osds_in_subtree,
     parse_spec,
     resolve_targets,
+)
+from .liveness import (
+    KNOWN_FLAGS,
+    ClusterFlags,
+    Detection,
+    LivenessDetector,
+    heartbeat_step,
 )
 from .peering import (
     FLAG_NAMES,
@@ -94,8 +107,15 @@ from .sharded import ShardedDecoder, sharded_decode_step
 
 __all__ = [
     "ACTIONS",
+    "KNOWN_FLAGS",
     "KNOWN_SCOPES",
+    "NET_ACTIONS",
+    "NET_SCOPES",
     "SCENARIOS",
+    "ClusterFlags",
+    "Detection",
+    "LivenessDetector",
+    "heartbeat_step",
     "AppliedCorruption",
     "AppliedEvent",
     "BitrotEvent",

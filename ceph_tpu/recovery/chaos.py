@@ -34,7 +34,14 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ..osdmap.map import Incremental, OSDMap
-from .failure import BitrotEvent, FailureSpec, inject, parse_spec
+from .failure import (
+    BitrotEvent,
+    FailureSpec,
+    inject,
+    parse_spec,
+    resolve_targets,
+)
+from .liveness import ClusterFlags, LivenessDetector
 
 
 class VirtualClock:
@@ -110,7 +117,7 @@ class ChaosTimeline:
 
 SCENARIOS = (
     "flap", "rack-cascade", "mid-repair-loss", "silent-bitrot",
-    "scrub-storm",
+    "scrub-storm", "flapping-osd",
 )
 
 
@@ -156,8 +163,6 @@ def build_scenario(
     if name == "flap":
         # one OSD of the target rack flaps down/up `cycles` times
         _, hosts = _rack_and_hosts(m, rack)
-        from .failure import resolve_targets
-
         osd = resolve_targets(m, FailureSpec("host", hosts[0], "down"))[0]
         pairs: list[tuple[float, object]] = []
         t = start_s
@@ -223,6 +228,26 @@ def build_scenario(
             (start_s, burst),
             (start_s + period_s, FailureSpec("host", hosts[0], "down_out")),
         ])
+    if name == "flapping-osd":
+        # the OBSERVED twin of "flap": one OSD's heartbeats cut and
+        # restored `cycles` times, with NO map events scheduled at all
+        # — every epoch in the run comes from the liveness detector,
+        # so the markdown-log damper's epoch-churn savings are
+        # directly measurable (damped vs undamped runs of this same
+        # timeline).  The drop window is 3/4 of the period: longer
+        # than one base grace, shorter than a once-doubled one.
+        _, hosts = _rack_and_hosts(m, rack)
+        osd = resolve_targets(m, FailureSpec("host", hosts[0], "down"))[0]
+        pairs = []
+        t = start_s
+        for _ in range(cycles):
+            pairs.append((t, FailureSpec("netsplit", str(osd), "drop")))
+            pairs.append(
+                (t + 0.75 * period_s,
+                 FailureSpec("netsplit", str(osd), "restore"))
+            )
+            t += period_s
+        return ChaosTimeline.from_pairs(pairs)
     raise ValueError(f"unknown chaos scenario {name!r}; one of {SCENARIOS}")
 
 
@@ -268,12 +293,20 @@ class ChaosEngine:
         clock: VirtualClock | None = None,
         journal=None,
         corrupt=None,
+        liveness: LivenessDetector | None = None,
+        flags: ClusterFlags | None = None,
+        config=None,
     ):
         self.osdmap = m
         self.timeline = timeline or ChaosTimeline()
         self.clock = clock or VirtualClock()
         self.journal = journal
         self.corrupt = corrupt
+        self.flags = flags if flags is not None else ClusterFlags()
+        self.liveness = liveness or LivenessDetector(
+            m.max_osd, self.clock, config=config, journal=journal,
+            flags=self.flags, osdmap=m,
+        )
         self.applied: list[AppliedEvent] = []
         self.corruptions: list[AppliedCorruption] = []
 
@@ -282,7 +315,10 @@ class ChaosEngine:
         return self.osdmap.epoch
 
     def exhausted(self) -> bool:
-        return len(self.timeline) == 0
+        return (
+            len(self.timeline) == 0
+            and self.liveness.next_deadline() is None
+        )
 
     def poll(self) -> list[Incremental]:
         """Inject every event due at the current virtual time; returns
@@ -294,17 +330,30 @@ class ChaosEngine:
         incs = []
         for ev in self.timeline.due(self.clock.now()):
             rot = [s for s in ev.specs if s.is_bitrot]
-            fail = tuple(s for s in ev.specs if not s.is_bitrot)
+            net = [s for s in ev.specs if s.is_net]
+            fail = tuple(
+                s for s in ev.specs if not s.is_bitrot and not s.is_net
+            )
             if fail:
                 inc = inject(self.osdmap, list(fail))
                 incs.append(inc)
                 self.applied.append(AppliedEvent(ev.t, inc.epoch, fail, inc))
+                self._sync_liveness(fail)
                 if self.journal is not None:
                     self.journal.event(
                         "chaos.inject",
                         epoch=inc.epoch,
                         sched_t=ev.t,
                         specs=[str(s) for s in fail],
+                    )
+            for spec in net:
+                self.liveness.apply(spec)
+                if self.journal is not None:
+                    self.journal.event(
+                        "chaos.net",
+                        epoch=self.osdmap.epoch,
+                        sched_t=ev.t,
+                        spec=str(spec),
                     )
             for spec in rot:
                 rot_ev = spec.bitrot()
@@ -325,15 +374,72 @@ class ChaosEngine:
                         offset=rot_ev.offset,
                         mask=rot_ev.mask,
                     )
+        incs.extend(self._poll_liveness())
         return incs
 
+    def _sync_liveness(self, specs) -> None:
+        """Authoritative up/in events reset detector bookkeeping for
+        the affected OSDs (a stale last-ack must never re-mark an OSD
+        an admin just brought back)."""
+        ups = [
+            o
+            for s in specs
+            if s.action in ("up", "in")
+            for o in resolve_targets(self.osdmap, s)
+        ]
+        if ups:
+            self.liveness.observe_map(ups)
+
+    def _effective_transitions(self, specs):
+        """Drop detector transitions the map already reflects, so a
+        detection that races a direct map event never burns an empty
+        epoch."""
+        out = []
+        for s in specs:
+            osd = int(s.target)
+            if s.action == "down" and self.osdmap.is_up(osd):
+                out.append(s)
+            elif s.action == "up" and self.osdmap.exists(osd) \
+                    and not self.osdmap.is_up(osd):
+                out.append(s)
+            elif s.action == "out" and not self.osdmap.is_out(osd):
+                out.append(s)
+        return out
+
+    def _poll_liveness(self) -> list[Incremental]:
+        """Tick the failure detector at the current virtual time; any
+        down/up/out transitions it reports become ONE ordinary epoch
+        (the mon batching simultaneous failure reports)."""
+        specs = self._effective_transitions(self.liveness.tick())
+        if not specs:
+            return []
+        inc = inject(self.osdmap, specs)
+        self.applied.append(
+            AppliedEvent(self.clock.now(), inc.epoch, tuple(specs), inc)
+        )
+        if self.journal is not None:
+            self.journal.event(
+                "chaos.detected",
+                epoch=inc.epoch,
+                t=self.clock.now(),
+                specs=[str(s) for s in specs],
+            )
+        return [inc]
+
     def advance_to_next(self) -> bool:
-        """Jump the clock to the next scheduled event (the idle path:
-        no repair work pending but chaos still scheduled).  Returns
-        False when the timeline is exhausted."""
-        t = self.timeline.peek_next()
-        if t is None:
+        """Jump the clock to the next scheduled event OR the next
+        liveness deadline (grace expiry / down->out), whichever comes
+        first — the idle path: no repair work pending but state still
+        due to change.  Returns False when both are exhausted."""
+        cands = [
+            t
+            for t in (self.timeline.peek_next(),
+                      self.liveness.next_deadline())
+            if t is not None
+        ]
+        if not cands:
             return False
+        t = min(cands)
         if t > self.clock.now():
             self.clock.advance(t - self.clock.now())
         return True

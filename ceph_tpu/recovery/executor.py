@@ -602,6 +602,8 @@ class SupervisedResult:
     verify_retries: int = 0  # schedule outputs re-derived via dense
     inconsistent_unrecoverable: set[int] = field(default_factory=set)
     time_to_zero_inconsistent_s: float = 0.0
+    # degraded-mode gating (zero unless cluster flags blocked work)
+    flag_gated_groups: int = 0  # pattern groups held back by flags
 
     def summary(self) -> dict:
         """Structured run report (the ``ceph status`` analog for a
@@ -634,6 +636,7 @@ class SupervisedResult:
             "time_to_zero_inconsistent_s": round(
                 self.time_to_zero_inconsistent_s, 6
             ),
+            "flag_gated_groups": self.flag_gated_groups,
         }
 
 
@@ -714,6 +717,11 @@ class SupervisedRecovery:
         self.op_tracker = op_tracker
         self.traffic = traffic
         self.arbiter = arbiter
+        # degraded-mode gating: the chaos engine's cluster flags
+        # (norecover / nobackfill / norebalance) hold pattern groups
+        # back instead of letting the loop over-repair a cluster an
+        # operator deliberately froze
+        self.flags = getattr(chaos, "flags", None)
         self.launch_duration_s = float(launch_duration_s)
         self.max_items = max_items
         self._rng = np.random.default_rng(seed)
@@ -760,11 +768,23 @@ class SupervisedRecovery:
                 bytes_recovered=bytes_recovered,
             )
         if self.health is not None:
+            liveness = getattr(self.chaos, "liveness", None)
+            kw = {}
+            if liveness is not None and hasattr(
+                self.health, "note_detection"
+            ):
+                # drain completed failure detections into the timeline
+                # (detection-latency SLO feed), and surface the
+                # detector's down/laggy counts on this sample
+                for det in liveness.pop_detections():
+                    self.health.note_detection(det.latency)
+                kw["liveness"] = liveness
             self.health.snapshot(
                 peering,
                 epoch=self.chaos.epoch,
                 bytes_recovered=bytes_recovered,
                 traffic=sample,
+                **kw,
             )
 
     def _schedule(
@@ -792,6 +812,27 @@ class SupervisedRecovery:
             bi += self.max_backfills
         out.extend(backfill[bi:])
         return out
+
+    def _flag_gated(
+        self, g: PatternGroup, peering: PeeringResult
+    ) -> bool:
+        """Is this pattern group held back by a cluster flag?
+        ``norecover`` blocks repair groups, ``nobackfill`` blocks
+        backfill groups, ``norebalance`` blocks backfill groups with
+        no data at risk (pure remap churn)."""
+        flags = self.flags
+        if not flags:
+            return False
+        backfill = all(
+            peering.flags[pg] & PG_STATE_BACKFILL for pg in g.pgs
+        )
+        if backfill:
+            if "nobackfill" in flags:
+                return True
+            return "norebalance" in flags and not any(
+                peering.flags[pg] & PG_STATE_DEGRADED for pg in g.pgs
+            )
+        return "norecover" in flags
 
     @staticmethod
     def _stale_pgs(
@@ -860,6 +901,18 @@ class SupervisedRecovery:
                 state_prev, cur_state(), m_prev.epoch, chaos.epoch
             )
         res.epochs.append(chaos.epoch)
+
+        def feed_reporters() -> None:
+            # the failure detector's reporter pool is the peering
+            # adjacency: only co-serving OSDs heartbeat each other, so
+            # only they can report a silence
+            liveness = getattr(chaos, "liveness", None)
+            if liveness is not None:
+                liveness.set_reporters(
+                    peering.peer_counts(chaos.osdmap.max_osd)
+                )
+
+        feed_reporters()
         # per-PG damage bitmask from the last scrub pass (bit s = shard
         # s failed its checksum); all-zero until bit rot lands
         inconsistent = np.zeros(peering.pg_num, np.uint32)
@@ -913,14 +966,27 @@ class SupervisedRecovery:
                         clean_survivors=int(eff_mask()[p]),
                     )
 
+        stagger_s = float(self.cfg.get("osd_scrub_stagger_period"))
+
         def scrub_now(final: bool = False) -> bool:
             """One device scrub pass; True if the damage map changed."""
             nonlocal inconsistent
             flags()[:] |= PG_STATE_SCRUBBING
-            sr = scrubber.scrub(read_shard)
+            if stagger_s > 0 and not final:
+                # staggered pass: only phase-due PGs verify (the final
+                # pass always covers the whole pool — convergence must
+                # confirm every write-back, not a phase slice)
+                sr = scrubber.scrub(
+                    read_shard, now=chaos.clock.now(), period_s=stagger_s
+                )
+            else:
+                sr = scrubber.scrub(read_shard)
             res.scrub_passes += 1
             res.scrubbed_bytes += sr.scrubbed_bytes
             new = np.asarray(sr.inconsistent_mask, np.uint32).copy()
+            if sr.due is not None:
+                # non-due PGs did not vote: keep their old damage bits
+                new[~sr.due] = inconsistent[~sr.due]
             fresh = np.flatnonzero(new & ~inconsistent)
             res.inconsistencies_found += int(len(fresh))
             changed = not np.array_equal(new, inconsistent)
@@ -993,6 +1059,7 @@ class SupervisedRecovery:
                 peering, _changed = engine.repeer(
                     peering, state_prev, cur_state(), chaos.epoch
                 )
+                feed_reporters()
                 annotate()
                 for pg in list(completed):
                     if not np.array_equal(
@@ -1058,6 +1125,25 @@ class SupervisedRecovery:
                 if chaos.advance_to_next():
                     continue
                 break
+            if self.flags and all(
+                self._flag_gated(g, peering) for g in pending
+            ):
+                # every pending group is held back by cluster flags:
+                # idle forward to the next chaos event / liveness
+                # deadline (the flags may outlive them), else stop and
+                # report the gated work as outstanding — a frozen
+                # cluster must terminate, not spin
+                res.flag_gated_groups = max(
+                    res.flag_gated_groups, len(pending)
+                )
+                if chaos.advance_to_next():
+                    continue
+                self._jevent(
+                    "recovery.gated",
+                    groups=len(pending),
+                    flags=list(self.flags),
+                )
+                break
             # dispatch a window of up to self.window groups back-to-back
             # (async device work overlaps); a mesh-sharded group closes
             # its window — it already occupies every chip.  A retry-
@@ -1065,9 +1151,16 @@ class SupervisedRecovery:
             # happens before anything else dispatches (matching the
             # serial loop's ordering).
             window: list[_Inflight] = []
+            gated: list[PatternGroup] = []
             ops: dict[int, object] = {}
             while pending and len(window) < self.window:
                 g = pending.pop(0)
+                if self._flag_gated(g, peering):
+                    gated.append(g)
+                    res.flag_gated_groups = max(
+                        res.flag_gated_groups, len(gated)
+                    )
+                    continue
                 attempt = 0
                 fl = None
                 op = (
@@ -1128,6 +1221,10 @@ class SupervisedRecovery:
                 window.append(fl)
                 if fl.sharded:
                     break
+            if gated:
+                # gated groups keep their place at the head of the
+                # queue; a flag clear or revision re-admits them
+                pending[:0] = gated
             if not window:
                 continue
             if len(window) > 1:

@@ -36,15 +36,26 @@ ACTIONS = ("down", "out", "down_out", "up", "in")
 # is *silent* until a scrub pass finds it).
 BITROT_ACTION = "corrupt"
 
+# The *observed*-failure scopes: ``netsplit:N`` stops OSD N's
+# heartbeats, ``slow:N`` makes it a straggler (acks late; laggy score
+# rises).  Neither is a map edit — the map only changes if and when
+# the liveness detector (:mod:`ceph_tpu.recovery.liveness`) notices.
+NET_SCOPES = ("netsplit", "slow")
+
+# Actions for NET_SCOPES: ``drop`` begins the condition (default),
+# ``restore`` ends it.
+NET_ACTIONS = ("drop", "restore")
+
 # The scopes a spec may name: ``osd`` plus the reference's stock CRUSH
 # bucket types (``src/crush/CrushWrapper.cc`` default type set), plus
 # ``bitrot`` — silent shard corruption, which is not a map edit at all
-# (see :class:`BitrotEvent`).  Maps with exotic custom type names can
-# pass ``scopes=`` to parse_spec.
+# (see :class:`BitrotEvent`) — plus the :data:`NET_SCOPES` heartbeat
+# conditions.  Maps with exotic custom type names can pass ``scopes=``
+# to parse_spec.
 KNOWN_SCOPES = (
     "osd", "host", "chassis", "rack", "row", "pdu", "pod", "room",
     "datacenter", "dc", "zone", "region", "root", "bitrot",
-)
+) + NET_SCOPES
 
 # The keys a dict-form spec may carry (the JSON timeline surface).
 SPEC_KEYS = ("scope", "target", "action")
@@ -111,6 +122,12 @@ class FailureSpec:
     def is_bitrot(self) -> bool:
         return self.scope == "bitrot"
 
+    @property
+    def is_net(self) -> bool:
+        """Heartbeat-layer spec (netsplit/slow): no map edit; routed
+        to the liveness detector, never to build_incremental."""
+        return self.scope in NET_SCOPES
+
     def bitrot(self) -> BitrotEvent:
         """Decode a ``bitrot`` spec's target (raises for map scopes)."""
         if not self.is_bitrot:
@@ -152,7 +169,12 @@ def parse_spec(text, scopes: tuple[str, ...] = KNOWN_SCOPES) -> FailureSpec:
     parts = text.split(":")
     if len(parts) == 2:
         scope, target = parts
-        action = BITROT_ACTION if scope == "bitrot" else "down"
+        if scope == "bitrot":
+            action = BITROT_ACTION
+        elif scope in NET_SCOPES:
+            action = "drop"
+        else:
+            action = "down"
     elif len(parts) == 3:
         scope, target, action = parts
     else:
@@ -178,6 +200,18 @@ def parse_spec(text, scopes: tuple[str, ...] = KNOWN_SCOPES) -> FailureSpec:
         # canonical: no leading zeros in any component
         target = str(BitrotEvent.from_target(target))
         return FailureSpec(scope, target, action)
+    if scope in NET_SCOPES:
+        if not target.isdigit():
+            raise ValueError(
+                f"{scope} target must be an OSD id (non-negative "
+                f"integer), got {target!r}"
+            )
+        if action not in NET_ACTIONS:
+            raise ValueError(
+                f"{scope} specs only support actions {NET_ACTIONS}, "
+                f"got {action!r}"
+            )
+        return FailureSpec(scope, str(int(target)), action)
     if action not in ACTIONS:
         raise ValueError(f"bad action {action!r}; one of {ACTIONS}")
     return FailureSpec(scope, target, action)
@@ -216,6 +250,8 @@ def resolve_targets(m: OSDMap, spec: FailureSpec) -> list[int]:
     prefixed: ``rack:0`` -> ``rack0``) and collect its subtree."""
     if spec.is_bitrot:
         raise ValueError(f"{spec} targets shard bytes, not OSDs")
+    if spec.is_net:
+        return [int(spec.target)]
     if spec.scope == "osd":
         osd = int(spec.target)
         if not m.exists(osd):
@@ -257,6 +293,12 @@ def build_incremental(m: OSDMap, specs) -> Incremental:
                 f"{spec} is silent corruption, not a map edit; route it "
                 "through ChaosEngine (corrupt= callback), not "
                 "build_incremental/inject"
+            )
+        if spec.is_net:
+            raise ValueError(
+                f"{spec} suppresses heartbeats, it is not a map edit; "
+                "route it through ChaosEngine's LivenessDetector — the "
+                "map changes only when detection fires"
             )
         for osd in resolve_targets(m, spec):
             if spec.action in ("down", "down_out") and m.is_up(osd):

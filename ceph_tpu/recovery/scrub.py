@@ -101,6 +101,17 @@ def apply_bitrot(buf: np.ndarray, offset: int, mask: int) -> None:
     buf[offset % len(buf)] ^= np.uint8(mask)
 
 
+def scrub_phases(n_pgs: int, period_s: float) -> np.ndarray:
+    """Per-PG deep-scrub phase offsets in ``[0, period_s)`` ([n_pgs]
+    f64): a Knuth multiplicative hash of the PG seed, so the pool's
+    scrub load spreads evenly across the period instead of every PG
+    scrubbing at once (the reference's ``osd_deep_scrub_randomize_ratio``
+    spread, but deterministic — the virtual clock has no randomness)."""
+    pgs = np.arange(n_pgs, dtype=np.uint64)
+    h = (pgs * np.uint64(2654435761)) & np.uint64(0xFFFFFFFF)
+    return h.astype(np.float64) / float(2**32) * float(period_s)
+
+
 # ---------------------------------------------------------------------------
 # device scrub step
 
@@ -223,6 +234,10 @@ class ScrubResult:
     n_inconsistent: int  # total damaged shard chunks
     scrubbed_bytes: int
     waited_s: float = 0.0  # QoS admission delay
+    # staggered pass: [n_pgs] bool of the PGs this pass actually
+    # verified (None = full-pool pass).  Non-due PGs never vote in
+    # ``inconsistent_mask``; the caller must keep their old damage bits.
+    due: np.ndarray | None = None
 
     @property
     def pgs(self) -> np.ndarray:
@@ -262,6 +277,9 @@ class Scrubber:
         self.pc = scrub_counters()
         self.checksums: np.ndarray | None = None  # [n_pgs, n_shards] u32
         self._lut = crc32c_table()
+        # staggered deep scrub: virtual time the phase window last
+        # closed at (None until the first staggered pass)
+        self._stagger_anchor: float | None = None
         if mesh is None:
             self._step = scrub_step()
             self.n_devices = 1
@@ -292,18 +310,101 @@ class Scrubber:
         ).reshape(self.n_pgs, self.n_shards)
         return self.checksums
 
+    def note_write(self, pg: int, read_shard) -> None:
+        """Checksum-at-write: refresh one PG's row of the table from the
+        bytes the write just landed — the reference's bluestore CRC
+        computed on the data in flight, so the table tracks the live
+        store instead of only the construction-time snapshot.  Rot that
+        lands AFTER the write still mismatches on the next scrub or
+        :meth:`verify_read`."""
+        if self.checksums is None:
+            raise RuntimeError("build_checksums() before note_write()")
+        pg = int(pg)
+        rows = np.stack([
+            np.asarray(read_shard(pg, s), np.uint8)  # jaxlint: disable=J003
+            for s in range(self.n_shards)
+        ])
+        self.checksums[pg] = crc32c_rows(rows)
+
+    def verify_read(self, pg: int, read_shard, mask=None) -> list[int]:
+        """Verify one PG's shards against the write-time table on the
+        read path (the degraded-read integrity check: data served while
+        the PG is degraded must still match its checksums).  ``mask``
+        restricts the check to surviving shards (survivor-bitmask
+        format, bit ``s`` = shard ``s`` holds data); returns the shard
+        ids whose bytes fail."""
+        if self.checksums is None:
+            raise RuntimeError("build_checksums() before verify_read()")
+        pg = int(pg)
+        shards = [
+            s for s in range(self.n_shards)
+            if mask is None or (int(mask) >> s) & 1
+        ]
+        if not shards:
+            return []
+        rows = np.stack([
+            np.asarray(read_shard(pg, s), np.uint8)  # jaxlint: disable=J003
+            for s in shards
+        ])
+        crcs = crc32c_rows(rows)
+        return [
+            s for s, c in zip(shards, crcs)
+            if int(c) != int(self.checksums[pg, s])
+        ]
+
     def _put(self, host: np.ndarray, spec: P):
         sharding = NamedSharding(self.mesh, spec)
         return jax.make_array_from_callback(
             host.shape, sharding, lambda idx: host[idx]
         )
 
-    def scrub(self, read_shard) -> ScrubResult:
-        """One whole-pool scrub pass against the live store."""
+    def _due_mask(self, now: float, period_s: float) -> np.ndarray:
+        """PGs whose hashed phase falls inside the window since the
+        last staggered pass ([n_pgs] bool).  Over one full period every
+        PG comes due exactly once, so scrub bandwidth per pass is
+        proportional to elapsed virtual time instead of the whole pool.
+        The first staggered pass covers a full period (everything due)."""
+        phases = scrub_phases(self.n_pgs, period_s)
+        anchor = self._stagger_anchor
+        self._stagger_anchor = float(now)
+        if anchor is None or now - anchor >= period_s:
+            return np.ones(self.n_pgs, bool)
+        lo = anchor % period_s
+        hi = now % period_s
+        if lo <= hi:
+            return (phases > lo) & (phases <= hi)
+        return (phases > lo) | (phases <= hi)  # window wraps the period
+
+    def scrub(
+        self, read_shard, now: float | None = None,
+        period_s: float | None = None,
+    ) -> ScrubResult:
+        """One scrub pass against the live store.
+
+        With ``now``/``period_s`` (knob ``osd_scrub_stagger_period``)
+        the pass is *staggered*: only PGs whose hashed phase came due
+        since the previous pass are verified — the device launch stays
+        full-width fixed-shape (no recompiles, J004), but non-due PGs
+        contribute zero bytes to QoS admission and never vote in the
+        inconsistent mask (``ScrubResult.due`` tells the caller which
+        damage bits are fresh).  Default is the whole pool every pass.
+        """
         if self.checksums is None:
             raise RuntimeError("build_checksums() before scrub()")
+        due: np.ndarray | None = None
+        if period_s is not None and period_s > 0 and now is not None:
+            due = self._due_mask(float(now), float(period_s))
         data = self._stack(read_shard)
-        nbytes = int(data.nbytes)
+        if due is not None and not due.all():
+            # fixed-shape partial pass: non-due PG rows become zero
+            # chunks whose expected CRC is the zero-chunk digest, so
+            # they can never mismatch (and cost no admitted bytes)
+            zero_crc = crc32c_rows(np.zeros((1, data.shape[2]), np.uint8))
+            data[~due] = 0
+            nbytes = int(due.sum()) * self.n_shards * data.shape[2]
+        else:
+            zero_crc = None
+            nbytes = int(data.nbytes)
         waited = 0.0
         if self.arbiter is not None:
             waited = self.arbiter.request("scrub", nbytes)
@@ -314,6 +415,9 @@ class Scrubber:
         )
         with span, trace_annotation("scrub:pass"), self.pc.time("l_scrub"):
             expected = np.ascontiguousarray(self.checksums, np.uint32)
+            if zero_crc is not None:
+                expected = expected.copy()
+                expected[~due] = zero_crc[0]
             if self.mesh is None:
                 bad_mask, hist, n_bad = self._step(
                     data, expected, self._lut
@@ -342,6 +446,7 @@ class Scrubber:
             n_inconsistent=n_bad,
             scrubbed_bytes=nbytes,
             waited_s=waited,
+            due=due,
         )
         if self.journal is not None and n_bad:
             self.journal.event(

@@ -117,6 +117,10 @@ def compile_once_cases() -> dict[str, dict]:
       (:class:`~ceph_tpu.recovery.scrub.Scrubber`) after a byte of the
       store rots — corruption changes values, never shapes, so the
       periodic background scrub must reuse the one compiled step.
+    - ``heartbeat_tick``: the liveness detector's vmapped heartbeat
+      update (:func:`ceph_tpu.recovery.liveness.heartbeat_step`) across
+      suppression-mask, clock, and policy-knob changes — every knob is
+      a traced scalar, so a whole chaos run of ticks is one compile.
 
     Raises ``AssertionError`` (from
     :func:`ceph_tpu.analysis.runtime_guard.assert_no_recompile`) if
@@ -253,6 +257,41 @@ def compile_once_cases() -> dict[str, dict]:
     assert sr.n_inconsistent == 1, sr.n_inconsistent
     report["scrub_pass"] = {
         "warm_compiles": warm_s.n_compiles, "second_compiles": 0,
+    }
+
+    # ---- heartbeat tick: netsplit -> tick -> new masks/knobs -> tick ----
+    from ..common.config import Config
+    from ..recovery.chaos import VirtualClock
+    from ..recovery.failure import parse_spec
+    from ..recovery.liveness import LivenessDetector
+
+    cfg = Config(env={})
+    cfg.set("osd_heartbeat_grace", 1.0)
+    cfg.set("mon_osd_min_down_reporters", 1)
+    clock = VirtualClock()
+    det = LivenessDetector(8, clock, config=cfg)
+    with CompileCounter() as warm_h:
+        # warm both rare paths (tick step + the restore scatter) once
+        det.apply(parse_spec("netsplit:5"))
+        clock.advance(0.5)
+        det.tick()
+        det.apply(parse_spec("netsplit:5:restore"))
+        clock.advance(0.1)
+        det.tick()
+    # value-only variations: different suppression masks, clock values,
+    # knob values — all traced, so none may retrace anything
+    det.apply(parse_spec("netsplit:1"))
+    det.apply(parse_spec("netsplit:3"))
+    cfg.set("osd_heartbeat_grace", 2.0)
+    with assert_no_recompile("heartbeat tick value-only changes"):
+        clock.advance(2.5)
+        det.tick()
+        det.apply(parse_spec("netsplit:1:restore"))
+        clock.advance(2.0)
+        det.tick()
+    assert det.osds_down >= 1, det.summary()
+    report["heartbeat_tick"] = {
+        "warm_compiles": warm_h.n_compiles, "second_compiles": 0,
     }
     return report
 

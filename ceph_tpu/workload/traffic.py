@@ -86,7 +86,6 @@ def _traffic_reduce(
         mask, n_alive, acting_primary, ids, salt, pg_b, pg_bmask,
         k, size, min_size, write_permille,
     )
-    del pg
     ok = in_range & ~blocked
     rho = jnp.clip(
         load_total[prim] / jnp.maximum(cap_ops, jnp.float32(1e-6))
@@ -113,7 +112,18 @@ def _traffic_reduce(
         jnp.sum(jnp.where(ok, qd, 0.0)),
     ]).astype(F32)
     max_rho = jnp.max(jnp.where(in_range, rho, 0.0)).astype(F32)
-    return counts, lat_hist, qd_hist, sums, max_rho
+    # per-PG integrity feed: which PGs took a committed write (their
+    # checksum rows must refresh: checksum-at-write) and which served
+    # a degraded read (verify against the table before trusting the
+    # reconstruct sources)
+    n_pgs = mask.shape[0]
+    written = jnp.zeros(n_pgs, I32).at[pg].add(
+        jnp.where(ok & is_write, 1, 0)
+    )
+    deg_read = jnp.zeros(n_pgs, I32).at[pg].add(
+        jnp.where(ok & degraded & ~is_write, 1, 0)
+    )
+    return counts, lat_hist, qd_hist, sums, max_rho, written, deg_read
 
 
 def _route(
@@ -159,7 +169,8 @@ def traffic_step(
     """Single-device step: ``f(mask, n_alive, acting_primary, salt,
     pg_b, pg_bmask, k, size, min_size, write_permille, service_ms,
     cap_ops, rho_recovery) -> (counts [3], lat_hist, qd_hist,
-    sums [2], max_rho)``.  Everything but the shapes is traced."""
+    sums [2], max_rho, written [pg], deg_read [pg])``.  Everything but
+    the shapes is traced."""
 
     def step(
         mask, n_alive, acting_primary, salt, pg_b, pg_bmask,
@@ -216,7 +227,8 @@ def sharded_traffic_step(
             ),
             axis,
         )
-        counts, lat_hist, qd_hist, sums, max_rho = _traffic_reduce(
+        (counts, lat_hist, qd_hist, sums, max_rho, written,
+         deg_read) = _traffic_reduce(
             mask, n_alive, acting_primary, ids, in_range, load,
             salt, pg_b, pg_bmask, k, size, min_size, write_permille,
             service_ms, cap_ops, rho_recovery, n_buckets, lat_min,
@@ -227,6 +239,8 @@ def sharded_traffic_step(
             jax.lax.psum(qd_hist, axis),
             jax.lax.psum(sums, axis),
             jax.lax.pmax(max_rho, axis),
+            jax.lax.psum(written, axis),
+            jax.lax.psum(deg_read, axis),
         )
 
     n_in = 14
@@ -235,7 +249,7 @@ def sharded_traffic_step(
             local,
             mesh=mesh,
             in_specs=tuple(P() for _ in range(n_in)),
-            out_specs=tuple(P() for _ in range(5)),
+            out_specs=tuple(P() for _ in range(7)),
         )
     )
 
@@ -381,6 +395,9 @@ class TrafficEngine:
         config: Config | None = None,
         n_buckets: int = N_BUCKETS,
         lat_min: float = LAT_MIN_MS,
+        flags=None,
+        scrubber=None,
+        read_shard=None,
     ):
         cfg = config or global_config()
         self.clock = clock
@@ -412,6 +429,22 @@ class TrafficEngine:
         self.seed = int(seed)
         self.arbiter = arbiter
         self.journal = journal
+        # degraded-mode gating + the checksum-at-write loop: with a
+        # ClusterFlags set attached, `pause` stalls the whole batch
+        # (an all-zero sample, no device step, no admission); with a
+        # Scrubber + read_shard attached, written PGs refresh their
+        # checksum rows and degraded reads verify before trusting
+        # their reconstruct sources
+        self.flags = flags
+        self.scrubber = scrubber
+        self.read_shard = read_shard
+        #: per-step bound on PGs CRC'd inline (the write path samples
+        #: its integrity work; a full sweep is the scrubber's job)
+        self.integrity_max_pgs_per_step = 16
+        self.paused_steps = 0
+        self.writes_checksummed = 0
+        self.degraded_reads_verified = 0
+        self.read_verify_failures = 0
         self.n_buckets = int(n_buckets)
         self.lat_min = float(lat_min)
         self.edges = bucket_edges(self.n_buckets, self.lat_min)
@@ -475,6 +508,26 @@ class TrafficEngine:
         fold it into the telemetry.  ``bytes_recovered`` is cumulative
         (the same figure the health timeline records) — the delta since
         the last observation becomes the recovery-utilization term."""
+        if self.flags is not None and "pause" in self.flags:
+            # the `pause` flag stalls all client IO: no admission, no
+            # device step — the sample records a zero-op interval so
+            # the series shows the outage instead of skipping it
+            t = float(self.clock())
+            ep = int(peering.epoch_cur if epoch is None else epoch)
+            sample = TrafficSample(
+                t=t, epoch=ep, ops=0, served=0, degraded=0, blocked=0,
+                p50_ms=0.0, p95_ms=0.0, p99_ms=0.0, mean_ms=0.0,
+                qd_p50=0.0, qd_p99=0.0, slow_ops=0, slow_fraction=0.0,
+                max_osd_utilization=0.0, rho_recovery=0.0,
+                ops_per_sec=0.0, ops_per_sec_wall=0.0,
+            )
+            self.paused_steps += 1
+            self._last_t = t
+            self._last_bytes = int(bytes_recovered)
+            self.samples.append(sample)
+            if self.journal is not None:
+                self.journal.event("traffic.paused", epoch=ep, t=t)
+            return sample
         if self.arbiter is not None:
             self.arbiter.request(
                 "client", self.ops_per_step * self.op_bytes
@@ -521,7 +574,8 @@ class TrafficEngine:
         ep = int(peering.epoch_cur if epoch is None else epoch)
         with self._jspan("traffic.step", epoch=ep, ops=self.ops_per_step):
             t0 = time.perf_counter()
-            counts, lat_hist, qd_hist, sums, max_rho = self._step(*args)
+            (counts, lat_hist, qd_hist, sums, max_rho, written,
+             deg_read) = self._step(*args)
             counts = np.asarray(counts)
             lat_hist = np.asarray(lat_hist)
             qd_hist = np.asarray(qd_hist)
@@ -575,7 +629,38 @@ class TrafficEngine:
             self._cum_lat_sum_ms,
         )
         self.samples.append(sample)
+        self._integrity(written, deg_read, peering, ep)
         return sample
+
+    def _integrity(self, written, deg_read, peering, epoch: int) -> None:
+        """The checksum-at-write loop (bluestore analog: checksum the
+        data in flight, store it with the onode): PGs that took a
+        committed write refresh their Scrubber checksum rows, and PGs
+        that served a degraded read verify their surviving shards
+        against the table before the reconstruct is trusted — rot can
+        no longer hide between scrub passes."""
+        if self.scrubber is None or self.read_shard is None:
+            return
+        lim = self.integrity_max_pgs_per_step
+        wpgs = np.flatnonzero(np.asarray(written))[:lim]
+        for pg in wpgs:
+            self.scrubber.note_write(int(pg), self.read_shard)
+        self.writes_checksummed += int(len(wpgs))
+        rpgs = np.flatnonzero(np.asarray(deg_read))[:lim]
+        for pg in rpgs:
+            pg = int(pg)
+            bad = self.scrubber.verify_read(
+                pg, self.read_shard,
+                mask=int(peering.survivor_mask[pg]),
+            )
+            self.degraded_reads_verified += 1
+            if bad:
+                self.read_verify_failures += 1
+                if self.journal is not None:
+                    self.journal.event(
+                        "traffic.read_verify_failed",
+                        epoch=epoch, pg=pg, shards=sorted(bad),
+                    )
 
     def _jspan(self, name: str, **attrs):
         if self.journal is not None:
@@ -600,4 +685,8 @@ class TrafficEngine:
             "degraded_fraction": round(self.total_degraded / total, 9),
             "blocked_fraction": round(self.total_blocked / total, 9),
             "ops_per_sec_wall": round(self.ops_per_sec_wall, 3),
+            "paused_steps": self.paused_steps,
+            "writes_checksummed": self.writes_checksummed,
+            "degraded_reads_verified": self.degraded_reads_verified,
+            "read_verify_failures": self.read_verify_failures,
         }

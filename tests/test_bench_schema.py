@@ -299,6 +299,92 @@ def test_scrub_record_schema():
     json.dumps(rec)  # one JSON line, always serializable
 
 
+# --- config6_recovery --liveness JSON schema (failure detection) ------
+
+
+class _FakeLivenessSupervised:
+    converged = True
+    time_to_zero_degraded_s = 3.0000004
+
+
+class _FakeDetection:
+    pass
+
+
+class _FakeLivenessDetector:
+    detections = [_FakeDetection(), _FakeDetection()]
+    flap_damped_events = 1
+    auto_out_events = 0
+
+
+class _FakeLivenessTimeline:
+    @staticmethod
+    def max_detection_latency():
+        return 0.5010000477
+
+    @staticmethod
+    def series():
+        return {
+            "t": [0.0, 0.501],
+            "epoch": [1, 2],
+            "health": ["HEALTH_OK", "HEALTH_WARN"],
+            "osds_down": [0, 1],
+            "osds_laggy": [0, 0],
+        }
+
+
+class _FakeLivenessReport:
+    status = "HEALTH_OK"
+    checks = [
+        _FakeCheck("SLO_RECOVERY_TIME", "HEALTH_OK"),
+        _FakeCheck("SLO_DETECTION_LATENCY", "HEALTH_OK"),
+    ]
+
+
+def test_liveness_record_schema():
+    import json
+
+    rec = config6.build_liveness_record(
+        "flapping-osd",
+        _FakeLivenessSupervised(),
+        _FakeLivenessSupervised(),
+        _FakeLivenessTimeline(),
+        _FakeLivenessReport(),
+        _FakeLivenessDetector(),
+        2,
+        6,
+        1234.56,
+        "tpu",
+        {"n_compiles": 1, "host_transfers": 9},
+        {"n_compiles": 1},
+    )
+    assert rec["metric"] == "liveness_heartbeat_ticks_per_sec"
+    assert rec["value"] == 1235 and rec["unit"] == "ticks/s"
+    assert rec["platform"] == "tpu"
+    # compile-once guard: warm-run compiles == total compiles
+    assert rec["n_compiles"] == 1 and rec["n_compiles_first"] == 1
+    assert rec["host_transfers"] == 9
+    assert rec["liveness_scenario"] == "flapping-osd"
+    assert rec["liveness_converged"] is True
+    assert rec["liveness_detections"] == 2
+    assert rec["liveness_detection_latency_s"] == 0.501
+    # the damped/undamped epoch churn pair IS the flap-damper verdict
+    assert rec["liveness_map_epochs_damped"] == 2
+    assert rec["liveness_map_epochs_undamped"] == 6
+    assert rec["liveness_epoch_churn_ratio"] == round(2 / 6, 9)
+    assert rec["liveness_flap_damped_events"] == 1
+    assert rec["liveness_auto_out_events"] == 0
+    assert rec["liveness_time_to_zero_degraded_s"] == 3.0
+    assert rec["liveness_health_status"] == "HEALTH_OK"
+    assert rec["liveness_slo_checks"] == {
+        "SLO_RECOVERY_TIME": "HEALTH_OK",
+        "SLO_DETECTION_LATENCY": "HEALTH_OK",
+    }
+    series = rec["liveness_health_series"]
+    assert series["osds_down"] == [0, 1]
+    json.dumps(rec)  # one JSON line, always serializable
+
+
 # --- config2/config4 --xor-schedule JSON schema (ec schedule compiler) ---
 
 _CONFIG2 = os.path.join(os.path.dirname(_BENCH), "bench", "config2_ec_encode.py")

@@ -302,6 +302,73 @@ def test_harvest_guard_collects_scrub_fields(tmp_path):
     assert dd.harvest_guard([p2]) == {}
 
 
+def test_harvest_guard_collects_liveness_fields(tmp_path):
+    """config6 --liveness lines carry the failure-detection verdict:
+    exact counters (int), detection latency / churn ratio (float), the
+    HEALTH_* status string, and the convergence bool; the per-check
+    dict and health series stay bench-only."""
+    p = _log(tmp_path, [
+        {"metric": "liveness_heartbeat_ticks_per_sec", "platform": "tpu",
+         "value": 120_000, "n_compiles": 1, "n_compiles_first": 1,
+         "host_transfers": 9, "liveness_scenario": "flapping-osd",
+         "liveness_converged": True, "liveness_detections": 2,
+         "liveness_detection_latency_s": 0.501,
+         "liveness_map_epochs_damped": 2,
+         "liveness_map_epochs_undamped": 6,
+         "liveness_epoch_churn_ratio": 0.333333333,
+         "liveness_flap_damped_events": 1,
+         "liveness_auto_out_events": 0,
+         "liveness_time_to_zero_degraded_s": 3.0,
+         "liveness_health_status": "HEALTH_OK",
+         "liveness_slo_checks": {"SLO_DETECTION_LATENCY": "HEALTH_OK"},
+         "liveness_health_series": {"t": [0.0]}},
+    ])
+    g = dd.harvest_guard([p])["liveness_heartbeat_ticks_per_sec"]
+    assert g["liveness_detections"] == 2
+    assert g["liveness_map_epochs_damped"] == 2
+    assert g["liveness_map_epochs_undamped"] == 6
+    assert g["liveness_flap_damped_events"] == 1
+    assert g["liveness_auto_out_events"] == 0
+    assert g["liveness_detection_latency_s"] == 0.501
+    assert g["liveness_time_to_zero_degraded_s"] == 3.0
+    assert g["liveness_epoch_churn_ratio"] == 0.333333333
+    assert isinstance(g["liveness_detection_latency_s"], float)
+    assert g["liveness_health_status"] == "HEALTH_OK"
+    assert g["liveness_converged"] is True
+    assert g["steady_state_clean"] is True
+    # the label, per-check dict and series stay in the bench line
+    assert "liveness_scenario" not in g
+    assert "liveness_slo_checks" not in g
+    assert "liveness_health_series" not in g
+    # a cpu smoke line never contributes liveness fields
+    p2 = _log(tmp_path, [
+        {"metric": "liveness_heartbeat_ticks_per_sec", "platform": "cpu",
+         "liveness_detections": 9, "liveness_health_status": "HEALTH_ERR"},
+    ])
+    assert dd.harvest_guard([p2]) == {}
+
+
+def test_liveness_rate_is_aux_metric(tmp_path):
+    p = _log(tmp_path, [
+        {"metric": "liveness_heartbeat_ticks_per_sec", "platform": "tpu",
+         "value": 120_000},
+        {"metric": "liveness_heartbeat_ticks_per_sec", "platform": "tpu",
+         "value": 150_000},
+    ])
+    aux = dd.harvest_aux([p])
+    assert aux["liveness_heartbeat_ticks_per_sec"] == 150_000
+
+
+def test_harvest_guard_liveness_fields_absent_when_not_emitted(tmp_path):
+    p = _log(tmp_path, [
+        {"metric": "recovery_decode_bytes_per_sec", "platform": "tpu",
+         "value": 9_000_000, "n_compiles": 5, "n_compiles_first": 5,
+         "host_transfers": 2},
+    ])
+    g = dd.harvest_guard([p])["recovery_decode_bytes_per_sec"]
+    assert not any(k.startswith("liveness_") for k in g)
+
+
 def test_harvest_guard_scrub_fields_absent_when_not_emitted(tmp_path):
     p = _log(tmp_path, [
         {"metric": "recovery_decode_bytes_per_sec", "platform": "tpu",

@@ -1,0 +1,324 @@
+"""Failure-detection control plane: heartbeat liveness, flap damping,
+down->out policy, and cluster-flag degraded modes."""
+
+import copy
+
+import numpy as np
+import pytest
+
+from ceph_tpu import recovery as rec
+from ceph_tpu.common.config import Config
+from ceph_tpu.ec import gf
+from ceph_tpu.ec.backend import MatrixCodec
+from ceph_tpu.models.clusters import build_osdmap
+from ceph_tpu.workload import TrafficEngine
+
+# ---- ClusterFlags ----------------------------------------------------
+
+
+def test_cluster_flags_validation():
+    f = rec.ClusterFlags("noout", "pause")
+    assert "noout" in f and "pause" in f and len(f) == 2
+    assert f.names() == ("noout", "pause")
+    f.clear("pause")
+    assert "pause" not in f and bool(f)
+    f.clear("noout")
+    assert not f
+    with pytest.raises(ValueError, match="unknown cluster flag"):
+        rec.ClusterFlags("nosnap")
+    with pytest.raises(ValueError, match="unknown cluster flag"):
+        f.set("noup")
+
+
+# ---- net-spec parsing (satellite) ------------------------------------
+
+
+def test_parse_spec_net_round_trip():
+    # default action is drop; targets canonicalize like osd specs
+    assert str(rec.parse_spec("netsplit:03")) == "netsplit:3:drop"
+    assert str(rec.parse_spec("slow:7:restore")) == "slow:7:restore"
+    assert rec.normalize("netsplit:5") == "netsplit:5:drop"
+    for s in ("netsplit:5", "slow:0:drop", "netsplit:12:restore"):
+        assert rec.normalize(rec.normalize(s)) == rec.normalize(s)
+
+
+def test_parse_spec_net_rejects_bad_input():
+    with pytest.raises(ValueError, match="only support actions"):
+        rec.parse_spec("netsplit:3:down")
+    with pytest.raises(ValueError, match="non-negative"):
+        rec.parse_spec("slow:hostX")
+    with pytest.raises(rec.UnknownSpecKeyError):
+        rec.parse_spec({"scope": "netsplit", "target": "3",
+                        "acton": "drop"})
+    # dict form round-trips through the same validation
+    sp = rec.parse_spec({"scope": "slow", "target": "04"})
+    assert str(sp) == "slow:4:drop"
+
+
+# ---- detector core ---------------------------------------------------
+
+
+def _detector(n=8, grace=0.5, reporters=1, adjust=False, interval=0.0,
+              **knobs):
+    cfg = Config(env={})
+    cfg.set("osd_heartbeat_grace", grace)
+    cfg.set("mon_osd_min_down_reporters", reporters)
+    cfg.set("mon_osd_adjust_heartbeat_grace", adjust)
+    cfg.set("mon_osd_down_out_interval", interval)
+    for k, v in knobs.items():
+        cfg.set(k, v)
+    clock = rec.VirtualClock()
+    return rec.LivenessDetector(n, clock, config=cfg), clock, cfg
+
+
+def test_netsplit_detection_latency():
+    det, clock, _ = _detector(grace=0.5)
+    det.apply(rec.parse_spec("netsplit:3"))
+    clock.advance(0.4)
+    assert det.tick() == []  # inside grace: no transition
+    clock.advance(0.2)
+    specs = det.tick()
+    assert [str(s) for s in specs] == ["osd:3:down"]
+    assert det.osds_down == 1
+    (d,) = det.pop_detections()
+    assert d.osd == 3 and d.t_fail == 0.0
+    # latency is real: strictly above grace, bounded by the poll gap
+    assert 0.5 < d.latency <= 0.6001
+    det.apply(rec.parse_spec("netsplit:3:restore"))
+    clock.advance(0.05)
+    assert [str(s) for s in det.tick()] == ["osd:3:up"]
+    assert det.osds_down == 0 and det.pop_detections() == []
+
+
+def test_detection_needs_enough_reporters():
+    det, clock, _ = _detector(grace=0.5, reporters=2)
+    det.set_reporters(np.array([2, 0, 2, 2, 2, 2, 2, 2], np.int32))
+    det.apply(rec.parse_spec("netsplit:1"))  # nobody peers with 1
+    det.apply(rec.parse_spec("netsplit:2"))
+    clock.advance(2.0)
+    specs = det.tick()
+    assert [str(s) for s in specs] == ["osd:2:down"]
+    assert det.osds_down == 1  # osd 1 can never collect reports
+
+
+def test_slow_marks_laggy_never_down():
+    det, clock, _ = _detector(grace=0.5, mon_osd_laggy_weight=0.4)
+    det.apply(rec.parse_spec("slow:2"))
+    for _ in range(5):
+        clock.advance(1.0)
+        assert det.tick() == []  # laggy never produces map events
+    assert det.osds_down == 0 and det.osds_laggy == 1
+    assert det.laggy_probability(2) > 0.5 > det.laggy_probability(0)
+
+
+def test_noout_suppresses_auto_out():
+    det, clock, _ = _detector(grace=0.5, interval=2.0,
+                              mon_osd_min_in_ratio=0.0)
+    det.flags.set("noout")
+    det.apply(rec.parse_spec("netsplit:4"))
+    clock.advance(1.0)
+    assert [str(s) for s in det.tick()] == ["osd:4:down"]
+    clock.advance(10.0)
+    assert det.tick() == []  # noout: down forever, never out
+    assert det.auto_out_events == 0
+    det.flags.clear("noout")
+    clock.advance(0.1)
+    assert [str(s) for s in det.tick()] == ["osd:4:out"]
+    assert det.auto_out_events == 1
+
+
+def test_auto_out_respects_min_in_ratio():
+    det, clock, _ = _detector(n=4, grace=0.5, interval=1.0,
+                              mon_osd_min_in_ratio=0.75)
+    for o in (0, 1):
+        det.apply(rec.parse_spec(f"netsplit:{o}"))
+    clock.advance(2.0)
+    down = det.tick()
+    assert sorted(str(s) for s in down if s.action == "down") == [
+        "osd:0:down", "osd:1:down"
+    ]
+    # 4 OSDs at 0.75 floor: only one may go out (3/4 >= 0.75, 2/4 < )
+    outs = [str(s) for s in down if s.action == "out"]
+    clock.advance(5.0)
+    outs += [str(s) for s in det.tick() if s.action == "out"]
+    assert len(outs) == 1 and det.auto_out_events == 1
+
+
+def test_flap_damper_doubles_grace():
+    """After one markdown the effective grace doubles: a second outage
+    longer than base grace but shorter than 2x is absorbed when
+    damping is on, detected when it is off."""
+
+    def one_run(adjust):
+        det, clock, _ = _detector(grace=0.5, adjust=adjust,
+                                  mon_osd_laggy_halflife=1e9)
+        downs = 0
+        t = 0.0
+        for _ in range(3):  # drop 0.75 s, up 0.25 s, repeat
+            det.apply(rec.parse_spec("netsplit:6"))
+            for _ in range(3):
+                t += 0.25
+                clock.sleep(t - clock.now())
+                downs += sum(s.action == "down" for s in det.tick())
+            det.apply(rec.parse_spec("netsplit:6:restore"))
+            t += 0.25
+            clock.sleep(t - clock.now())
+            det.tick()
+        return downs, det
+
+    undamped, _ = one_run(False)
+    damped, det = one_run(True)
+    assert undamped == 3  # every cycle thrashes the map
+    assert damped == 1  # doubled grace absorbs cycles 2 and 3
+    assert det.summary()["downs"] == 1
+
+
+def test_summary_shape():
+    det, clock, _ = _detector()
+    det.apply(rec.parse_spec("netsplit:0"))
+    clock.advance(1.0)
+    det.tick()
+    s = det.summary()
+    assert s["n_osds"] == 8 and s["ticks"] == 1 and s["downs"] == 1
+    assert s["osds_down"] == 1 and s["osds_suppressed"] == 1
+    assert s["detections"] == 1 and s["flags"] == []
+
+
+def test_idle_fast_path_skips_device_step():
+    det, clock, _ = _detector()
+    clock.advance(5.0)
+    assert det.tick() == [] and det.ticks == 0  # no device launch
+    assert det.next_deadline() is None
+
+
+def test_peer_counts_sanity():
+    m = build_osdmap(16, pg_num=32, size=6, pool_kind="erasure")
+    p = rec.peer_pool(m, m, 1)
+    counts = p.peer_counts(16)
+    assert counts.shape == (16,) and counts.dtype == np.int32
+    # every OSD serving a 6-wide acting set has >= 5 peers
+    assert (counts[counts > 0] >= 5).all()
+    assert counts.max() <= 15
+
+
+# ---- chaos integration ----------------------------------------------
+
+
+def _chaos_run(scenario, flags=None, damped=True, grace=0.5, cycles=3,
+               n_osds=64, pg_num=32, cfg=None, timeline=None):
+    k, m_par = 4, 2
+    if cfg is None:
+        cfg = Config(env={})
+    cfg.set("osd_heartbeat_grace", grace)
+    cfg.set("mon_osd_adjust_heartbeat_grace", damped)
+    cfg.set("mon_osd_min_down_reporters", 1)
+    m = build_osdmap(n_osds, pg_num=pg_num, size=k + m_par,
+                     pool_kind="erasure")
+    m_prev = copy.deepcopy(m)
+    chaos = rec.ChaosEngine(
+        m,
+        timeline if timeline is not None
+        else rec.build_scenario(scenario, m, cycles=cycles),
+        flags=flags, config=cfg,
+    )
+    codec = MatrixCodec(gf.vandermonde_matrix(k, m_par))
+    rng = np.random.default_rng(3)
+    store = {}
+
+    def read_shard(pg, s):
+        if pg not in store:
+            data = rng.integers(0, 256, (k, 64), dtype=np.uint8)
+            store[pg] = np.vstack([data, codec.encode(data)])
+        return store[pg][s]
+
+    e0 = chaos.epoch
+    sup = rec.SupervisedRecovery(codec, chaos, config=cfg, seed=0)
+    res = sup.run(m_prev, 1, read_shard)
+    return res, chaos, chaos.epoch - e0
+
+
+def test_netsplit_produces_detection_not_instant_epoch():
+    """A netsplit spec reaches the map only through the detector: the
+    down epoch lands one grace later, stamped with real latency."""
+    m = build_osdmap(16, pg_num=16, size=6, pool_kind="erasure")
+    cfg = Config(env={})
+    cfg.set("osd_heartbeat_grace", 0.5)
+    cfg.set("mon_osd_min_down_reporters", 1)
+    tl = rec.ChaosTimeline.from_pairs([(1.0, "netsplit:3")])
+    eng = rec.ChaosEngine(m, tl, config=cfg)
+    eng.clock.advance(1.0)
+    assert eng.poll() == [] and m.is_up(3)  # suppressed, not down
+    assert eng.liveness.osds_suppressed == 1
+    assert not eng.exhausted()  # a grace deadline is pending
+    assert eng.advance_to_next()
+    incs = eng.poll()
+    assert len(incs) == 1 and not m.is_up(3)
+    (d,) = eng.liveness.detections
+    assert d.t_fail == 1.0 and d.latency > 0.5
+
+
+@pytest.mark.slow
+def test_flapping_osd_damped_churn_below_undamped():
+    """The acceptance scenario: flapping-osd converges to zero degraded
+    under damping while its map-epoch churn stays strictly below the
+    undamped run of the SAME seeded timeline — and within budget."""
+    res_u, chaos_u, epochs_u = _chaos_run("flapping-osd", damped=False)
+    res_d, chaos_d, epochs_d = _chaos_run("flapping-osd", damped=True)
+    assert res_d.converged and res_d.final_counts["degraded"] == 0
+    assert not res_d.failed_pgs and len(res_d.unrecoverable) == 0
+    # every epoch in this scenario comes from the detector; undamped
+    # detection thrashes the map on repeated cycles (up to 6 epochs —
+    # poll cadence can merge a cycle), damping mutes all but the first
+    assert epochs_u >= 4
+    assert epochs_d < epochs_u
+    assert epochs_d <= 2  # budget: one down + one up, cycles 2-3 muted
+    assert chaos_d.liveness.downs < chaos_u.liveness.downs
+    assert chaos_u.osdmap.is_up(
+        int(chaos_u.liveness.detections[0].osd)
+    )
+
+
+def test_norecover_gates_recovery():
+    # pure `down` (no out, no remap): degraded repair groups, which
+    # norecover holds back until the frozen run terminates
+    flags = rec.ClusterFlags("norecover")
+    tl = rec.ChaosTimeline.from_pairs(
+        [(0.25, [f"osd:{o}" for o in range(8)])]
+    )
+    res, chaos, _ = _chaos_run("", flags=flags, n_osds=64, pg_num=16,
+                               timeline=tl)
+    assert not res.converged
+    assert res.launches == 0 and not res.completed_pgs
+    assert res.flag_gated_groups > 0
+    assert res.summary()["flag_gated_groups"] == res.flag_gated_groups
+
+
+def test_nobackfill_gates_out_remapped_groups():
+    # down_out remaps PGs -> backfill groups: norecover lets them
+    # through (the reference's semantics), nobackfill freezes them
+    res, *_ = _chaos_run("mid-repair-loss", n_osds=64, pg_num=16,
+                         flags=rec.ClusterFlags("norecover"))
+    assert res.converged and res.flag_gated_groups == 0
+    res, *_ = _chaos_run("mid-repair-loss", n_osds=64, pg_num=16,
+                         flags=rec.ClusterFlags("nobackfill"))
+    assert not res.converged
+    assert res.launches == 0 and res.flag_gated_groups > 0
+
+
+def test_pause_gates_traffic():
+    flags = rec.ClusterFlags("pause")
+    clock = rec.VirtualClock()
+    eng = TrafficEngine(
+        clock.now, 8, 32, 4, 6, 5, ops_per_step=1024,
+        osd_capacity_ops_per_s=1e9, flags=flags,
+    )
+    m = build_osdmap(8, pg_num=32, size=6, pool_kind="erasure")
+    peering = rec.peer_pool(m, m, 1)
+    clock.advance(1.0)
+    s = eng.observe(peering)
+    assert s.ops == 0 and s.served == 0 and s.p99_ms == 0.0
+    assert eng.paused_steps == 1
+    flags.clear("pause")
+    clock.advance(1.0)
+    s = eng.observe(peering)
+    assert s.ops == 1024 and eng.paused_steps == 1

@@ -42,7 +42,8 @@ def test_hot_paths_compile_once():
     is the J004 bug class at runtime and would gut the bench rates."""
     report = nonregression.compile_once_cases()  # raises on recompile
     assert set(report) == {
-        "pool_mapping", "pattern_decode", "schedule_decode", "scrub_pass"
+        "pool_mapping", "pattern_decode", "schedule_decode", "scrub_pass",
+        "heartbeat_tick",
     }
     for name, counts in report.items():
         assert counts["warm_compiles"] > 0, (name, counts)

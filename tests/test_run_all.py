@@ -213,17 +213,18 @@ def test_unfiltered_configs_cover_all_baseline_configs():
         "config1_crush", "config2_ec_encode", "config3_upmap",
         "config4_repair_decode", "config5_rebalance_sim",
         "config6_recovery", "config6_recovery_multichip",
-        "config6_recovery_scrub", "tpu_tier",
+        "config6_recovery_scrub", "config6_recovery_liveness",
+        "tpu_tier",
     ]
-    # the multichip/scrub entries re-use the config6 file in flag modes
-    multi = next(c for c in run_all.CONFIGS
-                 if c[0] == "config6_recovery_multichip")
-    assert multi[1] == "bench/config6_recovery.py"
-    assert tuple(multi[2]) == ("--multichip",)
-    scrub = next(c for c in run_all.CONFIGS
-                 if c[0] == "config6_recovery_scrub")
-    assert scrub[1] == "bench/config6_recovery.py"
-    assert tuple(scrub[2]) == ("--scrub",)
+    # the flag-mode entries re-use the config6 file
+    for name, flag in (
+        ("config6_recovery_multichip", "--multichip"),
+        ("config6_recovery_scrub", "--scrub"),
+        ("config6_recovery_liveness", "--liveness"),
+    ):
+        entry = next(c for c in run_all.CONFIGS if c[0] == name)
+        assert entry[1] == "bench/config6_recovery.py"
+        assert tuple(entry[2]) == (flag,)
 
 
 if __name__ == "__main__":

@@ -121,6 +121,103 @@ def test_scrubber_requires_checksums():
     scrubber = Scrubber(2, 2)
     with pytest.raises(RuntimeError, match="build_checksums"):
         scrubber.scrub(lambda pg, s: np.zeros(8, np.uint8))
+    with pytest.raises(RuntimeError, match="build_checksums"):
+        scrubber.note_write(0, lambda pg, s: np.zeros(8, np.uint8))
+    with pytest.raises(RuntimeError, match="build_checksums"):
+        scrubber.verify_read(0, lambda pg, s: np.zeros(8, np.uint8))
+
+
+# ---- checksum-at-write + degraded-read verify (satellite) ------------
+
+
+def test_note_write_refreshes_checksum_row():
+    n_pgs, n_shards, chunk = 4, 3, 32
+    store = _flat_store(n_pgs, n_shards, chunk)
+    read = lambda pg, s: store[(pg, s)]  # noqa: E731
+    scrubber = Scrubber(n_pgs, n_shards)
+    scrubber.build_checksums(read)
+    # a client write lands new bytes in pg 2: without note_write the
+    # table is stale and the scrub would flag the fresh data as rot
+    store[(2, 0)] = np.arange(chunk, dtype=np.uint8)
+    assert scrubber.scrub(read).pgs.tolist() == [2]
+    scrubber.note_write(2, read)
+    assert scrubber.scrub(read).n_inconsistent == 0
+    # rot landing AFTER the write still mismatches
+    apply_bitrot(store[(2, 0)], 5, 0x10)
+    assert scrubber.scrub(read).pgs.tolist() == [2]
+
+
+def test_verify_read_checks_surviving_shards():
+    n_pgs, n_shards, chunk = 4, 4, 32
+    store = _flat_store(n_pgs, n_shards, chunk)
+    read = lambda pg, s: store[(pg, s)]  # noqa: E731
+    scrubber = Scrubber(n_pgs, n_shards)
+    scrubber.build_checksums(read)
+    assert scrubber.verify_read(1, read) == []
+    apply_bitrot(store[(1, 2)], 0, 0xFF)
+    assert scrubber.verify_read(1, read) == [2]
+    # the degraded-read path only checks the survivor mask: a dead
+    # shard's stale bytes never vote, a surviving rotten one does
+    assert scrubber.verify_read(1, read, mask=0b0011) == []
+    assert scrubber.verify_read(1, read, mask=0b0100) == [2]
+    assert scrubber.verify_read(1, read, mask=0) == []
+
+
+# ---- staggered deep scrub (satellite) --------------------------------
+
+
+def test_scrub_phases_deterministic_spread():
+    p = scrub.scrub_phases(64, 10.0)
+    assert p.shape == (64,) and ((p >= 0) & (p < 10.0)).all()
+    np.testing.assert_array_equal(p, scrub.scrub_phases(64, 10.0))
+    # the hash spreads the pool: both period halves are populated
+    assert (p < 5.0).any() and (p >= 5.0).any()
+
+
+def test_scrub_stagger_covers_pool_once_per_period():
+    n_pgs, n_shards, chunk = 32, 2, 16
+    store = _flat_store(n_pgs, n_shards, chunk)
+    read = lambda pg, s: store[(pg, s)]  # noqa: E731
+    scrubber = Scrubber(n_pgs, n_shards)
+    scrubber.build_checksums(read)
+    # first staggered pass: no anchor yet, everything is due
+    sr = scrubber.scrub(read, now=0.0, period_s=1.0)
+    assert sr.due.all()
+    assert sr.scrubbed_bytes == n_pgs * n_shards * chunk
+    # four quarter-period passes: each PG comes due exactly once, and
+    # each pass admits bytes proportional to its due fraction
+    seen = np.zeros(n_pgs, np.int32)
+    for q in range(1, 5):
+        sr = scrubber.scrub(read, now=q * 0.25, period_s=1.0)
+        assert sr.scrubbed_bytes == int(sr.due.sum()) * n_shards * chunk
+        seen += sr.due.astype(np.int32)
+    assert (seen == 1).all()
+    # a gap longer than the period falls back to a full pass
+    sr = scrubber.scrub(read, now=2.5, period_s=1.0)
+    assert sr.due.all()
+
+
+def test_scrub_stagger_partial_pass_damage_visibility():
+    n_pgs, n_shards, chunk = 16, 2, 16
+    store = _flat_store(n_pgs, n_shards, chunk)
+    read = lambda pg, s: store[(pg, s)]  # noqa: E731
+    scrubber = Scrubber(n_pgs, n_shards)
+    scrubber.build_checksums(read)
+    scrubber.scrub(read, now=0.0, period_s=1.0)  # anchor the window
+    phases = scrub.scrub_phases(n_pgs, 1.0)
+    pg = int(np.argmax(phases))  # the latest-phase PG
+    apply_bitrot(store[(pg, 1)], 3, 0x7F)
+    # a window that closes before the PG's phase never checks it: the
+    # damage bit stays unvoted (the caller keeps its old bits via
+    # ScrubResult.due) — no false clean, no false alarm
+    early = (phases[pg] + 0.0) / 2  # halfway to the earliest due PG
+    sr = scrubber.scrub(read, now=min(early, phases[pg] * 0.5),
+                        period_s=1.0)
+    assert not sr.due[pg] and int(sr.inconsistent_mask[pg]) == 0
+    # the pass whose window sweeps past the phase finds the rot
+    sr = scrubber.scrub(read, now=1.0, period_s=1.0)
+    assert sr.due[pg] and sr.pgs.tolist() == [pg]
+    assert int(sr.inconsistent_mask[pg]) == 1 << 1
 
 
 # ---- peering fixtures for executor-level tests -----------------------
