@@ -81,7 +81,13 @@ def build_multichip_record(
 ) -> dict:
     """The ``--multichip`` JSON line (pure: schema-tested without
     running the bench).  ``guard``/``warm`` are runtime-guard snapshot
-    dicts; ``result`` is the measured run's RecoveryResult."""
+    dicts; ``result`` is the measured run's RecoveryResult.  The
+    ``lint_*`` fields snapshot the static-analysis state of the tree
+    the rate was measured on (AST only, no device), so a regression in
+    the J001-J012 gate shows up next to the number it would endanger.
+    """
+    from ceph_tpu.analysis import lint_fields
+
     return {
         "metric": "recovery_multichip_bytes_per_sec",
         "value": round(rate),
@@ -94,6 +100,7 @@ def build_multichip_record(
         "sharded_launches": int(result.sharded_launches),
         "psum_bytes_rebuilt": int(result.psum_bytes_rebuilt),
         "psum_shards_rebuilt": int(result.psum_shards_rebuilt),
+        **lint_fields(),
     }
 
 

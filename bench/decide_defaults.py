@@ -237,6 +237,13 @@ def harvest_guard(paths: list[str]) -> dict[str, dict]:
             fields.update(
                 {f: str(d[f]) for f in LIVENESS_STR_FIELDS if f in d}
             )
+            # jaxlint per-rule counters (lint_active, lint_J007_active,
+            # ...): dynamic key set — one field per registered rule, so
+            # new rules flow through without touching this harvest
+            fields.update(
+                {f: int(d[f]) for f in d
+                 if f.startswith("lint_") and isinstance(d[f], (int, bool))}
+            )
             if not fields:
                 continue
             if "n_compiles" in fields and "n_compiles_first" in fields:

@@ -1,27 +1,52 @@
-"""jaxlint — tracing-safety & recompile static analysis for the TPU
-data path, plus the runtime guard that verifies its claims.
+"""jaxlint — tracing-safety, recompile and cross-rank-consistency
+static analysis for the TPU data path, plus the runtime guard that
+verifies its claims.
 
-Static half (AST, no jax import needed):
+Static half (AST, no jax import needed).  Since PR 10 the analyzer is
+interprocedural: a module-level call graph makes helpers *called from*
+jit/shard_map entry points traced scopes on exactly the parameters
+that receive traced arguments, and gives J007/J008 their reachability
+closures.
 
-====  ======================  ==============================================
-J001  python-branch-on-traced Python ``if``/``while`` on traced values in
-                              jit/Pallas bodies
-J002  unpinned-loop-dtype     fori/while_loop bounds or carries as raw
-                              Python scalars (the PR-1 x64 bug class)
-J003  host-sync-in-loop       block_until_ready/.item()/np.asarray(call)
-                              in host loops of hot modules
-J004  recompile-forcer        jit/pallas_call built per-iteration; Python
-                              constants at non-static jit positions
-J005  raw-x64-toggle          jax_enable_x64 touched outside the
-                              ceph_tpu.enable_x64 shim
-J006  tracer-leak             traced values stored on self/globals
-====  ======================  ==============================================
+====  ========================  ============================================
+J001  python-branch-on-traced   Python ``if``/``while`` on traced values in
+                                jit/Pallas bodies (and helpers they call)
+J002  unpinned-loop-dtype       fori/while_loop bounds or carries as raw
+                                Python scalars (the PR-1 x64 bug class)
+J003  host-sync-in-loop         block_until_ready/.item()/np.asarray(call)
+                                in host loops of hot modules
+J004  recompile-forcer          jit/pallas_call built per-iteration; Python
+                                constants at non-static jit positions
+J005  raw-x64-toggle            jax_enable_x64 touched outside the
+                                ceph_tpu.enable_x64 shim
+J006  tracer-leak               traced values stored on self/globals
+J007  collective-consistency    psum/all_gather/ppermute outside any
+                                shard_map scope, or naming a literal axis
+                                the enclosing mesh does not define
+J008  rank-divergent-control-   branching on process_index()/pid/wall
+      flow                      clock on a path that executes a collective
+                                (the SPMD deadlock shape)
+J009  nondeterministic-         unordered set iteration building ordered
+      iteration                 output (appends, journal events, traced
+                                operands)
+J010  wall-clock-in-vclock-     time.time()/perf_counter() inside
+      domain                    VirtualClock-domain modules (recovery/
+                                chaos/liveness/workload)
+J011  unseeded-randomness       default_rng()/Random() with no seed; the
+                                global random.*/np.random.* functions
+J012  shard-map-closure-        shard_map body closing over an explicitly
+      capture                   placed device array
+====  ========================  ============================================
 
 Runtime half: :func:`ceph_tpu.analysis.runtime_guard.track` counts XLA
 compiles and device->host transfers so bench records ``n_compiles`` /
-``host_transfers`` per config, and
+``host_transfers`` per config,
 :func:`~ceph_tpu.analysis.runtime_guard.assert_no_recompile` turns
-"the hot path compiles once" into an assertion.
+"the hot path compiles once" into an assertion, and
+:func:`~ceph_tpu.analysis.runtime_guard.assert_rank_identical` — the
+dynamic twin of J007-J009, enabled by the ``debug_rank_checks`` config
+knob — cross-checks a cheap fingerprint of mesh-seam operands via a
+psum so rank-divergent state fails fast instead of deadlocking.
 
 Suppress a finding with ``# jaxlint: disable=J00x`` on (or directly
 above) the flagged line.
@@ -30,17 +55,24 @@ above) the flagged line.
 from .findings import RULES, Finding, Suppressions
 from .runner import (
     HOT_SEGMENTS,
+    VCLOCK_SEGMENTS,
     LintResult,
     is_hot,
+    is_vclock,
     iter_py_files,
+    lint_fields,
     lint_paths,
     lint_source,
 )
 from .runtime_guard import (
     CompileCounter,
     GuardStats,
+    RankDivergenceError,
     TransferCounter,
     assert_no_recompile,
+    assert_rank_identical,
+    rank_checks_enabled,
+    rank_fingerprint,
     track,
 )
 
@@ -49,14 +81,21 @@ __all__ = [
     "Finding",
     "Suppressions",
     "HOT_SEGMENTS",
+    "VCLOCK_SEGMENTS",
     "LintResult",
     "is_hot",
+    "is_vclock",
     "iter_py_files",
+    "lint_fields",
     "lint_paths",
     "lint_source",
     "CompileCounter",
     "GuardStats",
+    "RankDivergenceError",
     "TransferCounter",
     "assert_no_recompile",
+    "assert_rank_identical",
+    "rank_checks_enabled",
+    "rank_fingerprint",
     "track",
 ]

@@ -1,26 +1,46 @@
-"""jaxlint AST checkers J001-J006, tuned to this codebase's JAX idioms.
+"""jaxlint AST checkers J001-J012, tuned to this codebase's JAX idioms.
 
-One :class:`Analyzer` instance lints one module.  Two passes:
+One :class:`Analyzer` instance lints one module.  Three passes:
 
 1. *Collect* — find every traced entry point and its static-argument
    spec: functions decorated ``@jax.jit`` / ``@partial(jax.jit, ...)``,
-   ``name = jax.jit(fn)`` bindings, Pallas kernel bodies (a function
-   whose first argument is passed to ``pl.pallas_call`` or — the repo
-   convention — with two or more parameters ending in ``_ref``), and
-   functions handed to ``lax`` control flow.
+   ``jax.jit(fn)`` / ``jax.vmap(fn)`` bindings anywhere (including
+   ``return jax.jit(step)``), Pallas kernel bodies (a function whose
+   first argument is passed to ``pl.pallas_call`` or — the repo
+   convention — with two or more parameters ending in ``_ref``),
+   functions handed to ``lax`` control flow, and ``shard_map``/
+   ``pmap`` bodies (which are also *collective scopes*).  The same
+   pass records every module-level function def and literal mesh-axis
+   names.
 
-2. *Check* — walk the module with a scope stack.  Inside a traced
+2. *Propagate* — a module-level call graph closes the historical
+   under-approximation: helpers *called from* a traced entry become
+   traced scopes themselves, but only on the parameters that actually
+   receive traced arguments at some call site (union over call sites,
+   iterated to a fixpoint).  The same edges give two reachability
+   closures: functions reachable *from* a shard_map body may legally
+   host collectives (J007), and functions that transitively *contain*
+   a collective make rank-divergent branches around their call sites
+   dangerous (J008).  Resolution stays conservative — bare local
+   names and ``self.method`` only, duplicates dropped — so the pass
+   adds no false positives.
+
+3. *Check* — walk the module with a scope stack.  Inside a traced
    scope a conservative dataflow marks "traced names": non-static
    parameters plus anything assigned from an expression that touches a
    traced name or a ``jnp``/``lax`` call.  Shape/dtype/ndim accesses
    and ``len()`` break the taint (they are static under tracing).
+   Parallel per-scope taints track rank-local values (J008), unordered
+   set values (J009) and explicitly placed device arrays (J012).
 
-The dataflow is deliberately an under-approximation: helpers that are
-*called from* jit but not decorated are not traced scopes, and a bare
-name flowing in from a closure is assumed static.  The linter's gate
+The dataflow remains an under-approximation where resolution is
+ambiguous: a bare name flowing in from a closure is assumed static and
+aliased/dynamic calls are not graph edges.  The linter's gate
 (tests/test_lint_clean.py) needs zero false positives far more than it
-needs the last false negative — every rule still has a runtime
-counterpart in :mod:`ceph_tpu.analysis.runtime_guard`.
+needs the last false negative — the rules still have a runtime
+counterpart in :mod:`ceph_tpu.analysis.runtime_guard`
+(:func:`~ceph_tpu.analysis.runtime_guard.assert_rank_identical` is
+J007/J008/J009's dynamic twin).
 """
 
 from __future__ import annotations
@@ -56,6 +76,47 @@ _NP_CONVERT = {"numpy.asarray", "numpy.array", "numpy.ascontiguousarray"}
 _LAX_BODY_TAKERS = {"jax.lax.fori_loop", "jax.lax.while_loop",
                     "jax.lax.scan", "jax.lax.cond", "jax.lax.map",
                     "jax.lax.switch"}
+
+#: cross-device primitives that need an enclosing mesh axis (J007)
+_COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "psum_scatter",
+                "all_gather", "all_to_all", "ppermute", "pshuffle",
+                "axis_index", "axis_size"}
+_COLLECTIVE_FNS = {f"jax.lax.{c}" for c in _COLLECTIVES}
+
+#: calls whose result differs across SPMD ranks (J008 taint sources)
+_RANK_LOCAL_FNS = {"jax.process_index", "os.getpid", "os.uname",
+                   "socket.gethostname", "platform.node",
+                   "uuid.uuid1", "uuid.uuid4"}
+
+#: host wall-clock reads (J010, and J008 branch-predicate taint)
+_WALL_CLOCK_FNS = {"time.time", "time.time_ns", "time.monotonic",
+                   "time.monotonic_ns", "time.perf_counter",
+                   "time.perf_counter_ns", "datetime.datetime.now",
+                   "datetime.datetime.utcnow"}
+
+#: RNG factories that draw an OS-entropy seed when called bare (J011)
+_UNSEEDED_RNG_FACTORIES = {"numpy.random.default_rng", "random.Random"}
+#: legacy global-state RNG functions, always nondeterministic (J011)
+_NP_GLOBAL_RNG = {"rand", "randn", "randint", "random",
+                  "random_sample", "choice", "shuffle", "permutation",
+                  "uniform", "normal", "standard_normal", "bytes"}
+_PY_GLOBAL_RNG = {"random", "randint", "randrange", "uniform",
+                  "choice", "choices", "sample", "shuffle", "gauss",
+                  "normalvariate", "betavariate", "expovariate",
+                  "triangular", "getrandbits"}
+
+#: explicit device-placement APIs whose results a shard_map body must
+#: not close over (J012)
+_PLACED_ARRAY_FNS = {"jax.device_put", "jax.device_put_sharded",
+                     "jax.device_put_replicated",
+                     "jax.make_array_from_callback",
+                     "jax.make_array_from_process_local_data"}
+
+#: method names whose call on a loop body makes set-iteration order
+#: observable (J009 sinks)
+_ORDER_SINK_ATTRS = {"append", "extend", "insert", "write",
+                     "writelines", "put", "emit", "event", "span",
+                     "add_event", "send"}
 
 _LOOP_NODES = (ast.For, ast.While, ast.AsyncFor)
 _COMP_NODES = (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
@@ -131,16 +192,34 @@ def _literal_strs(node: ast.expr) -> frozenset[str]:
 class _Scope:
     traced: bool
     traced_names: set[str] = field(default_factory=set)
+    #: call-graph-propagated params: traced, but attribute projections
+    #: are assumed static (see Analyzer._expr_may_trace)
+    weak_names: set[str] = field(default_factory=set)
     global_names: set[str] = field(default_factory=set)
+    #: collectives are legal here (shard_map/pmap body or reachable)
+    collective_ok: bool = False
+    #: literal mesh-axis names of the enclosing shard_map call, if known
+    known_axes: frozenset[str] = frozenset()
+    #: names holding rank-local values (process_index, pid, wall clock)
+    ranklocal_names: set[str] = field(default_factory=set)
+    #: names holding unordered set values
+    set_names: set[str] = field(default_factory=set)
+    #: names holding explicitly placed device arrays
+    placed_names: set[str] = field(default_factory=set)
+    #: placed names a shard_map body closes over (J012), reported once
+    forbidden_captures: frozenset[str] = frozenset()
+    reported_captures: set[str] = field(default_factory=set)
 
 
 class Analyzer(ast.NodeVisitor):
     """Lint one parsed module; collects :class:`Finding` objects."""
 
-    def __init__(self, path: str, tree: ast.Module, hot: bool = True):
+    def __init__(self, path: str, tree: ast.Module, hot: bool = True,
+                 vclock: bool = True):
         self.path = path
         self.tree = tree
         self.hot = hot
+        self.vclock = vclock
         self.imports = ImportMap(tree)
         self.findings: list[Finding] = []
         self._scopes: list[_Scope] = [_Scope(traced=False)]
@@ -149,7 +228,23 @@ class Analyzer(ast.NodeVisitor):
         self.jitted: dict[str, StaticSpec] = {}
         self._kernel_fns: set[str] = set()
         self._lax_bodies: set[str] = set()
+        self._shard_bodies: dict[str, frozenset[str]] = {}
+        self._mesh_axes: set[str] = set()
+        self._defs: dict[str, ast.AST] = {}
+        self._def_dupes: set[str] = set()
         self._collect()
+        # propagate pass (call graph)
+        self._edges: dict[str, set[str]] = {}
+        self._direct_collective: set[str] = set()
+        self._build_call_graph()
+        self._collective_ok_fns = self._closure(
+            set(self._shard_bodies), self._edges
+        )
+        self._reaches_collective = self._reverse_closure(
+            self._direct_collective, self._edges
+        )
+        self._traced_params: dict[str, frozenset[str]] = {}
+        self._propagate_traced_params()
 
     # ------------------------------------------------------------- collect
 
@@ -184,6 +279,27 @@ class Analyzer(ast.NodeVisitor):
                 return StaticSpec()
         return None
 
+    def _spec_axis_literals(self, call: ast.Call) -> frozenset[str]:
+        """Literal mesh-axis names appearing in a shard_map call: strings
+        inside P(...)/PartitionSpec(...) specs plus an ``axis_names=``
+        keyword.  Empty when the specs are variables (axis unknown)."""
+        out: set[str] = set()
+        for n in ast.walk(call):
+            if isinstance(n, ast.Call):
+                f = self.imports.resolve(n.func)
+                if f and (f.endswith("PartitionSpec") or f == "P"):
+                    for a in n.args:
+                        out |= _literal_strs(a)
+        for kw in call.keywords:
+            if kw.arg == "axis_names":
+                out |= _literal_strs(kw.value)
+        return frozenset(out)
+
+    def _mark_shard_body(self, name: str, axes: frozenset[str]) -> None:
+        self._shard_bodies[name] = self._shard_bodies.get(
+            name, frozenset()
+        ) | axes
+
     def _collect(self) -> None:
         for node in ast.walk(self.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -193,17 +309,67 @@ class Analyzer(ast.NodeVisitor):
                 params = [a.arg for a in node.args.args]
                 if sum(p.endswith("_ref") for p in params) >= 2:
                     self._kernel_fns.add(node.name)
+                if node.name in self._defs or node.name in self._def_dupes:
+                    self._def_dupes.add(node.name)
+                    self._defs.pop(node.name, None)
+                else:
+                    self._defs[node.name] = node
             elif isinstance(node, ast.Call):
                 fn = self.imports.resolve(node.func)
                 if fn is None:
                     continue
-                if fn.endswith("pallas_call") and node.args:
-                    if isinstance(node.args[0], ast.Name):
-                        self._kernel_fns.add(node.args[0].id)
+                first = node.args[0] if node.args else None
+                if fn.endswith("pallas_call") and isinstance(
+                    first, ast.Name
+                ):
+                    self._kernel_fns.add(first.id)
                 elif fn in _LAX_BODY_TAKERS:
                     for arg in node.args:
                         if isinstance(arg, ast.Name):
                             self._lax_bodies.add(arg.id)
+                elif fn.endswith("shard_map") and isinstance(
+                    first, ast.Name
+                ):
+                    self._mark_shard_body(
+                        first.id, self._spec_axis_literals(node)
+                    )
+                elif fn in ("jax.pmap", "pmap") and isinstance(
+                    first, ast.Name
+                ):
+                    axes: frozenset[str] = frozenset()
+                    for kw in node.keywords:
+                        if kw.arg == "axis_name":
+                            axes = _literal_strs(kw.value)
+                    self._mark_shard_body(first.id, axes)
+                elif fn in ("jax.vmap", "vmap") and isinstance(
+                    first, ast.Name
+                ):
+                    # vmap bodies trace; with axis_name they may also
+                    # host collectives
+                    self._lax_bodies.add(first.id)
+                    for kw in node.keywords:
+                        if kw.arg == "axis_name":
+                            self._mark_shard_body(
+                                first.id, _literal_strs(kw.value)
+                            )
+                elif fn in ("jax.jit", "jit", "jax.pjit") and isinstance(
+                    first, ast.Name
+                ):
+                    # jax.jit(fn) anywhere — including `return
+                    # jax.jit(step)` (the Assign branch below only saw
+                    # name bindings)
+                    spec = self._jit_target(node) or StaticSpec()
+                    self.jitted.setdefault(first.id, spec)
+                if fn.endswith(".Mesh") or fn == "Mesh":
+                    if len(node.args) >= 2:
+                        self._mesh_axes |= _literal_strs(node.args[1])
+                    for kw in node.keywords:
+                        if kw.arg == "axis_names":
+                            self._mesh_axes |= _literal_strs(kw.value)
+                elif fn.endswith("make_mesh"):
+                    for kw in node.keywords:
+                        if kw.arg in ("axis", "axis_name", "axis_names"):
+                            self._mesh_axes |= _literal_strs(kw.value)
             elif isinstance(node, ast.Assign) and isinstance(
                 node.value, ast.Call
             ):
@@ -212,6 +378,164 @@ class Analyzer(ast.NodeVisitor):
                     for tgt in node.targets:
                         if isinstance(tgt, ast.Name):
                             self.jitted[tgt.id] = spec
+
+    # ----------------------------------------------------- call graph
+
+    def _callee_name(self, call: ast.Call) -> str | None:
+        """Bare local function (or ``self.method``) this call targets,
+        when that name maps to exactly one def in this module."""
+        func = call.func
+        name = None
+        if isinstance(func, ast.Name):
+            name = func.id
+        elif (
+            isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "self"
+        ):
+            name = func.attr
+        if name in self._defs and name not in self._def_dupes:
+            return name
+        return None
+
+    def _build_call_graph(self) -> None:
+        for name, fndef in self._defs.items():
+            edges: set[str] = set()
+            for n in ast.walk(fndef):
+                if not isinstance(n, ast.Call):
+                    continue
+                callee = self._callee_name(n)
+                if callee and callee != name:
+                    edges.add(callee)
+                fn = self.imports.resolve(n.func)
+                if fn in _COLLECTIVE_FNS:
+                    self._direct_collective.add(name)
+            self._edges[name] = edges
+
+    @staticmethod
+    def _closure(roots: set[str], edges: dict[str, set[str]]) -> set[str]:
+        """Everything reachable from ``roots`` along call edges."""
+        seen = set(roots)
+        work = list(roots)
+        while work:
+            for callee in edges.get(work.pop(), ()):
+                if callee not in seen:
+                    seen.add(callee)
+                    work.append(callee)
+        return seen
+
+    @staticmethod
+    def _reverse_closure(
+        targets: set[str], edges: dict[str, set[str]]
+    ) -> set[str]:
+        """Everything that reaches ``targets`` along call edges."""
+        reaches = set(targets)
+        changed = True
+        while changed:
+            changed = False
+            for name, callees in edges.items():
+                if name not in reaches and callees & reaches:
+                    reaches.add(name)
+                    changed = True
+        return reaches
+
+    def _is_entry(self, name: str) -> bool:
+        """Directly traced entry (jit/kernel/lax/shard_map body), whose
+        params taint strongly — vs a propagated helper (weak taint)."""
+        return (
+            name in self.jitted
+            or name in self._kernel_fns
+            or name in self._lax_bodies
+            or name in self._shard_bodies
+        )
+
+    def _propagate_traced_params(self) -> None:
+        """Interprocedural taint: a helper called from a traced scope
+        becomes a traced scope on exactly the parameters that receive
+        traced arguments at some call site (union, to a fixpoint)."""
+        for name, fndef in self._defs.items():
+            params = [a.arg for a in fndef.args.args]
+            if name in self.jitted:
+                spec = self.jitted[name]
+                self._traced_params[name] = frozenset(
+                    p for i, p in enumerate(params)
+                    if i not in spec.argnums and p not in spec.argnames
+                )
+            elif self._is_entry(name):
+                self._traced_params[name] = frozenset(params)
+        for _ in range(len(self._defs) + 1):
+            changed = False
+            for name in list(self._traced_params):
+                fndef = self._defs.get(name)
+                if fndef is None:
+                    continue
+                strong = (
+                    self._traced_params[name]
+                    if self._is_entry(name)
+                    else frozenset()
+                )
+                weak = self._traced_params[name] - strong
+                calls = self._call_site_taints(fndef, strong, weak)
+                for callee, hit in calls.items():
+                    old = self._traced_params.get(callee, frozenset())
+                    new = old | hit
+                    if new != old:
+                        self._traced_params[callee] = new
+                        changed = True
+            if not changed:
+                break
+
+    def _call_site_taints(
+        self, fndef, strong: frozenset[str], weak: frozenset[str]
+    ) -> dict[str, set[str]]:
+        """Per local callee: parameter names receiving traced args."""
+        tainted = set(strong)
+        for _ in range(8):
+            grew = False
+            for n in ast.walk(fndef):
+                tgts: list = []
+                if isinstance(n, ast.Assign) and self._expr_may_trace(
+                    n.value, tainted, weak
+                ):
+                    tgts = n.targets
+                elif isinstance(
+                    n, ast.AugAssign
+                ) and self._expr_may_trace(n.value, tainted, weak):
+                    tgts = [n.target]
+                for t in tgts:
+                    for leaf in ast.walk(t):
+                        if isinstance(
+                            leaf, ast.Name
+                        ) and leaf.id not in tainted:
+                            tainted.add(leaf.id)
+                            grew = True
+            if not grew:
+                break
+        out: dict[str, set[str]] = {}
+        for n in ast.walk(fndef):
+            if not isinstance(n, ast.Call):
+                continue
+            callee = self._callee_name(n)
+            if callee is None:
+                continue
+            cdef = self._defs[callee]
+            cparams = [a.arg for a in cdef.args.args]
+            hit: set[str] = set()
+            for i, a in enumerate(n.args):
+                if i < len(cparams) and self._expr_may_trace(
+                    a, tainted, weak
+                ):
+                    hit.add(cparams[i])
+            for kw in n.keywords:
+                if (
+                    kw.arg
+                    and kw.arg in cparams
+                    and self._expr_may_trace(kw.value, tainted, weak)
+                ):
+                    hit.add(kw.arg)
+            if hit:
+                out.setdefault(callee, set()).update(hit)
+        return out
 
     # ----------------------------------------------------------- taint
 
@@ -224,16 +548,37 @@ class Analyzer(ast.NodeVisitor):
         sc = self._scope
         if not sc.traced:
             return False
+        return self._expr_may_trace(node, sc.traced_names, sc.weak_names)
+
+    def _expr_may_trace(
+        self,
+        node: ast.expr,
+        names: set | frozenset,
+        weak: set | frozenset = frozenset(),
+    ) -> bool:
+        """May-be-traced test against an explicit tainted-name set
+        (shared by the scope walk and the call-graph propagation).
+
+        ``weak`` names came through call-graph propagation: the value
+        itself may trace, but attribute projections are assumed static
+        (pytree parameters commonly carry static aux fields like
+        ``smap.algs``), keeping the interprocedural pass FP-free.
+        """
+        rec = lambda n: self._expr_may_trace(n, names, weak)  # noqa: E731
         if isinstance(node, ast.Constant):
             return False
         if isinstance(node, ast.Name):
-            return node.id in sc.traced_names
+            return node.id in names or node.id in weak
         if isinstance(node, ast.Attribute):
             if node.attr in _STATIC_ATTRS:
                 return False
-            return self._is_traced(node.value)
+            if isinstance(node.value, ast.Name) and (
+                node.value.id in weak
+            ):
+                return False
+            return rec(node.value)
         if isinstance(node, ast.Subscript):
-            return self._is_traced(node.value) or self._is_traced(node.slice)
+            return rec(node.value) or rec(node.slice)
         if isinstance(node, ast.Call):
             fn = self.imports.resolve(node.func)
             if fn in _STATIC_CALLS:
@@ -241,30 +586,34 @@ class Analyzer(ast.NodeVisitor):
             if fn and fn.startswith(_TRACED_CALL_ROOTS):
                 return True
             args = list(node.args) + [kw.value for kw in node.keywords]
-            if any(self._is_traced(a) for a in args):
+            if any(rec(a) for a in args):
                 return True
             # method on a traced object (x.astype(...), x.at[i].set(v))
             if isinstance(node.func, ast.Attribute):
-                return self._is_traced(node.func.value)
+                return rec(node.func.value)
             return False
         if isinstance(node, (ast.BinOp,)):
-            return self._is_traced(node.left) or self._is_traced(node.right)
+            return rec(node.left) or rec(node.right)
         if isinstance(node, ast.UnaryOp):
-            return self._is_traced(node.operand)
+            return rec(node.operand)
         if isinstance(node, ast.BoolOp):
-            return any(self._is_traced(v) for v in node.values)
+            return any(rec(v) for v in node.values)
         if isinstance(node, ast.Compare):
-            return self._is_traced(node.left) or any(
-                self._is_traced(c) for c in node.comparators
+            # `x is None` / `x is not None` are identity tests: static
+            # Python bools even on a tracer
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return rec(node.left) or any(
+                rec(c) for c in node.comparators
             )
         if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
-            return any(self._is_traced(e) for e in node.elts)
+            return any(rec(e) for e in node.elts)
         if isinstance(node, ast.IfExp):
             return any(
-                self._is_traced(n) for n in (node.test, node.body, node.orelse)
+                rec(n) for n in (node.test, node.body, node.orelse)
             )
         if isinstance(node, ast.Starred):
-            return self._is_traced(node.value)
+            return rec(node.value)
         return False
 
     def _mark_targets(self, target: ast.expr) -> None:
@@ -295,21 +644,64 @@ class Analyzer(ast.NodeVisitor):
 
     def _enter_function(self, node) -> None:
         spec = None
-        traced = self._scope.traced  # nested defs trace with their parent
+        parent = self._scope
+        traced = parent.traced  # nested defs trace with their parent
+        all_params = False
+        helper_params: frozenset[str] | None = None
         if node.name in self.jitted:
             spec = self.jitted[node.name]
             traced = True
-        if node.name in self._kernel_fns or node.name in self._lax_bodies:
+        if (
+            node.name in self._kernel_fns
+            or node.name in self._lax_bodies
+            or node.name in self._shard_bodies
+        ):
             traced = True
+            all_params = True
+        if not traced and node.name in self._traced_params:
+            # helper reached from a traced entry through the call
+            # graph: traced only on the propagated parameter subset
+            traced = True
+            helper_params = self._traced_params[node.name]
         scope = _Scope(traced=traced)
+        scope.collective_ok = (
+            parent.collective_ok
+            or node.name in self._collective_ok_fns
+        )
+        scope.known_axes = self._shard_bodies.get(
+            node.name, parent.known_axes
+        )
+        # closure-visible host taints flow into nested scopes
+        scope.ranklocal_names = set(parent.ranklocal_names)
+        scope.set_names = set(parent.set_names)
+        scope.placed_names = set(parent.placed_names)
         if traced:
             params = [a.arg for a in node.args.args]
             for i, p in enumerate(params):
+                if helper_params is not None and not all_params:
+                    if p in helper_params:
+                        scope.weak_names.add(p)
+                    continue
                 if spec is not None and (
                     i in spec.argnums or p in spec.argnames
                 ):
                     continue
                 scope.traced_names.add(p)
+        if node.name in self._shard_bodies and parent.placed_names:
+            # J012: placed arrays visible from enclosing scopes, minus
+            # anything the body itself binds (params shadow captures)
+            bound = {a.arg for a in node.args.args}
+            for n in ast.walk(node):
+                if isinstance(n, ast.Name) and isinstance(
+                    n.ctx, (ast.Store, ast.Del)
+                ):
+                    bound.add(n.id)
+            scope.forbidden_captures = frozenset(
+                parent.placed_names - bound
+            )
+        elif parent.forbidden_captures:
+            scope.forbidden_captures = parent.forbidden_captures
+            scope.reported_captures = parent.reported_captures
         self._scopes.append(scope)
         outer_loops = self._host_loop_depth
         if traced:
@@ -326,26 +718,68 @@ class Analyzer(ast.NodeVisitor):
 
     def visit_If(self, node: ast.If) -> None:
         self._check_branch(node, "if")
+        self._check_rank_branch(node, "if")
         self.generic_visit(node)
 
     def visit_While(self, node: ast.While) -> None:
         self._check_branch(node, "while")
+        self._check_rank_branch(node, "while")
         self._visit_host_loop(node)
 
+    @staticmethod
+    def _literal_container_iter(it: ast.expr) -> bool:
+        """Iterating a literal tuple/list (or enumerate/zip of them)
+        walks static Python structure, even when the elements trace."""
+        if isinstance(it, (ast.Tuple, ast.List)):
+            return True
+        return (
+            isinstance(it, ast.Call)
+            and isinstance(it.func, ast.Name)
+            and it.func.id in ("enumerate", "zip", "reversed")
+            and bool(it.args)
+            and all(isinstance(a, (ast.Tuple, ast.List)) for a in it.args)
+        )
+
     def visit_For(self, node: ast.For) -> None:
-        if self._scope.traced and self._is_traced(node.iter):
+        traced_iter = (
+            self._scope.traced
+            and not self._literal_container_iter(node.iter)
+            and self._is_traced(node.iter)
+        )
+        if traced_iter:
             self._report(
                 "J001", node,
                 "Python `for` over a traced value inside a jit/Pallas "
                 "body; use lax.fori_loop/scan",
             )
-        if self._scope.traced and self._is_traced(node.iter):
             # iterating a traced value taints the loop targets;
             # range()/enumerate() iteration stays Python
             self._mark_targets(node.target)
+        if self._is_unordered(node.iter) and self._order_sensitive(node):
+            self._report(
+                "J009", node,
+                "iteration over an unordered set builds ordered output: "
+                "each rank (and each PYTHONHASHSEED) gets its own order; "
+                "iterate sorted(...) instead",
+            )
         self._visit_host_loop(node)
 
     visit_AsyncFor = visit_For
+
+    def visit_Name(self, node: ast.Name) -> None:
+        sc = self._scope
+        if (
+            isinstance(node.ctx, ast.Load)
+            and node.id in sc.forbidden_captures
+            and node.id not in sc.reported_captures
+        ):
+            sc.reported_captures.add(node.id)
+            self._report(
+                "J012", node,
+                f"shard_map body closes over placed device array "
+                f"`{node.id}`: one placement is baked into every "
+                "shard's program; pass it through in_specs instead",
+            )
 
     def _visit_host_loop(self, node) -> None:
         host = not self._scope.traced
@@ -363,11 +797,131 @@ class Analyzer(ast.NodeVisitor):
                 "body; use jnp.where/lax.cond/lax.select",
             )
 
+    # ------------------------------------------------- J008 rank taint
+
+    def _expr_ranklocal(self, node: ast.expr) -> bool:
+        """Does this expression read rank-local state (process index,
+        pid/hostname, wall clock) or a name tainted by one?"""
+        names = self._scope.ranklocal_names
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and n.id in names:
+                return True
+            if isinstance(n, ast.Call):
+                fn = self.imports.resolve(n.func)
+                if fn and (
+                    fn in _RANK_LOCAL_FNS
+                    or fn in _WALL_CLOCK_FNS
+                    or fn.endswith(".process_index")
+                ):
+                    return True
+        return False
+
+    def _branch_hits_collective(self, node) -> ast.Call | None:
+        """First collective executed inside either branch arm, directly
+        or through a local function that transitively contains one."""
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            fn = self.imports.resolve(n.func)
+            if fn in _COLLECTIVE_FNS:
+                return n
+            callee = self._callee_name(n)
+            if callee in self._reaches_collective:
+                return n
+        return None
+
+    def _check_rank_branch(self, node, kw: str) -> None:
+        if not self._expr_ranklocal(node.test):
+            return
+        hit = self._branch_hits_collective(node)
+        if hit is not None:
+            self._report(
+                "J008", node,
+                f"`{kw}` on rank-local state guards a collective "
+                f"(line {hit.lineno}): ranks taking different branches "
+                "deadlock in psum/all_gather; make the predicate "
+                "rank-identical or hoist the collective out",
+            )
+
+    # ------------------------------------------------- J009 set taint
+
+    def _is_unordered(self, node: ast.expr) -> bool:
+        """Expression yielding an unordered set (literal, set()/
+        frozenset(), set algebra, or a name holding one)."""
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Name):
+            return node.id in self._scope.set_names
+        if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.BitOr, ast.BitAnd, ast.BitXor, ast.Sub)
+        ):
+            return self._is_unordered(node.left) or self._is_unordered(
+                node.right
+            )
+        if isinstance(node, ast.Call):
+            fn = self.imports.resolve(node.func)
+            if fn in ("set", "frozenset"):
+                return True
+            if isinstance(node.func, ast.Attribute) and node.func.attr in (
+                "union", "intersection", "difference",
+                "symmetric_difference",
+            ):
+                return self._is_unordered(node.func.value)
+        return False
+
+    def _order_sensitive(self, loop) -> bool:
+        """Loop body whose effect depends on iteration order: ordered
+        appends/journal writes, generator yields, or any traced scope
+        (set order would reach traced operands)."""
+        if self._scope.traced:
+            return True
+        for n in ast.walk(loop):
+            if isinstance(n, (ast.Yield, ast.YieldFrom)):
+                return True
+            if (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in _ORDER_SINK_ATTRS
+            ):
+                return True
+        return False
+
+    # --------------------------------------------------------- assigns
+
+    def _track_host_taints(self, targets, value) -> None:
+        """Per-scope rank-local / set / placed-array name tracking.
+        A re-assignment to an untainted value kills the taint."""
+        sc = self._scope
+        names: list[str] = []
+        for t in targets:
+            if isinstance(t, ast.Name):
+                names.append(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                names.extend(
+                    e.id for e in t.elts if isinstance(e, ast.Name)
+                )
+        if not names:
+            return
+        ranklocal = self._expr_ranklocal(value)
+        unordered = self._is_unordered(value)
+        placed = False
+        if isinstance(value, ast.Call):
+            fn = self.imports.resolve(value.func)
+            placed = fn in _PLACED_ARRAY_FNS
+        for name in names:
+            (sc.ranklocal_names.add if ranklocal
+             else sc.ranklocal_names.discard)(name)
+            (sc.set_names.add if unordered
+             else sc.set_names.discard)(name)
+            (sc.placed_names.add if placed
+             else sc.placed_names.discard)(name)
+
     def visit_Assign(self, node: ast.Assign) -> None:
         self._check_tracer_leak(node.targets, node.value, node)
         if self._scope.traced and self._is_traced(node.value):
             for tgt in node.targets:
                 self._mark_targets(tgt)
+        self._track_host_taints(node.targets, node.value)
         self.generic_visit(node)
 
     def visit_AugAssign(self, node: ast.AugAssign) -> None:
@@ -402,9 +956,78 @@ class Analyzer(ast.NodeVisitor):
 
     # ------------------------------------------------------------- calls
 
+    def _check_collective(self, node: ast.Call, fn: str) -> None:
+        short = fn.rsplit(".", 1)[-1]
+        if not self._scope.collective_ok:
+            self._report(
+                "J007", node,
+                f"{short}() outside any shard_map/pmap scope: the axis "
+                "name is unbound at trace time; call it from a "
+                "shard_map body (directly or via a helper it calls)",
+            )
+            return
+        axis_node: ast.expr | None = None
+        if short in ("axis_index", "axis_size"):
+            axis_node = node.args[0] if node.args else None
+        elif len(node.args) >= 2:
+            axis_node = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "axis_name":
+                axis_node = kw.value
+        if isinstance(axis_node, ast.Constant) and isinstance(
+            axis_node.value, str
+        ):
+            known = self._scope.known_axes | frozenset(self._mesh_axes)
+            if known and axis_node.value not in known:
+                self._report(
+                    "J007", node,
+                    f"{short}() names axis {axis_node.value!r} but the "
+                    "enclosing shard_map mesh only defines "
+                    f"{sorted(known)}",
+                )
+
+    def _check_rng(self, node: ast.Call, fn: str) -> None:
+        if (
+            fn in _UNSEEDED_RNG_FACTORIES
+            and not node.args
+            and not node.keywords
+        ):
+            self._report(
+                "J011", node,
+                f"{fn}() with no seed draws from OS entropy: retry "
+                "jitter/stagger phases become unreproducible and "
+                "rank-divergent; thread an explicit seed",
+            )
+        elif fn.startswith("numpy.random.") and fn.rsplit(".", 1)[
+            -1
+        ] in _NP_GLOBAL_RNG:
+            self._report(
+                "J011", node,
+                f"global-state {fn}() is unseeded shared state; use "
+                "np.random.default_rng(seed)",
+            )
+        elif fn.startswith("random.") and fn.rsplit(".", 1)[
+            -1
+        ] in _PY_GLOBAL_RNG:
+            self._report(
+                "J011", node,
+                f"global-state {fn}() is unseeded shared state; use "
+                "random.Random(seed) or np.random.default_rng(seed)",
+            )
+
     def visit_Call(self, node: ast.Call) -> None:
         fn = self.imports.resolve(node.func)
         if fn:
+            if fn in _COLLECTIVE_FNS:
+                self._check_collective(node, fn)
+            if self.vclock and fn in _WALL_CLOCK_FNS:
+                self._report(
+                    "J010", node,
+                    f"{fn}() in a VirtualClock-domain module mixes wall "
+                    "time into simulated time; use clock.now() (justify "
+                    "real-rate measurement sites with a suppression)",
+                )
+            self._check_rng(node, fn)
             if fn.endswith("fori_loop") and (
                 fn.startswith("jax.lax") or fn == "lax.fori_loop"
             ):
@@ -586,7 +1209,17 @@ class Analyzer(ast.NodeVisitor):
         if host:
             self._host_loop_depth -= 1
 
-    visit_ListComp = _visit_comp
+    def visit_ListComp(self, node: ast.ListComp) -> None:
+        # a list built from a set captures the hash order (J009); a
+        # genexp/set/dict comp does not commit to an order by itself
+        if any(self._is_unordered(g.iter) for g in node.generators):
+            self._report(
+                "J009", node,
+                "list built by iterating an unordered set captures the "
+                "per-rank hash order; iterate sorted(...) instead",
+            )
+        self._visit_comp(node)
+
     visit_SetComp = _visit_comp
     visit_DictComp = _visit_comp
     visit_GeneratorExp = _visit_comp

@@ -72,6 +72,59 @@ RULES: dict[str, tuple[str, str]] = {
         "UnexpectedTracerError, or worse, a stale concrete value from "
         "a previous trace is silently reused.  Return values instead.",
     ),
+    "J007": (
+        "collective-consistency",
+        "psum/all_gather/ppermute are only meaningful inside a "
+        "shard_map/pmap body (directly or via a helper it calls); a "
+        "collective outside any such scope raises NameError on the "
+        "axis at trace time, and a literal axis name that is not one "
+        "of the enclosing shard_map's mesh axes does the same — but "
+        "only once that code path finally runs, typically mid-recovery.",
+    ),
+    "J008": (
+        "rank-divergent-control-flow",
+        "Branching on jax.process_index() / host-local state "
+        "(pid, hostname, wall clock) on a path that executes a "
+        "collective is the classic SPMD deadlock: some ranks enter "
+        "the psum/all_gather and block forever waiting for the ranks "
+        "that took the other branch.  Make the predicate "
+        "rank-identical, or keep collectives out of both branches.",
+    ),
+    "J009": (
+        "nondeterministic-iteration",
+        "Iterating an unordered set to build ordered output (appends, "
+        "journal events, traced operands) gives each rank — and each "
+        "PYTHONHASHSEED — its own ordering, so serialized state and "
+        "collective operands silently diverge across ranks.  Iterate "
+        "sorted(...) instead (dict iteration is insertion-ordered and "
+        "fine when the insertions themselves are deterministic).",
+    ),
+    "J010": (
+        "wall-clock-in-vclock-domain",
+        "time.time()/perf_counter() inside the VirtualClock domain "
+        "(recovery/chaos/liveness/workload) mixes host wall time into "
+        "simulated time: results stop being reproducible and ranks "
+        "disagree on timelines.  Use the VirtualClock (clock.now()) "
+        "for simulated time; real-rate measurement sites must carry a "
+        "justified suppression.",
+    ),
+    "J011": (
+        "unseeded-randomness",
+        "np.random.default_rng() / random.Random() with no seed (or "
+        "the global random.*/np.random.* functions) draw from OS "
+        "entropy: retry jitter, stagger phases and workloads become "
+        "unreproducible and rank-divergent.  Thread an explicit seed "
+        "(the codebase convention is a seed argument defaulting to 0).",
+    ),
+    "J012": (
+        "shard-map-closure-capture",
+        "A shard_map body that closes over an explicitly placed device "
+        "array (jax.device_put / make_array_from_callback / "
+        "make_array_from_process_local_data) bakes one placement into "
+        "every shard's program: if the captured array is not fully "
+        "replicated the body sees partial or resharded data.  Pass it "
+        "through in_specs instead.",
+    ),
 }
 
 _SUPPRESS_RE = re.compile(

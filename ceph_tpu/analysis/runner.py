@@ -1,9 +1,13 @@
 """jaxlint driver: walk files, run the checkers, format reports.
 
 The module scoping mirrors the rule definitions: J003's host-sync rule
-only fires in the hot data-path packages (``HOT_SEGMENTS``); every
-other rule applies everywhere.  ``lint_source`` is the unit-test entry
-(fixtures pass source strings), ``lint_paths`` the CLI/test-gate entry.
+only fires in the hot data-path packages (``HOT_SEGMENTS``), J010's
+wall-clock rule only in VirtualClock-domain packages
+(``VCLOCK_SEGMENTS``); every other rule applies everywhere.
+``lint_source`` is the unit-test entry (fixtures pass source strings),
+``lint_paths`` the CLI/test-gate entry, and ``lint_fields`` flattens
+per-rule counts for the bench JSON lines ``decide_defaults.py``
+harvests into ``guard_metrics``.
 """
 
 from __future__ import annotations
@@ -14,13 +18,19 @@ import os
 from dataclasses import dataclass, field
 
 from .checkers import Analyzer
-from .findings import Finding, Suppressions
+from .findings import RULES, Finding, Suppressions
 
 #: path segments whose modules are "hot" for J003 (device data path +
 #: the CLI progress paths that drive it)
 HOT_SEGMENTS = frozenset(
     {"crush", "ec", "recovery", "osdmap", "balancer", "cli", "core",
      "parallel", "obs", "workload", "liveness"}
+)
+
+#: path segments whose modules run on the VirtualClock (J010): real
+#: wall-clock reads there need a justified suppression
+VCLOCK_SEGMENTS = frozenset(
+    {"recovery", "workload", "chaos", "liveness"}
 )
 
 
@@ -56,6 +66,16 @@ class LintResult:
         )
         return "\n".join(lines)
 
+    def by_rule(self) -> dict[str, dict[str, int]]:
+        """Per-rule active/suppressed counts (every rule present)."""
+        out = {
+            rule: {"active": 0, "suppressed": 0} for rule in sorted(RULES)
+        }
+        for f in self.findings:
+            slot = out.setdefault(f.rule, {"active": 0, "suppressed": 0})
+            slot["suppressed" if f.suppressed else "active"] += 1
+        return out
+
     def to_json(self) -> dict:
         return {
             "tool": "jaxlint",
@@ -63,6 +83,7 @@ class LintResult:
             "findings": [f.to_json() for f in self.findings],
             "n_active": len(self.active),
             "n_suppressed": len(self.suppressed),
+            "by_rule": self.by_rule(),
             "errors": list(self.errors),
             "unused_suppressions": [
                 {"path": p, "line": ln} for p, ln in self.unused_suppressions
@@ -75,11 +96,19 @@ def is_hot(path: str) -> bool:
     return any(seg in HOT_SEGMENTS for seg in parts)
 
 
+def is_vclock(path: str) -> bool:
+    parts = os.path.normpath(path).split(os.sep)
+    if parts and parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    return any(seg in VCLOCK_SEGMENTS for seg in parts)
+
+
 def lint_source(
     source: str,
     path: str = "<string>",
     hot: bool = True,
     select: frozenset[str] | None = None,
+    vclock: bool = True,
 ) -> LintResult:
     """Lint one source string (the fixture/test entry point)."""
     res = LintResult(files=1)
@@ -88,7 +117,7 @@ def lint_source(
     except SyntaxError as e:
         res.errors.append(f"{path}: syntax error: {e.msg} (line {e.lineno})")
         return res
-    findings = Analyzer(path, tree, hot=hot).run()
+    findings = Analyzer(path, tree, hot=hot, vclock=vclock).run()
     if select is not None:
         findings = [f for f in findings if f.rule in select]
     supp = Suppressions.parse(source)
@@ -128,9 +157,30 @@ def lint_paths(
         except OSError as e:
             res.errors.append(f"{path}: unreadable: {e}")
             continue
-        one = lint_source(source, path=path, hot=is_hot(path), select=select)
+        one = lint_source(source, path=path, hot=is_hot(path),
+                          select=select, vclock=is_vclock(path))
         res.files += 1
         res.findings.extend(one.findings)
         res.errors.extend(one.errors)
         res.unused_suppressions.extend(one.unused_suppressions)
     return res
+
+
+def lint_fields(paths: list[str] | None = None) -> dict:
+    """Flat ``lint_*`` counters for a bench JSON line: total files/
+    active/suppressed plus per-rule counts, over the ``ceph_tpu``
+    package by default.  Harvested into ``guard_metrics`` by
+    ``bench/decide_defaults.py`` (every value is an int)."""
+    if paths is None:
+        paths = [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+    res = lint_paths(paths)
+    out = {
+        "lint_files": res.files,
+        "lint_active": len(res.active),
+        "lint_suppressed": len(res.suppressed),
+        "lint_unused_suppressions": len(res.unused_suppressions),
+    }
+    for rule, counts in res.by_rule().items():
+        out[f"lint_{rule}_active"] = counts["active"]
+        out[f"lint_{rule}_suppressed"] = counts["suppressed"]
+    return out

@@ -117,14 +117,18 @@ class TransferCounter:
         jax.device_get = wrapped_get
         self._undo.append(lambda: setattr(jax, "device_get", orig_get))
 
-        # implicit conversions + .item() on the concrete array class;
-        # patchable because jax copies these Python methods onto the
-        # C++ ArrayImpl at class-decoration time
+        # implicit conversions + .item() + scalar coercions on the
+        # concrete array class; patchable because jax copies these
+        # Python methods onto the C++ ArrayImpl at class-decoration
+        # time.  float(x)/int(x) resolve through the type's
+        # __float__/__int__/__index__ slots and never hit the numpy
+        # seams above, so they get their own hooks
         try:
             import jaxlib.xla_extension as _xe
 
             cls = _xe.ArrayImpl
-            for meth in ("__array__", "item"):
+            for meth in ("__array__", "item", "__float__", "__int__",
+                         "__index__"):
                 orig = getattr(cls, meth, None)
                 if orig is None:
                     continue
@@ -212,3 +216,129 @@ def assert_no_recompile(what: str = "steady state"):
             f"{cc.backend_compiles} backend compile(s) + "
             f"{cc.cache_hits} cache hit(s)"
         )
+
+
+# --------------------------------------------------------------------
+# rank-divergence sanitizer: the dynamic twin of J007-J009.  A cheap
+# host-side fingerprint of the operands about to enter a mesh seam is
+# psum'd across every device; if any rank computed a different
+# fingerprint the variance test fails *identically on all ranks*, so
+# every process raises RankDivergenceError instead of some subset
+# deadlocking inside the real collective that would have followed.
+
+
+class RankDivergenceError(AssertionError):
+    """Ranks disagree on data that must be rank-identical."""
+
+
+#: fingerprints are folded into this many bits so n * h^2 stays far
+#: inside int64 for any plausible device count
+_HASH_BITS = 20
+
+
+def rank_fingerprint(*arrays) -> int:
+    """Order-sensitive CRC of (shape, dtype, bytes) per operand, folded
+    to ``_HASH_BITS`` bits and never zero (an accidental all-zero psum
+    cannot fake a pass)."""
+    import zlib
+
+    import numpy as np
+
+    h = 0
+    for a in arrays:
+        a = np.ascontiguousarray(np.asarray(a))
+        h = zlib.crc32(repr((a.shape, str(a.dtype))).encode(), h)
+        h = zlib.crc32(a.tobytes(), h)
+    return (h % ((1 << _HASH_BITS) - 3)) + 1
+
+
+def rank_checks_enabled() -> bool:
+    """The ``debug_rank_checks`` config knob (env:
+    ``CEPH_TPU_DEBUG_RANK_CHECKS=1``)."""
+    from ..common.config import global_config
+
+    return bool(global_config().get("debug_rank_checks"))
+
+
+class RankSanitizer:
+    """Cross-rank fingerprint checker for one (mesh, axis).
+
+    ``check(tag, *arrays)`` hashes the operands locally, fills a
+    device-sharded int64 with the hash, and psums both the sum and the
+    sum of squares over the mesh axis.  All ranks identical means
+    ``n * sum(h^2) == (sum h)^2`` (zero variance) — a test every rank
+    evaluates to the same verdict, so divergence raises everywhere at
+    once rather than deadlocking a subset inside a later collective.
+    """
+
+    def __init__(self, mesh, axis: str | None = None):
+        import jax
+
+        from ..parallel.placement import shard_map
+
+        self.mesh = mesh
+        self.axis = axis or mesh.axis_names[0]
+        self.n_devices = int(mesh.devices.size)
+        self.checks = 0
+        ax = self.axis
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self._sharding = NamedSharding(mesh, P(ax))
+
+        def local(h):
+            h = h[0]  # each device owns one slot of the [n] operand
+            s1 = jax.lax.psum(h, ax)
+            s2 = jax.lax.psum(h * h, ax)
+            return s1, s2
+
+        self._step = jax.jit(
+            shard_map(
+                local, mesh=mesh, in_specs=(P(ax),), out_specs=(P(), P())
+            )
+        )
+
+    def _operand(self, h: int):
+        import jax
+        import numpy as np
+
+        n = self.n_devices
+
+        def cb(idx):
+            start, stop, _ = idx[0].indices(n)
+            return np.full((stop - start,), h, np.int64)
+
+        return jax.make_array_from_callback((n,), self._sharding, cb)
+
+    def check(self, tag: str, *arrays) -> None:
+        h = rank_fingerprint(*arrays)
+        s1, s2 = self._step(self._operand(h))
+        s1, s2 = int(s1), int(s2)
+        self.checks += 1
+        if self.n_devices * s2 != s1 * s1:
+            raise RankDivergenceError(
+                f"{tag}: rank-divergent operands at a mesh seam — this "
+                f"rank's fingerprint {h} disagrees across the "
+                f"{self.n_devices}-device '{self.axis}' axis "
+                f"(psum={s1}, psum_sq={s2}).  Some rank observed "
+                "different bytes/shape/dtype; the collective that "
+                "would have followed could deadlock or silently mix "
+                "divergent state"
+            )
+
+
+_sanitizers: dict = {}
+
+
+def assert_rank_identical(tag: str, *arrays, mesh, axis=None) -> None:
+    """Raise :class:`RankDivergenceError` (on every rank) when the
+    operand fingerprint differs across ``mesh``'s ``axis``.
+
+    Call this at mesh seams *before* launching sharded work, gated by
+    :func:`rank_checks_enabled`.  Sanitizer steps are cached per
+    (mesh, axis) so steady-state cost is one tiny compiled psum.
+    """
+    key = (mesh, axis)
+    san = _sanitizers.get(key)
+    if san is None:
+        san = _sanitizers[key] = RankSanitizer(mesh, axis)
+    san.check(tag, *arrays)

@@ -447,8 +447,9 @@ def calc_pg_upmaps(
         ).max():
             continue  # reject this pool's moves wholesale
         entries += pool_entries
-        # diff trial vs live state for this pool only
-        for pg in set(trial_items) | set(original_items):
+        # diff trial vs live state for this pool only; sorted so the
+        # incremental's entry order is rank- and hashseed-identical
+        for pg in sorted(set(trial_items) | set(original_items)):
             if pg.pool != pool_id:
                 continue
             new = trial_items.get(pg)

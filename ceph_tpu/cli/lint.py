@@ -4,10 +4,16 @@ Exit codes: 0 clean, 1 unsuppressed findings, 2 usage/IO error.
 
 ::
 
-    python -m ceph_tpu.cli.lint ceph_tpu/            # text report
-    python -m ceph_tpu.cli.lint --json ceph_tpu/     # machine-readable
+    python -m ceph_tpu.cli.lint ceph_tpu/                  # text report
+    python -m ceph_tpu.cli.lint --format=json ceph_tpu/    # machine-readable
+    python -m ceph_tpu.cli.lint --format=github ceph_tpu/  # CI annotations
     python -m ceph_tpu.cli.lint --select J002,J005 ceph_tpu/ec
     python -m ceph_tpu.cli.lint --explain J002
+
+``--format=github`` emits one GitHub Actions workflow command per
+active finding (``::error file=...,line=...``), so a CI step running
+the linter annotates the offending lines in the PR diff directly.
+``--json`` stays as an alias for ``--format=json``.
 """
 
 from __future__ import annotations
@@ -28,8 +34,12 @@ def main(argv=None) -> int:
     p.add_argument("paths", nargs="*", default=None,
                    help="files/directories to lint (default: the "
                         "ceph_tpu package)")
+    p.add_argument("--format", choices=("text", "json", "github"),
+                   default=None, dest="fmt",
+                   help="report format: human text (default), one JSON "
+                        "document, or GitHub Actions ::error annotations")
     p.add_argument("--json", action="store_true", dest="as_json",
-                   help="emit one JSON document instead of text")
+                   help="alias for --format=json")
     p.add_argument("--select", metavar="RULES",
                    help="comma-separated rule ids to run (default: all)")
     p.add_argument("--show-suppressed", action="store_true",
@@ -67,10 +77,23 @@ def main(argv=None) -> int:
         print(f"no such path(s): {', '.join(missing)}", file=sys.stderr)
         return 2
 
+    fmt = args.fmt or ("json" if args.as_json else "text")
+
     res = lint_paths(paths, select=select)
 
-    if args.as_json:
+    if fmt == "json":
         print(json.dumps(res.to_json(), indent=1, sort_keys=True))
+    elif fmt == "github":
+        for f in res.active:
+            name = RULES[f.rule][0]
+            # workflow-command escaping: the message rides in the data
+            # section, where %, CR and LF must be %-encoded
+            msg = (f.message.replace("%", "%25")
+                   .replace("\r", "%0D").replace("\n", "%0A"))
+            print(f"::error file={f.path},line={f.line},col={f.col},"
+                  f"title=jaxlint {f.rule} ({name})::{msg}")
+        print(f"jaxlint: {len(res.active)} finding(s) in {res.files} "
+              "file(s)", file=sys.stderr)
     else:
         print(res.render_text(show_suppressed=args.show_suppressed))
         if args.show_unused and res.unused_suppressions:

@@ -139,6 +139,13 @@ SCHEMA: list[Option] = [
            "supervised scheduling window when a mesh is attached "
            "(async launches round-robined over local devices); 1 "
            "serializes launches as before", min=1),
+    Option("debug_rank_checks", OPT_BOOL, False, LEVEL_ADVANCED,
+           "cross-check a fingerprint of mesh-seam operands across "
+           "ranks via a psum before every sharded decode/scrub/"
+           "pg-state launch (assert_rank_identical): rank-divergent "
+           "state raises RankDivergenceError on every rank instead of "
+           "deadlocking inside the collective.  One tiny collective "
+           "per launch — debug/CI only"),
     Option("osd_op_complaint_time", OPT_FLOAT, 30.0, LEVEL_ADVANCED,
            "an op in flight (or completed) at least this old (seconds) "
            "is a slow op: counted, kept in the slow-op history, and "

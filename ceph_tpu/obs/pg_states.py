@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..analysis import runtime_guard
 from ..parallel.padding import pad_to_multiple
 from ..parallel.placement import shard_map
 from ..recovery.peering import (
@@ -215,6 +216,11 @@ class PGStateClassifier:
             mask, _ = pad_to_multiple(mask, self.n_devices, axis=0)
             alive, _ = pad_to_multiple(alive, self.n_devices, axis=0)
             flags, _ = pad_to_multiple(flags, self.n_devices, axis=0)
+            if runtime_guard.rank_checks_enabled():
+                runtime_guard.assert_rank_identical(
+                    "pg_state_classify", mask, alive, flags, k, size,
+                    mesh=self.mesh, axis=self.axis,
+                )
             spec = P(self.axis)
             hist, aux = self._step(
                 self._put(mask, spec),

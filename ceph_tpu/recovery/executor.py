@@ -320,6 +320,8 @@ class RecoveryExecutor:
             self.pc.inc("throttle_waits")
         if self.on_decode_launch is not None:
             self.on_decode_launch(g, nbytes)
+        # real decode-rate measurement, never fed back into simulated
+        # time  # jaxlint: disable=J010
         t0 = time.perf_counter()
         # bit-level groups decode over GF(2) bit rows (their chunks are
         # packet-interleaved, so the byte-wise LUT/sharded paths would
@@ -400,6 +402,8 @@ class RecoveryExecutor:
             nb, sh = fl.counters
             result.psum_bytes_rebuilt += int(nb)
             result.psum_shards_rebuilt += int(sh)
+        # real decode-rate measurement, never fed back into simulated
+        # time  # jaxlint: disable=J010
         result.decode_s += time.perf_counter() - fl.t_dispatch
         return out, fl.chunk
 

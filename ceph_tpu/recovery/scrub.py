@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..analysis import runtime_guard
 from ..common.perf_counters import PerfCounters, PerfCountersBuilder, registry
 from ..common.tracing import trace_annotation
 from ..parallel.padding import pad_to_multiple
@@ -428,6 +429,11 @@ class Scrubber:
                 expected, _ = pad_to_multiple(
                     expected, self.n_devices, axis=0
                 )
+                if runtime_guard.rank_checks_enabled():
+                    runtime_guard.assert_rank_identical(
+                        "scrub_pass", data, expected, valid,
+                        mesh=self.mesh, axis=self.axis,
+                    )
                 bad_mask, hist, n_bad = self._step(
                     self._put(data, P(self.axis)),
                     self._put(expected, P(self.axis)),

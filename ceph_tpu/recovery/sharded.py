@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..analysis import runtime_guard
 from ..ec import gf
 from ..parallel.padding import pad_to_multiple, trim_to_size
 from ..parallel.placement import shard_map
@@ -150,6 +151,11 @@ class ShardedDecoder:
         padded, valid = pad_to_multiple(
             np.asarray(src, np.uint8), self.n_devices, axis=1
         )
+        if runtime_guard.rank_checks_enabled():
+            runtime_guard.assert_rank_identical(
+                "sharded_decode", luts, padded, np.int64(int(chunk)),
+                mesh=self.mesh, axis=self.axis,
+            )
         out, nbytes, shards = self._step(
             self._put(np.asarray(luts, np.uint8), P()),
             self._put(padded, P(None, self.axis)),

@@ -270,6 +270,13 @@ def compile_once_cases() -> dict[str, dict]:
     cfg.set("mon_osd_min_down_reporters", 1)
     clock = VirtualClock()
     det = LivenessDetector(8, clock, config=cfg)
+    # heartbeat_step is a module-level jit: anything else in this
+    # process that ticked an 8-OSD detector already populated its
+    # cache, which would serve the warm run silently (zero events)
+    # and void the warm_compiles > 0 claim — start from a cold wrapper
+    from ..recovery import liveness as _liveness
+
+    _liveness.heartbeat_step.clear_cache()
     with CompileCounter() as warm_h:
         # warm both rare paths (tick step + the restore scatter) once
         det.apply(parse_spec("netsplit:5"))

@@ -573,6 +573,7 @@ class TrafficEngine:
             args = [self._put(np.asarray(a)) for a in args]
         ep = int(peering.epoch_cur if epoch is None else epoch)
         with self._jspan("traffic.step", epoch=ep, ops=self.ops_per_step):
+            # real wall rate for the step  # jaxlint: disable=J010
             t0 = time.perf_counter()
             (counts, lat_hist, qd_hist, sums, max_rho, written,
              deg_read) = self._step(*args)
@@ -580,6 +581,8 @@ class TrafficEngine:
             lat_hist = np.asarray(lat_hist)
             qd_hist = np.asarray(qd_hist)
             sums = np.asarray(sums)
+            # measured step wall rate, reported next to simulated time
+            # and never mixed into it  # jaxlint: disable=J010
             wall = time.perf_counter() - t0
         served, degraded, blocked = (int(c) for c in counts)
         ok = served + degraded

@@ -82,10 +82,43 @@ def f(x, mode):
     _leak = x * 2
     return f(x, True)
 """,
+    # J007/J008/J012: collectives, rank-local branches, closure capture
+    """
+import jax
+from jax.sharding import PartitionSpec as P
+from ceph_tpu.parallel.placement import shard_map
+
+def build(mesh, table):
+    placed = jax.device_put(table)
+
+    def local(x):
+        return jax.lax.psum(x + placed, "bytes")
+
+    if jax.process_index() == 0:
+        return shard_map(local, mesh=mesh,
+                         in_specs=(P("objects"),), out_specs=P())
+    return jax.lax.all_gather(table, "objects")
+""",
+    # J009/J010/J011: unordered iteration, wall clock, unseeded rng
+    """
+import random
+import time
+import numpy as np
+
+def drain(pending, clock):
+    rng = np.random.default_rng()
+    t0 = time.time()
+    out = []
+    for pg in set(pending) | {0}:
+        out.append(pg + random.random())
+    return out, time.perf_counter() - t0
+""",
 ]
 
 IDENTS = ["x", "jnp", "jax", "fn", "fori_loop", "self", "np", "item",
-          "config", "update", "lax", "partial", "kern", "x_ref"]
+          "config", "update", "lax", "partial", "kern", "x_ref",
+          "psum", "shard_map", "mesh", "placed", "process_index",
+          "set", "time", "random", "default_rng", "device_put"]
 OPS = [("==", "!="), (">", "<"), ("+", "-"), ("*", "/"), ("(", ""),
        (")", ""), (":", ""), (",", " ")]
 
@@ -135,7 +168,8 @@ def main() -> int:
             src = mutate(src, rng)
         try:
             res = lint_source(src, path=f"<mutant-{n}>",
-                              hot=bool(rng.getrandbits(1)))
+                              hot=bool(rng.getrandbits(1)),
+                              vclock=bool(rng.getrandbits(1)))
         except Exception as e:  # noqa: BLE001 — any escape is the bug
             print(f"FUZZ FAILURE at mutant {n}: {type(e).__name__}: {e}\n"
                   f"--- source ---\n{src}\n--------------")

@@ -53,6 +53,15 @@ def test_multichip_record_schema():
     assert rec["sharded_launches"] == 21
     assert rec["psum_bytes_rebuilt"] == 1_458_176
     assert rec["psum_shards_rebuilt"] == 89
+    # the jaxlint snapshot rides along for decide_defaults' harvest:
+    # per-rule counters for the full J001-J012 registry, zero-active
+    # on the tree this record was built from
+    from ceph_tpu.analysis import RULES
+
+    assert rec["lint_files"] > 50
+    assert rec["lint_active"] == 0
+    for rid in RULES:
+        assert rec[f"lint_{rid}_active"] == 0
     json.dumps(rec)  # one JSON line, always serializable
 
 

@@ -231,6 +231,26 @@ def test_harvest_guard_collects_multichip_counters(tmp_path):
     assert aux["recovery_multichip_bytes_per_sec"] == 23_000_000
 
 
+def test_harvest_guard_collects_lint_fields(tmp_path):
+    """jaxlint per-rule counters on a bench line flow into the guard
+    harvest verbatim — any ``lint_`` key, so a new rule needs no
+    harvest change."""
+    p = _log(tmp_path, [
+        {"metric": "recovery_multichip_bytes_per_sec", "platform": "tpu",
+         "value": 23_000_000, "n_compiles": 11, "n_compiles_first": 11,
+         "host_transfers": 84, "lint_files": 88, "lint_active": 0,
+         "lint_suppressed": 15, "lint_unused_suppressions": 0,
+         "lint_J007_active": 0, "lint_J012_suppressed": 1,
+         "lint_notes": "free-text must not harvest"},
+    ])
+    g = dd.harvest_guard([p])["recovery_multichip_bytes_per_sec"]
+    assert g["lint_files"] == 88 and g["lint_active"] == 0
+    assert g["lint_suppressed"] == 15
+    assert g["lint_J007_active"] == 0
+    assert g["lint_J012_suppressed"] == 1
+    assert "lint_notes" not in g  # non-numeric lint_ keys stay out
+
+
 def test_harvest_guard_collects_xor_schedule_fields(tmp_path):
     """config2/config4 --xor-schedule lines carry the compile-time XOR
     counts (int) and the schedule-vs-dense rates (float) into the

@@ -1,11 +1,16 @@
-"""Tier-1 lint gate: the tree must be jaxlint-clean.
+"""Tier-1 lint gate: the tree must be jaxlint-clean under all 12 rules.
 
 Runs the analyzer over the whole ``ceph_tpu`` package (the same
 invocation as ``python -m ceph_tpu.cli.lint ceph_tpu/``) and fails on
 any unsuppressed finding — so a new Python-branch-on-tracer, unpinned
-loop dtype, stray host sync, recompile-forcer, raw x64 toggle, or
-tracer leak fails CI before it costs a chip session.  Fast (pure AST,
-no jax import in the analyzed path) and deliberately not ``slow``.
+loop dtype, stray host sync, recompile-forcer, raw x64 toggle, tracer
+leak, out-of-scope collective, rank-divergent branch, unordered-set
+ordering, wall-clock-in-vclock call, unseeded rng, or shard_map
+closure capture fails CI before it costs a chip session (J001-J012;
+the cross-rank rules guard the multihost deadlock class the runtime
+sanitizer ``assert_rank_identical`` catches dynamically).  Fast (pure
+AST, no jax import in the analyzed path) and deliberately not
+``slow``.
 """
 
 from __future__ import annotations
@@ -27,6 +32,31 @@ def test_tree_is_lint_clean():
     assert not res.active, "\n" + "\n".join(
         f.render() for f in res.active
     )
+
+
+def test_tree_is_clean_per_rule_including_cross_rank():
+    """Every rule — including the interprocedural J007-J012 additions —
+    reports zero active findings, and the per-rule aggregate the bench
+    harvest rides on covers the full registry."""
+    from ceph_tpu.analysis import RULES
+
+    by_rule = lint_paths([PKG]).by_rule()
+    assert set(by_rule) == set(RULES)
+    for rid, counts in by_rule.items():
+        assert counts["active"] == 0, (rid, counts)
+
+
+def test_lint_fields_feed_the_bench_harvest():
+    """The ``lint_*`` guard fields decide_defaults harvests from bench
+    JSON lines: flat, int-valued, and zero-active on a clean tree."""
+    from ceph_tpu.analysis import RULES, lint_fields
+
+    fields = lint_fields([PKG])
+    assert fields["lint_files"] > 50
+    assert fields["lint_active"] == 0
+    assert fields["lint_unused_suppressions"] == 0
+    for rid in RULES:
+        assert fields[f"lint_{rid}_active"] == 0
 
 
 def test_suppressions_all_earn_their_keep():

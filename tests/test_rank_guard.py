@@ -1,0 +1,131 @@
+"""Two-process rank-divergence sanitizer: the runtime twin of J007-J009.
+
+Spawns two child processes joined into one 8-device mesh, turns on
+``debug_rank_checks``, and runs the sharded decoder twice:
+
+1. with rank-identical operands — the sanitizer's psum passes and the
+   decoded bytes match the XOR ground truth (the guard costs a check,
+   not correctness);
+2. with an injected rank-divergent branch (rank 1 flips one survivor
+   byte, the exact bug class J008 lints for) — BOTH ranks must raise
+   :class:`RankDivergenceError` before the real collective launches,
+   instead of one rank deadlocking inside it.
+
+The variance test ``n * sum(h^2) == (sum h)^2`` evaluates identically
+on every rank, which is what makes the all-ranks-raise guarantee hold.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = r"""
+import json, os, sys
+import numpy as np
+from ceph_tpu.parallel import multihost
+
+rank = int(sys.argv[1])
+multihost.init(coordinator=sys.argv[2], num_processes=2, process_id=rank)
+import jax
+assert jax.process_count() == 2, jax.process_count()
+
+from ceph_tpu.common.config import global_config
+from ceph_tpu.analysis.runtime_guard import RankDivergenceError
+from ceph_tpu.recovery.sharded import ShardedDecoder
+from ceph_tpu.ec import gf
+
+global_config().set("debug_rank_checks", True)
+mesh = multihost.global_mesh()
+dec = ShardedDecoder(mesh, gather=True)
+
+# coefficient-1 repair rows: decode == src[0] ^ src[1]
+luts = gf.mul_table()[np.ones((1, 2), np.uint8)]
+src = np.random.default_rng(7).integers(0, 256, (2, 64), np.uint8)
+
+out, _, _ = dec.decode(luts, src, 32)
+clean_ok = bool((out[0] == src[0] ^ src[1]).all())
+
+# inject the J008 bug shape: a branch on process_index() mutating a
+# mesh-seam operand on one rank only
+src2 = src.copy()
+if jax.process_index() == 1:
+    src2[0, 0] ^= 0xFF
+caught = False
+try:
+    dec.decode(luts, src2, 32)
+except RankDivergenceError:
+    caught = True
+
+print("CHILD_RESULT " + json.dumps({
+    "rank": rank, "clean_ok": clean_ok, "caught": caught,
+}), flush=True)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_rank_divergence_caught_on_both_ranks():
+    from ceph_tpu.common.hermetic import scrubbed_env
+
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    env = scrubbed_env(_REPO, n_devices=4)
+    # file-backed output: PIPE could deadlock the collective if one
+    # child fills its pipe while the other blocks in the psum
+    import tempfile
+
+    outs = []
+    with tempfile.TemporaryDirectory() as td:
+        files = [open(os.path.join(td, f"r{r}.out"), "w+") for r in (0, 1)]
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", _CHILD, str(rank), coord],
+                env=env,
+                cwd=_REPO,
+                stdout=files[rank],
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+            for rank in range(2)
+        ]
+        rcs = []
+        try:
+            for p in procs:
+                rcs.append(p.wait(timeout=300))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            for f in files:
+                f.seek(0)
+                outs.append(f.read())
+                f.close()
+            if rcs != [0, 0]:
+                print("child logs:\n" + "\n".join(o[-2000:] for o in outs))
+        assert rcs == [0, 0], f"children failed {rcs}"
+
+    recs = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("CHILD_RESULT "):
+                rec = json.loads(line[len("CHILD_RESULT "):])
+                recs[rec["rank"]] = rec
+    assert set(recs) == {0, 1}
+    # rank-identical operands sail through with correct bytes...
+    assert recs[0]["clean_ok"] and recs[1]["clean_ok"]
+    # ...and the injected divergence raises on EVERY rank, including
+    # rank 0 whose local operands were untouched
+    assert recs[0]["caught"] and recs[1]["caught"]
