@@ -135,7 +135,12 @@ def _decided_modes() -> tuple[str, str]:
             d = json.load(f)
         if not isinstance(d, dict):
             return "0", "0"
-        m = str(d.get("CEPH_TPU_LEVEL_KERNEL", "0"))
+        m = d.get("CEPH_TPU_LEVEL_KERNEL", "0")
+        if isinstance(m, dict):
+            # per-platform form: the upgrade child this feeds targets
+            # the attached accelerator, so resolve the tpu entry
+            m = m.get("tpu", m.get("default", "0"))
+        m = str(m)
         c = str(d.get("CEPH_TPU_RETRY_COMPACT", "0"))
         return (m if m in ("0", "1", "level") else "0",
                 c if c in ("0", "1") else "0")

@@ -20,6 +20,34 @@ N_OSDS = 1024
 REPLICAS = 3
 
 
+def build_crush_record(platform, tpu_rate, cpu_rate, n_compiles,
+                       n_compiles_first, host_transfers,
+                       kernel_resolved, fused_pipeline):
+    """One JSON line for the batch-placement headline.
+
+    ``kernel_mode``/``kernel_mode_source`` (and ``kernel_gate`` when
+    the built-in TPU gate decided) come from
+    ``interp_batch.kernel_mode_resolved()``: the record says WHICH
+    backend produced the rate and which ladder rung picked it, so a
+    defaults-file flip or a gate fallback is visible in the artifact,
+    not just in process state.  ``fused_pipeline`` records whether the
+    placement→peering fusion was enabled in this process.
+    """
+    rec = {
+        "metric": "crush_placements_per_sec",
+        "value": round(tpu_rate),
+        "unit": "placements/s",
+        "vs_baseline": round(tpu_rate / cpu_rate, 2) if cpu_rate else None,
+        "platform": platform,
+        "n_compiles": int(n_compiles),
+        "n_compiles_first": int(n_compiles_first),
+        "host_transfers": int(host_transfers),
+        "fused_pipeline": bool(fused_pipeline),
+    }
+    rec.update(kernel_resolved)
+    return rec
+
+
 def main() -> None:
     from ceph_tpu.common.compile_cache import enable_persistent_cache
 
@@ -66,16 +94,14 @@ def main() -> None:
         )
     tpu_rate = N_OBJECTS / dt
 
-    print(json.dumps({
-        "metric": "crush_placements_per_sec",
-        "value": round(tpu_rate),
-        "unit": "placements/s",
-        "vs_baseline": round(tpu_rate / cpu_rate, 2),
-        "platform": jax.default_backend(),
-        "n_compiles": guard.n_compiles,
-        "n_compiles_first": warm.get("n_compiles", 0),
-        "host_transfers": guard.host_transfers,
-    }))
+    from ceph_tpu.crush.interp_batch import kernel_mode_resolved
+    from ceph_tpu.recovery.pipeline import fused_pipeline_enabled
+
+    print(json.dumps(build_crush_record(
+        jax.default_backend(), tpu_rate, cpu_rate,
+        guard.n_compiles, warm.get("n_compiles", 0), guard.host_transfers,
+        kernel_mode_resolved(), fused_pipeline_enabled(),
+    )))
 
 
 if __name__ == "__main__":

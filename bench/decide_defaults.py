@@ -42,6 +42,11 @@ MODES = {
     "kern_full": ("1", "0"),
 }
 
+# tags whose win flips a Pallas kernel mode on — these are the ones the
+# probe's bit-exactness check guards (a fast-but-wrong kernel measured
+# at any rate must never become the default)
+KERNEL_TAGS = frozenset(t for t, (k, _) in MODES.items() if k != "0")
+
 # Non-grid metrics worth carrying in the decision record for trend
 # tracking (they never vote on the kernel-mode winner): currently the
 # recovery subsystem's batched repair-decode rate (config6_recovery).
@@ -124,6 +129,21 @@ SCRUB_FLOAT_FIELDS = ("scrub_time_to_zero_inconsistent_s",
                       "scrub_time_to_zero_inconsistent_s_no_arbiter",
                       "scrub_p99_ms")
 SCRUB_STR_FIELDS = ("scrub_health_status",)
+
+# Upmap-optimizer fields (config3_upmap --vmapped): launches_per_round
+# is the one-launch candidate scorer's verdict (mapping + scoring
+# device launches per optimization round, acceptance bar <= 5);
+# candidate_evals_per_sec is the admissibility evaluations pushed
+# through the scorer per optimizer second.
+UPMAP_INT_FIELDS = ("candidate_evals_per_sec", "candidates_scored",
+                    "score_launches")
+UPMAP_FLOAT_FIELDS = ("launches_per_round",)
+
+# Provenance fields (config1_crush): which kernel-mode rung produced
+# the rate and whether the fused placement pipeline was on — a rate
+# measured under a different backend than the committed default is
+# visible in the artifact, not just in process state.
+PROVENANCE_STR_FIELDS = ("kernel_mode", "kernel_mode_source", "kernel_gate")
 
 # Failure-detection fields (config6_recovery --liveness): the damped /
 # undamped flapping passes run on the same seeded timeline, so every
@@ -237,6 +257,15 @@ def harvest_guard(paths: list[str]) -> dict[str, dict]:
             fields.update(
                 {f: str(d[f]) for f in LIVENESS_STR_FIELDS if f in d}
             )
+            fields.update(
+                {f: int(d[f]) for f in UPMAP_INT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: float(d[f]) for f in UPMAP_FLOAT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: str(d[f]) for f in PROVENANCE_STR_FIELDS if f in d}
+            )
             # jaxlint per-rule counters (lint_active, lint_J007_active,
             # ...): dynamic key set — one field per registered rule, so
             # new rules flow through without touching this harvest
@@ -252,6 +281,10 @@ def harvest_guard(paths: list[str]) -> dict[str, dict]:
                 )
             if "chaos_converged" in d:
                 fields["chaos_converged"] = bool(d["chaos_converged"])
+            if "vmapped_upmap" in d:
+                fields["vmapped_upmap"] = bool(d["vmapped_upmap"])
+            if "fused_pipeline" in d:
+                fields["fused_pipeline"] = bool(d["fused_pipeline"])
             if "scrub_converged" in d:
                 fields["scrub_converged"] = bool(d["scrub_converged"])
             if "liveness_converged" in d:
@@ -291,22 +324,73 @@ def harvest(paths: list[str]) -> dict[str, int]:
                     if tag == "kern_full":
                         continue  # forensics-only, gated on its error field
                     r = d.get(f"{tag}_rate_per_sec")
-                    if r and d.get(f"{tag}_ok", True):
+                    # a kernel variant's rate counts only when the same
+                    # probe proved it bit-exact against the scalar
+                    # interp (absent field = legacy log, trusted as the
+                    # pallas-test-covered path it measured)
+                    if (r and d.get(f"{tag}_ok", True)
+                            and d.get(f"{tag}_bitexact", True)):
                         rates[tag] = max(rates.get(tag, 0), int(r))
             elif d.get("metric") == "kernel_forensics":
                 r = d.get("kern_full_rate_per_sec")
-                if r and not d.get("error"):
+                if (r and not d.get("error")
+                        and d.get("kern_full_bitexact", True)):
                     rates["kern_full"] = max(rates.get("kern_full", 0), int(r))
     return rates
 
 
-def decide(rates: dict[str, int], sources: list[str]) -> dict:
+def harvest_bitexact(paths: list[str]) -> dict[str, bool]:
+    """Collect tag -> bit-exactness verdict from the probe logs.
+
+    Sticky-False: one observed divergence quarantines the tag for the
+    whole decision (including rates merged from a PRIOR defaults file —
+    a kernel that diverged today must not stay the default on the
+    strength of yesterday's measurement).  Tags that never reported the
+    field are absent (legacy logs)."""
+    verdicts: dict[str, bool] = {}
+    for path in paths:
+        try:
+            lines = open(path).read().splitlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if d.get("platform") != "tpu":
+                continue
+            for tag in MODES:
+                v = d.get(f"{tag}_bitexact")
+                if v is not None:
+                    verdicts[tag] = verdicts.get(tag, True) and bool(v)
+    return verdicts
+
+
+def decide(
+    rates: dict[str, int],
+    sources: list[str],
+    bitexact: dict[str, bool] | None = None,
+) -> dict:
+    failed = sorted(
+        t for t, ok in (bitexact or {}).items() if not ok
+    )
+    if failed:
+        # quarantine: a diverging kernel variant is removed from
+        # candidacy entirely — its rate (from this session OR a merged
+        # prior) can never crown it
+        rates = {t: r for t, r in rates.items() if t not in failed}
     out: dict = {
         "metric": "default_decision",
         "target_per_chip": TARGET_PER_CHIP,
         "rates": dict(sorted(rates.items(), key=lambda kv: -kv[1])),
         "sources": sources,
     }
+    if failed:
+        out["bitexact_failed"] = failed
     if not rates:
         out["decision"] = "no measured rates found — defaults unchanged"
         return out
@@ -337,6 +421,7 @@ def write_defaults(decision: dict, path: str | None = None) -> None:
     path = path or DEFAULTS_PATH
     rates = dict(decision["rates"])
     sources = list(decision["sources"])
+    failed = {t: False for t in decision.get("bitexact_failed", [])}
     try:
         with open(path) as f:
             prior = json.load(f)
@@ -362,8 +447,25 @@ def write_defaults(decision: dict, path: str | None = None) -> None:
         # and the session logs)
         print(f"decide_defaults: prior decision unreadable ({e}); "
               "overwriting", file=sys.stderr)
-    merged = decide(rates, sources)
-    out = dict(merged["recommend_env"])
+    # the quarantine applies AFTER the prior merge: a tag that just
+    # failed bit-exactness must not win on a prior session's rate
+    merged = decide(rates, sources, bitexact=failed)
+    if "winner" not in merged:
+        raise ValueError(
+            "bit-exactness quarantine removed every measured rate — "
+            "refusing to write defaults"
+        )
+    kmode = merged["recommend_env"]["CEPH_TPU_LEVEL_KERNEL"]
+    out: dict = {
+        # per-platform form read by interp_batch._decided_kernel_mode:
+        # the probe's evidence is TPU evidence, so the flip applies to
+        # the tpu backend only — every other platform keeps the XLA
+        # matmul path
+        "CEPH_TPU_LEVEL_KERNEL": {"tpu": kmode, "default": "0"},
+        "CEPH_TPU_RETRY_COMPACT": merged["recommend_env"][
+            "CEPH_TPU_RETRY_COMPACT"
+        ],
+    }
     out.update(
         {
             "winner": merged["winner"],
@@ -376,6 +478,8 @@ def write_defaults(decision: dict, path: str | None = None) -> None:
             ),
         }
     )
+    if merged.get("bitexact_failed"):
+        out["bitexact_failed"] = merged["bitexact_failed"]
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
         f.write("\n")
@@ -399,7 +503,7 @@ def main() -> int:
         # a typo'd log path must not silently shrink the evidence base
         print(f"decide_defaults: missing log(s): {missing}", file=sys.stderr)
         return 2
-    out = decide(harvest(paths), paths)
+    out = decide(harvest(paths), paths, bitexact=harvest_bitexact(paths))
     aux = harvest_aux(paths)
     if aux:
         out["aux_metrics"] = aux

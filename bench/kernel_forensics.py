@@ -210,6 +210,20 @@ def main() -> int:
             out["kern_full_rate_per_sec"] = round(MAXN / dt)
             out["kern_full_total_s"] = round(time.perf_counter() - t, 1)
             say(f"kernel {MAXN} rate: {MAXN / dt:,.0f} placements/s")
+            # the measured whole-descent rate only votes on the default
+            # if this same session proves it bit-exact on the golden
+            # maps (decide_defaults discards the rate otherwise)
+            try:
+                from ceph_tpu.crush.kernel_gate import check_bit_exact
+
+                check_bit_exact(mode="1")
+                out["kern_full_bitexact"] = True
+            except Exception as e:  # noqa: BLE001
+                out["kern_full_bitexact"] = False
+                out["kern_full_bitexact_error"] = (
+                    f"{type(e).__name__}: {e}"[:500]
+                )
+                say(f"kern_full bit-exactness FAILED: {e}")
         else:
             say(f"step 4 skipped: MAXN={MAXN} <= mid size {N_MID}")
     except Exception as e:  # noqa: BLE001 — bank whatever we measured

@@ -105,6 +105,7 @@ def main() -> int:
                   file=sys.stderr, flush=True)
             print(json.dumps(out), flush=True)
             return 1
+    gate_seeds = int(os.environ.get("CEPH_TPU_PROBE_GATE_SEEDS", 512))
     for tag, kmode, cmode in grid:
         os.environ["CEPH_TPU_LEVEL_KERNEL"] = kmode
         os.environ["CEPH_TPU_RETRY_COMPACT"] = cmode
@@ -115,6 +116,24 @@ def main() -> int:
             out[f"{tag}_ok"] = False
             out[f"{tag}_error"] = f"{type(e).__name__}: {e}"[:500]
             print(f"{tag} failed: {e}", file=sys.stderr, flush=True)
+            continue
+        if kmode == "0":
+            continue
+        # kernel variants must additionally prove golden-map
+        # bit-exactness IN THIS SESSION: decide_defaults discards a
+        # variant's rate (and quarantines prior rates) when this field
+        # is False, so a fast-but-diverging kernel can never flip the
+        # default (ceph_tpu/crush/kernel_gate.py)
+        try:
+            from ceph_tpu.crush.kernel_gate import check_bit_exact
+
+            check_bit_exact(n_seeds=gate_seeds, mode=kmode)
+            out[f"{tag}_bitexact"] = True
+        except Exception as e:  # noqa: BLE001
+            out[f"{tag}_bitexact"] = False
+            out[f"{tag}_bitexact_error"] = f"{type(e).__name__}: {e}"[:500]
+            print(f"{tag} bit-exactness FAILED: {e}",
+                  file=sys.stderr, flush=True)
 
     out["total_seconds"] = round(time.perf_counter() - t_all, 1)
     print(json.dumps(out), flush=True)

@@ -20,7 +20,13 @@ one device launch validates many moves instead of one launch per move.
 
 from __future__ import annotations
 
+import os
+from dataclasses import dataclass
+
 import numpy as np
+
+import jax
+import jax.numpy as jnp
 
 from ..common.log import get_logger
 from ..crush.map import ITEM_NONE, CrushMap
@@ -38,9 +44,45 @@ _LOG = get_logger("balancer")
 MAX_ROWS = 8192
 MAX_UNDER = 256
 
+# sentinel failure-domain id for an invalid row slot (matches no real
+# domain, including the -1 "unplaced" domain)
+_DOM_NONE = np.int64(-(2**31))
+
+#: hierarchy-walk memo for crush_device_weights / failure_domains,
+#: keyed per (crush map identity, rule, width): both walks are pure
+#: functions of the map revision, and calc_pg_upmaps calls them per
+#: pool per invocation — on a 10k-OSD map the recursive Python walk
+#: costs more than the device launches it feeds.  crush.uid is
+#: process-unique (never reused) and crush.version bumps on every
+#: mutation, so a stale hit is impossible.
+_HIER_CACHE: dict = {}
+_HIER_CACHE_MAX = 256
+
+
+def _hier_cached(kind: str, crush: CrushMap, rule_id: int, n_osd: int, build):
+    key = (kind, crush.uid, crush.version, rule_id, n_osd)
+    hit = _HIER_CACHE.get(key)
+    if hit is None:
+        if len(_HIER_CACHE) >= _HIER_CACHE_MAX:
+            _HIER_CACHE.clear()
+        hit = _HIER_CACHE[key] = build()
+    # callers scale/overwrite the result in place (expected_pg_share's
+    # reweight multiply) — hand out a copy, never the cached array
+    return hit.copy()
+
 
 def crush_device_weights(crush: CrushMap, rule_id: int, n_osd: int) -> np.ndarray:
-    """Effective CRUSH weight per OSD under the rule's TAKE root."""
+    """Effective CRUSH weight per OSD under the rule's TAKE root.
+    Memoized per (map revision, rule, width); returns a fresh copy."""
+    return _hier_cached(
+        "weights", crush, rule_id, n_osd,
+        lambda: _crush_device_weights_walk(crush, rule_id, n_osd),
+    )
+
+
+def _crush_device_weights_walk(
+    crush: CrushMap, rule_id: int, n_osd: int
+) -> np.ndarray:
     from ..crush.map import OP_TAKE
 
     rule = crush.rules[rule_id]
@@ -63,7 +105,17 @@ def crush_device_weights(crush: CrushMap, rule_id: int, n_osd: int) -> np.ndarra
 
 def failure_domains(crush: CrushMap, rule_id: int, n_osd: int) -> np.ndarray:
     """Failure-domain id for each OSD under the rule (its ancestor of
-    the rule's chooseleaf/choose type); domain -1 = unplaced."""
+    the rule's chooseleaf/choose type); domain -1 = unplaced.
+    Memoized per (map revision, rule, width); returns a fresh copy."""
+    return _hier_cached(
+        "domains", crush, rule_id, n_osd,
+        lambda: _failure_domains_walk(crush, rule_id, n_osd),
+    )
+
+
+def _failure_domains_walk(
+    crush: CrushMap, rule_id: int, n_osd: int
+) -> np.ndarray:
     from ..crush.map import (
         OP_CHOOSE_FIRSTN,
         OP_CHOOSE_INDEP,
@@ -117,29 +169,68 @@ def expected_pg_share(m: OSDMap, pool: Pool, n_osd: int) -> np.ndarray | None:
     return pool.pg_num * pool.size * cw / total
 
 
-def _score_candidate_moves(
+@dataclass
+class UpmapRunStats:
+    """Device-launch accounting for one calc_pg_upmaps invocation.
+
+    ``launches_per_round`` is the acceptance-criterion headline: with
+    the vmapped scorer every optimization round costs exactly one
+    pool-remap launch plus one candidate-scoring launch (the greedy
+    acceptance and entry GC are pure host bookkeeping), so the value is
+    =< 2 regardless of map size.  ``candidates_scored`` counts the
+    (pg-row x underfull-target) pairs evaluated, the bench's
+    candidate-evals/s numerator."""
+
+    rounds: int = 0
+    mapping_launches: int = 0
+    score_launches: int = 0
+    np_score_calls: int = 0
+    candidates_scored: int = 0
+    pools: int = 0
+
+    @property
+    def launches_per_round(self) -> float:
+        if self.rounds == 0:
+            return 0.0
+        return (self.mapping_launches + self.score_launches) / self.rounds
+
+    def as_dict(self) -> dict:
+        return {
+            "rounds": self.rounds,
+            "mapping_launches": self.mapping_launches,
+            "score_launches": self.score_launches,
+            "np_score_calls": self.np_score_calls,
+            "candidates_scored": self.candidates_scored,
+            "pools": self.pools,
+            "launches_per_round": self.launches_per_round,
+        }
+
+
+#: stats of the most recent calc_pg_upmaps call (benches read this)
+LAST_RUN_STATS = UpmapRunStats()
+
+
+def _vmapped_scoring() -> bool:
+    """Whether candidate scoring runs as one jitted launch per round
+    (default) or on the host numpy reference path
+    (``CEPH_TPU_VMAPPED_UPMAP=0``).  Both paths emit the identical
+    candidate stream — the numpy path is kept as the differential
+    reference and the no-jax escape hatch."""
+    return os.environ.get("CEPH_TPU_VMAPPED_UPMAP", "1") != "0"
+
+
+def _candidate_rows(
     up_all: np.ndarray,
     deviation: np.ndarray,
-    dom: np.ndarray,
     underfull: np.ndarray,
-    max_deviation: float,
     n_osd: int,
-) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-    """Vectorized scoring of every (pg, from, to) candidate move.
-
-    For each PG row the ``from`` is its most-overfull member (the
-    reference empties the most-overfull OSD first); ``to`` ranges over
-    all underfull OSDs.  Returns flat arrays (gain, pg, frm, to) of
-    admissible candidates, unsorted; a candidate is admissible when
-
-    - the move strictly improves balance (gain = dev[frm]-dev[to] > 1),
-    - it addresses an actual violation: frm above +max_deviation or
-      to below -max_deviation (both sides count — an OSD stuck 4 PGs
-      under its share is as unbalanced as one 4 over),
-    - ``to`` is not already in the row, and
-    - ``to``'s failure domain differs from ``frm``'s only if it is not
-      already used by another member (the reference's domain guard).
-    """
+):
+    """Host-side row/target selection shared by both scoring paths:
+    picks each PG's most-overfull member, keeps rows with positive
+    deviation, and applies the worst-first / neediest-first truncation
+    bounds.  This is [P, S] work — trivial next to the [R, S, U]
+    scoring broadcasts — and keeping it on the host guarantees the two
+    paths score the exact same candidate set in the exact same order."""
     valid = (up_all != ITEM_NONE) & (up_all >= 0) & (up_all < n_osd)
     up_c = np.clip(up_all, 0, n_osd - 1)
     dev_row = np.where(valid, deviation[up_c], -np.inf)  # [P, S]
@@ -149,8 +240,7 @@ def _score_candidate_moves(
     frm_dev = dev_row[rows, frm_slot]  # [P]
     r_sel = np.nonzero(frm_dev > 0.0)[0]
     if len(r_sel) == 0 or len(underfull) == 0:
-        empty = np.empty(0, np.int64)
-        return empty.astype(np.float64), empty, empty, empty
+        return valid, up_c, frm, frm_dev, r_sel[:0], underfull[:0]
     if len(r_sel) > MAX_ROWS:
         _LOG.info(
             "candidate truncation: keeping %d of %d overfull PG rows "
@@ -167,6 +257,72 @@ def _score_candidate_moves(
         )
         neediest = np.argsort(deviation[underfull], kind="stable")[:MAX_UNDER]
         underfull = underfull[neediest]
+    return valid, up_c, frm, frm_dev, r_sel, underfull
+
+
+def _empty_candidates():
+    empty = np.empty(0, np.int64)
+    return empty.astype(np.float64), empty, empty, empty
+
+
+def _score_candidate_moves(
+    up_all: np.ndarray,
+    deviation: np.ndarray,
+    dom: np.ndarray,
+    underfull: np.ndarray,
+    max_deviation: float,
+    n_osd: int,
+    stats: UpmapRunStats | None = None,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Vectorized scoring of every (pg, from, to) candidate move.
+
+    For each PG row the ``from`` is its most-overfull member (the
+    reference empties the most-overfull OSD first); ``to`` ranges over
+    all underfull OSDs.  Returns flat arrays (gain, pg, frm, to) of
+    admissible candidates, unsorted; a candidate is admissible when
+
+    - the move strictly improves balance (gain = dev[frm]-dev[to] > 1),
+    - it addresses an actual violation: frm above +max_deviation or
+      to below -max_deviation (both sides count — an OSD stuck 4 PGs
+      under its share is as unbalanced as one 4 over),
+    - ``to`` is not already in the row, and
+    - ``to``'s failure domain differs from ``frm``'s only if it is not
+      already used by another member (the reference's domain guard).
+
+    Dispatches to the one-launch jitted scorer by default (the [R,S,U]
+    broadcasts below are the per-round hot loop); the numpy path is
+    the bit-identical reference (``CEPH_TPU_VMAPPED_UPMAP=0``).  Both
+    produce the same flat candidate ordering — row-major over (worst
+    rows, underfull targets) — which the caller's stable gain sort
+    depends on, so the final upmap set is path-independent.
+    """
+    if _vmapped_scoring():
+        return _score_candidate_moves_vmapped(
+            up_all, deviation, dom, underfull, max_deviation, n_osd, stats
+        )
+    return _score_candidate_moves_np(
+        up_all, deviation, dom, underfull, max_deviation, n_osd, stats
+    )
+
+
+def _score_candidate_moves_np(
+    up_all: np.ndarray,
+    deviation: np.ndarray,
+    dom: np.ndarray,
+    underfull: np.ndarray,
+    max_deviation: float,
+    n_osd: int,
+    stats: UpmapRunStats | None = None,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Host numpy reference scorer (see _score_candidate_moves)."""
+    valid, up_c, frm, frm_dev, r_sel, underfull = _candidate_rows(
+        up_all, deviation, underfull, n_osd
+    )
+    if len(r_sel) == 0 or len(underfull) == 0:
+        return _empty_candidates()
+    if stats is not None:
+        stats.np_score_calls += 1
+        stats.candidates_scored += len(r_sel) * len(underfull)
     sub_up = up_c[r_sel]  # [R, S]
     sub_valid = valid[r_sel]
     sub_frm = frm[r_sel]  # [R]
@@ -175,7 +331,7 @@ def _score_candidate_moves(
         (sub_up[:, :, None] == underfull[None, None, :]) & sub_valid[:, :, None]
     ).any(axis=1)  # [R, U]
     # failure-domain guard
-    row_doms = np.where(sub_valid, dom[sub_up], np.int64(-(2**31)))  # [R, S]
+    row_doms = np.where(sub_valid, dom[sub_up], _DOM_NONE)  # [R, S]
     to_dom = dom[underfull]  # [U]
     dom_used = (row_doms[:, :, None] == to_dom[None, None, :]).any(axis=1)
     dom_conflict = dom_used & (to_dom[None, :] != dom[sub_frm][:, None])
@@ -191,6 +347,111 @@ def _score_candidate_moves(
         r_sel[ri].astype(np.int64),
         sub_frm[ri].astype(np.int64),
         underfull[ui].astype(np.int64),
+    )
+
+
+@jax.jit
+def _score_kernel(
+    sub_up,      # [R, S] i64, row members clipped to [0, n_osd)
+    sub_valid,   # [R, S] bool
+    sub_frm,     # [R]    i64, most-overfull member per row
+    sub_frm_dev, # [R]    f64, its deviation
+    row_ok,      # [R]    bool, False on padding rows
+    underfull,   # [U]    i64, target OSDs (0 on padding)
+    u_ok,        # [U]    bool, False on padding targets
+    deviation,   # [N]    f64
+    dom,         # [N]    i64 failure-domain ids
+    max_deviation,  # f64 scalar
+):
+    """One-launch candidate scorer: the [R,S,U] admissibility
+    broadcasts of _score_candidate_moves_np as a single jitted
+    program over padded fixed shapes.  All arithmetic is float64
+    gather/subtract/compare — IEEE-identical to the numpy reference,
+    which is what makes the two paths produce the same candidate set
+    bit-for-bit (the package-wide x64 shim keeps f64 live under jit).
+
+    Shapes are padded to per-pool constants (R = min(MAX_ROWS, pg_num),
+    U = min(MAX_UNDER, n_osd)), so every round of every epoch reuses
+    one compiled program."""
+    to_dev = deviation[underfull]  # [U]
+    in_row = (
+        (sub_up[:, :, None] == underfull[None, None, :])
+        & sub_valid[:, :, None]
+    ).any(axis=1)  # [R, U]
+    row_doms = jnp.where(sub_valid, dom[sub_up], jnp.int64(_DOM_NONE))
+    to_dom = dom[underfull]  # [U]
+    dom_used = (row_doms[:, :, None] == to_dom[None, None, :]).any(axis=1)
+    dom_conflict = dom_used & (to_dom[None, :] != dom[sub_frm][:, None])
+    gain = sub_frm_dev[:, None] - to_dev[None, :]  # [R, U]
+    violates = (sub_frm_dev[:, None] > max_deviation) | (
+        to_dev[None, :] < -max_deviation
+    )
+    ok = (
+        ~in_row
+        & ~dom_conflict
+        & (gain > 1.0)
+        & violates
+        & row_ok[:, None]
+        & u_ok[None, :]
+    )
+    return gain, ok
+
+
+def _score_candidate_moves_vmapped(
+    up_all: np.ndarray,
+    deviation: np.ndarray,
+    dom: np.ndarray,
+    underfull: np.ndarray,
+    max_deviation: float,
+    n_osd: int,
+    stats: UpmapRunStats | None = None,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """One-launch scorer: batches ALL candidate (pg, from, to) triples
+    of a round into a single _score_kernel dispatch, padded to fixed
+    per-pool shapes so rounds never recompile.  The flat candidate
+    stream (order included) is identical to the numpy path's."""
+    valid, up_c, frm, frm_dev, r_sel, underfull = _candidate_rows(
+        up_all, deviation, underfull, n_osd
+    )
+    n_r, n_u = len(r_sel), len(underfull)
+    if n_r == 0 or n_u == 0:
+        return _empty_candidates()
+    r_cap = min(MAX_ROWS, up_all.shape[0])
+    u_cap = min(MAX_UNDER, n_osd)
+
+    def _pad(a: np.ndarray, cap: int, fill) -> np.ndarray:
+        out = np.full((cap,) + a.shape[1:], fill, a.dtype)
+        out[: a.shape[0]] = a
+        return out
+
+    sub_up = _pad(up_c[r_sel].astype(np.int64), r_cap, 0)
+    sub_valid = _pad(valid[r_sel], r_cap, False)
+    sub_frm = _pad(frm[r_sel].astype(np.int64), r_cap, 0)
+    sub_frm_dev = _pad(frm_dev[r_sel], r_cap, 0.0)
+    row_ok = np.zeros(r_cap, bool)
+    row_ok[:n_r] = True
+    under_pad = _pad(underfull.astype(np.int64), u_cap, 0)
+    u_ok = np.zeros(u_cap, bool)
+    u_ok[:n_u] = True
+
+    gain, ok = _score_kernel(
+        sub_up, sub_valid, sub_frm, sub_frm_dev, row_ok,
+        under_pad, u_ok,
+        np.asarray(deviation, np.float64),
+        np.asarray(dom, np.int64),
+        np.float64(max_deviation),
+    )
+    if stats is not None:
+        stats.score_launches += 1
+        stats.candidates_scored += n_r * n_u
+    gain = np.asarray(gain)
+    ok = np.asarray(ok)
+    ri, ui = np.nonzero(ok)  # row-major: same flat order as numpy path
+    return (
+        gain[ri, ui],
+        r_sel[ri].astype(np.int64),
+        sub_frm[ri],
+        under_pad[ui],
     )
 
 
@@ -212,6 +473,8 @@ def calc_pg_upmaps(
     The Incremental is diffed from the final validated trial state, so
     the committed epoch always equals what the optimizer scored.
     """
+    global LAST_RUN_STATS
+    stats = UpmapRunStats()
     inc = Incremental(epoch=m.epoch + 1)
     pool_ids = pools or sorted(m.pools)
     mapping = mapping or OSDMapMapping(m)
@@ -228,6 +491,7 @@ def calc_pg_upmaps(
         cw *= np.asarray(m.osd_weight, np.float64)[:n_osd] / 0x10000
         dom = failure_domains(m.crush, pool.crush_rule, n_osd)
 
+        stats.pools += 1
         mapping.update(pool_id)
         base_counts = mapping.pg_counts_by_osd(pool_id, acting=False)
 
@@ -254,6 +518,8 @@ def calc_pg_upmaps(
                     break
                 # ONE device launch per round re-maps the whole pool
                 # with the trial upmap tables as inputs
+                stats.rounds += 1
+                stats.mapping_launches += 1
                 mapping.update(pool_id)
                 up_all, _, _, _ = mapping._results[pool_id]
                 counts = mapping.pg_counts_by_osd(pool_id, acting=False)
@@ -384,7 +650,8 @@ def calc_pg_upmaps(
                 if len(under) == 0 and gc_removed == 0:
                     break
                 gains, pgs, frms, tos = _score_candidate_moves(
-                    up_all, deviation, dom, under, max_deviation, n_osd
+                    up_all, deviation, dom, under, max_deviation, n_osd,
+                    stats=stats,
                 )
                 if len(gains) == 0 and gc_removed == 0:
                     break
@@ -460,4 +727,5 @@ def calc_pg_upmaps(
                 inc.new_pg_upmap_items[pg] = new
             else:
                 inc.old_pg_upmap_items.append(pg)
+    LAST_RUN_STATS = stats
     return inc

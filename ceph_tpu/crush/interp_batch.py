@@ -470,26 +470,109 @@ def _decided_defaults() -> dict:
     return _defaults_cache
 
 
+_mode_override: str | None = None
+
+
+class _force_kernel_mode:
+    """Internal forcing lever (NOT an env flag): pins ``_kernel_mode``
+    to a literal while the bit-exactness gate runs both sides of its
+    comparison, and while the fused-pipeline differential tests do the
+    same.  Re-entrancy guard for the gate: with the override set, the
+    gate's own placements never consult the gate again."""
+
+    def __init__(self, mode: str | None):
+        self.mode = mode
+
+    def __enter__(self):
+        global _mode_override
+        self.prev = _mode_override
+        _mode_override = self.mode
+        return self
+
+    def __exit__(self, *exc):
+        global _mode_override
+        _mode_override = self.prev
+        return False
+
+
+def _decided_kernel_mode() -> str | None:
+    """The defaults-file rung: accepts the legacy flat string form
+    (applies to every platform) or the per-platform dict form written
+    by ``decide_defaults.py`` (keyed by ``jax.default_backend()`` with
+    an optional ``"default"`` fallback).  None when the file has no
+    opinion for this platform."""
+    decided = _decided_defaults().get("CEPH_TPU_LEVEL_KERNEL")
+    if isinstance(decided, dict):
+        decided = decided.get(jax.default_backend(), decided.get("default"))
+    if decided is None:
+        return None
+    mode = str(decided)
+    return mode if mode in ("0", "1", "level") else "0"
+
+
+def _platform_default_mode() -> str:
+    """Built-in rung of the ladder: the per-level Pallas kernels are
+    the default batch-placement backend on TPU, *gated* on the
+    golden-map bit-exactness probe (``crush/kernel_gate.py``) — the
+    mode flips on only after the kernel path reproduces the scalar
+    interp bit for bit in this process, and any gate failure falls
+    back to the XLA one-hot-matmul path.  Off-TPU the matmul path
+    stays the default (the kernels run there only in interpret mode,
+    which is a correctness vehicle, not a fast path).  The fused
+    whole-descent kernel (mode '1') stays opt-in everywhere: its
+    Mosaic compile was never demonstrated bounded on silicon
+    (ROUND5_NOTES.md)."""
+    if jax.default_backend() != "tpu":
+        return "0"
+    from . import kernel_gate
+
+    return "level" if kernel_gate.gate_passes() else "0"
+
+
 def _kernel_mode() -> str:
     """'1' forces the Pallas level/descent kernels (interpret off-TPU),
     'level' forces the per-level kernels while keeping the fused
     whole-descent kernel OFF (its Mosaic program is ~levels x larger —
     the fallback lever if only the big kernel's on-chip compile is
-    pathological), '0' forces the XLA matmul path.  Built-in default is
-    OFF (opt-in): the kernels are bit-exact in tests, but whole-descent
-    Mosaic compiles exceeded 20 min in local chipless AOT (superlinear
-    in kernel size even with the fanout fori_loop) and were never
-    demonstrated bounded on silicon before the round-3 tunnel wedge —
-    auto-enabling would put the driver's whole bench run at risk.  The
-    flat fused straw2 kernel (CEPH_TPU_FUSED_STRAW2, auto-on) is the
-    proven path.  A committed ``bench/kernel_defaults.json`` (written
-    only from measured on-chip grid data) overrides the built-in; the
-    env flag overrides both."""
+    pathological), '0' forces the XLA matmul path.
+
+    Resolution ladder: env flag (CEPH_TPU_LEVEL_KERNEL) ->
+    ``bench/kernel_defaults.json`` (per-platform dict or legacy flat
+    string, written only from measured probe data by
+    ``decide_defaults.py --write``) -> built-in platform default
+    ('level' on TPU gated on the golden-map bit-exactness probe,
+    '0' elsewhere)."""
+    if _mode_override is not None:
+        return _mode_override
     env = os.environ.get("CEPH_TPU_LEVEL_KERNEL")
     if env is not None:
         return env
-    mode = str(_decided_defaults().get("CEPH_TPU_LEVEL_KERNEL", "0"))
-    return mode if mode in ("0", "1", "level") else "0"
+    decided = _decided_kernel_mode()
+    if decided is not None:
+        return decided
+    return _platform_default_mode()
+
+
+def kernel_mode_resolved() -> dict:
+    """Resolved mode plus its provenance, for bench JSON lines: which
+    rung of the ladder decided, and (when the gate was consulted) the
+    gate's verdict detail."""
+    if _mode_override is not None:
+        return {"kernel_mode": _mode_override, "kernel_mode_source": "forced"}
+    env = os.environ.get("CEPH_TPU_LEVEL_KERNEL")
+    if env is not None:
+        return {"kernel_mode": env, "kernel_mode_source": "env"}
+    decided = _decided_kernel_mode()
+    if decided is not None:
+        return {"kernel_mode": decided, "kernel_mode_source": "defaults_file"}
+    mode = _platform_default_mode()
+    out = {"kernel_mode": mode, "kernel_mode_source": "builtin"}
+    if jax.default_backend() == "tpu":
+        from . import kernel_gate
+
+        out["kernel_mode_source"] = "gate"
+        out["kernel_gate"] = kernel_gate.gate_detail()
+    return out
 
 
 def _whole_descent_on() -> bool:

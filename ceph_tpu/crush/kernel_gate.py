@@ -1,0 +1,105 @@
+"""Bit-exactness gate for the Pallas level-kernel straw2 default.
+
+The level kernels (``core/pallas_straw2.py``) have been bit-exact in
+tests since round 3, but they only become the *default* batch-placement
+backend on a platform after this gate re-proves that equivalence in the
+running process: the same golden map shapes the non-regression archive
+pins (``testing/nonregression.crush_cases``) are placed once through
+the scalar ``vmap`` interpreter (:mod:`ceph_tpu.crush.interp` — itself
+differentially tested against the in-repo C++ reference) and once
+through the level-kernel path, and the results must match bit for bit.
+
+Any divergence — or any failure to build/compile/run the kernels at
+all (no Mosaic support, interpret-mode breakage, out-of-bounds level
+shapes) — resolves the gate to False and ``interp_batch._kernel_mode``
+falls back to the XLA one-hot-matmul path.  The gate therefore encodes
+the ladder's safety property: the default can *flip on* only on a
+platform where the kernel path just demonstrated reference semantics,
+and flips itself back off on any platform where it cannot.
+
+The verdict is memoized per backend for the process lifetime; benches
+surface it through ``interp_batch.kernel_mode_resolved()``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+#: seeds per golden map — enough to exercise retries/collisions on the
+#: weighted and hierarchical shapes, small enough that the one-time
+#: probe costs a handful of tiny compiles
+GATE_SEEDS = 512
+
+_GATE_CACHE: dict[str, bool] = {}
+_GATE_DETAIL: dict[str, str] = {}
+
+
+def golden_maps() -> dict:
+    """The archive trio: flat, weighted-flat (uneven straw2 draws), and
+    rack/host/osd (chooseleaf descent) — same builders the golden
+    archive digests were generated from."""
+    from ceph_tpu.models.clusters import build_flat, build_hierarchy
+
+    weighted = build_flat(7)
+    root = weighted.bucket_by_name("default")
+    for i, osd in enumerate(root.items):
+        weighted.adjust_item_weight(root.id, osd, 0x8000 + i * 0x4000)
+    return {
+        "flat_16": build_flat(16),
+        "flat_7_weighted": weighted,
+        "rack_host_osd": build_hierarchy([("rack", 2), ("host", 4)], 4),
+    }
+
+
+def check_bit_exact(n_seeds: int = GATE_SEEDS, mode: str = "level") -> None:
+    """Raise unless the kernel path for ``mode`` ('level' per-level
+    kernels, '1' fused whole-descent) reproduces the scalar interp bit
+    for bit on every golden map (results AND lens)."""
+    from . import interp, interp_batch
+
+    runs = []
+    for name, m in golden_maps().items():
+        rule = m.rule_by_name("replicated_rule")
+        dense = m.to_dense()
+        xs = np.arange(n_seeds, dtype=np.uint32)
+        w = np.full(dense.max_devices, 0x10000, np.uint32)
+        smap = interp.StaticCrushMap(dense)
+        ref = interp.batch_do_rule(smap, rule, xs, w, 3)
+        with interp_batch._force_kernel_mode(mode):
+            got = interp_batch.batch_do_rule_fast(dense, rule, xs, w, 3)
+        runs.append((name, ref, got))
+    # device sync once, after every program has been dispatched
+    for name, (ref_res, ref_len), (got_res, got_len) in runs:
+        if not (
+            np.array_equal(np.asarray(ref_res), np.asarray(got_res))
+            and np.array_equal(np.asarray(ref_len), np.asarray(got_len))
+        ):
+            raise AssertionError(
+                f"kernel mode {mode!r} diverges from scalar interp on {name}"
+            )
+
+
+def gate_passes() -> bool:
+    """Memoized per-backend verdict: may the level kernels be the
+    built-in default here?  Never raises."""
+    backend = jax.default_backend()
+    hit = _GATE_CACHE.get(backend)
+    if hit is None:
+        try:
+            check_bit_exact()
+            hit, detail = True, "bit-exact on golden maps"
+        except Exception as e:  # noqa: BLE001 — any failure means "fall back"
+            hit, detail = False, f"{type(e).__name__}: {e}"
+        _GATE_CACHE[backend] = hit
+        _GATE_DETAIL[backend] = detail
+    return hit
+
+
+def gate_detail() -> str:
+    """Human-readable verdict provenance for bench JSON lines."""
+    backend = jax.default_backend()
+    if backend not in _GATE_CACHE:
+        return "not probed"
+    return _GATE_DETAIL[backend]

@@ -161,6 +161,44 @@ def _compact_left(row, valid):
 _POOL_FN_CACHE: dict = {}
 
 
+def pool_program_key(dense, pool: Pool, rule) -> tuple:
+    """Hashable static signature of one pool's mapping program: the
+    CRUSH runner signature plus every pool constant baked in at trace
+    time.  Equal keys share one compiled executable — this is also the
+    fused placement→peering pipeline's cache key
+    (:mod:`ceph_tpu.recovery.pipeline`), so incremental map epochs that
+    only change traced state reuse the lowered program."""
+    return (
+        runner_signature(dense, rule, pool.size),
+        pool.id,
+        pool.size,
+        pool.pgp_num,
+        pool.hashpspool,
+        pool.can_shift_osds(),
+    )
+
+
+def make_seeds(pool: Pool):
+    """PG index -> (ps, pps) seed derivation for one pool (the
+    reference's ``raw_pg_to_pps``), as a traceable closure over the
+    pool constants."""
+    pool_id = np.uint32(pool.id)
+    pgp_num = np.uint32(pool.pgp_num)
+    pgp_mask = np.uint32(pool.pgp_num_mask)
+    hashpspool = pool.hashpspool
+
+    def seeds(pg_indices):
+        ps = jnp.asarray(pg_indices, U32)
+        folded = ceph_stable_mod(ps, pgp_num, pgp_mask)
+        if hashpspool:
+            pps = crush_hash32_2(folded, pool_id)
+        else:
+            pps = folded + pool_id
+        return ps, pps
+
+    return seeds
+
+
 def compile_pool_mapping(dense, pool: Pool, rule):
     """Build the pool mapping program; returns ``(crush_arg, fn)`` with
     ``fn(crush_arg, state, pg_indices) -> (up, up_primary, acting,
@@ -181,22 +219,50 @@ def compile_pool_mapping(dense, pool: Pool, rule):
     memoized process-wide — tracing costs seconds, so equal-signature
     calls must not re-trace.
     """
-    key = (
-        runner_signature(dense, rule, pool.size),
-        pool.id,
-        pool.size,
-        pool.pgp_num,
-        pool.hashpspool,
-        pool.can_shift_osds(),
-    )
+    key = pool_program_key(dense, pool, rule)
     crush_arg, crush_fn = make_batch_runner(dense, rule, pool.size)
     cached = _POOL_FN_CACHE.get(key)
     if cached is not None:
         return crush_arg, cached
+    post_one = make_post_one(pool)
+    seeds = make_seeds(pool)
+
+    if key[0][0] == "host":
+        # exact C++ tier (legacy bucket algs / overflowing chained
+        # chooses): the CRUSH stage is a host ctypes call and cannot be
+        # traced — run it eagerly, jit only the post-processing
+        @jax.jit
+        def post_fn(state, ps, pps, raw):
+            return jax.vmap(
+                lambda ps_, pps_, raw_: post_one(state, ps_, pps_, raw_)
+            )(ps, pps, raw)
+
+        def fn(crush_arg, state: PoolMapState, pg_indices):
+            ps, pps = seeds(pg_indices)
+            raw, _raw_len = crush_fn(crush_arg, state.osd_weight, pps)
+            return post_fn(state, ps, pps, raw)
+    else:
+        @jax.jit
+        def fn(crush_arg, state: PoolMapState, pg_indices):
+            ps, pps = seeds(pg_indices)
+            raw, _raw_len = crush_fn(crush_arg, state.osd_weight, pps)
+            return jax.vmap(
+                lambda ps_, pps_, raw_: post_one(state, ps_, pps_, raw_)
+            )(ps, pps, raw)
+
+    _memo_put(_POOL_FN_CACHE, key, fn)
+    return crush_arg, fn
+
+
+def make_post_one(pool: Pool):
+    """Build the per-PG post-CRUSH stage for one pool: ``post_one(state,
+    ps, pps, raw) -> (up, up_primary, acting, acting_primary)`` — the
+    reference's ``_apply_upmap -> _raw_to_up_osds -> _pick_primary ->
+    _apply_primary_affinity -> _get_temp_osds`` chain as a traceable
+    closure over the pool constants, shared by the staged pool-mapping
+    program above and the fused placement→peering pipeline
+    (:mod:`ceph_tpu.recovery.pipeline`)."""
     size = pool.size
-    pool_id = np.uint32(pool.id)
-    pgp_num = np.uint32(pool.pgp_num)
-    pgp_mask = np.uint32(pool.pgp_num_mask)
     shift = pool.can_shift_osds()
 
     def in_range(o, n_osd):
@@ -301,40 +367,7 @@ def compile_pool_mapping(dense, pool: Pool, rule):
         acting = jnp.where(has_temp, temp, up)
         return up, up_primary, acting, acting_primary
 
-    def seeds(pg_indices):
-        ps = jnp.asarray(pg_indices, U32)
-        folded = ceph_stable_mod(ps, pgp_num, pgp_mask)
-        if pool.hashpspool:
-            pps = crush_hash32_2(folded, pool_id)
-        else:
-            pps = folded + pool_id
-        return ps, pps
-
-    if key[0][0] == "host":
-        # exact C++ tier (legacy bucket algs / overflowing chained
-        # chooses): the CRUSH stage is a host ctypes call and cannot be
-        # traced — run it eagerly, jit only the post-processing
-        @jax.jit
-        def post_fn(state, ps, pps, raw):
-            return jax.vmap(
-                lambda ps_, pps_, raw_: post_one(state, ps_, pps_, raw_)
-            )(ps, pps, raw)
-
-        def fn(crush_arg, state: PoolMapState, pg_indices):
-            ps, pps = seeds(pg_indices)
-            raw, _raw_len = crush_fn(crush_arg, state.osd_weight, pps)
-            return post_fn(state, ps, pps, raw)
-    else:
-        @jax.jit
-        def fn(crush_arg, state: PoolMapState, pg_indices):
-            ps, pps = seeds(pg_indices)
-            raw, _raw_len = crush_fn(crush_arg, state.osd_weight, pps)
-            return jax.vmap(
-                lambda ps_, pps_, raw_: post_one(state, ps_, pps_, raw_)
-            )(ps, pps, raw)
-
-    _memo_put(_POOL_FN_CACHE, key, fn)
-    return crush_arg, fn
+    return post_one
 
 
 class OSDMapMapping:

@@ -54,6 +54,7 @@ from ..osdmap.mapping import (
     build_pool_state,
     compile_pool_mapping,
 )
+from . import pipeline
 
 PG_STATE_CLEAN = 1
 PG_STATE_REMAPPED = 2
@@ -82,13 +83,15 @@ I32 = jnp.int32
 U32 = jnp.uint32
 
 
-@jax.jit
-def _classify(prev_acting, up, acting, min_size):
+def classify_rows(prev_acting, up, acting, min_size):
     """Per-PG state flags + survivor bitmask, vmapped over the pool.
 
     All inputs are [pg_num, size] i32 (ITEM_NONE holes) except
     ``min_size`` (scalar).  Returns (flags [pg] i32, survivor_mask [pg]
-    u32, n_alive [pg] i32).
+    u32, n_alive [pg] i32).  Traceable body shared by the staged
+    ``_classify`` launch below and the fused placement→peering program
+    (:mod:`ceph_tpu.recovery.pipeline`), so both paths classify with
+    literally the same code.
     """
     size = acting.shape[1]
 
@@ -126,9 +129,19 @@ def _classify(prev_acting, up, acting, min_size):
     return jax.vmap(one)(prev_acting, up, acting)
 
 
+_classify = jax.jit(classify_rows)
+
+
 @dataclass
 class PeeringResult:
-    """One pool's whole-cluster peering pass output (host arrays)."""
+    """One pool's whole-cluster peering pass output (host arrays).
+
+    When produced by the fused pipeline, the classifier outputs the
+    traffic router consumes every step also ride along as device-
+    resident arrays (``dev_*``) so :meth:`ceph_tpu.workload.traffic
+    .TrafficEngine.observe` can feed its compiled step without a
+    host→device upload; host-array consumers are unaffected.
+    """
 
     pool_id: int
     epoch_prev: int
@@ -143,6 +156,10 @@ class PeeringResult:
     flags: np.ndarray  # [pg] i32 (PG_STATE_* bits)
     survivor_mask: np.ndarray  # [pg] u32 (bit s = shard s data survived)
     n_alive: np.ndarray  # [pg] i32
+    # device-resident twins of the router inputs (fused pipeline only)
+    dev_survivor_mask: object = None  # [pg] u32 jax array | None
+    dev_n_alive: object = None  # [pg] i32 jax array | None
+    dev_acting_primary: object = None  # [pg] i32 jax array | None
 
     @property
     def pg_num(self) -> int:
@@ -197,6 +214,14 @@ class PeeringEngine:
     for two :class:`PoolMapState` epochs and classifies the diff.  All
     dynamic state is traced, so any number of trial epochs (the fault
     injector's output, balancer what-ifs) reuse the same executable.
+
+    By default the whole pass is ONE launch — the fused
+    placement→peering program of :mod:`ceph_tpu.recovery.pipeline`
+    (cached per CRUSH-program signature, so incremental epochs reuse
+    the lowered executable).  Maps routed to the host C++ CRUSH tier,
+    or runs under ``CEPH_TPU_FUSED_PIPELINE=0``, use the staged
+    three-launch path (:meth:`run_staged`) instead; both are
+    bit-identical (tests/test_fused_pipeline.py).
     """
 
     def __init__(self, m: OSDMap, pool_id: int):
@@ -206,6 +231,9 @@ class PeeringEngine:
         dense = m.crush.to_dense(choose_args=choose_args)
         rule = m.crush.rules[self.pool.crush_rule]
         self._crush_arg, self._fn = compile_pool_mapping(
+            dense, self.pool, rule
+        )
+        _fused_arg, self._fused = pipeline.compile_fused_peering(
             dense, self.pool, rule
         )
         self._pgs = jnp.arange(self.pool.pg_num, dtype=jnp.uint32)
@@ -253,6 +281,42 @@ class PeeringEngine:
         self, state_prev: PoolMapState, state_cur: PoolMapState,
         epoch_prev: int = 0, epoch_cur: int = 0,
     ) -> PeeringResult:
+        if self._fused is None:
+            return self.run_staged(
+                state_prev, state_cur,
+                epoch_prev=epoch_prev, epoch_cur=epoch_cur,
+            )
+        (up, upp, act, actp, pact, flags, mask, n_alive) = self._fused(
+            self._crush_arg, state_prev, state_cur, self._pgs,
+            jnp.int32(self.pool.min_size),
+        )
+        jax.block_until_ready(flags)
+        return PeeringResult(
+            pool_id=self.pool.id,
+            epoch_prev=epoch_prev,
+            epoch_cur=epoch_cur,
+            size=self.pool.size,
+            min_size=self.pool.min_size,
+            up=np.asarray(up),
+            up_primary=np.asarray(upp),
+            acting=np.asarray(act),
+            acting_primary=np.asarray(actp),
+            prev_acting=np.asarray(pact),
+            flags=np.asarray(flags),
+            survivor_mask=np.asarray(mask, dtype=np.uint32),
+            n_alive=np.asarray(n_alive),
+            dev_survivor_mask=mask,
+            dev_n_alive=n_alive,
+            dev_acting_primary=actp,
+        )
+
+    def run_staged(
+        self, state_prev: PoolMapState, state_cur: PoolMapState,
+        epoch_prev: int = 0, epoch_cur: int = 0,
+    ) -> PeeringResult:
+        """The pre-fusion three-launch pass (map prev, map cur,
+        classify) — the host-CRUSH-tier path, and the differential
+        reference the fused program is pinned against."""
         _pup, _pupp, pact, _pactp = self.map_epoch(state_prev)
         up, upp, act, actp = self.map_epoch(state_cur)
         flags, mask, n_alive = _classify(

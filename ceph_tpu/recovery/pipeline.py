@@ -1,0 +1,155 @@
+"""Fused placement→peering pipeline: one launch from PG seeds to flags.
+
+The staged peering pass (:meth:`ceph_tpu.recovery.peering.PeeringEngine
+.run_staged`) is three separately-launched programs — map the previous
+epoch, map the current epoch, classify the diff — so two full
+[pg_num, size] placement tables round-trip through HBM (and a host
+sync) between stages, and the previous epoch's up/up_primary outputs
+are materialized only to be thrown away.  Here the whole chain —
+pps seeds → CRUSH → upmap/up-set/primary/temp post-processing for BOTH
+epochs → state flags + survivor bitmask — is a single jitted program:
+the placement intermediates stay inside one XLA computation (the dead
+prev-epoch outputs are eliminated entirely), and downstream consumers
+(the traffic engine's router) can take the classifier outputs as
+device-resident arrays without a host round-trip.
+
+Compiled pipelines are memoized in a :class:`PipelineCache` (the
+PR-7 ``ScheduleCache`` pattern applied to placement): the key is
+:func:`ceph_tpu.osdmap.mapping.pool_program_key` — CRUSH program
+signature + pool constants — so incremental map epochs, which only
+change *traced* state (weights, up bits, upmap tables), hash to the
+same entry and reuse the lowered program.  Hit/miss counters make the
+reuse observable to tests and benches.
+
+The host C++ CRUSH tier cannot be traced, so maps that route there
+keep the staged path (:func:`compile_fused_peering` returns ``None``
+and :class:`~ceph_tpu.recovery.peering.PeeringEngine` falls back);
+``CEPH_TPU_FUSED_PIPELINE=0`` forces the staged path everywhere (the
+differential-test lever).
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+
+import jax
+
+from ..crush.engine import make_batch_runner
+from ..osdmap.mapping import (
+    PoolMapState,
+    make_post_one,
+    make_seeds,
+    pool_program_key,
+)
+
+
+def fused_pipeline_enabled() -> bool:
+    """Whether peering may use the fused single-launch pipeline at all
+    (``CEPH_TPU_FUSED_PIPELINE=0`` pins the staged three-launch path)."""
+    return os.environ.get("CEPH_TPU_FUSED_PIPELINE", "1") != "0"
+
+
+class PipelineCache:
+    """Compiled fused-pipeline cache, one entry per (CRUSH program
+    signature, pool constants) — equal-key epochs reuse one lowered
+    program.  ``max_entries`` bounds the LRU (0 = unbounded): a chaos
+    timeline that churns crush topology visits many signatures and must
+    not grow device executables without limit."""
+
+    def __init__(self, max_entries: int = 0):
+        self.max_entries = int(max_entries)
+        self._entries: OrderedDict = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def get(self, key, build):
+        """Fetch the pipeline for ``key``, building (and counting) once;
+        refreshes the key's LRU position and evicts past the bound."""
+        fn = self._entries.get(key)
+        if fn is not None:
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return fn
+        self.misses += 1
+        fn = self._entries[key] = build()
+        if self.max_entries > 0:
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+        return fn
+
+    def stats(self) -> dict:
+        return {
+            "entries": len(self._entries),
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+        }
+
+
+#: process-wide cache (the ScheduleCache analog for placement programs)
+PIPELINES = PipelineCache()
+
+
+def compile_fused_peering(dense, pool, rule, cache: PipelineCache | None = None):
+    """Build (or fetch) the fused peering program for one pool.
+
+    Returns ``(crush_arg, fn)`` with ``fn(crush_arg, state_prev,
+    state_cur, pg_indices, min_size) -> (up, up_primary, acting,
+    acting_primary, prev_acting, flags, survivor_mask, n_alive)`` —
+    every output for the CURRENT epoch plus the previous epoch's acting
+    table, all device arrays from one launch.  Returns ``(None, None)``
+    when the map routes to the host C++ CRUSH tier (an eager ctypes
+    call cannot live inside a traced program) or the fused pipeline is
+    disabled — callers fall back to the staged path.
+    """
+    if not fused_pipeline_enabled():
+        return None, None
+    cache = PIPELINES if cache is None else cache
+    key = pool_program_key(dense, pool, rule)
+    if key[0][0] == "host":
+        return None, None
+    crush_arg, crush_fn = make_batch_runner(dense, rule, pool.size)
+
+    def build():
+        # deferred import: peering imports this module at the top level
+        from .peering import classify_rows
+
+        post_one = make_post_one(pool)
+        seeds = make_seeds(pool)
+
+        @jax.jit
+        def fused(
+            crush_arg,
+            state_prev: PoolMapState,
+            state_cur: PoolMapState,
+            pg_indices,
+            min_size,
+        ):
+            ps, pps = seeds(pg_indices)
+
+            def epoch(state):
+                raw, _raw_len = crush_fn(crush_arg, state.osd_weight, pps)
+                return jax.vmap(
+                    lambda ps_, pps_, raw_: post_one(state, ps_, pps_, raw_)
+                )(ps, pps, raw)
+
+            # the previous epoch contributes ONLY its acting table; the
+            # unused up/primaries are dead inside this one program and
+            # XLA eliminates them instead of materializing them to HBM
+            _pup, _pupp, prev_acting, _pactp = epoch(state_prev)
+            up, up_primary, acting, acting_primary = epoch(state_cur)
+            flags, survivor_mask, n_alive = classify_rows(
+                prev_acting, up, acting, min_size
+            )
+            return (up, up_primary, acting, acting_primary,
+                    prev_acting, flags, survivor_mask, n_alive)
+
+        return fused
+
+    return crush_arg, cache.get(key, build)

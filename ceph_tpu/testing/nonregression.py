@@ -121,6 +121,10 @@ def compile_once_cases() -> dict[str, dict]:
       update (:func:`ceph_tpu.recovery.liveness.heartbeat_step`) across
       suppression-mask, clock, and policy-knob changes — every knob is
       a traced scalar, so a whole chaos run of ticks is one compile.
+    - ``fused_placement``: the single-launch placement→peering program
+      (:mod:`ceph_tpu.recovery.pipeline`) across a down-OSD/reweight
+      epoch — the chaos timeline's per-epoch cost must stay one cached
+      executable, zero recompiles.
 
     Raises ``AssertionError`` (from
     :func:`ceph_tpu.analysis.runtime_guard.assert_no_recompile`) if
@@ -299,6 +303,26 @@ def compile_once_cases() -> dict[str, dict]:
     assert det.osds_down >= 1, det.summary()
     report["heartbeat_tick"] = {
         "warm_compiles": warm_h.n_compiles, "second_compiles": 0,
+    }
+
+    # ---- fused placement→peering: run -> down OSD -> run ---------------
+    from ..osdmap.mapping import build_pool_state
+    from ..recovery.peering import PeeringEngine
+
+    m_f = build_osdmap(32, pg_num=16)
+    eng = PeeringEngine(m_f, 1)
+    state_a = build_pool_state(m_f, m_f.pools[1])
+    with CompileCounter() as warm_p:
+        eng.run(state_a, state_a)
+    # value-only epoch change: an OSD drops, weights shift — every
+    # changed bit is a traced input of the one fused program
+    m_f.mark_down(3)
+    m_f.osd_weight[5] = 0x8000
+    state_b = build_pool_state(m_f, m_f.pools[1])
+    with assert_no_recompile("fused placement second epoch"):
+        eng.run(state_a, state_b)
+    report["fused_placement"] = {
+        "warm_compiles": warm_p.n_compiles, "second_compiles": 0,
     }
     return report
 

@@ -553,10 +553,21 @@ class TrafficEngine:
         salt = np.uint32(
             (self.seed * 2654435761 + self._steps * 40503) & 0xFFFFFFFF
         )
+        if self.mesh is None and peering.dev_survivor_mask is not None:
+            # fused-pipeline peering: the router inputs are already
+            # device-resident — feed them straight to the compiled step
+            # instead of bouncing the [pg]-wide tables through the host
+            mask_in = peering.dev_survivor_mask
+            alive_in = peering.dev_n_alive
+            prim_in = peering.dev_acting_primary
+        else:
+            mask_in = np.ascontiguousarray(peering.survivor_mask, np.uint32)
+            alive_in = np.ascontiguousarray(peering.n_alive, np.int32)
+            prim_in = np.ascontiguousarray(peering.acting_primary, np.int32)
         args = [
-            np.ascontiguousarray(peering.survivor_mask, np.uint32),
-            np.ascontiguousarray(peering.n_alive, np.int32),
-            np.ascontiguousarray(peering.acting_primary, np.int32),
+            mask_in,
+            alive_in,
+            prim_in,
             salt,
             np.uint32(self.pg_num),
             np.uint32(self.pg_bmask),

@@ -626,3 +626,121 @@ def test_failed_measurement_falls_back_to_pin(tmp_path):
     rate, info = bench.resolve_baseline(0.0, path=p)
     assert rate == 150_000
     assert info["cpu_ref_source"] == "pinned"
+
+
+# --- config3 --vmapped / config1 provenance JSON schemas (fused PR) ---
+
+_CONFIG3 = os.path.join(os.path.dirname(_BENCH), "bench", "config3_upmap.py")
+_spec3 = importlib.util.spec_from_file_location("bench_config3", _CONFIG3)
+config3 = importlib.util.module_from_spec(_spec3)
+_spec3.loader.exec_module(config3)
+
+_CONFIG1 = os.path.join(os.path.dirname(_BENCH), "bench", "config1_crush.py")
+_spec1 = importlib.util.spec_from_file_location("bench_config1", _CONFIG1)
+config1 = importlib.util.module_from_spec(_spec1)
+_spec1.loader.exec_module(config1)
+
+_OPTIMIZER = {
+    "pg_num": 10_240, "rounds": 3, "entries": 120, "removals": 2,
+    "final_upmap_pgs": 118, "final_upmap_pairs": 130, "seconds": 4.2,
+    "final_max_deviation": 0.9, "target_max_deviation": 1.0,
+    "converged": True,
+}
+
+_UPMAP_STATS = {
+    "rounds": 5, "mapping_launches": 5, "score_launches": 5,
+    "np_score_calls": 0, "candidates_scored": 250_000, "pools": 1,
+    "launches_per_round": 2.0,
+}
+
+
+def test_upmap_record_schema_vmapped():
+    import json
+
+    rec = config3.build_upmap_record(
+        "tpu", 4_000_000.0, 6, 6, 0, _OPTIMIZER, _UPMAP_STATS, 4.2, True,
+    )
+    assert rec["metric"] == "bulk_pg_remap_per_sec"
+    assert rec["value"] == 4_000_000 and rec["unit"] == "pg_mappings/s"
+    assert rec["platform"] == "tpu"
+    assert rec["vmapped_upmap"] is True
+    # the acceptance-bar headline: one mapping + one scoring launch per
+    # optimization round, well under the <= 5 bar
+    assert rec["launches_per_round"] == 2.0 <= 5
+    assert rec["candidate_evals_per_sec"] == round(250_000 / 4.2)
+    assert rec["candidates_scored"] == 250_000
+    assert rec["score_launches"] == 5
+    assert rec["optimizer"]["converged"] is True
+    json.dumps(rec)
+
+
+def test_upmap_record_schema_numpy_reference():
+    rec = config3.build_upmap_record(
+        "cpu", 1_000_000.0, 6, 6, 0, _OPTIMIZER,
+        {**_UPMAP_STATS, "score_launches": 0, "np_score_calls": 5,
+         "launches_per_round": 1.0},
+        0.0, False,
+    )
+    assert rec["vmapped_upmap"] is False
+    assert rec["score_launches"] == 0
+    assert rec["candidate_evals_per_sec"] == 0  # zero elapsed: no rate
+
+
+def test_upmap_record_harvested_by_decide_defaults(tmp_path):
+    import json
+
+    rec = config3.build_upmap_record(
+        "tpu", 4_000_000.0, 6, 6, 0, _OPTIMIZER, _UPMAP_STATS, 4.2, True,
+    )
+    p = tmp_path / "session.log"
+    p.write_text(json.dumps(rec) + "\n")
+    _DD = os.path.join(os.path.dirname(_BENCH), "bench", "decide_defaults.py")
+    _sdd = importlib.util.spec_from_file_location("bench_dd_upmap", _DD)
+    dd = importlib.util.module_from_spec(_sdd)
+    _sdd.loader.exec_module(dd)
+    g = dd.harvest_guard([str(p)])["bulk_pg_remap_per_sec"]
+    assert g["launches_per_round"] == 2.0
+    assert g["candidate_evals_per_sec"] == round(250_000 / 4.2)
+    assert g["candidates_scored"] == 250_000
+    assert g["score_launches"] == 5
+    assert g["vmapped_upmap"] is True
+    assert g["steady_state_clean"] is True
+
+
+def test_crush_record_schema_carries_provenance():
+    import json
+
+    resolved = {"kernel_mode": "level", "kernel_mode_source": "gate",
+                "kernel_gate": "bit-exact on golden maps"}
+    rec = config1.build_crush_record(
+        "tpu", 50_123_456.7, 156_000.0, 3, 3, 1, resolved, True,
+    )
+    assert rec["metric"] == "crush_placements_per_sec"
+    assert rec["value"] == 50_123_457
+    assert rec["vs_baseline"] == round(50_123_456.7 / 156_000.0, 2)
+    assert rec["kernel_mode"] == "level"
+    assert rec["kernel_mode_source"] == "gate"
+    assert rec["kernel_gate"] == "bit-exact on golden maps"
+    assert rec["fused_pipeline"] is True
+    json.dumps(rec)
+
+
+def test_crush_record_provenance_harvested_by_decide_defaults(tmp_path):
+    import json
+
+    resolved = {"kernel_mode": "0", "kernel_mode_source": "defaults_file"}
+    rec = config1.build_crush_record(
+        "tpu", 50_000_000.0, 0.0, 3, 3, 1, resolved, False,
+    )
+    assert rec["vs_baseline"] is None  # no cpu reference: no ratio
+    p = tmp_path / "session.log"
+    p.write_text(json.dumps(rec) + "\n")
+    _DD = os.path.join(os.path.dirname(_BENCH), "bench", "decide_defaults.py")
+    _sdd = importlib.util.spec_from_file_location("bench_dd_crush", _DD)
+    dd = importlib.util.module_from_spec(_sdd)
+    _sdd.loader.exec_module(dd)
+    g = dd.harvest_guard([str(p)])["crush_placements_per_sec"]
+    assert g["kernel_mode"] == "0"
+    assert g["kernel_mode_source"] == "defaults_file"
+    assert "kernel_gate" not in g  # only present when the gate decided
+    assert g["fused_pipeline"] is False

@@ -431,19 +431,26 @@ def test_write_defaults_roundtrip_and_engine_pickup(tmp_path, monkeypatch):
     out = tmp_path / "kernel_defaults.json"
     dd.write_defaults(decision, path=str(out))
     d = json.loads(out.read_text())
-    assert d["CEPH_TPU_LEVEL_KERNEL"] == "1"
+    # per-platform form: TPU evidence flips TPU only, everything else
+    # keeps the XLA matmul path
+    assert d["CEPH_TPU_LEVEL_KERNEL"] == {"tpu": "1", "default": "0"}
     assert d["CEPH_TPU_RETRY_COMPACT"] == "0"
     assert d["winner"] == "kern_full" and d["decided_from"] == [p]
     assert d["timestamp_utc"]
 
-    # engine resolution: committed file beats built-in, env beats file
+    # engine resolution: committed file beats built-in, env beats file;
+    # the per-platform dict resolves through the current backend
     from ceph_tpu.crush import interp_batch as ib
 
     monkeypatch.setattr(ib, "_DEFAULTS_PATH", str(out))
     monkeypatch.setattr(ib, "_defaults_cache", None)
     monkeypatch.delenv("CEPH_TPU_LEVEL_KERNEL", raising=False)
     monkeypatch.delenv("CEPH_TPU_RETRY_COMPACT", raising=False)
-    assert ib._kernel_mode() == "1"
+    assert ib._kernel_mode() == "0"  # cpu backend -> "default" entry
+    orig_backend = ib.jax.default_backend
+    monkeypatch.setattr(ib.jax, "default_backend", lambda: "tpu")
+    assert ib._kernel_mode() == "1"  # tpu backend -> flipped entry
+    monkeypatch.setattr(ib.jax, "default_backend", orig_backend)
     assert ib._retry_compact() is False
     monkeypatch.setenv("CEPH_TPU_LEVEL_KERNEL", "level")
     assert ib._kernel_mode() == "level"
@@ -482,7 +489,7 @@ def test_write_defaults_merges_with_prior_decision(tmp_path):
     dd.write_defaults(dd.decide(dd.harvest([trim]), [trim]), path=str(out))
     d = json.loads(out.read_text())
     assert d["winner"] == "kern_full"
-    assert d["CEPH_TPU_LEVEL_KERNEL"] == "1"
+    assert d["CEPH_TPU_LEVEL_KERNEL"]["tpu"] == "1"
     assert d["rates"]["fused_straw2"] == 1_800_000  # new data still lands
     assert full in d["decided_from"] and trim in d["decided_from"]
 
@@ -502,7 +509,7 @@ def test_write_defaults_new_winner_beats_prior(tmp_path):
     dd.write_defaults(dd.decide(dd.harvest([new]), [new]), path=str(out))
     d = json.loads(out.read_text())
     assert d["winner"] == "level_kernel_compact"
-    assert d["CEPH_TPU_LEVEL_KERNEL"] == "1"
+    assert d["CEPH_TPU_LEVEL_KERNEL"] == {"tpu": "1", "default": "0"}
     assert d["CEPH_TPU_RETRY_COMPACT"] == "1"
 
 
@@ -546,6 +553,88 @@ def test_write_defaults_refuses_without_winner(tmp_path):
     with pytest.raises(ValueError):
         dd.write_defaults({"metric": "default_decision"}, path=str(
             tmp_path / "x.json"))
+
+
+def test_bitexact_failed_rate_never_counts(tmp_path):
+    """A kernel variant that failed the golden-map bit-exactness probe
+    contributes no rate: whatever it measured, it can never win."""
+    p = _log(tmp_path, [
+        {"metric": "level_kernel_probe", "platform": "tpu",
+         "fused_straw2_rate_per_sec": 1_800_000, "fused_straw2_ok": True,
+         "level_kernel_rate_per_sec": 90_000_000, "level_kernel_ok": True,
+         "level_kernel_bitexact": False,
+         "level_kernel_bitexact_error": "AssertionError: diverges"},
+    ])
+    rates = dd.harvest([p])
+    assert "level_kernel" not in rates
+    out = dd.decide(rates, [p], bitexact=dd.harvest_bitexact([p]))
+    assert out["winner"] == "fused_straw2"
+    assert out["recommend_env"]["CEPH_TPU_LEVEL_KERNEL"] == "0"
+    assert out["bitexact_failed"] == ["level_kernel"]
+
+
+def test_bitexact_passing_variant_still_flips(tmp_path):
+    p = _log(tmp_path, [
+        {"metric": "level_kernel_probe", "platform": "tpu",
+         "fused_straw2_rate_per_sec": 1_800_000, "fused_straw2_ok": True,
+         "level_only_rate_per_sec": 9_000_000, "level_only_ok": True,
+         "level_only_bitexact": True},
+    ])
+    out = dd.decide(dd.harvest([p]), [p], bitexact=dd.harvest_bitexact([p]))
+    assert out["winner"] == "level_only"
+    assert out["recommend_env"]["CEPH_TPU_LEVEL_KERNEL"] == "level"
+    assert "bitexact_failed" not in out
+
+
+def test_bitexact_quarantines_prior_rates(tmp_path):
+    """A variant that diverged TODAY must not stay the default on the
+    strength of a PRIOR session's rate: write_defaults re-decides over
+    the merged rates with the quarantine applied."""
+    out = tmp_path / "kernel_defaults.json"
+    old = _log(tmp_path, [
+        {"metric": "level_kernel_probe", "platform": "tpu",
+         "fused_straw2_rate_per_sec": 1_800_000, "fused_straw2_ok": True,
+         "level_kernel_rate_per_sec": 12_000_000, "level_kernel_ok": True,
+         "level_kernel_bitexact": True},
+    ])
+    dd.write_defaults(
+        dd.decide(dd.harvest([old]), [old],
+                  bitexact=dd.harvest_bitexact([old])), path=str(out))
+    assert json.loads(out.read_text())["winner"] == "level_kernel"
+    new = _log(tmp_path, [
+        {"metric": "level_kernel_probe", "platform": "tpu",
+         "fused_straw2_rate_per_sec": 1_900_000, "fused_straw2_ok": True,
+         "level_kernel_rate_per_sec": 12_000_000, "level_kernel_ok": True,
+         "level_kernel_bitexact": False},
+    ])
+    dd.write_defaults(
+        dd.decide(dd.harvest([new]), [new],
+                  bitexact=dd.harvest_bitexact([new])), path=str(out))
+    d = json.loads(out.read_text())
+    assert d["winner"] == "fused_straw2"
+    assert d["CEPH_TPU_LEVEL_KERNEL"] == {"tpu": "0", "default": "0"}
+    assert d["bitexact_failed"] == ["level_kernel"]
+    assert "level_kernel" not in d["rates"]
+
+
+def test_bitexact_quarantine_of_everything_refuses_write(tmp_path):
+    import pytest
+
+    p = _log(tmp_path, [
+        {"metric": "level_kernel_probe", "platform": "tpu",
+         "level_kernel_rate_per_sec": 12_000_000, "level_kernel_ok": True,
+         "level_kernel_bitexact": False},
+    ])
+    decision = dd.decide(dd.harvest([p]), [p],
+                         bitexact=dd.harvest_bitexact([p]))
+    assert "winner" not in decision
+    with pytest.raises(ValueError):
+        dd.write_defaults(decision, path=str(tmp_path / "x.json"))
+
+
+def test_kernel_tags_cover_all_kernel_modes():
+    assert dd.KERNEL_TAGS == {
+        "level_only", "level_kernel", "level_kernel_compact", "kern_full"}
 
 
 def test_engine_ignores_bogus_defaults_file(tmp_path, monkeypatch):

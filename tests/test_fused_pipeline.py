@@ -1,0 +1,247 @@
+"""Fused placement→peering pipeline vs the staged path, and the
+kernel-mode resolution ladder behind the level-kernel default.
+
+The fused program (ceph_tpu/recovery/pipeline.py) replaces three
+launches with one; these tests pin that it is a pure fusion — every
+output bit-identical to the staged reference on every state feature
+the post-processing chain handles (upmap overrides, pairwise items,
+pg_temp/primary_temp, primary affinity, down OSDs) — and that the
+compiled-pipeline cache actually shares executables across engines.
+"""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+from ceph_tpu.models.clusters import build_osdmap, build_skewed_osdmap
+from ceph_tpu.osdmap.map import PGId
+from ceph_tpu.osdmap.mapping import build_pool_state
+from ceph_tpu.recovery import peering as peering_mod
+from ceph_tpu.recovery import pipeline
+from ceph_tpu.recovery.peering import PeeringEngine
+
+
+def _assert_same(fused, staged):
+    for f in ("up", "up_primary", "acting", "acting_primary",
+              "prev_acting", "flags", "survivor_mask", "n_alive"):
+        np.testing.assert_array_equal(
+            getattr(fused, f), getattr(staged, f), err_msg=f
+        )
+
+
+def _engine_and_states(m_prev, m_cur, pool_id=1):
+    eng = PeeringEngine(m_cur, pool_id)
+    sp = build_pool_state(m_prev, m_prev.pools[pool_id])
+    sc = build_pool_state(m_cur, m_cur.pools[pool_id])
+    return eng, sp, sc
+
+
+def test_fused_equals_staged_basic_down_osd():
+    m = build_osdmap(32, pg_num=64)
+    eng, sp, _ = _engine_and_states(m, m)
+    m.mark_down(3)
+    m.mark_down(17)
+    sc = build_pool_state(m, m.pools[1])
+    fused = eng.run(sp, sc)
+    staged = eng.run_staged(sp, sc)
+    _assert_same(fused, staged)
+    # the fused result additionally carries the device-resident
+    # classifier outputs the traffic router consumes without a
+    # host round-trip
+    assert fused.dev_survivor_mask is not None
+    assert fused.dev_n_alive is not None
+    assert staged.dev_survivor_mask is None
+
+
+def test_fused_equals_staged_full_state_zoo():
+    """Every post-processing feature at once: full pg_upmap overrides,
+    pairwise items, pg_temp + primary_temp, non-default primary
+    affinity, down and reweighted OSDs — the golden-archive state mix
+    of tests/test_osdmap.py, peered across two epochs."""
+    rng = random.Random(7)
+    m = build_osdmap(40, pg_num=64)
+    pool = m.pools[1]
+    for ps in range(0, 64, 5):
+        m.pg_upmap[PGId(1, ps)] = tuple(
+            rng.sample(range(40), pool.size)
+        )
+    for ps in range(1, 64, 7):
+        m.pg_upmap_items[PGId(1, ps)] = ((ps % 40, (ps * 3) % 40),)
+    for ps in range(2, 64, 9):
+        m.pg_temp[PGId(1, ps)] = tuple(rng.sample(range(40), pool.size))
+        m.primary_temp[PGId(1, ps)] = rng.randrange(40)
+    for o in range(0, 40, 3):
+        m.osd_primary_affinity[o] = 0x4000  # 25%
+    sp = build_pool_state(m, pool)
+    m.mark_down(5)
+    m.osd_weight[11] = 0x8000
+    eng = PeeringEngine(m, 1)
+    sc = build_pool_state(m, pool)
+    _assert_same(eng.run(sp, sc), eng.run_staged(sp, sc))
+
+
+def test_fused_equals_staged_weighted_skew():
+    m_prev = build_skewed_osdmap(24, 48, 3, seed=5)
+    m = build_skewed_osdmap(24, 48, 3, seed=5)
+    m.mark_down(2)
+    eng, sp, sc = _engine_and_states(m_prev, m)
+    _assert_same(eng.run(sp, sc), eng.run_staged(sp, sc))
+
+
+def test_pipeline_cache_shares_executables():
+    cache = pipeline.PipelineCache()
+    m = build_osdmap(16, pg_num=16)
+    dense = m.crush.to_dense()
+    rule = m.crush.rules[m.pools[1].crush_rule]
+    _, fn1 = pipeline.compile_fused_peering(dense, m.pools[1], rule, cache)
+    _, fn2 = pipeline.compile_fused_peering(dense, m.pools[1], rule, cache)
+    assert fn1 is fn2
+    assert cache.stats() == {
+        "entries": 1, "hits": 1, "misses": 1, "evictions": 0}
+
+
+def test_pipeline_cache_lru_bound():
+    cache = pipeline.PipelineCache(max_entries=2)
+    for i in range(4):
+        cache.get(("k", i), lambda: object())
+    assert len(cache) == 2
+    assert cache.stats()["evictions"] == 2
+    # refreshing an entry keeps it resident
+    cache.get(("k", 3), lambda: object())
+    cache.get(("k", 9), lambda: object())
+    assert ("k", 3) in cache._entries and ("k", 2) not in cache._entries
+
+
+def test_env_kill_switch_forces_staged(monkeypatch):
+    monkeypatch.setenv("CEPH_TPU_FUSED_PIPELINE", "0")
+    assert not pipeline.fused_pipeline_enabled()
+    m = build_osdmap(16, pg_num=16)
+    eng = PeeringEngine(m, 1)
+    assert eng._fused is None
+    sp = build_pool_state(m, m.pools[1])
+    res = eng.run(sp, sp)  # falls back to the staged path
+    assert res.dev_survivor_mask is None
+    assert (res.flags == peering_mod.PG_STATE_CLEAN).all()
+
+
+# ---------------------------------------------------------------------------
+# kernel-mode resolution ladder (interp_batch) and the bit-exactness gate
+# ---------------------------------------------------------------------------
+
+from ceph_tpu.crush import interp_batch as ib  # noqa: E402
+from ceph_tpu.crush import kernel_gate  # noqa: E402
+
+
+@pytest.fixture
+def clean_ladder(monkeypatch):
+    monkeypatch.delenv("CEPH_TPU_LEVEL_KERNEL", raising=False)
+    monkeypatch.setattr(ib, "_defaults_cache", None)
+    monkeypatch.setattr(ib, "_mode_override", None)
+    yield monkeypatch
+    ib._defaults_cache = None
+
+
+def test_ladder_force_beats_everything(clean_ladder):
+    clean_ladder.setenv("CEPH_TPU_LEVEL_KERNEL", "1")
+    with ib._force_kernel_mode("0"):
+        assert ib._kernel_mode() == "0"
+        assert ib.kernel_mode_resolved()["kernel_mode_source"] == "forced"
+    assert ib._kernel_mode() == "1"
+
+
+def test_ladder_env_beats_defaults_file(clean_ladder, tmp_path):
+    f = tmp_path / "kernel_defaults.json"
+    f.write_text('{"CEPH_TPU_LEVEL_KERNEL": "level"}')
+    clean_ladder.setattr(ib, "_DEFAULTS_PATH", str(f))
+    clean_ladder.setenv("CEPH_TPU_LEVEL_KERNEL", "0")
+    assert ib._kernel_mode() == "0"
+    assert ib.kernel_mode_resolved()["kernel_mode_source"] == "env"
+
+
+def test_ladder_defaults_file_flat_and_per_platform(clean_ladder, tmp_path):
+    f = tmp_path / "kernel_defaults.json"
+    clean_ladder.setattr(ib, "_DEFAULTS_PATH", str(f))
+    # legacy flat string applies to every platform
+    f.write_text('{"CEPH_TPU_LEVEL_KERNEL": "level"}')
+    assert ib._kernel_mode() == "level"
+    assert ib.kernel_mode_resolved()["kernel_mode_source"] == "defaults_file"
+    # per-platform dict resolves through jax.default_backend()
+    ib._defaults_cache = None
+    f.write_text(
+        '{"CEPH_TPU_LEVEL_KERNEL": {"tpu": "level", "default": "0"}}'
+    )
+    assert ib._kernel_mode() == "0"  # tests run on cpu
+    orig = ib.jax.default_backend
+    clean_ladder.setattr(ib.jax, "default_backend", lambda: "tpu")
+    assert ib._kernel_mode() == "level"
+    clean_ladder.setattr(ib.jax, "default_backend", orig)
+    # dict with no entry for this platform -> ladder falls through
+    ib._defaults_cache = None
+    f.write_text('{"CEPH_TPU_LEVEL_KERNEL": {"tpu": "level"}}')
+    assert ib._decided_kernel_mode() is None
+    # garbage value validates to the safe "0"
+    ib._defaults_cache = None
+    f.write_text('{"CEPH_TPU_LEVEL_KERNEL": "yolo"}')
+    assert ib._kernel_mode() == "0"
+
+
+def test_builtin_default_off_tpu_is_matmul(clean_ladder, tmp_path):
+    clean_ladder.setattr(ib, "_DEFAULTS_PATH", str(tmp_path / "absent.json"))
+    assert ib._kernel_mode() == "0"
+    assert ib.kernel_mode_resolved()["kernel_mode_source"] == "builtin"
+
+
+def test_builtin_default_on_tpu_gated_on_bit_exactness(
+    clean_ladder, tmp_path, monkeypatch
+):
+    """On TPU the built-in default is the level kernels IF AND ONLY IF
+    the golden-map gate passes in this process; any gate failure falls
+    back to the XLA matmul path."""
+    clean_ladder.setattr(ib, "_DEFAULTS_PATH", str(tmp_path / "absent.json"))
+    orig = ib.jax.default_backend
+    clean_ladder.setattr(ib.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(kernel_gate, "_GATE_CACHE", {})
+    monkeypatch.setattr(kernel_gate, "_GATE_DETAIL", {})
+    monkeypatch.setattr(
+        kernel_gate, "check_bit_exact", lambda n_seeds=0, mode="level": None
+    )
+    assert ib._kernel_mode() == "level"
+    resolved = ib.kernel_mode_resolved()
+    assert resolved["kernel_mode_source"] == "gate"
+    assert resolved["kernel_gate"] == "bit-exact on golden maps"
+
+    # a diverging kernel (or any probe crash) flips the default OFF
+    def _boom(n_seeds=0, mode="level"):
+        raise AssertionError("kernel diverges on flat_16")
+
+    monkeypatch.setattr(kernel_gate, "_GATE_CACHE", {})
+    monkeypatch.setattr(kernel_gate, "_GATE_DETAIL", {})
+    monkeypatch.setattr(kernel_gate, "check_bit_exact", _boom)
+    assert ib._kernel_mode() == "0"
+    assert "diverges" in ib.kernel_mode_resolved()["kernel_gate"]
+    clean_ladder.setattr(ib.jax, "default_backend", orig)
+
+
+def test_gate_memoizes_per_backend(monkeypatch):
+    calls = []
+    monkeypatch.setattr(kernel_gate, "_GATE_CACHE", {})
+    monkeypatch.setattr(kernel_gate, "_GATE_DETAIL", {})
+    monkeypatch.setattr(
+        kernel_gate, "check_bit_exact",
+        lambda n_seeds=0, mode="level": calls.append(1),
+    )
+    assert kernel_gate.gate_detail() == "not probed"
+    assert kernel_gate.gate_passes() is True
+    assert kernel_gate.gate_passes() is True
+    assert len(calls) == 1  # memoized: one probe per backend per process
+    assert kernel_gate.gate_detail() == "bit-exact on golden maps"
+
+
+@pytest.mark.slow
+def test_gate_end_to_end_bit_exact():
+    """The real gate, end to end: the level-kernel path (interpret mode
+    on CPU) reproduces the scalar interp on the golden trio.  Slow —
+    Pallas interpret mode pays a large per-program overhead."""
+    kernel_gate.check_bit_exact(n_seeds=32)

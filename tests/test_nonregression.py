@@ -43,7 +43,7 @@ def test_hot_paths_compile_once():
     report = nonregression.compile_once_cases()  # raises on recompile
     assert set(report) == {
         "pool_mapping", "pattern_decode", "schedule_decode", "scrub_pass",
-        "heartbeat_tick",
+        "heartbeat_tick", "fused_placement",
     }
     for name, counts in report.items():
         assert counts["warm_compiles"] > 0, (name, counts)
