@@ -55,7 +55,27 @@ def communicate_no_kill(
         # the orphan may already have printed its result before blocking
         # (e.g. measured, then hung in PJRT detach): TimeoutExpired
         # carries the partial output — as bytes even with text=True
-        return _decode(e.stdout), _decode(e.stderr), True
+        out, err = _decode(e.stdout), _decode(e.stderr)
+        _detach(proc)
+        return out, err, True
+
+
+def _detach(proc: subprocess.Popen) -> None:
+    """Escalation-free detach from an orphaned child (BENCH_r05: a
+    wedged TPU-attached pid stayed chained to the parent's pipes).
+
+    Closing our pipe ends means the orphan is never again blocked
+    writing into a full pipe nobody drains (it unblocks into EPIPE and
+    finishes its interpreter exit on its own schedule), and the parent
+    leaks no fds waiting on a child it already gave up on.  No signal
+    is sent — escalating to SIGKILL is exactly the proven tunnel-wedge
+    mechanism this module exists to avoid."""
+    for pipe in (proc.stdin, proc.stdout, proc.stderr):
+        if pipe is not None:
+            try:
+                pipe.close()
+            except OSError:
+                pass
 
 
 def _decode(v) -> str:

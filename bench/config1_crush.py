@@ -32,9 +32,15 @@ def build_crush_record(platform, tpu_rate, cpu_rate, n_compiles,
     defaults-file flip or a gate fallback is visible in the artifact,
     not just in process state.  ``fused_pipeline`` records whether the
     placement→peering fusion was enabled in this process.
+
+    ``status`` is ``"ok"`` for a completed measurement; the run_all
+    harness stamps ``"timeout"`` on records salvaged from a child that
+    hung (BENCH_r05: those used to surface as ``value: 0`` and poison
+    ``decide_defaults``' best-of merge — now typed so harvests skip).
     """
     rec = {
         "metric": "crush_placements_per_sec",
+        "status": "ok",
         "value": round(tpu_rate),
         "unit": "placements/s",
         "vs_baseline": round(tpu_rate / cpu_rate, 2) if cpu_rate else None,

@@ -1,0 +1,151 @@
+"""BASELINE config 7: epoch-loop rate, staged vs compiled superstep.
+
+Drives the same :class:`~ceph_tpu.recovery.superstep.EpochDriver`
+through both of its paths at the 1k-OSD/8k-PG acceptance geometry —
+the staged per-epoch reference (one launch per stage, host syncs
+between stages: today's recovery loop) and the one-launch compiled
+superstep (``lax.scan`` over the event tape, host exits only at
+snapshot boundaries) — and reports epochs/sec for each.  The tape
+carries two ``slow:`` specs so the liveness tick is non-idle every
+epoch (an all-idle tape would flatter the staged path by letting its
+detector skip).  A small-scale bit-equality check over a zoo scenario
+rides along (``epoch_bitequal``): the speedup only counts if the two
+paths still agree.  Emits one JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+N_OSDS = int(os.environ.get("CEPH_TPU_BENCH_EPOCH_OSDS", 1024))
+PG_NUM = int(os.environ.get("CEPH_TPU_BENCH_EPOCH_PGS", 8192))
+N_OPS = int(os.environ.get("CEPH_TPU_BENCH_EPOCH_OPS", 64))
+EPOCHS = int(os.environ.get("CEPH_TPU_BENCH_EPOCHS", 1024))
+STAGED_EPOCHS = int(os.environ.get("CEPH_TPU_BENCH_EPOCHS_STAGED", 128))
+EC_K, EC_M = 4, 2
+#: journal/snapshot chunk — the scan's trip count is a compiled shape,
+#: so warm-up and the timed run must use the SAME chunk size (EPOCHS is
+#: kept a multiple of it)
+CHUNK = 256
+
+
+def build_epoch_record(platform, sup_rate, staged_rate, bitequal,
+                       epochs_measured, n_compiles, n_compiles_first,
+                       host_transfers, superstep_enabled):
+    """One JSON line for the epoch-loop headline.
+
+    ``value`` is the superstep rate; ``vs_baseline`` the
+    superstep/staged speedup.  The typed ``epoch_*`` fields are the
+    ``decide_defaults`` harvest surface — ``epoch_bitequal`` gates the
+    rate (a fast-but-divergent superstep is a bug, not a win), and
+    ``epoch_superstep_enabled`` records the kill-switch state the
+    process measured under.  ``status`` is ``"ok"`` for a completed
+    measurement; the run_all harness stamps ``"timeout"`` on value-less
+    salvage from a hung child so harvests skip it.
+    """
+    return {
+        "metric": "epoch_loop_rate_per_sec",
+        "status": "ok",
+        "value": round(sup_rate),
+        "unit": "epochs/s",
+        "vs_baseline": round(sup_rate / staged_rate, 2)
+        if staged_rate else None,
+        "platform": platform,
+        "epoch_rate_superstep_per_sec": round(sup_rate, 1),
+        "epoch_rate_staged_per_sec": round(staged_rate, 1),
+        "epoch_speedup": round(sup_rate / staged_rate, 2)
+        if staged_rate else 0.0,
+        "epoch_n_osds": int(N_OSDS),
+        "epoch_pg_num": int(PG_NUM),
+        "epoch_n_ops": int(N_OPS),
+        "epoch_epochs_measured": int(epochs_measured),
+        "epoch_bitequal": bool(bitequal),
+        "epoch_superstep_enabled": bool(superstep_enabled),
+        "n_compiles": int(n_compiles),
+        "n_compiles_first": int(n_compiles_first),
+        "host_transfers": int(host_transfers),
+    }
+
+
+def _bitequal_check() -> bool:
+    """Small-scale differential: superstep vs staged over a zoo
+    scenario must agree bit-for-bit (the full zoo lives in
+    tests/test_superstep.py; this is the bench's canary)."""
+    from ceph_tpu.models.clusters import build_osdmap
+    from ceph_tpu.recovery import EpochDriver, build_scenario
+
+    m = build_osdmap(64, pg_num=128, size=6, pool_kind="erasure")
+    timeline = build_scenario("flap", m)
+    d = EpochDriver(m, timeline, n_ops=256)
+    sup = d.run_superstep(40)
+    staged = d.run_staged(40)
+    diff = sup.diff(staged)
+    if diff:
+        print(f"BITEQUAL FAIL: fields differ: {diff}", file=sys.stderr)
+    return not diff
+
+
+def main() -> None:
+    from ceph_tpu.common.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    import jax
+
+    from ceph_tpu.analysis.runtime_guard import track
+    from ceph_tpu.models.clusters import build_osdmap
+    from ceph_tpu.recovery import EpochDriver, epoch_superstep_enabled
+    from ceph_tpu.recovery.chaos import ChaosEvent, ChaosTimeline, parse_spec
+
+    m = build_osdmap(
+        N_OSDS, pg_num=PG_NUM, size=EC_K + EC_M, pool_kind="erasure"
+    )
+    # two slow OSDs from t=0.1: liveness stays non-idle every epoch
+    # without ever dirtying the map (both paths would pay the same
+    # re-peer launch on a dirty epoch, diluting the loop-overhead
+    # ratio this config exists to measure)
+    timeline = ChaosTimeline([
+        ChaosEvent(0.1, (parse_spec("slow:5"), parse_spec("slow:17"))),
+    ])
+    driver = EpochDriver(m, timeline, n_ops=N_OPS)
+
+    with track() as guard:
+        # warm with the SAME chunk shape the timed run scans (the scan
+        # trip count is a shape: a different chunk would recompile
+        # inside the timing)
+        driver.run_superstep(CHUNK, snapshot_every=CHUNK)
+        warm = guard.snapshot()
+
+        t0 = time.perf_counter()
+        driver.run_superstep(EPOCHS, snapshot_every=CHUNK)
+        sup_rate = EPOCHS / (time.perf_counter() - t0)
+
+    # the staged reference re-launches the same jitted pieces as
+    # top-level calls: warm those signatures, then time
+    driver.run_staged(8)
+    t0 = time.perf_counter()
+    driver.run_staged(STAGED_EPOCHS)
+    staged_rate = STAGED_EPOCHS / (time.perf_counter() - t0)
+
+    bitequal = _bitequal_check()
+
+    print(
+        f"epoch loop: {N_OSDS} OSDs / {PG_NUM} PGs, n_ops={N_OPS}: "
+        f"superstep {sup_rate:.0f} ep/s ({EPOCHS} epochs), "
+        f"staged {staged_rate:.0f} ep/s ({STAGED_EPOCHS} epochs) -> "
+        f"{sup_rate / staged_rate:.1f}x, "
+        f"bitequal={'ok' if bitequal else 'FAIL'}",
+        file=sys.stderr,
+    )
+    print(json.dumps(build_epoch_record(
+        jax.default_backend(), sup_rate, staged_rate, bitequal,
+        EPOCHS, guard.n_compiles, warm["n_compiles"],
+        guard.host_transfers, epoch_superstep_enabled(),
+    )))
+
+
+if __name__ == "__main__":
+    main()

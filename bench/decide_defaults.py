@@ -160,6 +160,20 @@ LIVENESS_FLOAT_FIELDS = ("liveness_detection_latency_s",
                          "liveness_epoch_churn_ratio")
 LIVENESS_STR_FIELDS = ("liveness_health_status",)
 
+# Epoch-loop fields (config7_epoch_loop): staged-vs-superstep epoch
+# rates and their ratio at the 1k-OSD/8k-PG acceptance geometry.
+# ``epoch_bitequal`` gates the speedup (the superstep's contract is
+# bit-identical state/histogram/SLO series vs the staged reference —
+# a fast-but-divergent scan is a bug, not a win) and
+# ``epoch_superstep_enabled`` records the kill-switch state the rate
+# was measured under.
+EPOCH_INT_FIELDS = ("epoch_n_osds", "epoch_pg_num", "epoch_n_ops",
+                    "epoch_epochs_measured")
+EPOCH_FLOAT_FIELDS = ("epoch_rate_superstep_per_sec",
+                      "epoch_rate_staged_per_sec",
+                      "epoch_speedup")
+EPOCH_BOOL_FIELDS = ("epoch_bitequal", "epoch_superstep_enabled")
+
 
 def harvest_aux(paths: list[str]) -> dict[str, int]:
     """Collect auxiliary metric -> best value from the logs.
@@ -182,6 +196,8 @@ def harvest_aux(paths: list[str]) -> dict[str, int]:
             except json.JSONDecodeError:
                 continue
             if d.get("platform") != "tpu":
+                continue
+            if d.get("status") == "timeout":
                 continue
             name = d.get("metric")
             if name in AUX_METRICS and d.get("value"):
@@ -213,6 +229,11 @@ def harvest_guard(paths: list[str]) -> dict[str, dict]:
             except json.JSONDecodeError:
                 continue
             if d.get("platform") != "tpu" or not d.get("metric"):
+                continue
+            if d.get("status") == "timeout":
+                # a record run_all salvaged from a hung child: typed as
+                # incomplete, never harvested (BENCH_r05: these used to
+                # surface as value 0 and shadow a real prior run)
                 continue
             fields = {f: int(d[f]) for f in GUARD_FIELDS if f in d}
             fields.update(
@@ -265,6 +286,15 @@ def harvest_guard(paths: list[str]) -> dict[str, dict]:
             )
             fields.update(
                 {f: str(d[f]) for f in PROVENANCE_STR_FIELDS if f in d}
+            )
+            fields.update(
+                {f: int(d[f]) for f in EPOCH_INT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: float(d[f]) for f in EPOCH_FLOAT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: bool(d[f]) for f in EPOCH_BOOL_FIELDS if f in d}
             )
             # jaxlint per-rule counters (lint_active, lint_J007_active,
             # ...): dynamic key set — one field per registered rule, so
@@ -319,6 +349,8 @@ def harvest(paths: list[str]) -> dict[str, int]:
                 continue
             if d.get("platform") != "tpu":
                 continue
+            if d.get("status") == "timeout":
+                continue
             if d.get("metric") == "level_kernel_probe":
                 for tag in MODES:
                     if tag == "kern_full":
@@ -362,6 +394,8 @@ def harvest_bitexact(paths: list[str]) -> dict[str, bool]:
             except json.JSONDecodeError:
                 continue
             if d.get("platform") != "tpu":
+                continue
+            if d.get("status") == "timeout":
                 continue
             for tag in MODES:
                 v = d.get(f"{tag}_bitexact")

@@ -44,6 +44,7 @@ CONFIGS = [
      ("--scrub",)),
     ("config6_recovery_liveness", "bench/config6_recovery.py",
      ("--liveness",)),
+    ("config7_epoch_loop", "bench/config7_epoch_loop.py"),
     ("tpu_tier", "bench/tpu_tier.py"),
 ]
 
@@ -82,6 +83,18 @@ def _run_one(name: str, path: str, timeout: int,
         rec["error"] = f"timeout after {timeout}s"
         if "result" in rec:
             rec["teardown_timed_out"] = True
+            # a measurement that printed before the hang is complete
+            # and keeps its own status; a value-less salvage gets the
+            # typed timeout status (BENCH_r05: untyped salvage surfaced
+            # as value 0 and was harvested as a real rate)
+            if not rec["result"].get("value"):
+                rec["result"]["status"] = "timeout"
+        else:
+            rec["result"] = {
+                "metric": name,
+                "status": "timeout",
+                "value": None,
+            }
     else:
         rec["rc"] = proc.returncode
         if "result" not in rec:

@@ -25,7 +25,10 @@ import argparse
 import json
 import sys
 
-COMMANDS = ("status", "health", "timeline", "journal")
+COMMANDS = ("status", "health", "timeline", "journal", "caches")
+
+#: CLI command -> admin-socket prefix (identity unless listed)
+_SOCKET_PREFIX = {"caches": "dump_placement_caches"}
 
 
 def _render(cmd: str, reply: dict, as_json: bool, out) -> None:
@@ -41,6 +44,17 @@ def _render(cmd: str, reply: dict, as_json: bool, out) -> None:
         for name, check in sorted(reply.get("checks", {}).items()):
             print(f"  {name} {check['status']}: {check['detail']}",
                   file=out)
+    elif cmd == "caches":
+        for name, c in sorted(reply.items()):
+            if not isinstance(c, dict):
+                continue
+            print(
+                f"{name}: {c.get('hits', 0)} hits, "
+                f"{c.get('misses', 0)} misses, "
+                f"{c.get('evictions', 0)} evictions"
+                + (f", {c['entries']} entries" if "entries" in c else ""),
+                file=out,
+            )
     elif cmd == "timeline":
         for s in reply.get("series", []):
             states = " ".join(
@@ -221,13 +235,19 @@ def _demo(args, out) -> tuple[dict, dict]:
             ),
         }
     liveness_panel = chaos.liveness.summary()
+    # compiled-program cache counters (PipelineCache/ScheduleCache are
+    # process-global; this is their runtime window)
+    from ..recovery.pipeline import dump_placement_caches
+
     return {
         "status": status_dict(
-            timeline, spec, scrub=scrub_panel, liveness=liveness_panel
+            timeline, spec, scrub=scrub_panel, liveness=liveness_panel,
+            caches=dump_placement_caches(),
         ),
         "health": evaluate(timeline, spec).to_dict(),
         "timeline": {"series": timeline.to_dicts()},
         "journal": {"records": journal.records},
+        "caches": dump_placement_caches(),
     }
 
 
@@ -283,7 +303,10 @@ def main(argv=None) -> int:
         from ..common.admin_socket import ask
 
         try:
-            reply = ask(args.socket, args.command)
+            reply = ask(
+                args.socket,
+                _SOCKET_PREFIX.get(args.command, args.command),
+            )
         except OSError as e:
             print(f"status: cannot reach {args.socket}: {e}",
                   file=sys.stderr)

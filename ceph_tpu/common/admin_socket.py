@@ -35,6 +35,9 @@ class AdminSocket:
         self.register("config show", lambda cmd: self.config.show())
         self.register("config set", self._config_set)
         self.register("dump_ec_schedules", self._dump_ec_schedules)
+        self.register(
+            "dump_placement_caches", self._dump_placement_caches
+        )
         self.register("help", lambda cmd: {"commands": sorted(self._hooks)})
 
     @staticmethod
@@ -44,6 +47,13 @@ class AdminSocket:
         from ..ec.schedule import dump_ec_schedules
 
         return dump_ec_schedules()
+
+    @staticmethod
+    def _dump_placement_caches(cmd: dict) -> dict:
+        # lazy import, same reason as _dump_ec_schedules
+        from ..recovery.pipeline import dump_placement_caches
+
+        return dump_placement_caches()
 
     def _config_set(self, cmd: dict) -> dict:
         self.config.set(cmd["key"], cmd["value"])

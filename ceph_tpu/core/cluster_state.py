@@ -1,0 +1,333 @@
+"""Device-resident cluster state: one pytree, resident across epochs.
+
+Every epoch-loop consumer so far (peering, the traffic router, the
+PG-state classifier, the liveness detector) kept its *own* slice of
+cluster state on device and re-uploaded the rest from the host
+``OSDMap`` each epoch via :func:`~ceph_tpu.osdmap.mapping
+.build_pool_state` — an O(cluster) host walk per epoch that caps the
+simulator's epoch rate and the map size it can afford.  This module
+unifies those slices into one :class:`ClusterState` pytree that stays
+resident in HBM across epochs:
+
+- the pool-mapping tables (a nested
+  :class:`~ceph_tpu.osdmap.mapping.PoolMapState`: bucket weights,
+  up/exists bits, affinity, upmap/temp overrides),
+- per-OSD liveness lanes (the :mod:`ceph_tpu.recovery.liveness`
+  heartbeat state plus the host-authoritative suppression/out bits,
+  promoted to device lanes),
+- per-PG peering outputs (up/acting tables, primaries, flags, survivor
+  bitmasks, alive counts),
+- the PG-state histogram and aux counts,
+- optional checksum-table refs (the scrubber's stored CRC32C table),
+- scalar clocks/cursors (map epoch, virtual now, last liveness tick,
+  chaos event-tape cursor, epoch-loop step).
+
+OSDMap :class:`~ceph_tpu.osdmap.map.Incremental` deltas apply as ONE
+compiled fixed-shape scatter (:func:`apply_incremental`) — O(delta)
+work instead of the O(cluster) ``build_pool_state`` recompute — with
+the pad width bucketed to powers of two so delta size never recompiles.
+Structural edits (``new_max_osd``, pool changes, upmap/temp rewrites)
+change shapes or dict layouts and still go through
+:meth:`ClusterState.from_osdmap`; the compiled path covers the
+hot-loop deltas chaos and the failure detector actually emit
+(state xors + reweights + affinity).
+
+The compiled epoch superstep (:mod:`ceph_tpu.recovery.superstep`)
+carries a :class:`ClusterState` through ``lax.scan``; the staged
+differential-reference path advances the identical pytree one jitted
+piece at a time.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, replace
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..crush.map import ITEM_NONE
+from ..osdmap.map import Incremental, OSDMap
+from ..osdmap.mapping import PoolMapState, build_pool_state
+
+I32 = jnp.int32
+U32 = jnp.uint32
+F32 = jnp.float32
+F64 = jnp.float64
+
+#: reporter count meaning "always enough reporters" (the
+#: LivenessDetector default before peering adjacency is known)
+ALWAYS_REPORTED = 1 << 16
+
+#: fields of one Incremental the compiled scatter path cannot express
+#: without a shape change or a dict rewrite — they route through
+#: ``from_osdmap`` instead
+_STRUCTURAL_FIELDS = (
+    "new_pg_upmap", "old_pg_upmap", "new_pg_upmap_items",
+    "old_pg_upmap_items", "new_pg_temp", "new_primary_temp", "new_pools",
+)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass(frozen=True)
+class ClusterState:
+    """The whole cluster's dynamic state as one device-resident pytree.
+
+    All leaves are fixed-shape device arrays; every update path
+    (compiled incrementals, the epoch superstep, the staged reference)
+    returns a new instance via :func:`dataclasses.replace` — the pytree
+    is immutable, so it can be a ``lax.scan`` carry.
+    """
+
+    # -- pool mapping (nested pytree; the CRUSH program's traced state)
+    pool: PoolMapState
+
+    # -- per-OSD liveness lanes (heartbeat_step's eight lanes plus the
+    #    bits the host detector kept authoritative)
+    last_ack: jnp.ndarray      # f32 [n_osd]
+    laggy: jnp.ndarray         # f32 [n_osd]
+    markdowns: jnp.ndarray     # f32 [n_osd]
+    down: jnp.ndarray          # bool [n_osd]  detector-marked down
+    down_since: jnp.ndarray    # f32 [n_osd]
+    suppressed: jnp.ndarray    # bool [n_osd]  netsplit: heartbeats cut
+    slow: jnp.ndarray          # bool [n_osd]  slow: acks late
+    out: jnp.ndarray           # bool [n_osd]  auto-out bookkeeping
+    reporters: jnp.ndarray     # i32 [n_osd]  failure-reporter pool
+
+    # -- per-PG peering tables (the fused pipeline's outputs)
+    up: jnp.ndarray            # i32 [pg_num, size]  ITEM_NONE padded
+    up_primary: jnp.ndarray    # i32 [pg_num]
+    acting: jnp.ndarray        # i32 [pg_num, size]
+    acting_primary: jnp.ndarray  # i32 [pg_num]
+    flags: jnp.ndarray         # i32 [pg_num]  PG_STATE_* bits
+    survivor_mask: jnp.ndarray  # u32 [pg_num]
+    n_alive: jnp.ndarray       # i32 [pg_num]
+
+    # -- cluster-wide observability
+    pg_hist: jnp.ndarray       # i32 [N_STATES]
+    pg_aux: jnp.ndarray        # i32 [2]  degraded_slots, misplaced
+
+    # -- checksum table ref (the scrubber's stored CRC32C table; None
+    #    when no store is attached — consistently absent or present
+    #    across a run, like any optional pytree leaf)
+    checksums: jnp.ndarray | None  # u32 [pg_num, n_shards] | None
+
+    # -- scalars
+    epoch: jnp.ndarray         # i32 []  map epoch
+    now: jnp.ndarray           # f64 []  virtual time
+    last_tick: jnp.ndarray     # f64 []  last non-idle liveness tick
+    tape_cursor: jnp.ndarray   # i32 []  chaos event-tape position
+    step: jnp.ndarray          # i32 []  epoch-loop step index
+
+    def tree_flatten(self):
+        return (
+            (
+                self.pool,
+                self.last_ack, self.laggy, self.markdowns, self.down,
+                self.down_since, self.suppressed, self.slow, self.out,
+                self.reporters,
+                self.up, self.up_primary, self.acting,
+                self.acting_primary, self.flags, self.survivor_mask,
+                self.n_alive,
+                self.pg_hist, self.pg_aux, self.checksums,
+                self.epoch, self.now, self.last_tick, self.tape_cursor,
+                self.step,
+            ),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # -- constructors --------------------------------------------------
+
+    @classmethod
+    def from_osdmap(
+        cls,
+        m: OSDMap,
+        pool_id: int | None = None,
+        *,
+        max_items: int = 8,
+        now: float = 0.0,
+        reporters: np.ndarray | None = None,
+        checksums: np.ndarray | None = None,
+    ) -> "ClusterState":
+        """Compile a host OSDMap into the resident pytree (the cold
+        path; epoch deltas after this go through
+        :func:`apply_incremental` or the superstep's event tape)."""
+        # deferred: obs.pg_states pulls in recovery.peering, whose
+        # package __init__ loads the superstep module, which builds on
+        # this one — a module-level import here would close that cycle
+        from ..obs.pg_states import N_STATES
+
+        pool = m.pools[min(m.pools) if pool_id is None else pool_id]
+        pool_state = build_pool_state(m, pool, max_items)
+        n = int(pool_state.osd_weight.shape[0])
+        pg_num = int(pool.pg_num)
+        size = int(pool.size)
+        if reporters is None:
+            rep = np.full(n, ALWAYS_REPORTED, np.int32)
+        else:
+            rep = np.asarray(reporters, np.int32)
+            if rep.shape != (n,):
+                raise ValueError(
+                    f"reporters shape {rep.shape} != ({n},)"
+                )
+        return cls(
+            pool=pool_state,
+            last_ack=jnp.full((n,), float(now), F32),
+            laggy=jnp.zeros((n,), F32),
+            markdowns=jnp.zeros((n,), F32),
+            down=jnp.zeros((n,), bool),
+            down_since=jnp.zeros((n,), F32),
+            suppressed=jnp.zeros((n,), bool),
+            slow=jnp.zeros((n,), bool),
+            out=jnp.zeros((n,), bool),
+            reporters=jnp.asarray(rep),
+            up=jnp.full((pg_num, size), ITEM_NONE, I32),
+            up_primary=jnp.full((pg_num,), -1, I32),
+            acting=jnp.full((pg_num, size), ITEM_NONE, I32),
+            acting_primary=jnp.full((pg_num,), -1, I32),
+            flags=jnp.zeros((pg_num,), I32),
+            survivor_mask=jnp.zeros((pg_num,), U32),
+            n_alive=jnp.zeros((pg_num,), I32),
+            pg_hist=jnp.zeros((N_STATES,), I32),
+            pg_aux=jnp.zeros((2,), I32),
+            checksums=(
+                None if checksums is None
+                else jnp.asarray(checksums, U32)
+            ),
+            epoch=jnp.int32(m.epoch),
+            now=jnp.float64(now),
+            last_tick=jnp.float64(now),
+            tape_cursor=jnp.int32(0),
+            step=jnp.int32(0),
+        )
+
+    @property
+    def n_osds(self) -> int:
+        return int(self.pool.osd_weight.shape[0])
+
+    @property
+    def pg_num(self) -> int:
+        return int(self.up.shape[0])
+
+
+# ---------------------------------------------------------------------------
+# compiled O(delta) incremental application
+
+
+def _pad_to(n: int) -> int:
+    """Pad bucket for a delta of ``n`` rows: next power of two (min 1),
+    so delta *size* never changes the compiled program's shape."""
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def incremental_arrays(inc: Incremental, n_osds: int):
+    """Compile one Incremental's per-OSD edits into fixed-shape scatter
+    rows: ``(s_idx, s_up, s_ex, w_idx, w_val, a_idx, a_val)``, each
+    padded to a power of two with out-of-range indices (``n_osds``)
+    that the device scatter drops.
+
+    Raises for structural edits (:data:`_STRUCTURAL_FIELDS`,
+    ``new_max_osd``): those change shapes or rewrite padded dict
+    tables and take the :meth:`ClusterState.from_osdmap` rebuild.
+    """
+    if inc.new_max_osd is not None:
+        raise ValueError(
+            "new_max_osd resizes every per-OSD lane; rebuild via "
+            "ClusterState.from_osdmap"
+        )
+    for f in _STRUCTURAL_FIELDS:
+        if getattr(inc, f):
+            raise ValueError(
+                f"incremental field {f!r} is structural (dict-table "
+                "rewrite); rebuild via ClusterState.from_osdmap"
+            )
+    from ..osdmap.map import EXISTS, UP
+
+    def rows(items, conv):
+        idx = sorted(int(o) for o in items)
+        pad = _pad_to(len(idx))
+        out_idx = np.full(pad, n_osds, np.int32)  # OOB pad -> dropped
+        out_idx[: len(idx)] = idx
+        vals = [conv(items[o]) for o in idx]
+        return out_idx, vals, pad
+
+    s_idx, s_vals, s_pad = rows(inc.new_state, int)
+    s_up = np.zeros(s_pad, bool)
+    s_ex = np.zeros(s_pad, bool)
+    for j, v in enumerate(s_vals):
+        s_up[j] = bool(v & UP)
+        s_ex[j] = bool(v & EXISTS)
+    w_idx, w_vals, w_pad = rows(inc.new_weight, int)
+    w_val = np.zeros(w_pad, np.uint32)
+    w_val[: len(w_vals)] = w_vals
+    a_idx, a_vals, a_pad = rows(inc.new_primary_affinity, int)
+    a_val = np.zeros(a_pad, np.uint32)
+    a_val[: len(a_vals)] = a_vals
+    return (
+        jnp.asarray(s_idx), jnp.asarray(s_up), jnp.asarray(s_ex),
+        jnp.asarray(w_idx), jnp.asarray(w_val),
+        jnp.asarray(a_idx), jnp.asarray(a_val),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _apply_delta_fn(s_pad: int, w_pad: int, a_pad: int):
+    """One compiled scatter program per (pad-bucket triple) — deltas of
+    any size within the buckets reuse it."""
+
+    @jax.jit
+    def apply(state: ClusterState, epoch,
+              s_idx, s_up, s_ex, w_idx, w_val, a_idx, a_val):
+        pool = state.pool
+        n = pool.osd_up.shape[0]
+        cid = jnp.clip(s_idx, 0, n - 1)
+        # the reference xors raw state bits; the resident lanes store
+        # the *effective* bits (osd_up = exists & up), so: an UP xor
+        # flips the stored up bit only while the OSD exists (the raw
+        # bit on a non-existing OSD is invisible — build_incremental
+        # never emits that row), and an EXISTS flip to False forces
+        # the effective up bit False.
+        new_ex = pool.osd_exists[cid] ^ s_ex
+        new_up = (pool.osd_up[cid] ^ (s_up & pool.osd_exists[cid])) & new_ex
+        osd_up = pool.osd_up.at[s_idx].set(new_up, mode="drop")
+        osd_exists = pool.osd_exists.at[s_idx].set(new_ex, mode="drop")
+        osd_weight = pool.osd_weight.at[w_idx].set(w_val, mode="drop")
+        affinity = pool.primary_affinity.at[a_idx].set(a_val, mode="drop")
+        return replace(
+            state,
+            pool=replace(
+                pool,
+                osd_up=osd_up,
+                osd_exists=osd_exists,
+                osd_weight=osd_weight,
+                primary_affinity=affinity,
+            ),
+            epoch=epoch,
+        )
+
+    return apply
+
+
+def apply_incremental(state: ClusterState, inc: Incremental) -> ClusterState:
+    """Apply one epoch delta to the resident state as a compiled
+    O(delta) scatter — the device twin of
+    :meth:`ceph_tpu.osdmap.map.OSDMap.apply_incremental` for the
+    per-OSD hot-loop fields.  The new map epoch comes from the
+    incremental itself (no device scalar is pulled to host); callers
+    that interleave host-map and device-state application keep them in
+    lockstep by construction, and the differential tests assert it."""
+    n = state.n_osds
+    arrs = incremental_arrays(inc, n)
+    fn = _apply_delta_fn(
+        int(arrs[0].shape[0]), int(arrs[3].shape[0]), int(arrs[5].shape[0])
+    )
+    return fn(state, jnp.int32(inc.epoch), *arrs)

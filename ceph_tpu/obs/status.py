@@ -18,6 +18,7 @@ def status_dict(
     spec: SLOSpec | None = None,
     scrub: dict | None = None,
     liveness: dict | None = None,
+    caches: dict | None = None,
 ) -> dict:
     """The ``status`` reply: latest histogram + rolled-up health.
 
@@ -28,7 +29,9 @@ def status_dict(
     ``liveness`` is an optional failure-detection panel — a
     :meth:`~ceph_tpu.recovery.liveness.LivenessDetector.summary` dict,
     optionally extended with a ``flags`` list of raised cluster
-    flags."""
+    flags.  ``caches`` is an optional compiled-program cache panel —
+    the :func:`~ceph_tpu.recovery.pipeline.dump_placement_caches`
+    shape (per-cache hit/miss/eviction counters)."""
     latest = timeline.latest
     report = (
         evaluate(timeline, spec).to_dict() if spec is not None else None
@@ -80,6 +83,8 @@ def status_dict(
         out["scrub"] = dict(scrub)
     if liveness is not None:
         out["liveness"] = dict(liveness)
+    if caches is not None:
+        out["caches"] = dict(caches)
     return out
 
 
@@ -166,6 +171,20 @@ def render_status(status: dict) -> str:
                 f"{lv.get('auto_out_events', 0)} auto-out, "
                 f"{lv.get('flap_damped_events', 0)} flap-damped"
             )
+    caches = status.get("caches")
+    if caches is not None:
+        lines.append("  caches:")
+        for name, c in sorted(caches.items()):
+            if not isinstance(c, dict):
+                continue
+            parts = (
+                f"    {name}: {c.get('hits', 0)} hits, "
+                f"{c.get('misses', 0)} misses, "
+                f"{c.get('evictions', 0)} evictions"
+            )
+            if "entries" in c:
+                parts += f", {c['entries']} entries"
+            lines.append(parts)
     return "\n".join(lines)
 
 

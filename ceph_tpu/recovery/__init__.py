@@ -25,6 +25,11 @@ The subsystem that closes the loop the standalone workloads left open
   the virtual clock: heartbeat grace, the markdown flap damper,
   down→out policy, and the cluster flag set
   (``noout``/``norecover``/``nobackfill``/``norebalance``/``pause``).
+- :mod:`~ceph_tpu.recovery.superstep` — the compiled epoch loop:
+  heartbeats → liveness transitions → fused peering → PG-state
+  classify → traffic → scrub tick as ONE jitted ``lax.scan`` over a
+  device-side chaos event tape (``CEPH_TPU_EPOCH_SUPERSTEP=0`` pins
+  the staged per-epoch reference).
 """
 
 from .chaos import (
@@ -104,6 +109,16 @@ from .executor import (
     recovery_counters,
 )
 from .sharded import ShardedDecoder, sharded_decode_step
+from .superstep import (
+    EpochDriver,
+    EpochSeries,
+    EventTape,
+    build_epoch_driver,
+    compile_epoch_superstep,
+    compile_event_tape,
+    epoch_superstep_enabled,
+    run_epochs,
+)
 
 __all__ = [
     "ACTIONS",
@@ -170,4 +185,12 @@ __all__ = [
     "recovery_counters",
     "ShardedDecoder",
     "sharded_decode_step",
+    "EpochDriver",
+    "EpochSeries",
+    "EventTape",
+    "build_epoch_driver",
+    "compile_epoch_superstep",
+    "compile_event_tape",
+    "epoch_superstep_enabled",
+    "run_epochs",
 ]

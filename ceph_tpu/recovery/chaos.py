@@ -103,6 +103,12 @@ class ChaosTimeline:
     def __len__(self) -> int:
         return len(self._events)
 
+    def events(self) -> tuple[ChaosEvent, ...]:
+        """Non-consuming view of the pending schedule, in replay order
+        (the event-tape compiler's input: the superstep pre-stages the
+        whole timeline on device without draining it)."""
+        return tuple(self._events)
+
     def peek_next(self) -> float | None:
         """Time of the next pending event, or None when exhausted."""
         return self._events[0].t if self._events else None

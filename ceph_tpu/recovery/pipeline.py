@@ -96,6 +96,25 @@ class PipelineCache:
 PIPELINES = PipelineCache()
 
 
+def dump_placement_caches() -> dict:
+    """Admin-socket hook body: the process-global compiled-program
+    caches the placement path builds — the fused-peering
+    :data:`PIPELINES` cache and the EC :class:`~ceph_tpu.ec.schedule.
+    ScheduleCache` aggregate (hit/miss/eviction counters that were
+    previously process state with no runtime window)."""
+    from ..ec.schedule import schedule_counters
+
+    sched = schedule_counters().dump().get("ec_schedule", {})
+    return {
+        "pipeline": PIPELINES.stats(),
+        "schedule": {
+            "hits": int(sched.get("schedule_cache_hits", 0)),
+            "misses": int(sched.get("schedules_compiled", 0)),
+            "evictions": int(sched.get("schedule_cache_evictions", 0)),
+        },
+    }
+
+
 def compile_fused_peering(dense, pool, rule, cache: PipelineCache | None = None):
     """Build (or fetch) the fused peering program for one pool.
 

@@ -1,0 +1,998 @@
+"""Compiled epoch superstep: the whole per-epoch pipeline in one scan.
+
+Every simulated epoch used to be a Python round-trip stitching
+``heartbeat_step`` → liveness transitions → re-peer → PG-state
+classify → traffic step → scrub-window tick, with host syncs between
+stages — ~10 epochs/sec no matter how small the per-stage device work
+is.  This module compiles the *entire* epoch into one traced step over
+a :class:`~ceph_tpu.core.cluster_state.ClusterState` carry and drives
+``lax.scan`` over a pre-staged device-side **event tape** compiled
+from a :class:`~ceph_tpu.recovery.chaos.ChaosTimeline`, exiting to
+host Python only at journal/snapshot boundaries
+(:meth:`EpochDriver.run_superstep`'s chunked scan) and for
+plan/execute phases that genuinely need the planner.
+
+Event tape
+----------
+
+:func:`compile_event_tape` flattens the timeline into fixed-shape
+``(t, kind, osd, bump)`` rows (f64/i32/i32/i32), host-resolved against
+the baseline map topology:
+
+- map actions become :data:`TAPE_DOWN`/:data:`TAPE_UP`/
+  :data:`TAPE_OUT`/:data:`TAPE_IN` rows, one per target OSD
+  (``down_out`` emits a DOWN and an OUT row); the FIRST map row of
+  each event carries ``bump=1`` — the epoch advance the host engine's
+  one-Incremental-per-event convention produces, even when the edit is
+  a state no-op.
+- ``netsplit:``/``slow:`` specs become NET/SLOW drop/restore rows
+  (liveness lanes only, no epoch bump), ordered after the same
+  event's map rows exactly like :meth:`ChaosEngine.poll` applies
+  them.
+- ``bitrot:`` specs never touch map or liveness state and emit no
+  rows (they are counted so callers can route them to a host store at
+  snapshot boundaries).
+
+Per epoch the step consumes the tape window ``(prev_now, now]`` with a
+``searchsorted`` cursor plus an O(delta) ``fori_loop`` of scatter
+updates — the device twin of the host engine's due-event drain.
+
+Differential reference
+----------------------
+
+Bit-equality is by *construction*: the staged per-epoch path
+(:meth:`EpochDriver.run_staged`) calls the very same jitted piece
+functions — tape apply, liveness tick, fused peering (PR 11's
+:class:`~ceph_tpu.recovery.pipeline.PipelineCache` program), PG-state
+reduce, traffic step, scrub tick — as separate launches with host
+syncs between stages, while the superstep inlines them into one scan
+body.  Same traced subgraphs, same inputs ⇒ identical state,
+histograms, and SLO inputs (asserted over the chaos scenario zoo in
+``tests/test_superstep.py``).  ``CEPH_TPU_EPOCH_SUPERSTEP=0`` is the
+kill switch pinning the staged path everywhere
+(:func:`epoch_superstep_enabled`, the ``CEPH_TPU_FUSED_PIPELINE``
+pattern).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, replace
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..common.config import global_config
+from ..core.cluster_state import ClusterState
+from ..crush.map import ITEM_NONE
+from ..osdmap.map import OSDMap
+from ..osdmap.mapping import build_pool_state
+from .chaos import ChaosTimeline
+from .liveness import heartbeat_step
+from .pipeline import compile_fused_peering
+from .scrub import scrub_phases
+
+I32 = jnp.int32
+U32 = jnp.uint32
+F32 = jnp.float32
+F64 = jnp.float64
+
+#: the traffic engine's per-step salt stride (u32 math — exact on host
+#: and device alike)
+_SALT_STEP = np.uint32(40503)
+
+
+def epoch_superstep_enabled() -> bool:
+    """Whether :func:`run_epochs` uses the one-launch compiled scan
+    (``CEPH_TPU_EPOCH_SUPERSTEP=0`` pins the staged per-epoch
+    reference path everywhere — the differential-test lever and the
+    rollback switch)."""
+    return os.environ.get("CEPH_TPU_EPOCH_SUPERSTEP", "1") != "0"
+
+
+# ---------------------------------------------------------------------------
+# event tape
+
+TAPE_DOWN = 0
+TAPE_UP = 1
+TAPE_OUT = 2
+TAPE_IN = 3
+TAPE_NET_DROP = 4
+TAPE_NET_RESTORE = 5
+TAPE_SLOW_DROP = 6
+TAPE_SLOW_RESTORE = 7
+
+_N_TAPE_KINDS = 8
+
+#: kinds that edit map lanes (their presence in an epoch's window makes
+#: the epoch dirty: peering must re-run)
+_MAP_KINDS = (TAPE_DOWN, TAPE_UP, TAPE_OUT, TAPE_IN)
+
+_ACTION_KINDS = {
+    "down": (TAPE_DOWN,),
+    "up": (TAPE_UP,),
+    "out": (TAPE_OUT,),
+    "in": (TAPE_IN,),
+    "down_out": (TAPE_DOWN, TAPE_OUT),
+}
+
+_NET_KINDS = {
+    ("netsplit", "drop"): TAPE_NET_DROP,
+    ("netsplit", "restore"): TAPE_NET_RESTORE,
+    ("slow", "drop"): TAPE_SLOW_DROP,
+    ("slow", "restore"): TAPE_SLOW_RESTORE,
+}
+
+#: tape kinds whose lane edits conflict when they hit the same OSD
+#: inside ONE event (the host engine batches an event into one
+#: Incremental where such pairs cancel differently than sequential
+#: scatter rows would)
+_CONFLICTS = ((TAPE_DOWN, TAPE_UP), (TAPE_OUT, TAPE_IN))
+
+
+@dataclass(frozen=True)
+class EventTape:
+    """The compiled device-side chaos schedule: time-sorted fixed-shape
+    rows; ``bump`` marks epoch advances (one per event with map
+    specs)."""
+
+    t: np.ndarray      # f64 [rows]
+    kind: np.ndarray   # i32 [rows]
+    osd: np.ndarray    # i32 [rows]
+    bump: np.ndarray   # i32 [rows]
+    n_events: int
+    n_bitrot: int
+
+    def __len__(self) -> int:
+        return int(self.t.shape[0])
+
+    def device(self):
+        return (
+            jnp.asarray(self.t), jnp.asarray(self.kind),
+            jnp.asarray(self.osd), jnp.asarray(self.bump),
+        )
+
+
+def compile_event_tape(timeline: ChaosTimeline, m: OSDMap) -> EventTape:
+    """Flatten a timeline into :class:`EventTape` rows, resolving
+    bucket scopes against the map's topology once, up front.  Raises
+    when one event carries conflicting map actions for the same OSD
+    (down+up or out+in): the host engine folds those into one
+    Incremental whose xor semantics a sequential row replay cannot
+    reproduce — schedule them as separate events instead."""
+    from .failure import resolve_targets
+
+    t_rows: list[float] = []
+    kind_rows: list[int] = []
+    osd_rows: list[int] = []
+    bump_rows: list[int] = []
+    n_bitrot = 0
+    for ev in timeline.events():
+        map_rows: list[tuple[int, int]] = []
+        net_rows: list[tuple[int, int]] = []
+        for spec in ev.specs:
+            if spec.is_bitrot:
+                n_bitrot += 1
+                continue
+            if spec.is_net:
+                net_rows.append(
+                    (_NET_KINDS[(spec.scope, spec.action)],
+                     int(spec.target))
+                )
+                continue
+            for kind in _ACTION_KINDS[spec.action]:
+                for osd in resolve_targets(m, spec):
+                    map_rows.append((kind, int(osd)))
+        for a, b in _CONFLICTS:
+            hit = {o for k, o in map_rows if k == a} & {
+                o for k, o in map_rows if k == b
+            }
+            if hit:
+                raise ValueError(
+                    f"event at t={ev.t} applies conflicting actions to "
+                    f"osd(s) {sorted(hit)}; split them into separate "
+                    "events"
+                )
+        for j, (kind, osd) in enumerate(map_rows + net_rows):
+            t_rows.append(float(ev.t))
+            kind_rows.append(kind)
+            osd_rows.append(osd)
+            bump_rows.append(1 if (j == 0 and map_rows) else 0)
+    return EventTape(
+        t=np.asarray(t_rows, np.float64),
+        kind=np.asarray(kind_rows, np.int32),
+        osd=np.asarray(osd_rows, np.int32),
+        bump=np.asarray(bump_rows, np.int32),
+        n_events=len(timeline),
+        n_bitrot=n_bitrot,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the epoch series (scan outputs / staged journal)
+
+_SERIES_FIELDS = (
+    "now", "epoch", "dirty", "hist", "aux", "counts", "lat_hist",
+    "qd_hist", "sums", "max_rho", "writes", "deg_reads", "down_total",
+    "eff_down", "eff_up", "eff_out", "down_checksum", "scrub_due",
+)
+
+
+@dataclass(frozen=True)
+class EpochSeries:
+    """Per-epoch outputs, host numpy, one leading epoch axis each —
+    the journal/snapshot payload and the differential test's
+    comparison surface."""
+
+    now: np.ndarray          # f64 [n]
+    epoch: np.ndarray        # i32 [n]  map epoch after the step
+    dirty: np.ndarray        # i32 [n]  1 = peering re-ran
+    hist: np.ndarray         # i32 [n, N_STATES]
+    aux: np.ndarray          # i32 [n, 2]
+    counts: np.ndarray       # i32 [n, 3]  served/degraded/blocked
+    lat_hist: np.ndarray     # i32 [n, B]
+    qd_hist: np.ndarray      # i32 [n, B]
+    sums: np.ndarray         # f32 [n, 2]  lat/qd sums (SLO inputs)
+    max_rho: np.ndarray      # f32 [n]
+    writes: np.ndarray       # i32 [n]  committed writes
+    deg_reads: np.ndarray    # i32 [n]  degraded reads served
+    down_total: np.ndarray   # i32 [n]  detector-down OSDs
+    eff_down: np.ndarray     # i32 [n]  map transitions this epoch
+    eff_up: np.ndarray       # i32 [n]
+    eff_out: np.ndarray      # i32 [n]
+    down_checksum: np.ndarray  # i32 [n]  sum(osd+1) over the down set
+    scrub_due: np.ndarray    # i32 [n]  PGs whose scrub window ticked
+
+    def __len__(self) -> int:
+        return int(self.now.shape[0])
+
+    @classmethod
+    def from_device(cls, rows) -> "EpochSeries":
+        host = jax.device_get(rows)
+        return cls(**{
+            f: np.asarray(v) for f, v in zip(_SERIES_FIELDS, host)
+        })
+
+    @classmethod
+    def concat(cls, parts: list["EpochSeries"]) -> "EpochSeries":
+        if len(parts) == 1:
+            return parts[0]
+        return cls(**{
+            f: np.concatenate([getattr(p, f) for p in parts])
+            for f in _SERIES_FIELDS
+        })
+
+    def diff(self, other: "EpochSeries") -> list[str]:
+        """Field names where the two series differ bit-for-bit (floats
+        compared exactly: the superstep's contract)."""
+        out = []
+        for f in _SERIES_FIELDS:
+            a, b = getattr(self, f), getattr(other, f)
+            if a.shape != b.shape or not np.array_equal(a, b):
+                out.append(f)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# the driver
+
+
+class EpochDriver:
+    """Owns the compiled pieces of one epoch loop — tape apply,
+    liveness tick, fused peering, classify, traffic, scrub tick — and
+    the two drivers over them: the one-scan superstep and the staged
+    multi-launch reference.  Both advance the same
+    :class:`ClusterState` pytree through the same jitted functions, so
+    their outputs are bit-equal by construction."""
+
+    def __init__(
+        self,
+        m: OSDMap,
+        timeline: ChaosTimeline,
+        *,
+        pool_id: int | None = None,
+        dt: float = 0.25,
+        t0: float = 0.0,
+        n_ops: int = 1024,
+        k: int | None = None,
+        seed: int = 0,
+        write_fraction: float = 0.25,
+        service_ms: float = 0.5,
+        osd_capacity_ops_per_s: float | None = None,
+        scrub_period_s: float | None = None,
+        config=None,
+        noout: bool = False,
+        reporters: np.ndarray | None = None,
+        max_items: int = 8,
+    ):
+        cfg = config or global_config()
+        pool = m.pools[min(m.pools) if pool_id is None else pool_id]
+        self.pool = pool
+        self.dt = float(dt)
+        self.t0 = float(t0)
+        self.n_ops = int(n_ops)
+        self.seed = int(seed)
+        # the EC reconstruction threshold the traffic router and the
+        # PG-state classifier key "inactive" on; replicated pools read
+        # from any one survivor
+        self.k = int(
+            k if k is not None
+            else (pool.min_size if pool.kind == "erasure" else 1)
+        )
+        self.size = int(pool.size)
+        self.min_size = int(pool.min_size)
+        self.pg_num = int(pool.pg_num)
+        self.write_permille = int(round(float(write_fraction) * 1000))
+        self.service_ms = float(service_ms)
+        self.cap_ops = float(
+            osd_capacity_ops_per_s
+            if osd_capacity_ops_per_s is not None
+            else 2.0 * self.n_ops / max(m.max_osd, 1)
+        )
+        self.scrub_period_s = float(
+            scrub_period_s if scrub_period_s is not None
+            else cfg.get("osd_scrub_stagger_period")
+        )
+        # liveness policy scalars, frozen at build time (the compiled
+        # tape has the same freeze: a mid-run knob change would need a
+        # rebuild, exactly like re-staging the tape)
+        self.grace = float(cfg.get("osd_heartbeat_grace"))
+        self.grace_cap = float(cfg.get("mon_osd_grace_doublings_max"))
+        self.adjust = (
+            1.0 if cfg.get("mon_osd_adjust_heartbeat_grace") else 0.0
+        )
+        self.min_reporters = int(cfg.get("mon_osd_min_down_reporters"))
+        self.down_out_interval = float(
+            cfg.get("mon_osd_down_out_interval")
+        )
+        self.laggy_weight = float(cfg.get("mon_osd_laggy_weight"))
+        self.laggy_halflife = float(cfg.get("mon_osd_laggy_halflife"))
+        self.min_in_ratio = float(cfg.get("mon_osd_min_in_ratio"))
+        # noout / interval<=0 gate auto-out entirely (static, like the
+        # host detector's early returns)
+        self.outs_enabled = (
+            not noout and self.down_out_interval > 0.0
+        )
+
+        choose_args = m.crush.choose_args_name_for_pool(pool.id)
+        dense = m.crush.to_dense(choose_args=choose_args)
+        rule = m.crush.rules[pool.crush_rule]
+        crush_arg, fused = compile_fused_peering(dense, pool, rule)
+        if fused is None:
+            raise ValueError(
+                "epoch superstep needs the traceable CRUSH tier and "
+                "CEPH_TPU_FUSED_PIPELINE enabled (host-tier maps keep "
+                "the legacy per-epoch loop)"
+            )
+        self._crush_arg = crush_arg
+        self._fused = fused
+        self._pg_idx = jnp.arange(self.pg_num, dtype=jnp.uint32)
+        # previous-epoch reference for survivor classification: the
+        # baseline (pre-chaos) placement, fixed for the run — the
+        # executor's convention of diffing against the epoch the last
+        # completed repair committed under
+        self._state_prev = build_pool_state(m, pool, max_items)
+
+        self.tape = compile_event_tape(timeline, m)
+        self._tape_dev = self.tape.device()
+
+        init = ClusterState.from_osdmap(
+            m, pool.id, max_items=max_items, now=self.t0,
+            reporters=reporters,
+        )
+        # seed the peering tables (and reporter pools, unless given)
+        # from the baseline placement so epoch 0 diffs against a real
+        # mapping rather than empty tables
+        init = self._peer_fn(init)
+        if reporters is None:
+            acting = np.asarray(init.acting)
+            init = replace(
+                init,
+                reporters=jnp.asarray(
+                    _peer_counts(acting, init.n_osds)
+                ),
+            )
+        hist, aux = self._hist_fn(init)
+        self._init_state = replace(init, pg_hist=hist, pg_aux=aux)
+        self._scan_fn = None
+
+    # -- the jitted pieces (shared verbatim by both drivers) -----------
+
+    def _now_of(self, step):
+        """Virtual time after epoch ``step`` (f64; the staged driver
+        computes the identical value from the identical expression)."""
+        return self.t0 + (step + 1).astype(F64) * self.dt
+
+    @property
+    def _tape_fn(self):
+        fn = getattr(self, "_tape_fn_c", None)
+        if fn is not None:
+            return fn
+        t_dev, kind_dev, osd_dev, bump_dev = self._tape_dev
+        n_rows = int(t_dev.shape[0])
+
+        def branches(now32, exists):
+            def down(lanes, o):
+                (up, w, ack, sup, slow, out) = lanes
+                return (up.at[o].set(False), w, ack, sup, slow, out)
+
+            def upb(lanes, o):
+                (up, w, ack, sup, slow, out) = lanes
+                # the conditioned xor sets the effective bit to exists
+                # (a non-existing OSD emits no row: up stays False);
+                # observe_map: an authoritative up re-arms the detector
+                return (
+                    up.at[o].set(exists[o]), w,
+                    ack.at[o].set(now32), sup.at[o].set(False), slow,
+                    out.at[o].set(False),
+                )
+
+            def outb(lanes, o):
+                (up, w, ack, sup, slow, out) = lanes
+                return (up, w.at[o].set(jnp.uint32(0)), ack, sup, slow,
+                        out)
+
+            def inb(lanes, o):
+                (up, w, ack, sup, slow, out) = lanes
+                wv = jnp.where(
+                    w[o] == 0, jnp.uint32(0x10000), w[o]
+                )
+                return (
+                    up, w.at[o].set(wv), ack.at[o].set(now32),
+                    sup.at[o].set(False), slow, out.at[o].set(False),
+                )
+
+            def net_drop(lanes, o):
+                (up, w, ack, sup, slow, out) = lanes
+                return (up, w, ack.at[o].set(now32),
+                        sup.at[o].set(True), slow, out)
+
+            def net_restore(lanes, o):
+                (up, w, ack, sup, slow, out) = lanes
+                return (up, w, ack.at[o].set(now32),
+                        sup.at[o].set(False), slow, out)
+
+            def slow_drop(lanes, o):
+                (up, w, ack, sup, slow, out) = lanes
+                return (up, w, ack, sup, slow.at[o].set(True), out)
+
+            def slow_restore(lanes, o):
+                (up, w, ack, sup, slow, out) = lanes
+                return (up, w, ack, sup, slow.at[o].set(False), out)
+
+            return (down, upb, outb, inb, net_drop, net_restore,
+                    slow_drop, slow_restore)
+
+        @jax.jit
+        def tape_fn(state: ClusterState, step):
+            now = self._now_of(step)
+            now32 = now.astype(F32)
+            stop = jnp.searchsorted(
+                t_dev, now, side="right"
+            ).astype(I32)
+            brs = branches(now32, state.pool.osd_exists)
+
+            def row(i, carry):
+                lanes, bumps, map_rows = carry
+                k = kind_dev[i]
+                o = osd_dev[i]
+                lanes = jax.lax.switch(
+                    k, [lambda ls, b=b: b(ls, o) for b in brs], lanes
+                )
+                return (
+                    lanes,
+                    bumps + bump_dev[i],
+                    map_rows + jnp.where(k <= TAPE_IN, 1, 0).astype(I32),
+                )
+
+            lanes0 = (
+                state.pool.osd_up, state.pool.osd_weight,
+                state.last_ack, state.suppressed, state.slow, state.out,
+            )
+            if n_rows:
+                lanes, bumps, map_rows = jax.lax.fori_loop(
+                    state.tape_cursor, stop, row,
+                    (lanes0, jnp.int32(0), jnp.int32(0)),
+                )
+            else:
+                lanes, bumps, map_rows = lanes0, jnp.int32(0), jnp.int32(0)
+            (up, w, ack, sup, slow, out) = lanes
+            state = replace(
+                state,
+                pool=replace(state.pool, osd_up=up, osd_weight=w),
+                last_ack=ack, suppressed=sup, slow=slow, out=out,
+                epoch=state.epoch + bumps,
+                now=now, tape_cursor=stop, step=step,
+            )
+            return state, (map_rows > 0)
+
+        self._tape_fn_c = tape_fn
+        return tape_fn
+
+    @property
+    def _live_fn(self):
+        fn = getattr(self, "_live_fn_c", None)
+        if fn is not None:
+            return fn
+
+        @jax.jit
+        def live_fn(state: ClusterState):
+            idle = ~(
+                jnp.any(state.suppressed) | jnp.any(state.slow)
+                | jnp.any(state.down) | jnp.any(state.laggy != 0)
+            )
+
+            def skip(st):
+                z = jnp.int32(0)
+                return st, (
+                    z, z, z,
+                    jnp.sum(st.down.astype(I32)).astype(I32),
+                    _down_checksum(st.down), jnp.asarray(False),
+                )
+
+            def tick(st):
+                now = st.now
+                # the host detector's decay, traced: exponential over
+                # the window since the last non-idle tick (idle epochs
+                # deliberately don't advance last_tick, so decay
+                # composes over the full elapsed window)
+                dtw = jnp.maximum(now - st.last_tick, 0.0)
+                decay = (
+                    jnp.float64(0.5)
+                    ** (dtw / max(self.laggy_halflife, 1e-9))
+                ).astype(F32)
+                now32 = now.astype(F32)
+                (ack, laggy, md, down, dsince, propose) = heartbeat_step(
+                    st.last_ack, st.laggy, st.markdowns, st.down,
+                    st.down_since, st.suppressed, st.slow,
+                    st.reporters,
+                    now32, self.grace, self.grace_cap, self.adjust,
+                    self.min_reporters, self.down_out_interval,
+                    self.laggy_weight, decay,
+                )
+                newly_down = down & ~st.down
+                newly_up = st.down & ~down
+                w = st.pool.osd_weight
+                exists = st.pool.osd_exists
+                if self.outs_enabled:
+                    cand = propose & ~st.out
+                    # the host approves candidates in ascending OSD
+                    # order until (n_in - approved)/n_exist would drop
+                    # below the floor; the ratio is monotone in the
+                    # 1-based candidate index, so the break is a prefix
+                    # — expressible as one cumsum mask
+                    c = jnp.cumsum(cand.astype(I32))
+                    n_exist = jnp.sum(exists.astype(I32))
+                    n_in = jnp.sum((exists & (w > 0)).astype(I32))
+                    ok = (n_exist == 0) | (
+                        (n_in - c).astype(F64)
+                        / jnp.maximum(n_exist, 1).astype(F64)
+                        >= self.min_in_ratio
+                    )
+                    approved = cand & ok
+                else:
+                    approved = jnp.zeros_like(st.out)
+                out2 = st.out | approved
+                # transitions the map doesn't already reflect become
+                # the epoch's one detection Incremental
+                eff_down = newly_down & st.pool.osd_up
+                eff_up = newly_up & exists & ~st.pool.osd_up
+                eff_out = approved & (w > 0)
+                osd_up2 = (st.pool.osd_up & ~eff_down) | eff_up
+                w2 = jnp.where(eff_out, jnp.uint32(0), w)
+                nd = jnp.sum(eff_down.astype(I32)).astype(I32)
+                nu = jnp.sum(eff_up.astype(I32)).astype(I32)
+                no = jnp.sum(eff_out.astype(I32)).astype(I32)
+                trans = (nd + nu + no) > 0
+                st = replace(
+                    st,
+                    pool=replace(
+                        st.pool, osd_up=osd_up2, osd_weight=w2
+                    ),
+                    last_ack=ack, laggy=laggy, markdowns=md,
+                    down=down, down_since=dsince, out=out2,
+                    epoch=st.epoch + trans.astype(I32),
+                    last_tick=now,
+                )
+                return st, (
+                    nd, nu, no,
+                    jnp.sum(down.astype(I32)).astype(I32),
+                    _down_checksum(down), trans,
+                )
+
+            return jax.lax.cond(idle, skip, tick, state)
+
+        self._live_fn_c = live_fn
+        return live_fn
+
+    @property
+    def _peer_fn(self):
+        fn = getattr(self, "_peer_fn_c", None)
+        if fn is not None:
+            return fn
+        fused = self._fused
+        crush_arg = self._crush_arg
+        state_prev = self._state_prev
+        pg_idx = self._pg_idx
+        min_size = jnp.int32(self.min_size)
+
+        @jax.jit
+        def peer_fn(state: ClusterState):
+            (up, upp, acting, actp, _prev_acting, flags, mask,
+             n_alive) = fused(
+                crush_arg, state_prev, state.pool, pg_idx, min_size
+            )
+            return replace(
+                state, up=up, up_primary=upp, acting=acting,
+                acting_primary=actp, flags=flags, survivor_mask=mask,
+                n_alive=n_alive,
+            )
+
+        self._peer_fn_c = peer_fn
+        return peer_fn
+
+    @property
+    def _hist_fn(self):
+        fn = getattr(self, "_hist_fn_c", None)
+        if fn is not None:
+            return fn
+        # deferred: obs.pg_states imports recovery.peering, whose
+        # package __init__ loads this module — a module-level import
+        # would close that cycle
+        from ..obs.pg_states import _reduce
+
+        k = jnp.int32(self.k)
+        size = jnp.int32(self.size)
+        in_range = jnp.ones(self.pg_num, dtype=bool)
+
+        @jax.jit
+        def hist_fn(state: ClusterState):
+            return _reduce(
+                state.survivor_mask, state.n_alive, state.flags,
+                k, size, in_range,
+            )
+
+        self._hist_fn_c = hist_fn
+        return hist_fn
+
+    @property
+    def _peer_hist_fn(self):
+        """Re-peer then reclassify, as one piece: the dirty branch of
+        the epoch body (quiet epochs carry both results forward)."""
+        fn = getattr(self, "_peer_hist_fn_c", None)
+        if fn is not None:
+            return fn
+        peer_fn = self._peer_fn
+        hist_fn = self._hist_fn
+
+        @jax.jit
+        def peer_hist_fn(state: ClusterState):
+            state = peer_fn(state)
+            hist, aux = hist_fn(state)
+            return replace(state, pg_hist=hist, pg_aux=aux)
+
+        self._peer_hist_fn_c = peer_hist_fn
+        return peer_hist_fn
+
+    @property
+    def _traffic_fn(self):
+        fn = getattr(self, "_traffic_fn_c", None)
+        if fn is not None:
+            return fn
+        # deferred: workload.traffic imports recovery.peering, whose
+        # package __init__ loads this module — a module-level import
+        # would close that cycle
+        from ..workload.histogram import LAT_MIN_MS, N_BUCKETS
+        from ..workload.traffic import (
+            _route,
+            _scatter_load,
+            _traffic_reduce,
+        )
+
+        n_ops = self.n_ops
+        n_osds = int(self._state_prev.osd_weight.shape[0])
+        pg_b = np.uint32(self.pg_num)
+        pg_bmask = np.uint32(
+            (1 << max(self.pg_num - 1, 1).bit_length()) - 1
+        )
+        k = np.int32(self.k)
+        size = np.int32(self.size)
+        min_size = np.int32(self.min_size)
+        wpm = np.int32(self.write_permille)
+        service_ms = np.float32(self.service_ms)
+        cap_ops = np.float32(self.cap_ops)
+        salt_base = np.uint32((self.seed * 2654435761) & 0xFFFFFFFF)
+
+        @jax.jit
+        def traffic_fn(state: ClusterState, step):
+            # the TrafficEngine's per-step salt, u32 wraparound exact
+            salt = salt_base + step.astype(U32) * _SALT_STEP
+            ids = jnp.arange(n_ops, dtype=U32)
+            in_range = jnp.ones(n_ops, dtype=bool)
+            load = _scatter_load(
+                state.survivor_mask, state.n_alive,
+                state.acting_primary, ids, in_range,
+                salt, pg_b, pg_bmask, k, size, min_size, wpm, n_osds,
+            )
+            (counts, lat_hist, qd_hist, sums, max_rho, _written,
+             _deg_read) = _traffic_reduce(
+                state.survivor_mask, state.n_alive,
+                state.acting_primary, ids, in_range, load,
+                salt, pg_b, pg_bmask, k, size, min_size, wpm,
+                service_ms, cap_ops, 0.0, N_BUCKETS, LAT_MIN_MS,
+            )
+            # the epoch series only needs the committed-write and
+            # degraded-read TOTALS: sum the route predicates directly
+            # (integer-exact equal to summing the per-PG scatter
+            # tables, whose [pg_num]-wide scatters then dead-code out
+            # of the epoch program — the scan's hot floor)
+            pg, prim, is_write, blocked, degraded, _cost = _route(
+                state.survivor_mask, state.n_alive,
+                state.acting_primary, ids,
+                salt, pg_b, pg_bmask, k, size, min_size, wpm,
+            )
+            ok = in_range & ~blocked
+            writes = jnp.sum(
+                jnp.where(ok & is_write, 1, 0).astype(I32)
+            ).astype(I32)
+            deg_reads = jnp.sum(
+                jnp.where(ok & degraded & ~is_write, 1, 0).astype(I32)
+            ).astype(I32)
+            return (counts, lat_hist, qd_hist, sums, max_rho,
+                    writes, deg_reads)
+
+        self._traffic_fn_c = traffic_fn
+        return traffic_fn
+
+    @property
+    def _scrub_fn(self):
+        fn = getattr(self, "_scrub_fn_c", None)
+        if fn is not None:
+            return fn
+        period = self.scrub_period_s
+        if period <= 0:
+
+            @jax.jit
+            def scrub_fn(prev_now, now):
+                return jnp.int32(0)
+
+        else:
+            phases = jnp.asarray(scrub_phases(self.pg_num, period))
+
+            @jax.jit
+            def scrub_fn(prev_now, now):
+                # the Scrubber's staggered due-window, anchored at the
+                # previous epoch: a full period elapses -> everything
+                # due; otherwise the (lo, hi] phase window, wrapping
+                full = (now - prev_now) >= period
+                lo = prev_now % period
+                hi = now % period
+                in_win = jnp.where(
+                    lo <= hi,
+                    (phases > lo) & (phases <= hi),
+                    (phases > lo) | (phases <= hi),
+                )
+                return jnp.sum((full | in_win).astype(I32))
+
+        self._scrub_fn_c = scrub_fn
+        return scrub_fn
+
+    # -- one epoch (the scan body; the staged driver replays it as
+    #    separate launches with host syncs) ----------------------------
+
+    def _epoch_step(self, state: ClusterState, step):
+        prev_now = state.now
+        state, tape_dirty = self._tape_fn(state, step)
+        state, (nd, nu, no, down_total, down_ck, trans) = self._live_fn(
+            state
+        )
+        dirty = tape_dirty | trans
+        # pg_hist/pg_aux only move when peering moves (mask/n_alive/
+        # flags are peer_fn outputs), so the classify+reduce rides
+        # inside the dirty branch and quiet epochs carry it forward —
+        # value-identical to reclassifying unchanged inputs, and it
+        # keeps the [pg_num, N_STATES] reduce off the quiet floor
+        state = jax.lax.cond(
+            dirty, self._peer_hist_fn, lambda s: s, state
+        )
+        (counts, lat_hist, qd_hist, sums, max_rho, writes,
+         deg_reads) = self._traffic_fn(state, step)
+        scrub_due = self._scrub_fn(prev_now, state.now)
+        row = (
+            state.now, state.epoch, dirty.astype(I32), state.pg_hist,
+            state.pg_aux, counts, lat_hist, qd_hist, sums, max_rho,
+            writes, deg_reads, down_total, nd, nu, no, down_ck,
+            scrub_due,
+        )
+        return state, row
+
+    # -- drivers -------------------------------------------------------
+
+    def compile_superstep(self):
+        """The ONE jitted program: ``(state, steps) -> (state, rows)``,
+        a ``lax.scan`` of the fused epoch body over a step-index
+        window.  Compiled once; every chunk of every run reuses it."""
+        if self._scan_fn is None:
+
+            @jax.jit
+            def scan_fn(state, steps):
+                return jax.lax.scan(self._epoch_step, state, steps)
+
+            self._scan_fn = scan_fn
+        return self._scan_fn
+
+    def run_superstep(
+        self, n_epochs: int, *, snapshot_every: int = 0,
+        on_snapshot=None, pull: bool = True,
+    ):
+        """Drive the compiled scan; host exits only at snapshot
+        boundaries (every ``snapshot_every`` epochs; 0 = one chunk).
+        ``on_snapshot(start_epoch, series_chunk)`` sees each pulled
+        chunk — the journaling seam.  With ``pull=False`` and no
+        snapshots, returns ``(state, rows)`` device-resident (the
+        zero-host-transfer path the nonregression scenario pins)."""
+        scan_fn = self.compile_superstep()
+        state = self._init_state
+        chunk = int(snapshot_every) or int(n_epochs)
+        parts: list[EpochSeries] = []
+        dev_rows = None
+        start = 0
+        while start < n_epochs:
+            size = min(chunk, n_epochs - start)
+            steps = jnp.arange(start, start + size, dtype=I32)
+            state, rows = scan_fn(state, steps)
+            if pull or on_snapshot is not None:
+                part = EpochSeries.from_device(rows)
+                parts.append(part)
+                if on_snapshot is not None:
+                    on_snapshot(start, part)
+            else:
+                dev_rows = rows
+            start += size
+        self.final_state = state
+        if not pull and on_snapshot is None:
+            return state, dev_rows
+        return EpochSeries.concat(parts)
+
+    def run_staged(self, n_epochs: int, *, snapshot_every: int = 0,
+                   on_snapshot=None):
+        """The differential reference: the SAME jitted pieces as the
+        superstep, launched one stage at a time with host syncs
+        between them — today's per-epoch Python round-trip, kept
+        behind ``CEPH_TPU_EPOCH_SUPERSTEP=0``."""
+        state = self._init_state
+        rows = []
+        parts: list[EpochSeries] = []
+        flushed = 0
+
+        def flush(upto):
+            nonlocal flushed
+            if on_snapshot is not None and rows[flushed:upto]:
+                part = _series_from_host_rows(rows[flushed:upto])
+                parts.append(part)
+                on_snapshot(flushed, part)
+                flushed = upto
+
+        for e in range(int(n_epochs)):
+            prev_now = state.now
+            state, tape_dirty = self._tape_fn(state, jnp.int32(e))
+            state, (nd, nu, no, down_total, down_ck, trans) = (
+                self._live_fn(state)
+            )
+            # the per-epoch host syncs the superstep eliminates: the
+            # dirty decision round-trips to Python, and the host
+            # detector's per-tick lane mirror (LivenessDetector.tick
+            # device_gets all six heartbeat lanes for deadline and
+            # transition bookkeeping) is replayed faithfully
+            jax.device_get((
+                state.last_ack, state.laggy, state.markdowns,
+                state.down, state.down_since, state.out,
+            ))
+            dirty = bool(np.asarray(tape_dirty)) or bool(
+                np.asarray(trans)
+            )
+            if dirty:
+                state = self._peer_hist_fn(state)
+            (counts, lat_hist, qd_hist, sums, max_rho, writes,
+             deg_reads) = self._traffic_fn(state, jnp.int32(e))
+            scrub_due = self._scrub_fn(prev_now, state.now)
+            rows.append(tuple(
+                np.asarray(v) for v in (
+                    state.now, state.epoch, np.int32(dirty),
+                    state.pg_hist, state.pg_aux, counts, lat_hist,
+                    qd_hist, sums, max_rho, writes, deg_reads,
+                    down_total, nd, nu, no, down_ck, scrub_due,
+                )
+            ))
+            if snapshot_every and (e + 1) % snapshot_every == 0:
+                flush(e + 1)
+        flush(len(rows))
+        self.final_state = state
+        if parts and flushed == len(rows):
+            return EpochSeries.concat(parts)
+        return _series_from_host_rows(rows)
+
+    def run(self, n_epochs: int, *, snapshot_every: int = 0,
+            on_snapshot=None):
+        """Kill-switch dispatch (:func:`epoch_superstep_enabled`)."""
+        if epoch_superstep_enabled():
+            return self.run_superstep(
+                n_epochs, snapshot_every=snapshot_every,
+                on_snapshot=on_snapshot,
+            )
+        return self.run_staged(
+            n_epochs, snapshot_every=snapshot_every,
+            on_snapshot=on_snapshot,
+        )
+
+
+def _down_checksum(down):
+    """Order-free integer fingerprint of the down set (sum of id+1)."""
+    n = down.shape[0]
+    return jnp.sum(
+        jnp.where(down, jnp.arange(n, dtype=I32) + 1, 0)
+    ).astype(I32)
+
+
+def _series_from_host_rows(rows) -> EpochSeries:
+    cols = list(zip(*rows))
+    return EpochSeries(**{
+        f: np.stack([np.asarray(v) for v in col])
+        for f, col in zip(_SERIES_FIELDS, cols)
+    })
+
+
+def _peer_counts(acting: np.ndarray, n_osds: int) -> np.ndarray:
+    """Distinct co-serving peers per OSD from an acting table — the
+    failure-reporter pool (an OSD nobody peers with can never collect
+    enough down reports)."""
+    adj = np.zeros((n_osds, n_osds), bool)
+    for row in np.asarray(acting):
+        osds = [int(o) for o in row if o != ITEM_NONE and 0 <= o < n_osds]
+        for a in osds:
+            for b in osds:
+                adj[a, b] = True
+    np.fill_diagonal(adj, False)
+    return adj.sum(axis=1).astype(np.int32)
+
+
+def build_epoch_driver(m: OSDMap, timeline: ChaosTimeline,
+                       **kwargs) -> EpochDriver:
+    """Convenience constructor (the CLI/bench surface)."""
+    return EpochDriver(m, timeline, **kwargs)
+
+
+def compile_epoch_superstep(driver: EpochDriver):
+    """The fused one-launch epoch program for a built driver:
+    ``scan_fn(state, steps) -> (state, rows)``.  Heartbeats, liveness
+    transitions, fused peering (the PR-11 ``PipelineCache`` program),
+    PG-state classification, the traffic step, and the scrub-window
+    tick — one ``lax.scan``, zero host exits inside."""
+    return driver.compile_superstep()
+
+
+def run_epochs(
+    m_or_driver,
+    timeline: ChaosTimeline | None = None,
+    n_epochs: int = 0,
+    *,
+    snapshot_every: int = 0,
+    on_snapshot=None,
+    **kwargs,
+) -> EpochSeries:
+    """Run an epoch loop end to end.  Accepts a prebuilt
+    :class:`EpochDriver` or ``(OSDMap, ChaosTimeline)`` plus driver
+    kwargs; dispatches superstep-vs-staged on the
+    ``CEPH_TPU_EPOCH_SUPERSTEP`` kill switch; exits to host only at
+    ``snapshot_every`` journal boundaries."""
+    if isinstance(m_or_driver, EpochDriver):
+        driver = m_or_driver
+    else:
+        if timeline is None:
+            raise ValueError("run_epochs(m, timeline, n_epochs, ...)")
+        driver = EpochDriver(m_or_driver, timeline, **kwargs)
+    return driver.run(
+        n_epochs, snapshot_every=snapshot_every, on_snapshot=on_snapshot
+    )

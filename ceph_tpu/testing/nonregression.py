@@ -125,6 +125,11 @@ def compile_once_cases() -> dict[str, dict]:
       (:mod:`ceph_tpu.recovery.pipeline`) across a down-OSD/reweight
       epoch — the chaos timeline's per-epoch cost must stay one cached
       executable, zero recompiles.
+    - ``epoch_superstep``: the one-scan compiled epoch loop
+      (:mod:`ceph_tpu.recovery.superstep`) over a chaos tape — a
+      second same-shape epoch window must reuse the one compiled scan
+      with ZERO device->host transfers inside it (the whole point of
+      the superstep: host exits only at snapshot boundaries).
 
     Raises ``AssertionError`` (from
     :func:`ceph_tpu.analysis.runtime_guard.assert_no_recompile`) if
@@ -323,6 +328,30 @@ def compile_once_cases() -> dict[str, dict]:
         eng.run(state_a, state_b)
     report["fused_placement"] = {
         "warm_compiles": warm_p.n_compiles, "second_compiles": 0,
+    }
+
+    # ---- epoch superstep: scan window -> same-shape window --------------
+    from ..analysis.runtime_guard import track
+    from ..recovery.chaos import ChaosEvent, ChaosTimeline
+    from ..recovery.superstep import EpochDriver
+
+    m_e = build_osdmap(32, pg_num=16, size=6, pool_kind="erasure")
+    tape = ChaosTimeline([
+        ChaosEvent(0.3, (parse_spec("osd:3:down_out"), parse_spec("slow:7"))),
+    ])
+    with CompileCounter() as warm_e:
+        drv = EpochDriver(m_e, tape, n_ops=64)
+        drv.run_superstep(8, pull=False)
+    # a second same-shape window: the one scan executable is reused,
+    # and with pull=False nothing inside it syncs to host — the
+    # zero-host-transfer contract the staged path exists to contrast
+    with assert_no_recompile("epoch superstep second window"):
+        with track() as g_e:
+            drv.run_superstep(8, pull=False)
+    assert g_e.host_transfers == 0, g_e.host_transfers
+    report["epoch_superstep"] = {
+        "warm_compiles": warm_e.n_compiles, "second_compiles": 0,
+        "in_scan_host_transfers": g_e.host_transfers,
     }
     return report
 

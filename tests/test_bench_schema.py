@@ -716,6 +716,7 @@ def test_crush_record_schema_carries_provenance():
         "tpu", 50_123_456.7, 156_000.0, 3, 3, 1, resolved, True,
     )
     assert rec["metric"] == "crush_placements_per_sec"
+    assert rec["status"] == "ok"  # completed measurement, typed
     assert rec["value"] == 50_123_457
     assert rec["vs_baseline"] == round(50_123_456.7 / 156_000.0, 2)
     assert rec["kernel_mode"] == "level"
@@ -723,6 +724,109 @@ def test_crush_record_schema_carries_provenance():
     assert rec["kernel_gate"] == "bit-exact on golden maps"
     assert rec["fused_pipeline"] is True
     json.dumps(rec)
+
+
+# --- config7_epoch_loop JSON schema (compiled epoch superstep) --------
+
+_CONFIG7 = os.path.join(os.path.dirname(_BENCH), "bench", "config7_epoch_loop.py")
+_spec7 = importlib.util.spec_from_file_location("bench_config7", _CONFIG7)
+config7 = importlib.util.module_from_spec(_spec7)
+_spec7.loader.exec_module(config7)
+
+
+def test_epoch_record_schema():
+    import json
+
+    rec = config7.build_epoch_record(
+        "tpu", 19_990.4, 642.3, True, 1024, 4, 4, 36, True,
+    )
+    assert rec["metric"] == "epoch_loop_rate_per_sec"
+    assert rec["status"] == "ok"
+    assert rec["value"] == 19_990 and rec["unit"] == "epochs/s"
+    assert rec["platform"] == "tpu"
+    # the acceptance-bar headline: superstep/staged epoch-rate ratio
+    assert rec["vs_baseline"] == rec["epoch_speedup"] == round(
+        19_990.4 / 642.3, 2
+    )
+    assert rec["epoch_rate_superstep_per_sec"] == 19_990.4
+    assert rec["epoch_rate_staged_per_sec"] == 642.3
+    # bit-equality gates the rate; the kill-switch state is provenance
+    assert rec["epoch_bitequal"] is True
+    assert rec["epoch_superstep_enabled"] is True
+    assert rec["epoch_n_osds"] == config7.N_OSDS
+    assert rec["epoch_pg_num"] == config7.PG_NUM
+    assert rec["epoch_n_ops"] == config7.N_OPS
+    assert rec["epoch_epochs_measured"] == 1024
+    assert rec["n_compiles"] == 4 and rec["n_compiles_first"] == 4
+    assert rec["host_transfers"] == 36
+    json.dumps(rec)  # one JSON line, always serializable
+
+
+def test_epoch_record_zero_staged_rate():
+    # a failed staged pass must not divide by zero or fake a speedup
+    rec = config7.build_epoch_record(
+        "cpu", 1000.0, 0.0, False, 64, 1, 1, 0, True,
+    )
+    assert rec["vs_baseline"] is None
+    assert rec["epoch_speedup"] == 0.0
+    assert rec["epoch_bitequal"] is False
+
+
+def _load_dd(tag):
+    _DD = os.path.join(os.path.dirname(_BENCH), "bench", "decide_defaults.py")
+    _sdd = importlib.util.spec_from_file_location(f"bench_dd_{tag}", _DD)
+    dd = importlib.util.module_from_spec(_sdd)
+    _sdd.loader.exec_module(dd)
+    return dd
+
+
+def test_epoch_record_harvested_by_decide_defaults(tmp_path):
+    import json
+
+    rec = config7.build_epoch_record(
+        "tpu", 19_990.4, 642.3, True, 1024, 4, 4, 36, True,
+    )
+    p = tmp_path / "session.log"
+    p.write_text(json.dumps(rec) + "\n")
+    dd = _load_dd("epoch")
+    g = dd.harvest_guard([str(p)])["epoch_loop_rate_per_sec"]
+    assert g["epoch_rate_superstep_per_sec"] == 19_990.4
+    assert g["epoch_rate_staged_per_sec"] == 642.3
+    assert g["epoch_speedup"] == round(19_990.4 / 642.3, 2)
+    assert g["epoch_n_osds"] == config7.N_OSDS
+    assert g["epoch_bitequal"] is True
+    assert g["epoch_superstep_enabled"] is True
+    assert g["steady_state_clean"] is True
+
+
+def test_timeout_records_skipped_by_harvests(tmp_path):
+    """BENCH_r05: a hung child's salvaged record used to surface as
+    ``value: 0`` and poison the best-of merge — typed ``status:
+    "timeout"`` lines must be invisible to every harvest."""
+    import json
+
+    good = config7.build_epoch_record(
+        "tpu", 19_990.4, 642.3, True, 1024, 4, 4, 36, True,
+    )
+    dead = {
+        "metric": "epoch_loop_rate_per_sec", "status": "timeout",
+        "value": None, "platform": "tpu",
+        "epoch_rate_superstep_per_sec": 0.0, "n_compiles": 0,
+        "n_compiles_first": 0, "host_transfers": 0,
+    }
+    dead_aux = {
+        "metric": "recovery_decode_bytes_per_sec", "status": "timeout",
+        "value": 0, "platform": "tpu",
+    }
+    p = tmp_path / "session.log"
+    p.write_text("\n".join(json.dumps(d) for d in (good, dead, dead_aux)))
+    dd = _load_dd("timeout")
+    g = dd.harvest_guard([str(p)])
+    # latest-line-wins would have let the dead record shadow the good
+    # one; the typed skip keeps the real measurement
+    assert g["epoch_loop_rate_per_sec"]["epoch_rate_superstep_per_sec"] == 19_990.4
+    assert "recovery_decode_bytes_per_sec" not in g
+    assert dd.harvest_aux([str(p)]) == {}
 
 
 def test_crush_record_provenance_harvested_by_decide_defaults(tmp_path):

@@ -1,0 +1,132 @@
+"""Device-resident ClusterState: compiled O(delta) incrementals.
+
+The resident pytree's hot-loop update path (:func:`apply_incremental`)
+must be an exact twin of the host pair ``OSDMap.apply_incremental`` +
+``build_pool_state`` for the per-OSD lanes it covers, refuse the
+structural edits it cannot express, and bucket its scatter pads so
+delta *size* never compiles a new program.
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.core.cluster_state import (
+    ClusterState,
+    _apply_delta_fn,
+    _pad_to,
+    apply_incremental,
+    incremental_arrays,
+)
+from ceph_tpu.models.clusters import build_osdmap
+from ceph_tpu.osdmap.map import EXISTS, UP, Incremental
+from ceph_tpu.osdmap.mapping import build_pool_state
+
+
+def _map():
+    return build_osdmap(32, pg_num=16, size=6, pool_kind="erasure")
+
+
+def _pool_lanes(state):
+    return {
+        "osd_up": np.asarray(state.pool.osd_up),
+        "osd_exists": np.asarray(state.pool.osd_exists),
+        "osd_weight": np.asarray(state.pool.osd_weight),
+        "primary_affinity": np.asarray(state.pool.primary_affinity),
+    }
+
+
+def _assert_matches_host(m, state):
+    host = build_pool_state(m, m.pools[min(m.pools)])
+    want = {
+        "osd_up": np.asarray(host.osd_up),
+        "osd_exists": np.asarray(host.osd_exists),
+        "osd_weight": np.asarray(host.osd_weight),
+        "primary_affinity": np.asarray(host.primary_affinity),
+    }
+    got = _pool_lanes(state)
+    for lane in want:
+        assert np.array_equal(got[lane], want[lane]), lane
+    assert int(state.epoch) == m.epoch
+
+
+def test_apply_incremental_matches_host_rebuild():
+    m = _map()
+    state = ClusterState.from_osdmap(m)
+    # the hot-loop delta mix chaos actually emits: downs, a reweight,
+    # an affinity change
+    inc = Incremental(
+        epoch=m.epoch + 1,
+        new_state={3: UP, 7: UP},          # xor: mark 3 and 7 down
+        new_weight={5: 0x8000, 9: 0},      # reweight + out
+        new_primary_affinity={2: 0x8000},
+    )
+    state = apply_incremental(state, inc)
+    m.apply_incremental(inc)
+    _assert_matches_host(m, state)
+    # a second delta reversing part of the first (up again via xor)
+    inc2 = Incremental(epoch=m.epoch + 1, new_state={3: UP},
+                       new_weight={5: 0x10000})
+    state = apply_incremental(state, inc2)
+    m.apply_incremental(inc2)
+    _assert_matches_host(m, state)
+
+
+def test_apply_incremental_exists_flip_forces_up_false():
+    m = _map()
+    state = ClusterState.from_osdmap(m)
+    # destroying an OSD (EXISTS xor) must drop its effective up bit
+    inc = Incremental(epoch=m.epoch + 1, new_state={4: EXISTS | UP})
+    state = apply_incremental(state, inc)
+    m.apply_incremental(inc)
+    _assert_matches_host(m, state)
+    assert not bool(np.asarray(state.pool.osd_up)[4])
+    assert not bool(np.asarray(state.pool.osd_exists)[4])
+
+
+def test_structural_incrementals_are_rejected():
+    m = _map()
+    state = ClusterState.from_osdmap(m)
+    with pytest.raises(ValueError, match="new_max_osd"):
+        apply_incremental(
+            state, Incremental(epoch=m.epoch + 1, new_max_osd=64)
+        )
+    from ceph_tpu.osdmap.map import PGId
+
+    with pytest.raises(ValueError, match="structural"):
+        apply_incremental(
+            state,
+            Incremental(
+                epoch=m.epoch + 1, new_pg_temp={PGId(1, 0): (1, 2, 3)}
+            ),
+        )
+
+
+def test_pad_bucketing_never_recompiles_within_bucket():
+    assert [_pad_to(n) for n in (0, 1, 2, 3, 4, 5, 8, 9)] == [
+        1, 1, 2, 4, 4, 8, 8, 16,
+    ]
+    # deltas of size 3 and 4 land in the same pad bucket -> the SAME
+    # compiled scatter program serves both (delta size is not a shape)
+    arrs3 = incremental_arrays(
+        Incremental(epoch=2, new_state={1: UP, 2: UP, 3: UP}), 32
+    )
+    arrs4 = incremental_arrays(
+        Incremental(epoch=2, new_state={1: UP, 2: UP, 3: UP, 4: UP}), 32
+    )
+    assert arrs3[0].shape == arrs4[0].shape == (4,)
+    fn3 = _apply_delta_fn(4, 1, 1)
+    fn4 = _apply_delta_fn(4, 1, 1)
+    assert fn3 is fn4
+    # pad rows carry an out-of-range index the scatter drops
+    assert int(arrs3[0][3]) == 32
+
+
+def test_from_osdmap_reporter_validation():
+    m = _map()
+    with pytest.raises(ValueError, match="reporters shape"):
+        ClusterState.from_osdmap(m, reporters=np.zeros(7, np.int32))
+    st = ClusterState.from_osdmap(
+        m, reporters=np.full(32, 3, np.int32)
+    )
+    assert (np.asarray(st.reporters) == 3).all()
+    assert st.n_osds == 32 and st.pg_num == 16

@@ -205,6 +205,40 @@ def test_run_one_salvages_result_printed_before_teardown_hang(tmp_path):
     assert rec["rc"] == -1
     assert rec["teardown_timed_out"] is True
     assert rec["result"]["value"] == 7
+    # a complete measurement keeps its own status (absent here): the
+    # typed timeout stamp is for value-less salvage only
+    assert rec["result"].get("status") != "timeout"
+
+
+def test_run_one_types_valueless_salvage_as_timeout(tmp_path):
+    """BENCH_r05: a child that hung before measuring used to surface as
+    ``value: 0`` and poison decide_defaults' best-of merge — the typed
+    status lets harvests skip it."""
+    import textwrap
+
+    stub = tmp_path / "stub_cfg.py"
+    stub.write_text(textwrap.dedent("""
+        import json, time
+        print(json.dumps({"metric": "stub", "value": 0}), flush=True)
+        time.sleep(60)
+    """))
+    rec = run_all._run_one(
+        "stub", os.path.relpath(str(stub), run_all._REPO), timeout=2
+    )
+    assert rec["rc"] == -1
+    assert rec["result"]["status"] == "timeout"
+
+
+def test_run_one_synthesizes_typed_timeout_record(tmp_path):
+    stub = tmp_path / "stub_cfg.py"
+    stub.write_text("import time\ntime.sleep(60)\n")
+    rec = run_all._run_one(
+        "stub", os.path.relpath(str(stub), run_all._REPO), timeout=2
+    )
+    assert rec["rc"] == -1
+    assert rec["result"] == {
+        "metric": "stub", "status": "timeout", "value": None,
+    }
 
 
 def test_unfiltered_configs_cover_all_baseline_configs():
@@ -214,7 +248,7 @@ def test_unfiltered_configs_cover_all_baseline_configs():
         "config4_repair_decode", "config5_rebalance_sim",
         "config6_recovery", "config6_recovery_multichip",
         "config6_recovery_scrub", "config6_recovery_liveness",
-        "tpu_tier",
+        "config7_epoch_loop", "tpu_tier",
     ]
     # the flag-mode entries re-use the config6 file
     for name, flag in (

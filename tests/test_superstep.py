@@ -1,0 +1,142 @@
+"""Compiled epoch superstep vs the staged per-epoch reference.
+
+The superstep's contract is bit-equality *by construction*: the scan
+body composes the very same jitted piece functions the staged path
+launches one at a time, so over any chaos tape the two must produce
+identical PG-state series, liveness transitions, and traffic outcome
+counts — floats compared exactly, no tolerance.  The zoo below is the
+chaos scenario set the failure-detection and integrity PRs pinned;
+netsplit gets a dedicated hold-long-enough-to-mark-down timeline
+because the stock scenarios restore inside the grace window.
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.models.clusters import build_osdmap
+from ceph_tpu.recovery import EpochDriver, build_scenario, run_epochs
+from ceph_tpu.recovery.chaos import ChaosEvent, ChaosTimeline
+from ceph_tpu.recovery.failure import parse_spec
+from ceph_tpu.recovery.superstep import compile_event_tape
+
+ZOO = (
+    "flap",
+    "rack-cascade",
+    "mid-repair-loss",
+    "silent-bitrot",
+    "scrub-storm",
+    "flapping-osd",
+)
+
+
+def _map(n_osd=64, pg_num=128):
+    return build_osdmap(n_osd, pg_num=pg_num, size=6, pool_kind="erasure")
+
+
+@pytest.mark.parametrize("scenario", ZOO)
+def test_superstep_bitequal_over_zoo(scenario):
+    m = _map()
+    d = EpochDriver(m, build_scenario(scenario, m), n_ops=256)
+    sup = d.run_superstep(40)
+    staged = d.run_staged(40)
+    # every lane bit-equal: PG-state histograms, liveness transitions
+    # (eff_down/up/out + the down-set checksum), traffic outcomes,
+    # scrub windows, clocks and epochs
+    assert sup.diff(staged) == []
+    # the run must not be vacuous: scenarios with map actions exercise
+    # the dirty re-peer path; flapping-osd's netsplits stay inside the
+    # grace window (liveness lanes move, the map never does) and
+    # silent-bitrot's events are host-store-only and emit no rows
+    if scenario == "silent-bitrot":
+        assert d.tape.n_bitrot > 0
+    elif scenario == "flapping-osd":
+        assert len(d.tape) > 0 and sup.dirty.sum() == 0
+    else:
+        assert sup.dirty.sum() > 0, scenario
+    # traffic conservation: served + degraded + blocked == ops issued
+    assert (sup.counts.sum(axis=1) == 256).all()
+
+
+def test_superstep_bitequal_netsplit_hold():
+    # the stock scenarios restore the split inside the grace window;
+    # to drive real mark-down -> auto-out transitions through BOTH
+    # paths, hold a 2-OSD netsplit past a tightened grace/out interval
+    cfg = Config(env={})
+    cfg.set("osd_heartbeat_grace", 0.5)
+    cfg.set("mon_osd_down_out_interval", 2.0)
+    m = _map()
+    timeline = ChaosTimeline([
+        ChaosEvent(0.3, (parse_spec("netsplit:3"), parse_spec("netsplit:9"))),
+        ChaosEvent(8.0, (parse_spec("netsplit:3:restore"),
+                         parse_spec("netsplit:9:restore"))),
+    ])
+    d = EpochDriver(m, timeline, n_ops=256, config=cfg)
+    sup = d.run_superstep(48)
+    staged = d.run_staged(48)
+    assert sup.diff(staged) == []
+    # the liveness transition series actually moved: both OSDs marked
+    # down, then auto-outed, then marked up again on restore
+    assert sup.eff_down.sum() == 2
+    assert sup.eff_out.sum() == 2
+    assert sup.eff_up.sum() == 2
+    assert sup.down_total.max() == 2
+
+
+def test_kill_switch_pins_staged_path(monkeypatch):
+    m = _map(32, 64)
+    timeline = ChaosTimeline([ChaosEvent(0.3, (parse_spec("osd:3:down_out"),))])
+    d = EpochDriver(m, timeline, n_ops=64)
+    calls = []
+    orig = EpochDriver.run_staged
+    monkeypatch.setattr(
+        EpochDriver, "run_staged",
+        lambda self, *a, **kw: (calls.append("staged"), orig(self, *a, **kw))[1],
+    )
+    monkeypatch.setenv("CEPH_TPU_EPOCH_SUPERSTEP", "0")
+    off = d.run(12)
+    assert calls == ["staged"]
+    monkeypatch.setenv("CEPH_TPU_EPOCH_SUPERSTEP", "1")
+    on = d.run(12)
+    assert calls == ["staged"]  # superstep path did not re-enter staged
+    # flipping the switch changes the execution strategy, never the data
+    assert on.diff(off) == []
+
+
+def test_run_epochs_convenience_and_snapshots():
+    m = _map(32, 64)
+    timeline = ChaosTimeline([ChaosEvent(0.3, (parse_spec("osd:5"),))])
+    seen = []
+    series = run_epochs(
+        m, timeline, 16, n_ops=64, snapshot_every=4,
+        on_snapshot=lambda start, part: seen.append((start, len(part))),
+    )
+    assert len(series) == 16
+    # journal boundaries: four chunks of four, in order
+    assert seen == [(0, 4), (4, 4), (8, 4), (12, 4)]
+    # chunked and one-shot runs see the same tape -> same series
+    d = EpochDriver(m, timeline, n_ops=64)
+    assert series.diff(d.run_superstep(16)) == []
+
+
+def test_event_tape_shape_and_bumps():
+    m = _map(32, 64)
+    timeline = ChaosTimeline([
+        ChaosEvent(0.3, (parse_spec("osd:3:down_out"), parse_spec("slow:7"))),
+        ChaosEvent(0.8, (parse_spec("netsplit:5"),)),
+    ])
+    tape = compile_event_tape(timeline, m)
+    # down_out:3 -> DOWN+OUT rows, slow:7 -> one SLOW row, netsplit:5
+    # -> one NET row; only the first event has map rows -> one bump
+    assert len(tape) == 4
+    assert tape.bump.sum() == 1
+    assert (np.diff(tape.t) >= 0).all()
+
+
+def test_event_tape_rejects_conflicting_actions():
+    m = _map(32, 64)
+    timeline = ChaosTimeline([
+        ChaosEvent(0.3, (parse_spec("osd:3:down"), parse_spec("osd:3:up"))),
+    ])
+    with pytest.raises(ValueError, match="conflicting"):
+        compile_event_tape(timeline, m)
