@@ -401,10 +401,15 @@ def format_result(
     """Build the one scored JSON line.
 
     A non-TPU measurement is NOT reported under the headline metric: the
-    metric name gains a ``_cpu_fallback`` suffix and the headline fields
-    are zeroed, so a reader scanning only ``value``/``vs_baseline`` can
-    never mistake a host-backend fallback for a device result (round-3
-    verdict, weakness 5).
+    metric name gains a ``_cpu_fallback`` suffix and ``status`` says
+    ``"cpu_fallback"`` (or ``"failed"`` when there is no measurement at
+    all), so a reader scanning the record can never mistake a
+    host-backend fallback for a device result (round-3 verdict,
+    weakness 5).  The measured host rate IS promoted to ``value`` /
+    ``vs_baseline`` — a zeroed headline made trajectory plots show a
+    false regression on every fallback run (BENCH_r05) — with the
+    ``cpu_fallback_rate`` / ``cpu_fallback_vs_baseline`` side fields
+    kept for older readers.
 
     When the live device attempt fails but a prior silicon measurement is
     banked (``bench/banked_headline.json``), the fallback JSON carries it
@@ -420,13 +425,19 @@ def format_result(
             "value": round(result["rate"]),
             "unit": "placements/s",
             "vs_baseline": round(result["rate"] / cpu_rate, 2) if cpu_rate else 0.0,
+            "status": "ok",
         }
     else:
         out = {
             "metric": "crush_placements_per_sec_cpu_fallback",
-            "value": 0,
+            "value": round(result["rate"]) if result else 0,
             "unit": "placements/s",
-            "vs_baseline": 0.0,
+            "vs_baseline": (
+                round(result["rate"] / cpu_rate, 2)
+                if result and cpu_rate
+                else 0.0
+            ),
+            "status": "cpu_fallback" if result else "failed",
         }
         if result:
             out["cpu_fallback_rate"] = round(result["rate"])

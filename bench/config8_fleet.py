@@ -1,0 +1,335 @@
+"""BASELINE config 8: vmapped scenario fleets, Monte Carlo durability.
+
+Drives :class:`~ceph_tpu.recovery.fleet.FleetDriver` — N seeded,
+jittered chaos timelines advancing as one leading-axis
+:class:`ClusterState` pytree through ONE compiled scan — and reports
+aggregate *cluster-epochs per second* against the sequential way the
+repo ran distinct timelines before the fleet existed: one
+:class:`EpochDriver` per timeline, whose event tape is baked into the
+program as constants, so every new timeline pays its own XLA compile.
+That compile is the real per-scenario cost a population study pays N
+times, which is why the headline baseline includes it
+(``fleet_seq_includes_compile: true`` in-record); the warm
+tape-as-argument sequential rate — itself a capability this fleet
+layer adds — rides along as ``fleet_seq_epoch_rate_warm_per_sec``
+with its own honest ratio, which lockstep divergence can push below
+1x (``bench/PERF_MODEL.md`` itemizes the cost model).
+
+The headline only counts when the same record shows
+``fleet_bitequal: true`` — every sampled fleet lane exactly matches
+its own sequential superstep run (``EpochSeries.diff``, all 18
+series fields) — and ``fleet_same_bucket_zero_recompile: true`` — a
+different fleet size inside the same power-of-two pad bucket reuses
+the compiled program, zero new compiles.
+
+A Monte Carlo durability panel (survival / MTTDL CI / availability /
+time-to-zero-degraded per scenario) and a ``decide_defaults`` sweep
+grid (``mon_osd_down_out_interval`` x mclock recovery share, scored
+on measured fleet outcomes) ride along.  Emits one JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+FLEET = int(os.environ.get("CEPH_TPU_BENCH_FLEET", 256))
+N_OSDS = int(os.environ.get("CEPH_TPU_BENCH_FLEET_OSDS", 32))
+PG_NUM = int(os.environ.get("CEPH_TPU_BENCH_FLEET_PGS", 16))
+N_OPS = int(os.environ.get("CEPH_TPU_BENCH_FLEET_OPS", 32))
+EPOCHS = int(os.environ.get("CEPH_TPU_BENCH_FLEET_EPOCHS", 256))
+#: sequential-baseline sample size (timed one cluster at a time, then
+#: expressed as a rate — 256 cold compiles would measure nothing new)
+SEQ_COLD = int(os.environ.get("CEPH_TPU_BENCH_FLEET_SEQ", 2))
+SCENARIO = os.environ.get("CEPH_TPU_BENCH_FLEET_SCENARIO", "ssd-burst")
+PANEL = tuple(
+    s for s in os.environ.get(
+        "CEPH_TPU_BENCH_FLEET_PANEL", "ssd-steady,ssd-burst,ssd-skew"
+    ).split(",") if s
+)
+SWEEP = os.environ.get("CEPH_TPU_BENCH_FLEET_SWEEP", "1") not in (
+    "0", "", "false"
+)
+SWEEP_FLEET = int(os.environ.get("CEPH_TPU_BENCH_FLEET_GRID", 16))
+SWEEP_EPOCHS = int(os.environ.get("CEPH_TPU_BENCH_FLEET_GRID_EPOCHS", 48))
+SEED = int(os.environ.get("CEPH_TPU_BENCH_FLEET_SEED", 0))
+N_BOOT = int(os.environ.get("CEPH_TPU_BENCH_FLEET_BOOT", 256))
+EC_K, EC_M = 4, 2
+
+#: the decide_defaults sweep grid: mon_osd_down_out_interval seconds x
+#: mclock recovery weight (normalized against the client/scrub weights
+#: into the traffic step's recovery utilization share)
+DOWN_OUT_GRID = (30.0, 120.0, 600.0)
+RECOVERY_WGT_GRID = (1.0, 4.0)
+
+
+def build_fleet_record(platform, fleet_rate, seq_cold_rate,
+                       seq_warm_rate, bitequal, same_bucket_zero,
+                       ftape, est, panel, sweep_grid, best,
+                       n_compiles, n_compiles_first, host_transfers):
+    """One JSON line for the fleet headline.
+
+    ``value`` is aggregate cluster-epochs/s of the vmapped fleet scan;
+    ``vs_baseline`` divides by the per-timeline sequential rate
+    *including each timeline's compile* (the pre-fleet cost of N
+    distinct scenarios — typed via ``fleet_seq_includes_compile``).
+    The ``fleet_*`` / ``durability_*`` fields are the
+    ``decide_defaults`` harvest surface; ``fleet_scenario_panel`` is
+    the ``cli.status fleet`` panel; ``status`` is ``"ok"`` for a
+    completed measurement (run_all stamps ``"timeout"`` on salvage).
+    """
+    rec = {
+        "metric": "fleet_epoch_rate_per_sec",
+        "status": "ok",
+        "value": round(fleet_rate),
+        "unit": "cluster-epochs/s",
+        "vs_baseline": round(fleet_rate / seq_cold_rate, 2)
+        if seq_cold_rate else 0.0,
+        "platform": platform,
+        "fleet_scenario": SCENARIO,
+        "fleet_n_clusters": int(FLEET),
+        "fleet_n_epochs": int(EPOCHS),
+        "fleet_n_osds": int(N_OSDS),
+        "fleet_pg_num": int(PG_NUM),
+        "fleet_n_ops": int(N_OPS),
+        "fleet_pad": int(ftape.fleet_pad),
+        "fleet_rows_pad": int(ftape.rows_pad),
+        "fleet_seq_clusters_measured": int(SEQ_COLD),
+        "fleet_epoch_rate_per_sec": round(fleet_rate, 1),
+        "fleet_seq_epoch_rate_per_sec": round(seq_cold_rate, 2),
+        "fleet_seq_epoch_rate_warm_per_sec": round(seq_warm_rate, 1),
+        "fleet_seq_includes_compile": True,
+        "fleet_aggregate_speedup": round(fleet_rate / seq_cold_rate, 2)
+        if seq_cold_rate else 0.0,
+        "fleet_aggregate_speedup_warm": round(
+            fleet_rate / seq_warm_rate, 2
+        ) if seq_warm_rate else 0.0,
+        "fleet_bitequal": bool(bitequal),
+        "fleet_same_bucket_zero_recompile": bool(same_bucket_zero),
+        "fleet_scenario_panel": panel,
+        "n_compiles": int(n_compiles),
+        "n_compiles_first": int(n_compiles_first),
+        "host_transfers": int(host_transfers),
+    }
+    rec.update(est.to_dict())
+    if sweep_grid:
+        rec["fleet_sweep_grid"] = sweep_grid
+        rec["fleet_best_down_out_interval_s"] = float(
+            best["down_out_interval_s"]
+        )
+        rec["fleet_best_recovery_share"] = float(best["recovery_share"])
+    return rec
+
+
+def _panel_entry(est) -> dict:
+    """The per-scenario slice of a DurabilityEstimate the status CLI
+    renders (survival, MTTDL CI, worst-cluster health)."""
+    return {
+        "scenario": est.scenario,
+        "n_clusters": est.n_clusters,
+        "survival_fraction": round(est.survival_fraction, 9),
+        "n_lost": est.n_lost,
+        "mttdl_s": round(est.mttdl_s, 3),
+        "mttdl_ci_lo_s": round(est.mttdl_ci_lo_s, 3),
+        "mttdl_ci_hi_s": round(est.mttdl_ci_hi_s, 3),
+        "mttdl_censored": est.mttdl_censored,
+        "availability_mean": round(est.availability_mean, 9),
+        "ttzd_mean_s": round(est.ttzd_mean_s, 6),
+        "worst_cluster": est.worst_cluster,
+        "worst_availability": round(est.worst_availability, 9),
+    }
+
+
+def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="config8_fleet")
+    ap.add_argument("--scenario", default=None,
+                    help="named chaos scenario for the headline fleet "
+                         "(default: env CEPH_TPU_BENCH_FLEET_SCENARIO "
+                         "or ssd-burst)")
+    args = ap.parse_args()
+    global SCENARIO
+    if args.scenario:
+        SCENARIO = args.scenario
+
+    from ceph_tpu.common.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    import jax
+
+    from ceph_tpu.analysis.runtime_guard import CompileCounter, track
+    from ceph_tpu.common.config import Config, global_config
+    from ceph_tpu.models.clusters import build_osdmap
+    from ceph_tpu.recovery.durability import estimate_durability
+    from ceph_tpu.recovery.fleet import FleetDriver, FleetSeries, stack_tapes
+    from ceph_tpu.recovery.superstep import EpochDriver, compile_event_tape
+
+    m = build_osdmap(
+        N_OSDS, pg_num=PG_NUM, size=EC_K + EC_M, pool_kind="erasure"
+    )
+    fd = FleetDriver(m, seed=SEED, n_ops=N_OPS)
+    tls = fd.sample(FLEET, SCENARIO)
+    ftape = stack_tapes([compile_event_tape(tl, m) for tl in tls])
+
+    # -- headline: the vmapped fleet scan, warm-timed ------------------
+    with track() as guard:
+        state, rows = fd.run_fleet(EPOCHS, tls, pull=False)
+        jax.block_until_ready(state)
+        warm = guard.snapshot()
+        t0 = time.perf_counter()
+        state, rows = fd.run_fleet(EPOCHS, tls, pull=False)
+        jax.block_until_ready(rows)
+        fleet_elapsed = time.perf_counter() - t0
+    fleet_rate = FLEET * EPOCHS / fleet_elapsed
+    fs = FleetSeries.from_device(rows, FLEET)
+
+    # -- pad-bucket guard: a smaller fleet in the SAME power-of-two
+    # bucket must reuse the compiled program (fleet size is a value,
+    # never a shape)
+    with CompileCounter() as cc:
+        fd.run_fleet(EPOCHS, tls[: FLEET - 1], pull=False)
+    same_bucket_zero = cc.n_compiles == 0
+
+    # -- sequential baselines + bit-equality ---------------------------
+    # cold: the pre-fleet API — one EpochDriver per timeline, the tape
+    # baked into the program, so each timeline compiles.  Timed over
+    # SEQ_COLD sample timelines; the pulled series double as the
+    # strongest bit-equality references (plain run_superstep, exact).
+    t0 = time.perf_counter()
+    refs = []
+    for kk in range(SEQ_COLD):
+        d = EpochDriver(m, tls[kk], seed=SEED + kk, n_ops=N_OPS)
+        refs.append(d.run_superstep(EPOCHS))
+    seq_cold_rate = SEQ_COLD * EPOCHS / (time.perf_counter() - t0)
+
+    bitequal = True
+    for kk, ref in enumerate(refs):
+        diff = fs.cluster(kk).diff(ref)
+        if diff:
+            bitequal = False
+            print(
+                f"BITEQUAL FAIL: cluster {kk} differs: {diff}",
+                file=sys.stderr,
+            )
+
+    # warm: the fleet layer's own tape-as-argument one-cluster scan —
+    # one compiled program across all timelines, timed on its second
+    # pass (the strictest baseline; divergence can push the fleet
+    # below it, see PERF_MODEL)
+    fd.run_sequential(EPOCHS, tls[:SEQ_COLD])
+    t0 = time.perf_counter()
+    seqs = fd.run_sequential(EPOCHS, tls[:SEQ_COLD])
+    seq_warm_rate = SEQ_COLD * EPOCHS / (time.perf_counter() - t0)
+    for kk, s in enumerate(seqs):
+        if fs.cluster(kk).diff(s):
+            bitequal = False
+            print(
+                f"BITEQUAL FAIL: warm sequential cluster {kk}",
+                file=sys.stderr,
+            )
+
+    # -- Monte Carlo durability: headline scenario + panel -------------
+    down_out_default = float(
+        global_config().get("mon_osd_down_out_interval")
+    )
+    est = estimate_durability(
+        fs, dt=fd.driver.dt, scenario=SCENARIO, seed=SEED,
+        n_boot=N_BOOT, codec="reed-solomon", ec_k=EC_K, ec_m=EC_M,
+        placement="crush", down_out_interval_s=down_out_default,
+    )
+    panel = []
+    for sc in PANEL:
+        if sc == SCENARIO:
+            panel.append(_panel_entry(est))
+            continue
+        p_tls = fd.sample(FLEET, sc)
+        p_fs = fd.run_fleet(EPOCHS, p_tls)
+        panel.append(_panel_entry(estimate_durability(
+            p_fs, dt=fd.driver.dt, scenario=sc, seed=SEED,
+            n_boot=N_BOOT, codec="reed-solomon", ec_k=EC_K, ec_m=EC_M,
+            placement="crush", down_out_interval_s=down_out_default,
+        )))
+        print(f"panel {sc}: done", file=sys.stderr)
+
+    # -- decide_defaults sweep: down-out interval x mclock share -------
+    sweep_grid, best = [], None
+    if SWEEP:
+        for interval in DOWN_OUT_GRID:
+            for rec_w in RECOVERY_WGT_GRID:
+                cfg = Config(env={})
+                cfg.set("mon_osd_down_out_interval", interval)
+                cfg.set("osd_mclock_recovery_wgt", rec_w)
+                share = rec_w / (
+                    float(cfg.get("osd_mclock_client_wgt"))
+                    + rec_w
+                    + float(cfg.get("osd_mclock_scrub_wgt"))
+                )
+                sfd = FleetDriver(
+                    m, seed=SEED, n_ops=N_OPS, config=cfg,
+                    rho_recovery=share,
+                )
+                s_fs = sfd.run_fleet(
+                    SWEEP_EPOCHS, sfd.sample(SWEEP_FLEET, SCENARIO)
+                )
+                s_est = estimate_durability(
+                    s_fs, dt=sfd.driver.dt, scenario=SCENARIO,
+                    seed=SEED, n_boot=64, codec="reed-solomon",
+                    ec_k=EC_K, ec_m=EC_M, placement="crush",
+                    down_out_interval_s=interval,
+                )
+                point = {
+                    "down_out_interval_s": interval,
+                    "recovery_wgt": rec_w,
+                    "recovery_share": round(share, 6),
+                    "survival_fraction": round(
+                        s_est.survival_fraction, 9
+                    ),
+                    "availability_mean": round(
+                        s_est.availability_mean, 9
+                    ),
+                    "ttzd_mean_s": round(s_est.ttzd_mean_s, 6),
+                }
+                sweep_grid.append(point)
+                print(
+                    f"sweep down_out={interval:g}s share={share:.3f}: "
+                    f"survival={point['survival_fraction']:.3f} "
+                    f"avail={point['availability_mean']:.6f} "
+                    f"ttzd={point['ttzd_mean_s']:.2f}s",
+                    file=sys.stderr,
+                )
+        # best = survive first, then serve, then recover fast
+        best = max(
+            sweep_grid,
+            key=lambda p: (
+                p["survival_fraction"], p["availability_mean"],
+                -p["ttzd_mean_s"],
+            ),
+        )
+
+    print(
+        f"fleet {SCENARIO}: {FLEET} clusters x {EPOCHS} epochs "
+        f"({N_OSDS} OSDs / {PG_NUM} PGs / {N_OPS} ops): "
+        f"{fleet_rate:.0f} cluster-epochs/s, "
+        f"seq cold {seq_cold_rate:.1f} "
+        f"(-> {fleet_rate / seq_cold_rate:.0f}x), "
+        f"seq warm {seq_warm_rate:.0f} "
+        f"(-> {fleet_rate / seq_warm_rate:.2f}x), "
+        f"bitequal={'ok' if bitequal else 'FAIL'}, "
+        f"same_bucket_zero_recompile="
+        f"{'ok' if same_bucket_zero else 'FAIL'}",
+        file=sys.stderr,
+    )
+    print(json.dumps(build_fleet_record(
+        jax.default_backend(), fleet_rate, seq_cold_rate,
+        seq_warm_rate, bitequal, same_bucket_zero, ftape, est, panel,
+        sweep_grid, best, guard.n_compiles, warm["n_compiles"],
+        guard.host_transfers,
+    )))
+
+
+if __name__ == "__main__":
+    main()

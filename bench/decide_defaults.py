@@ -174,6 +174,56 @@ EPOCH_FLOAT_FIELDS = ("epoch_rate_superstep_per_sec",
                       "epoch_speedup")
 EPOCH_BOOL_FIELDS = ("epoch_bitequal", "epoch_superstep_enabled")
 
+# Scenario-fleet fields (config8_fleet): aggregate cluster-epochs/s of
+# the vmapped fleet scan vs the warm one-cluster sequential baseline.
+# ``fleet_bitequal`` gates the headline (every fleet lane must match
+# its own sequential superstep bit-for-bit), and
+# ``fleet_same_bucket_zero_recompile`` pins the pad-bucket contract
+# (a second same-bucket run compiles nothing).  ``fleet_best_*`` are
+# the sweep-harvest picks: the ``mon_osd_down_out_interval`` and
+# mclock recovery share with the best measured durability/availability
+# trade on the fleet grid.
+FLEET_INT_FIELDS = ("fleet_n_clusters", "fleet_n_epochs",
+                    "fleet_n_osds", "fleet_pg_num", "fleet_n_ops",
+                    "fleet_pad", "fleet_rows_pad",
+                    "fleet_seq_clusters_measured")
+FLEET_FLOAT_FIELDS = ("fleet_epoch_rate_per_sec",
+                      "fleet_seq_epoch_rate_per_sec",
+                      "fleet_seq_epoch_rate_warm_per_sec",
+                      "fleet_aggregate_speedup",
+                      "fleet_aggregate_speedup_warm",
+                      "fleet_best_down_out_interval_s",
+                      "fleet_best_recovery_share")
+FLEET_BOOL_FIELDS = ("fleet_bitequal",
+                     "fleet_same_bucket_zero_recompile",
+                     "fleet_seq_includes_compile")
+FLEET_STR_FIELDS = ("fleet_scenario",)
+
+# Monte Carlo durability fields (config8_fleet): the
+# ``DurabilityEstimate.to_dict`` surface — survival / MTTDL with
+# bootstrap CI / availability / time-to-zero-degraded, keyed per
+# (codec, k, m, placement, down-out interval).
+DURABILITY_INT_FIELDS = ("durability_n_clusters", "durability_n_epochs",
+                         "durability_n_lost", "durability_worst_cluster",
+                         "durability_seed", "durability_n_boot",
+                         "durability_ec_k", "durability_ec_m")
+DURABILITY_FLOAT_FIELDS = ("durability_mission_s",
+                           "durability_survival_fraction",
+                           "durability_mttdl_s",
+                           "durability_mttdl_ci_lo_s",
+                           "durability_mttdl_ci_hi_s",
+                           "durability_availability_mean",
+                           "durability_availability_ci_lo",
+                           "durability_availability_ci_hi",
+                           "durability_ttzd_mean_s",
+                           "durability_ttzd_ci_lo_s",
+                           "durability_ttzd_ci_hi_s",
+                           "durability_worst_availability",
+                           "durability_down_out_interval_s")
+DURABILITY_BOOL_FIELDS = ("durability_mttdl_censored",)
+DURABILITY_STR_FIELDS = ("durability_scenario", "durability_codec",
+                         "durability_placement")
+
 
 def harvest_aux(paths: list[str]) -> dict[str, int]:
     """Collect auxiliary metric -> best value from the logs.
@@ -295,6 +345,30 @@ def harvest_guard(paths: list[str]) -> dict[str, dict]:
             )
             fields.update(
                 {f: bool(d[f]) for f in EPOCH_BOOL_FIELDS if f in d}
+            )
+            fields.update(
+                {f: int(d[f]) for f in FLEET_INT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: float(d[f]) for f in FLEET_FLOAT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: bool(d[f]) for f in FLEET_BOOL_FIELDS if f in d}
+            )
+            fields.update(
+                {f: str(d[f]) for f in FLEET_STR_FIELDS if f in d}
+            )
+            fields.update(
+                {f: int(d[f]) for f in DURABILITY_INT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: float(d[f]) for f in DURABILITY_FLOAT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: bool(d[f]) for f in DURABILITY_BOOL_FIELDS if f in d}
+            )
+            fields.update(
+                {f: str(d[f]) for f in DURABILITY_STR_FIELDS if f in d}
             )
             # jaxlint per-rule counters (lint_active, lint_J007_active,
             # ...): dynamic key set — one field per registered rule, so

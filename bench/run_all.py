@@ -45,6 +45,7 @@ CONFIGS = [
     ("config6_recovery_liveness", "bench/config6_recovery.py",
      ("--liveness",)),
     ("config7_epoch_loop", "bench/config7_epoch_loop.py"),
+    ("config8_fleet", "bench/config8_fleet.py"),
     ("tpu_tier", "bench/tpu_tier.py"),
 ]
 

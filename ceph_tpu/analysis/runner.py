@@ -24,13 +24,15 @@ from .findings import RULES, Finding, Suppressions
 #: the CLI progress paths that drive it)
 HOT_SEGMENTS = frozenset(
     {"crush", "ec", "recovery", "osdmap", "balancer", "cli", "core",
-     "parallel", "obs", "workload", "liveness", "superstep"}
+     "parallel", "obs", "workload", "liveness", "superstep", "fleet",
+     "durability"}
 )
 
 #: path segments whose modules run on the VirtualClock (J010): real
 #: wall-clock reads there need a justified suppression
 VCLOCK_SEGMENTS = frozenset(
-    {"recovery", "workload", "chaos", "liveness", "superstep"}
+    {"recovery", "workload", "chaos", "liveness", "superstep", "fleet",
+     "durability"}
 )
 
 

@@ -16,7 +16,10 @@ Two modes::
 Commands: ``status`` (default; the ``ceph -s`` shape), ``health``
 (SLO healthchecks), ``timeline`` (the per-epoch PG-state series),
 ``journal`` (correlated span/event records; demo mode only unless the
-daemon registered a journal).
+daemon registered a journal), ``fleet`` (the Monte Carlo durability
+panel from the latest ``config8_fleet`` bench record — per-scenario
+survival fraction, MTTDL confidence interval, worst-cluster health;
+reads bench logs only, never runs a demo).
 """
 
 from __future__ import annotations
@@ -25,7 +28,8 @@ import argparse
 import json
 import sys
 
-COMMANDS = ("status", "health", "timeline", "journal", "caches")
+COMMANDS = ("status", "health", "timeline", "journal", "caches",
+            "fleet")
 
 #: CLI command -> admin-socket prefix (identity unless listed)
 _SOCKET_PREFIX = {"caches": "dump_placement_caches"}
@@ -76,6 +80,76 @@ def _render(cmd: str, reply: dict, as_json: bool, out) -> None:
     else:  # journal
         for r in reply.get("records", []):
             print(json.dumps(r, sort_keys=True), file=out)
+
+
+def load_fleet_record(paths=None) -> dict | None:
+    """Latest ``config8_fleet`` JSON line from the bench logs.
+
+    ``paths`` defaults to ``BENCH*.json`` in the working directory
+    (the run_all output files); within them, the last
+    ``fleet_epoch_rate_per_sec`` line wins — the same
+    latest-record-per-metric discipline ``decide_defaults`` uses.
+    """
+    import glob
+
+    if not paths:
+        paths = sorted(glob.glob("BENCH*.json"))
+    rec = None
+    for path in paths:
+        try:
+            lines = open(path).read().splitlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if d.get("metric") == "fleet_epoch_rate_per_sec":
+                rec = d
+    return rec
+
+
+def render_fleet(rec: dict, out) -> None:
+    """Text panel for one ``config8_fleet`` record: the headline rate
+    plus per-scenario survival / MTTDL CI / worst-cluster health."""
+    bitequal = rec.get("fleet_bitequal")
+    print(
+        f"fleet: {rec.get('fleet_n_clusters', '?')} clusters x "
+        f"{rec.get('fleet_n_epochs', '?')} epochs "
+        f"({rec.get('fleet_scenario', '?')}) on "
+        f"{rec.get('platform', '?')}: "
+        f"{rec.get('value', 0):,} cluster-epochs/s "
+        f"({rec.get('vs_baseline', 0)}x sequential), "
+        f"bitequal={'ok' if bitequal else 'FAIL'}",
+        file=out,
+    )
+    if rec.get("fleet_best_down_out_interval_s") is not None:
+        print(
+            f"  sweep picks: mon_osd_down_out_interval="
+            f"{rec['fleet_best_down_out_interval_s']:g}s, "
+            f"recovery_share="
+            f"{rec.get('fleet_best_recovery_share', 0):g}",
+            file=out,
+        )
+    panel = rec.get("fleet_scenario_panel") or []
+    for row in panel:
+        ci = (
+            f"[{row.get('mttdl_ci_lo_s', 0):.4g}, "
+            f"{row.get('mttdl_ci_hi_s', 0):.4g}]"
+        )
+        cens = " (censored)" if row.get("mttdl_censored") else ""
+        print(
+            f"  {row.get('scenario', '?'):<12} "
+            f"survival={row.get('survival_fraction', 0):.4f} "
+            f"mttdl={row.get('mttdl_s', 0):.4g}s {ci}{cens} "
+            f"worst=#{row.get('worst_cluster', 0)} "
+            f"avail={row.get('worst_availability', 0):.6f}",
+            file=out,
+        )
 
 
 def _demo(args, out) -> tuple[dict, dict]:
@@ -296,8 +370,28 @@ def main(argv=None) -> int:
     p.add_argument("--max-detection-latency", type=float, default=None,
                    help="SLO budget on failure-to-mark-down latency "
                         "(virtual seconds); default: check disabled")
+    p.add_argument("--bench-log", action="append", default=[],
+                   metavar="PATH",
+                   help="bench JSONL file(s) for the fleet panel "
+                        "(repeatable; default: BENCH*.json in the "
+                        "working directory)")
     args = p.parse_args(argv)
     out = sys.stdout
+
+    if args.command == "fleet":
+        rec = load_fleet_record(args.bench_log)
+        if rec is None:
+            print(
+                "status: no config8_fleet record found (run "
+                "bench/config8_fleet.py or pass --bench-log)",
+                file=sys.stderr,
+            )
+            return 1
+        if args.as_json:
+            print(json.dumps(rec, sort_keys=True), file=out)
+        else:
+            render_fleet(rec, out)
+        return 0
 
     if args.socket is not None:
         from ..common.admin_socket import ask

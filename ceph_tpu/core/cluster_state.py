@@ -36,6 +36,18 @@ The compiled epoch superstep (:mod:`ceph_tpu.recovery.superstep`)
 carries a :class:`ClusterState` through ``lax.scan``; the staged
 differential-reference path advances the identical pytree one jitted
 piece at a time.
+
+Fleets
+------
+
+:func:`stack_states` stacks N independent ``ClusterState`` pytrees
+along a new leading *fleet* axis — every leaf gains ``[fleet, ...]``
+and the result is still a ``ClusterState`` (the vmapped scenario-fleet
+superstep's carry, :mod:`ceph_tpu.recovery.fleet`).  The batched twin
+of the O(delta) scatter is :func:`apply_incremental_fleet`: one
+compiled vmapped scatter applies a *per-cluster* Incremental to every
+fleet member, with the delta pads bucketed to powers of two across the
+fleet so neither fleet size nor delta size recompiles.
 """
 
 from __future__ import annotations
@@ -229,11 +241,19 @@ def _pad_to(n: int) -> int:
     return p
 
 
-def incremental_arrays(inc: Incremental, n_osds: int):
+def incremental_arrays(
+    inc: Incremental,
+    n_osds: int,
+    pads: tuple[int, int, int] | None = None,
+):
     """Compile one Incremental's per-OSD edits into fixed-shape scatter
     rows: ``(s_idx, s_up, s_ex, w_idx, w_val, a_idx, a_val)``, each
     padded to a power of two with out-of-range indices (``n_osds``)
     that the device scatter drops.
+
+    ``pads`` pins the ``(state, weight, affinity)`` pad widths instead
+    of deriving them per-delta — the fleet path uses this to give every
+    cluster's delta the same shape so one vmapped scatter covers all.
 
     Raises for structural edits (:data:`_STRUCTURAL_FIELDS`,
     ``new_max_osd``): those change shapes or rewrite padded dict
@@ -252,9 +272,15 @@ def incremental_arrays(inc: Incremental, n_osds: int):
             )
     from ..osdmap.map import EXISTS, UP
 
+    forced = iter(pads) if pads is not None else None
+
     def rows(items, conv):
         idx = sorted(int(o) for o in items)
-        pad = _pad_to(len(idx))
+        pad = _pad_to(len(idx)) if forced is None else next(forced)
+        if len(idx) > pad:
+            raise ValueError(
+                f"delta of {len(idx)} rows exceeds forced pad {pad}"
+            )
         out_idx = np.full(pad, n_osds, np.int32)  # OOB pad -> dropped
         out_idx[: len(idx)] = idx
         vals = [conv(items[o]) for o in idx]
@@ -331,3 +357,78 @@ def apply_incremental(state: ClusterState, inc: Incremental) -> ClusterState:
         int(arrs[0].shape[0]), int(arrs[3].shape[0]), int(arrs[5].shape[0])
     )
     return fn(state, jnp.int32(inc.epoch), *arrs)
+
+
+# ---------------------------------------------------------------------------
+# fleets: a leading cluster batch axis over the same pytree
+
+
+def stack_states(states) -> ClusterState:
+    """Stack N independent :class:`ClusterState` pytrees into one fleet
+    pytree: every leaf gains a leading ``[fleet, ...]`` axis and the
+    result is still a ``ClusterState``, so the vmapped fleet superstep
+    (:mod:`ceph_tpu.recovery.fleet`) can carry it through ``lax.scan``
+    unchanged.  All members must share geometry (same leaf shapes) and
+    agree on checksum presence — a mixed fleet has no single pytree
+    structure."""
+    states = list(states)
+    if not states:
+        raise ValueError("stack_states needs at least one state")
+    with_ck = sum(1 for s in states if s.checksums is not None)
+    if with_ck not in (0, len(states)):
+        raise ValueError(
+            "checksum tables must be attached to every fleet member "
+            f"or none ({with_ck}/{len(states)} have one)"
+        )
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
+
+
+def index_state(fleet: ClusterState, i: int) -> ClusterState:
+    """Slice cluster ``i`` back out of a :func:`stack_states` fleet."""
+    return jax.tree_util.tree_map(lambda x: x[i], fleet)
+
+
+def fleet_incremental_arrays(incs, n_osds: int):
+    """Batch per-cluster Incrementals into stacked scatter rows.
+
+    All clusters share one ``(state, weight, affinity)`` pad triple —
+    the power-of-two bucket of the *largest* delta per lane — so the
+    vmapped scatter's shape depends only on the buckets, never on which
+    cluster had the biggest delta.  Returns ``(epochs, arrays, pads)``
+    where each array is ``[fleet, pad]``.
+    """
+    incs = list(incs)
+    if not incs:
+        raise ValueError("fleet_incremental_arrays needs >= 1 delta")
+    pads = (
+        _pad_to(max(len(i.new_state) for i in incs)),
+        _pad_to(max(len(i.new_weight) for i in incs)),
+        _pad_to(max(len(i.new_primary_affinity) for i in incs)),
+    )
+    per = [incremental_arrays(i, n_osds, pads=pads) for i in incs]
+    arrays = tuple(jnp.stack(col) for col in zip(*per))
+    epochs = jnp.asarray([int(i.epoch) for i in incs], I32)
+    return epochs, arrays, pads
+
+
+@functools.lru_cache(maxsize=None)
+def _apply_fleet_delta_fn(s_pad: int, w_pad: int, a_pad: int):
+    """The vmapped twin of :func:`_apply_delta_fn`: one compiled
+    program per pad-bucket triple, batched over the fleet axis."""
+    return jax.jit(jax.vmap(_apply_delta_fn(s_pad, w_pad, a_pad)))
+
+
+def apply_incremental_fleet(fleet: ClusterState, incs) -> ClusterState:
+    """Apply one per-cluster epoch delta to every fleet member as a
+    single compiled vmapped scatter — batched O(delta) application.
+    ``incs`` must have exactly one Incremental per fleet member (pad
+    clusters take an empty ``Incremental(epoch=...)`` no-op)."""
+    incs = list(incs)
+    fleet_n = int(fleet.epoch.shape[0])
+    if len(incs) != fleet_n:
+        raise ValueError(
+            f"{len(incs)} incrementals for a fleet of {fleet_n}"
+        )
+    n_osds = int(fleet.pool.osd_weight.shape[-1])
+    epochs, arrays, pads = fleet_incremental_arrays(incs, n_osds)
+    return _apply_fleet_delta_fn(*pads)(fleet, epochs, *arrays)

@@ -30,6 +30,14 @@ The subsystem that closes the loop the standalone workloads left open
   classify → traffic → scrub tick as ONE jitted ``lax.scan`` over a
   device-side chaos event tape (``CEPH_TPU_EPOCH_SUPERSTEP=0`` pins
   the staged per-epoch reference).
+- :mod:`~ceph_tpu.recovery.fleet` — vmapped scenario fleets: N seeded
+  chaos timelines advance as one leading-axis
+  :class:`~ceph_tpu.core.cluster_state.ClusterState` pytree through
+  ONE compiled scan (power-of-two fleet/row pad buckets, so fleet
+  size never recompiles).
+- :mod:`~ceph_tpu.recovery.durability` — device-side Monte Carlo
+  reduction of fleet outcomes into MTTDL / availability /
+  time-to-zero-degraded estimates with seeded bootstrap CIs.
 """
 
 from .chaos import (
@@ -119,6 +127,15 @@ from .superstep import (
     epoch_superstep_enabled,
     run_epochs,
 )
+from .fleet import (
+    FleetDriver,
+    FleetSeries,
+    FleetTape,
+    run_fleet,
+    sample_timelines,
+    stack_tapes,
+)
+from .durability import DurabilityEstimate, estimate_durability
 
 __all__ = [
     "ACTIONS",
@@ -193,4 +210,12 @@ __all__ = [
     "compile_event_tape",
     "epoch_superstep_enabled",
     "run_epochs",
+    "FleetDriver",
+    "FleetSeries",
+    "FleetTape",
+    "run_fleet",
+    "sample_timelines",
+    "stack_tapes",
+    "DurabilityEstimate",
+    "estimate_durability",
 ]

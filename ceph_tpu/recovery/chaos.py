@@ -124,6 +124,7 @@ class ChaosTimeline:
 SCENARIOS = (
     "flap", "rack-cascade", "mid-repair-loss", "silent-bitrot",
     "scrub-storm", "flapping-osd",
+    "ssd-steady", "ssd-burst", "ssd-skew",
 )
 
 
@@ -253,6 +254,54 @@ def build_scenario(
                  FailureSpec("netsplit", str(osd), "restore"))
             )
             t += period_s
+        return ChaosTimeline.from_pairs(pairs)
+    if name == "ssd-steady":
+        # the arXiv:1709.05365 steady-state SSD-array profile's failure
+        # half (its traffic half is the same-named TrafficMix):
+        # independent device churn — a drive dies and is auto-outed,
+        # its replacement comes up a few periods later, a second drive
+        # on another host dies near the end of the window
+        _, hosts = _rack_and_hosts(m, rack)
+        a = resolve_targets(m, FailureSpec("host", hosts[0], "down"))[0]
+        b_host = hosts[1 % len(hosts)]
+        b = resolve_targets(m, FailureSpec("host", b_host, "down"))[0]
+        return ChaosTimeline.from_pairs([
+            (start_s, FailureSpec("osd", str(a), "down_out")),
+            (start_s + 3 * period_s, [
+                FailureSpec("osd", str(a), "up"),
+                FailureSpec("osd", str(a), "in"),
+            ]),
+            (start_s + 5 * period_s, FailureSpec("osd", str(b), "down_out")),
+        ])
+    if name == "ssd-burst":
+        # the ingest-burst profile: a correlated host loss lands inside
+        # a write burst, a second host's drive browns out (down, then
+        # back) while the first repair is still in flight
+        _, hosts = _rack_and_hosts(m, rack)
+        h0 = hosts[0]
+        b_host = hosts[1 % len(hosts)]
+        b = resolve_targets(m, FailureSpec("host", b_host, "down"))[0]
+        return ChaosTimeline.from_pairs([
+            (start_s + period_s, FailureSpec("host", h0, "down_out")),
+            (start_s + 2 * period_s, FailureSpec("osd", str(b), "down")),
+            (start_s + 3 * period_s, FailureSpec("osd", str(b), "up")),
+        ])
+    if name == "ssd-skew":
+        # the hot-spot profile: the drive under the skewed read set
+        # goes slow (late acks) for `cycles` windows, then dies for
+        # good — tail latency degrades before availability does
+        _, hosts = _rack_and_hosts(m, rack)
+        osd = resolve_targets(m, FailureSpec("host", hosts[0], "down"))[0]
+        pairs: list[tuple[float, object]] = []
+        t = start_s
+        for _ in range(cycles):
+            pairs.append((t, FailureSpec("slow", str(osd), "drop")))
+            pairs.append(
+                (t + 0.5 * period_s,
+                 FailureSpec("slow", str(osd), "restore"))
+            )
+            t += period_s
+        pairs.append((t, FailureSpec("osd", str(osd), "down_out")))
         return ChaosTimeline.from_pairs(pairs)
     raise ValueError(f"unknown chaos scenario {name!r}; one of {SCENARIOS}")
 

@@ -1,0 +1,249 @@
+"""Monte Carlo durability estimation over scenario fleets.
+
+A :class:`~ceph_tpu.recovery.fleet.FleetSeries` is N independent
+chaos-timeline outcomes of one cluster configuration — exactly the
+sample a Monte Carlo durability estimate wants.  This module reduces
+those outcomes device-side (one jitted pass over the ``[epochs,
+fleet, ...]`` arrays, then one jitted seeded bootstrap over the
+per-cluster results; only the O(1) summary scalars ever cross to
+host) into the ROADMAP's capacity-planning estimates, keyed per
+(codec, k, m, placement policy, down-out interval):
+
+- **survival / MTTDL** — a cluster is *lost* when any epoch shows an
+  inactive PG (below-``k`` readable: the availability-loss proxy for
+  data loss this simulator can observe).  With ``f`` losses over ``N``
+  missions of ``T`` seconds, MTTDL ≈ ``N·T/f`` (exposure over
+  failures); a zero-loss fleet reports the 95% rule-of-three lower
+  bound ``N·T/3`` with ``mttdl_censored=True``.
+- **availability** — per-cluster served fraction ``1 - blocked/ops``
+  from the traffic outcome counts, fleet mean.
+- **time-to-zero-degraded** — per-cluster span from the first to the
+  last epoch whose PG histogram shows anything but active+clean
+  (the recovery-completion time a down-out interval sweep trades
+  against churn).
+
+Confidence intervals are seeded bootstrap percentiles
+(``jax.random.PRNGKey(seed)``; resample clusters with replacement,
+``n_boot`` times, device-side).  Zero-loss resamples take the
+rule-of-three continuity floor so every MTTDL quantile stays finite
+and JSON-safe.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+F32 = jnp.float32
+F64 = jnp.float64
+
+#: zero-failure resamples read as this many failures (the 95%
+#: rule-of-three bound), keeping bootstrap MTTDL quantiles finite
+RULE_OF_THREE = 3.0
+
+
+@functools.partial(jax.jit, static_argnames=("pg_num",))
+def _outcome_reduce(hist, counts, pg_num: int):
+    """``[epochs, fleet, ...]`` series -> per-cluster outcome lanes:
+    ``(lost bool[F], avail f64[F], degraded_epochs i32[F],
+    ttzd_epochs i32[F])``."""
+    # deferred: obs.pg_states imports recovery.peering; at import time
+    # this module may load as part of the recovery package __init__
+    from ..obs.pg_states import STATE_ACTIVE_CLEAN, STATE_INACTIVE
+
+    n = hist.shape[0]
+    inactive = hist[:, :, STATE_INACTIVE] > 0          # [n, F]
+    lost = jnp.any(inactive, axis=0)                   # [F]
+    blocked = jnp.sum(counts[:, :, 2], axis=0).astype(F64)
+    total = jnp.sum(counts.astype(I32), axis=(0, 2)).astype(F64)
+    avail = 1.0 - blocked / jnp.maximum(total, 1.0)    # [F]
+    deg = hist[:, :, STATE_ACTIVE_CLEAN] < pg_num      # [n, F]
+    any_deg = jnp.any(deg, axis=0)
+    first = jnp.argmax(deg, axis=0).astype(I32)
+    last = (n - 1) - jnp.argmax(deg[::-1], axis=0).astype(I32)
+    deg_epochs = jnp.sum(deg.astype(I32), axis=0)
+    ttzd = jnp.where(any_deg, last - first + 1, 0).astype(I32)
+    return lost, avail, deg_epochs, ttzd
+
+
+@functools.partial(jax.jit, static_argnames=("n_boot",))
+def _bootstrap(key, lost, avail, ttzd_s, n_boot: int, q_lo, q_hi):
+    """Seeded cluster-resample bootstrap: quantiles of the fleet mean
+    for (loss fraction, availability, time-to-zero-degraded)."""
+    f = lost.shape[0]
+    idx = jax.random.randint(key, (n_boot, f), 0, f)
+    lostf = lost.astype(F64)
+
+    def stat(v):
+        means = jnp.mean(v[idx], axis=1)
+        return jnp.quantile(means, jnp.asarray([q_lo, q_hi]))
+
+    return stat(lostf), stat(avail.astype(F64)), stat(ttzd_s.astype(F64))
+
+
+@dataclass(frozen=True)
+class DurabilityEstimate:
+    """One fleet's Monte Carlo durability summary (host scalars), plus
+    the configuration key it was measured under."""
+
+    scenario: str
+    n_clusters: int
+    n_epochs: int
+    mission_s: float
+    survival_fraction: float
+    n_lost: int
+    mttdl_s: float
+    mttdl_ci_lo_s: float
+    mttdl_ci_hi_s: float
+    mttdl_censored: bool
+    availability_mean: float
+    availability_ci_lo: float
+    availability_ci_hi: float
+    ttzd_mean_s: float
+    ttzd_ci_lo_s: float
+    ttzd_ci_hi_s: float
+    worst_cluster: int
+    worst_availability: float
+    seed: int
+    n_boot: int
+    # the (codec, k, m, placement, down-out) configuration key
+    codec: str = ""
+    ec_k: int = 0
+    ec_m: int = 0
+    placement: str = ""
+    down_out_interval_s: float = 0.0
+
+    def to_dict(self, prefix: str = "durability_") -> dict:
+        """Flat, typed record fields (the bench-record / harvest
+        surface — every value JSON-scalar)."""
+        return {
+            f"{prefix}scenario": self.scenario,
+            f"{prefix}n_clusters": int(self.n_clusters),
+            f"{prefix}n_epochs": int(self.n_epochs),
+            f"{prefix}mission_s": round(float(self.mission_s), 6),
+            f"{prefix}survival_fraction": round(
+                float(self.survival_fraction), 9
+            ),
+            f"{prefix}n_lost": int(self.n_lost),
+            f"{prefix}mttdl_s": round(float(self.mttdl_s), 3),
+            f"{prefix}mttdl_ci_lo_s": round(float(self.mttdl_ci_lo_s), 3),
+            f"{prefix}mttdl_ci_hi_s": round(float(self.mttdl_ci_hi_s), 3),
+            f"{prefix}mttdl_censored": bool(self.mttdl_censored),
+            f"{prefix}availability_mean": round(
+                float(self.availability_mean), 9
+            ),
+            f"{prefix}availability_ci_lo": round(
+                float(self.availability_ci_lo), 9
+            ),
+            f"{prefix}availability_ci_hi": round(
+                float(self.availability_ci_hi), 9
+            ),
+            f"{prefix}ttzd_mean_s": round(float(self.ttzd_mean_s), 6),
+            f"{prefix}ttzd_ci_lo_s": round(float(self.ttzd_ci_lo_s), 6),
+            f"{prefix}ttzd_ci_hi_s": round(float(self.ttzd_ci_hi_s), 6),
+            f"{prefix}worst_cluster": int(self.worst_cluster),
+            f"{prefix}worst_availability": round(
+                float(self.worst_availability), 9
+            ),
+            f"{prefix}seed": int(self.seed),
+            f"{prefix}n_boot": int(self.n_boot),
+            f"{prefix}codec": self.codec,
+            f"{prefix}ec_k": int(self.ec_k),
+            f"{prefix}ec_m": int(self.ec_m),
+            f"{prefix}placement": self.placement,
+            f"{prefix}down_out_interval_s": round(
+                float(self.down_out_interval_s), 6
+            ),
+        }
+
+
+def estimate_durability(
+    fleet,
+    *,
+    dt: float,
+    scenario: str = "",
+    seed: int = 0,
+    n_boot: int = 256,
+    alpha: float = 0.05,
+    pg_num: int | None = None,
+    codec: str = "",
+    ec_k: int = 0,
+    ec_m: int = 0,
+    placement: str = "",
+    down_out_interval_s: float = 0.0,
+) -> DurabilityEstimate:
+    """Reduce one fleet's outcomes into a :class:`DurabilityEstimate`.
+
+    ``fleet`` is a :class:`~ceph_tpu.recovery.fleet.FleetSeries` (or
+    anything with ``hist``/``counts`` arrays shaped ``[epochs, fleet,
+    ...]``).  ``dt`` is the driver's epoch width; ``pg_num`` defaults
+    to the histogram row sum of epoch 0 (exact: the classifier
+    histograms every PG exactly once).
+    """
+    hist = jnp.asarray(np.asarray(fleet.hist))
+    counts = jnp.asarray(np.asarray(fleet.counts))
+    n_epochs, n_clusters = int(hist.shape[0]), int(hist.shape[1])
+    if pg_num is None:
+        pg_num = int(np.asarray(fleet.hist)[0, 0].sum())
+    mission_s = float(n_epochs) * float(dt)
+    lost, avail, _deg_epochs, ttzd = _outcome_reduce(
+        hist, counts, int(pg_num)
+    )
+    ttzd_s = ttzd.astype(F64) * float(dt)
+    key = jax.random.PRNGKey(int(seed))
+    (lf_ci, av_ci, tz_ci) = _bootstrap(
+        key, lost, avail, ttzd_s, int(n_boot),
+        alpha / 2.0, 1.0 - alpha / 2.0,
+    )
+    lost_h = np.asarray(lost)
+    avail_h = np.asarray(avail)
+    ttzd_h = np.asarray(ttzd_s)
+    lf_ci, av_ci, tz_ci = (
+        np.asarray(lf_ci), np.asarray(av_ci), np.asarray(tz_ci)
+    )
+    n_lost = int(lost_h.sum())
+    exposure = n_clusters * mission_s
+    censored = n_lost == 0
+    mttdl = exposure / (n_lost if n_lost else RULE_OF_THREE)
+    # the CI is the monotone image of the loss-fraction quantiles.
+    # Continuity floors keep a zero quantile from producing an
+    # infinite (JSON-unsafe) bound: a censored fleet takes the
+    # rule-of-three count on both ends, otherwise half an observed
+    # failure
+    floor = RULE_OF_THREE if censored else 0.5
+    f_hi = max(float(lf_ci[1]) * n_clusters, floor)
+    f_lo = max(float(lf_ci[0]) * n_clusters, floor)
+    worst = int(np.argmin(avail_h)) if n_clusters else 0
+    return DurabilityEstimate(
+        scenario=scenario,
+        n_clusters=n_clusters,
+        n_epochs=n_epochs,
+        mission_s=mission_s,
+        survival_fraction=1.0 - n_lost / max(n_clusters, 1),
+        n_lost=n_lost,
+        mttdl_s=mttdl,
+        mttdl_ci_lo_s=exposure / f_hi,
+        mttdl_ci_hi_s=exposure / f_lo,
+        mttdl_censored=censored,
+        availability_mean=float(avail_h.mean()),
+        availability_ci_lo=float(av_ci[0]),
+        availability_ci_hi=float(av_ci[1]),
+        ttzd_mean_s=float(ttzd_h.mean()),
+        ttzd_ci_lo_s=float(tz_ci[0]),
+        ttzd_ci_hi_s=float(tz_ci[1]),
+        worst_cluster=worst,
+        worst_availability=float(avail_h[worst]) if n_clusters else 1.0,
+        seed=int(seed),
+        n_boot=int(n_boot),
+        codec=codec,
+        ec_k=int(ec_k),
+        ec_m=int(ec_m),
+        placement=placement,
+        down_out_interval_s=float(down_out_interval_s),
+    )

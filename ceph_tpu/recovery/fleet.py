@@ -1,0 +1,467 @@
+"""Vmapped scenario fleets: N chaos timelines in one compiled scan.
+
+PR 12's superstep made one simulated cluster cheap (one ``lax.scan``
+over the fused epoch body); the ROADMAP's capacity-planning questions
+— MTTDL per codec, a tuned ``mon_osd_down_out_interval``, mclock
+shares — need *populations* of clusters.  The simulator is pure
+state → state, so the fleet layer is exactly a leading batch axis:
+
+- :func:`sample_timelines` draws N seeded, jittered variants of one
+  named :func:`~ceph_tpu.recovery.chaos.build_scenario` (start/period
+  scale, cycle count, rack rotation) — deterministic per
+  ``(seed, index)``, so a fleet is reproducible from one integer.
+- :func:`stack_tapes` lowers the per-cluster
+  :class:`~ceph_tpu.recovery.superstep.EventTape`\\ s into one padded
+  ``[fleet, rows]`` tape.  Both axes round up to powers of two
+  (:func:`~ceph_tpu.core.cluster_state._pad_to`): pad rows carry
+  ``t=+inf`` (the searchsorted window never reaches them), pad
+  clusters carry empty tapes and are cropped from every output — so
+  *fleet size never recompiles* within a bucket.
+- :class:`FleetDriver` compiles ONE scan whose body vmaps the
+  superstep's epoch body (:meth:`EpochDriver._epoch_step_with`) over
+  (state leaves, tape rows, traffic salts).  Divergent per-cluster
+  epochs ride the existing dirty-gating ``lax.cond`` — under ``vmap``
+  it lowers to a select, so a fleet with ANY dirty lane pays one
+  peering launch for all lanes (the divergence cost
+  ``bench/PERF_MODEL.md`` itemizes) while every lane's values stay
+  bit-equal to its own sequential run (asserted per-cluster, exact,
+  over the chaos zoo in ``tests/test_fleet.py``).
+
+Outputs land as a :class:`FleetSeries` — the
+:class:`~ceph_tpu.recovery.superstep.EpochSeries` fields with a second
+fleet axis — which :mod:`ceph_tpu.recovery.durability` reduces
+device-side into MTTDL / availability / time-to-zero-degraded
+estimates.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.cluster_state import _pad_to, stack_states
+from ..osdmap.map import OSDMap
+from .chaos import ChaosTimeline, build_scenario
+from .superstep import (
+    _SERIES_FIELDS,
+    EpochDriver,
+    EpochSeries,
+    EventTape,
+    compile_event_tape,
+)
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+#: the TrafficEngine's seed -> salt-base fold (u32 Knuth multiplicative)
+_SALT_MULT = 2654435761
+
+
+def _salt_base(seed: int) -> np.uint32:
+    return np.uint32((int(seed) * _SALT_MULT) & 0xFFFFFFFF)
+
+
+def sample_timelines(
+    seed: int,
+    n: int,
+    scenario: str,
+    m: OSDMap,
+    *,
+    jitter: float = 0.25,
+    start_s: float = 0.25,
+    period_s: float = 1.0,
+    cycles: int = 3,
+) -> list[ChaosTimeline]:
+    """Draw ``n`` seeded variants of one named chaos scenario.
+
+    Cluster ``i``'s timeline comes from ``default_rng([seed, i])`` —
+    deterministic per (seed, index), independent of ``n`` (growing the
+    fleet never changes existing members).  ``jitter`` scales the
+    scenario's start/period by ``1 ± jitter``, wobbles the cycle count
+    by ±1, and rotates the target rack; ``jitter=0`` yields n copies
+    of the base scenario.
+    """
+    racks = sorted(
+        b.name for b in m.crush.buckets.values()
+        if m.crush.types[b.type_id] == "rack"
+    )
+    out = []
+    for i in range(int(n)):
+        rng = np.random.default_rng([int(seed), int(i)])
+
+        def scale(v):
+            return float(v) * (1.0 + jitter * (2.0 * rng.random() - 1.0))
+
+        rack = racks[int(rng.integers(len(racks)))] if racks else None
+        cyc = int(cycles)
+        if jitter > 0:
+            cyc = max(1, cyc + int(rng.integers(-1, 2)))
+        out.append(build_scenario(
+            scenario, m,
+            start_s=scale(start_s), period_s=scale(period_s),
+            cycles=cyc, rack=rack,
+        ))
+    return out
+
+
+def _pad_tape_arrays(tape: EventTape, rows: int):
+    """One tape -> fixed ``rows``-wide host arrays; pad rows carry
+    ``t=+inf`` so the per-epoch ``searchsorted`` window never includes
+    them (the cursor parks below the pad forever)."""
+    k = len(tape)
+    if k > rows:
+        raise ValueError(f"tape of {k} rows exceeds pad {rows}")
+    t = np.full(rows, np.inf, np.float64)
+    kind = np.zeros(rows, np.int32)
+    osd = np.zeros(rows, np.int32)
+    bump = np.zeros(rows, np.int32)
+    t[:k] = tape.t
+    kind[:k] = tape.kind
+    osd[:k] = tape.osd
+    bump[:k] = tape.bump
+    return t, kind, osd, bump
+
+
+@dataclass(frozen=True)
+class FleetTape:
+    """N event tapes as one padded ``[fleet, rows]`` device schedule
+    (both axes power-of-two bucketed; pad clusters hold empty tapes)."""
+
+    t: np.ndarray      # f64 [fleet_pad, rows_pad]
+    kind: np.ndarray   # i32 [fleet_pad, rows_pad]
+    osd: np.ndarray    # i32 [fleet_pad, rows_pad]
+    bump: np.ndarray   # i32 [fleet_pad, rows_pad]
+    n_clusters: int    # real clusters (<= fleet_pad)
+
+    @property
+    def fleet_pad(self) -> int:
+        return int(self.t.shape[0])
+
+    @property
+    def rows_pad(self) -> int:
+        return int(self.t.shape[1])
+
+    def device(self):
+        return (
+            jnp.asarray(self.t), jnp.asarray(self.kind),
+            jnp.asarray(self.osd), jnp.asarray(self.bump),
+        )
+
+
+def stack_tapes(tapes: list[EventTape]) -> FleetTape:
+    """Stack per-cluster tapes into a :class:`FleetTape`, bucketing the
+    fleet axis to ``_pad_to(n)`` and the row axis to the power-of-two
+    bucket of the longest tape (min 1)."""
+    tapes = list(tapes)
+    if not tapes:
+        raise ValueError("stack_tapes needs at least one tape")
+    f_pad = _pad_to(len(tapes))
+    r_pad = _pad_to(max(max(len(tp) for tp in tapes), 1))
+    cols = [_pad_tape_arrays(tp, r_pad) for tp in tapes]
+    empty = _pad_tape_arrays(
+        EventTape(
+            t=np.zeros(0, np.float64), kind=np.zeros(0, np.int32),
+            osd=np.zeros(0, np.int32), bump=np.zeros(0, np.int32),
+            n_events=0, n_bitrot=0,
+        ),
+        r_pad,
+    )
+    cols.extend([empty] * (f_pad - len(tapes)))
+    t, kind, osd, bump = (np.stack(c) for c in zip(*cols))
+    return FleetTape(
+        t=t, kind=kind, osd=osd, bump=bump, n_clusters=len(tapes)
+    )
+
+
+@dataclass(frozen=True)
+class FleetSeries:
+    """Per-epoch outputs for every fleet member: the
+    :class:`~ceph_tpu.recovery.superstep.EpochSeries` fields with a
+    fleet axis second — ``[n_epochs, fleet, ...]`` each."""
+
+    now: np.ndarray
+    epoch: np.ndarray
+    dirty: np.ndarray
+    hist: np.ndarray
+    aux: np.ndarray
+    counts: np.ndarray
+    lat_hist: np.ndarray
+    qd_hist: np.ndarray
+    sums: np.ndarray
+    max_rho: np.ndarray
+    writes: np.ndarray
+    deg_reads: np.ndarray
+    down_total: np.ndarray
+    eff_down: np.ndarray
+    eff_up: np.ndarray
+    eff_out: np.ndarray
+    down_checksum: np.ndarray
+    scrub_due: np.ndarray
+
+    def __len__(self) -> int:
+        return int(self.now.shape[0])
+
+    @property
+    def n_clusters(self) -> int:
+        return int(self.now.shape[1])
+
+    @classmethod
+    def from_device(cls, rows, n_clusters: int) -> "FleetSeries":
+        """Pull scan outputs and crop the pad clusters."""
+        host = jax.device_get(rows)
+        return cls(**{
+            f: np.asarray(v)[:, :n_clusters]
+            for f, v in zip(_SERIES_FIELDS, host)
+        })
+
+    def cluster(self, i: int) -> EpochSeries:
+        """Cluster ``i``'s lane as a plain :class:`EpochSeries` — the
+        exact-diff surface against a sequential run of its timeline."""
+        return EpochSeries(**{
+            f: getattr(self, f)[:, i] for f in _SERIES_FIELDS
+        })
+
+
+class FleetDriver:
+    """One map geometry, one compiled *fleet* superstep.
+
+    Owns a template :class:`EpochDriver` (built on an empty timeline:
+    it contributes the jitted epoch-body pieces and the seeded initial
+    state, never a tape) plus two scans compiled from the same body:
+
+    - :meth:`run_fleet` — the vmapped fleet scan, one launch per
+      chunk regardless of fleet size; jit's shape cache keys it by the
+      ``(fleet_pad, rows_pad)`` bucket, so growing a fleet of 3 to 4
+      reuses the program and 4 → 5 compiles exactly one new bucket.
+    - :meth:`run_sequential` — the one-cluster scan with the tape and
+      salt as traced arguments: N warm sequential superstep runs
+      through a single compiled program, the honest baseline the
+      ``config8_fleet`` headline divides by.
+
+    Every driver kwarg (geometry, knobs, config, mix, rho_recovery)
+    passes through to the template — the whole fleet shares them;
+    what varies per cluster is the timeline and the traffic seed.
+    """
+
+    def __init__(self, m: OSDMap, *, seed: int = 0, **driver_kwargs):
+        self.m = m
+        self.seed = int(seed)
+        self.driver = EpochDriver(
+            m, ChaosTimeline(), seed=seed, **driver_kwargs
+        )
+        self._fleet_scan = None
+        self._seq_scan = None
+        self._init_cache: dict[int, object] = {}
+
+    # -- inputs --------------------------------------------------------
+
+    def sample(self, n: int, scenario: str, **kw) -> list[ChaosTimeline]:
+        """:func:`sample_timelines` with this driver's seed and map."""
+        return sample_timelines(self.seed, n, scenario, self.m, **kw)
+
+    def _salts(self, n: int, f_pad: int, seeds) -> jnp.ndarray:
+        if seeds is None:
+            seeds = [self.seed + i for i in range(n)]
+        seeds = list(seeds)
+        if len(seeds) != n:
+            raise ValueError(f"{len(seeds)} seeds for {n} timelines")
+        salts = np.zeros(f_pad, np.uint32)
+        salts[:n] = [_salt_base(s) for s in seeds]
+        return jnp.asarray(salts)
+
+    def _fleet_state(self, f_pad: int):
+        """The stacked initial fleet state, cached per pad bucket so a
+        warm same-bucket run dispatches zero fresh stacking ops."""
+        st = self._init_cache.get(f_pad)
+        if st is None:
+            st = stack_states([self.driver._init_state] * f_pad)
+            self._init_cache[f_pad] = st
+        return st
+
+    # -- the compiled scans --------------------------------------------
+
+    def _fleet_scan_fn(self):
+        """``(fleet_state, steps, t, kind, osd, bump, salts) ->
+        (fleet_state, rows)``: a scan whose body vmaps the epoch body
+        over the fleet axis.  One jitted callable; XLA programs are
+        cached per (fleet_pad, rows_pad) bucket by jit's shape cache.
+
+        The superstep's dirty-gating ``lax.cond`` is hoisted to fleet
+        level: naively vmapping the whole epoch body would lower the
+        per-lane cond to a select that evaluates the peering branch —
+        the one compute-bound piece of the body — every epoch for
+        every lane.  Instead the body vmaps the cheap stages (tape,
+        liveness, traffic, scrub), then wraps the vmapped peering pass
+        in a scalar ``lax.cond`` on ``any(dirty)``: an epoch where no
+        lane's map changed skips peering entirely, and a divergent
+        epoch peers all lanes once with a per-lane ``where`` keeping
+        clean lanes' state untouched — the same select semantics the
+        vmapped cond would have used, so every lane's values stay
+        bit-equal to its own sequential run (asserted in
+        ``tests/test_fleet.py``)."""
+        if self._fleet_scan is None:
+            drv = self.driver
+
+            def peer_select(fstate, dirty):
+                peered = jax.vmap(drv._peer_hist_fn)(fstate)
+                return jax.tree_util.tree_map(
+                    lambda p, s: jnp.where(
+                        dirty.reshape((-1,) + (1,) * (p.ndim - 1)), p, s
+                    ),
+                    peered, fstate,
+                )
+
+            @jax.jit
+            def scan_fn(fstate, steps, t, kind, osd, bump, salts):
+                def lane_pre(st, ti, ki, oi, bi, step):
+                    prev_now = st.now
+                    st, tape_dirty = drv._tape_apply(
+                        st, step, (ti, ki, oi, bi)
+                    )
+                    st, (nd, nu, no, down_total, down_ck, trans) = (
+                        drv._live_fn(st)
+                    )
+                    return st, (
+                        tape_dirty | trans, prev_now,
+                        nd, nu, no, down_total, down_ck,
+                    )
+
+                def lane_post(st, salt, prev_now, step):
+                    traffic = drv._traffic_apply(st, step, salt)
+                    scrub_due = drv._scrub_fn(prev_now, st.now)
+                    return traffic, scrub_due
+
+                def sbody(carry, step):
+                    carry, (dirty, prev_now, nd, nu, no, dtot, dck) = (
+                        jax.vmap(
+                            lane_pre, in_axes=(0, 0, 0, 0, 0, None)
+                        )(carry, t, kind, osd, bump, step)
+                    )
+                    carry = jax.lax.cond(
+                        jnp.any(dirty),
+                        lambda s: peer_select(s, dirty),
+                        lambda s: s,
+                        carry,
+                    )
+                    (
+                        (counts, lat_hist, qd_hist, sums, max_rho,
+                         writes, deg_reads),
+                        scrub_due,
+                    ) = jax.vmap(
+                        lane_post, in_axes=(0, 0, 0, None)
+                    )(carry, salts, prev_now, step)
+                    row = (
+                        carry.now, carry.epoch, dirty.astype(I32),
+                        carry.pg_hist, carry.pg_aux, counts, lat_hist,
+                        qd_hist, sums, max_rho, writes, deg_reads,
+                        dtot, nd, nu, no, dck, scrub_due,
+                    )
+                    return carry, row
+
+                return jax.lax.scan(sbody, fstate, steps)
+
+            self._fleet_scan = scan_fn
+        return self._fleet_scan
+
+    def _seq_scan_fn(self):
+        """The one-cluster scan with (tape, salt) traced in — swapping
+        a cluster's tape or seed never recompiles, so N sequential
+        baseline runs share one program."""
+        if self._seq_scan is None:
+            body = self.driver._epoch_step_with
+
+            @jax.jit
+            def scan_fn(state, steps, t, kind, osd, bump, salt):
+                def sbody(carry, step):
+                    return body(carry, step, (t, kind, osd, bump), salt)
+
+                return jax.lax.scan(sbody, state, steps)
+
+            self._seq_scan = scan_fn
+        return self._seq_scan
+
+    # -- drivers -------------------------------------------------------
+
+    def run_fleet(
+        self,
+        n_epochs: int,
+        timelines,
+        *,
+        seeds=None,
+        pull: bool = True,
+    ):
+        """Advance every timeline ``n_epochs`` epochs in one vmapped
+        scan.  Returns a cropped :class:`FleetSeries`, or — with
+        ``pull=False`` — the device-resident ``(state, rows)`` pair
+        (the zero-host-transfer path the ``fleet_superstep``
+        nonregression scenario pins)."""
+        tls = list(timelines)
+        tapes = [compile_event_tape(tl, self.m) for tl in tls]
+        ftape = stack_tapes(tapes)
+        salts = self._salts(len(tls), ftape.fleet_pad, seeds)
+        fstate = self._fleet_state(ftape.fleet_pad)
+        steps = jnp.arange(int(n_epochs), dtype=I32)
+        scan_fn = self._fleet_scan_fn()
+        state, rows = scan_fn(fstate, steps, *ftape.device(), salts)
+        self.final_state = state
+        if not pull:
+            return state, rows
+        return FleetSeries.from_device(rows, len(tls))
+
+    def run_sequential(
+        self,
+        n_epochs: int,
+        timelines,
+        *,
+        seeds=None,
+        rows_pad: int | None = None,
+    ) -> list[EpochSeries]:
+        """N one-cluster superstep runs through the single compiled
+        tape-as-argument scan — the warm sequential baseline.  Bit
+        -equal to ``EpochDriver(m, timeline_i, seed=seed_i)
+        .run_superstep(n_epochs)`` per cluster: same body, and the
+        pad rows sit past every epoch's searchsorted window."""
+        tls = list(timelines)
+        if seeds is None:
+            seeds = [self.seed + i for i in range(len(tls))]
+        seeds = list(seeds)
+        if len(seeds) != len(tls):
+            raise ValueError(f"{len(seeds)} seeds for {len(tls)} timelines")
+        tapes = [compile_event_tape(tl, self.m) for tl in tls]
+        r_pad = _pad_to(max(max(len(tp) for tp in tapes), 1))
+        if rows_pad is not None:
+            r_pad = max(r_pad, int(rows_pad))
+        steps = jnp.arange(int(n_epochs), dtype=I32)
+        scan_fn = self._seq_scan_fn()
+        out = []
+        for tp, sd in zip(tapes, seeds):
+            arrs = tuple(
+                jnp.asarray(a) for a in _pad_tape_arrays(tp, r_pad)
+            )
+            _state, rows = scan_fn(
+                self.driver._init_state, steps, *arrs,
+                jnp.asarray(_salt_base(sd)),
+            )
+            out.append(EpochSeries.from_device(rows))
+        return out
+
+
+def run_fleet(
+    m: OSDMap,
+    scenario: str,
+    n_clusters: int,
+    n_epochs: int,
+    *,
+    seed: int = 0,
+    jitter: float = 0.25,
+    **driver_kwargs,
+) -> FleetSeries:
+    """Convenience one-shot: sample ``n_clusters`` timelines of a named
+    scenario and advance them together (the CLI/bench surface)."""
+    drv = FleetDriver(m, seed=seed, **driver_kwargs)
+    tls = drv.sample(n_clusters, scenario, jitter=jitter)
+    return drv.run_fleet(n_epochs, tls)

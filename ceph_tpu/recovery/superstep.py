@@ -298,7 +298,7 @@ class EpochDriver:
         n_ops: int = 1024,
         k: int | None = None,
         seed: int = 0,
-        write_fraction: float = 0.25,
+        write_fraction: float | None = None,
         service_ms: float = 0.5,
         osd_capacity_ops_per_s: float | None = None,
         scrub_period_s: float | None = None,
@@ -306,6 +306,8 @@ class EpochDriver:
         noout: bool = False,
         reporters: np.ndarray | None = None,
         max_items: int = 8,
+        mix=None,
+        rho_recovery: float = 0.0,
     ):
         cfg = config or global_config()
         pool = m.pools[min(m.pools) if pool_id is None else pool_id]
@@ -314,6 +316,23 @@ class EpochDriver:
         self.t0 = float(t0)
         self.n_ops = int(n_ops)
         self.seed = int(seed)
+        self.salt_base = np.uint32((self.seed * 2654435761) & 0xFFFFFFFF)
+        # named workload mix (the arXiv:1709.05365 SSD-array
+        # characterization): supplies the default read/write split and
+        # the skew/burst shape; None keeps today's uniform traffic
+        # bit-identical
+        from ..workload.traffic import resolve_mix
+
+        self._mix = resolve_mix(mix)
+        if write_fraction is None:
+            write_fraction = (
+                self._mix.write_fraction if self._mix is not None
+                else 0.25
+            )
+        # background-recovery utilization claimed by the mclock
+        # recovery class (a share sweep maps shares onto this knob);
+        # 0.0 is today's no-recovery-pressure traffic step
+        self.rho_recovery = float(rho_recovery)
         # the EC reconstruction threshold the traffic router and the
         # PG-state classifier key "inactive" on; replicated pools read
         # from any one survivor
@@ -405,12 +424,12 @@ class EpochDriver:
         computes the identical value from the identical expression)."""
         return self.t0 + (step + 1).astype(F64) * self.dt
 
-    @property
-    def _tape_fn(self):
-        fn = getattr(self, "_tape_fn_c", None)
-        if fn is not None:
-            return fn
-        t_dev, kind_dev, osd_dev, bump_dev = self._tape_dev
+    def _tape_apply(self, state: ClusterState, step, tape):
+        """The tape-window drain over explicit ``(t, kind, osd, bump)``
+        arrays — the body :attr:`_tape_fn` jits with this driver's own
+        tape closed over, and the fleet superstep vmaps with a
+        per-cluster ``[rows]`` slice traced in."""
+        t_dev, kind_dev, osd_dev, bump_dev = tape
         n_rows = int(t_dev.shape[0])
 
         def branches(now32, exists):
@@ -465,48 +484,57 @@ class EpochDriver:
             return (down, upb, outb, inb, net_drop, net_restore,
                     slow_drop, slow_restore)
 
+        now = self._now_of(step)
+        now32 = now.astype(F32)
+        stop = jnp.searchsorted(
+            t_dev, now, side="right"
+        ).astype(I32)
+        brs = branches(now32, state.pool.osd_exists)
+
+        def row(i, carry):
+            lanes, bumps, map_rows = carry
+            k = kind_dev[i]
+            o = osd_dev[i]
+            lanes = jax.lax.switch(
+                k, [lambda ls, b=b: b(ls, o) for b in brs], lanes
+            )
+            return (
+                lanes,
+                bumps + bump_dev[i],
+                map_rows + jnp.where(k <= TAPE_IN, 1, 0).astype(I32),
+            )
+
+        lanes0 = (
+            state.pool.osd_up, state.pool.osd_weight,
+            state.last_ack, state.suppressed, state.slow, state.out,
+        )
+        if n_rows:
+            lanes, bumps, map_rows = jax.lax.fori_loop(
+                state.tape_cursor, stop, row,
+                (lanes0, jnp.int32(0), jnp.int32(0)),
+            )
+        else:
+            lanes, bumps, map_rows = lanes0, jnp.int32(0), jnp.int32(0)
+        (up, w, ack, sup, slow, out) = lanes
+        state = replace(
+            state,
+            pool=replace(state.pool, osd_up=up, osd_weight=w),
+            last_ack=ack, suppressed=sup, slow=slow, out=out,
+            epoch=state.epoch + bumps,
+            now=now, tape_cursor=stop, step=step,
+        )
+        return state, (map_rows > 0)
+
+    @property
+    def _tape_fn(self):
+        fn = getattr(self, "_tape_fn_c", None)
+        if fn is not None:
+            return fn
+        tape = self._tape_dev
+
         @jax.jit
         def tape_fn(state: ClusterState, step):
-            now = self._now_of(step)
-            now32 = now.astype(F32)
-            stop = jnp.searchsorted(
-                t_dev, now, side="right"
-            ).astype(I32)
-            brs = branches(now32, state.pool.osd_exists)
-
-            def row(i, carry):
-                lanes, bumps, map_rows = carry
-                k = kind_dev[i]
-                o = osd_dev[i]
-                lanes = jax.lax.switch(
-                    k, [lambda ls, b=b: b(ls, o) for b in brs], lanes
-                )
-                return (
-                    lanes,
-                    bumps + bump_dev[i],
-                    map_rows + jnp.where(k <= TAPE_IN, 1, 0).astype(I32),
-                )
-
-            lanes0 = (
-                state.pool.osd_up, state.pool.osd_weight,
-                state.last_ack, state.suppressed, state.slow, state.out,
-            )
-            if n_rows:
-                lanes, bumps, map_rows = jax.lax.fori_loop(
-                    state.tape_cursor, stop, row,
-                    (lanes0, jnp.int32(0), jnp.int32(0)),
-                )
-            else:
-                lanes, bumps, map_rows = lanes0, jnp.int32(0), jnp.int32(0)
-            (up, w, ack, sup, slow, out) = lanes
-            state = replace(
-                state,
-                pool=replace(state.pool, osd_up=up, osd_weight=w),
-                last_ack=ack, suppressed=sup, slow=slow, out=out,
-                epoch=state.epoch + bumps,
-                now=now, tape_cursor=stop, step=step,
-            )
-            return state, (map_rows > 0)
+            return self._tape_apply(state, step, tape)
 
         self._tape_fn_c = tape_fn
         return tape_fn
@@ -676,11 +704,14 @@ class EpochDriver:
         self._peer_hist_fn_c = peer_hist_fn
         return peer_hist_fn
 
-    @property
-    def _traffic_fn(self):
-        fn = getattr(self, "_traffic_fn_c", None)
-        if fn is not None:
-            return fn
+    def _traffic_apply(self, state: ClusterState, step, salt_base):
+        """The traffic step over an explicit per-run salt base — the
+        body :attr:`_traffic_fn` jits with this driver's seed baked in,
+        and the fleet superstep vmaps with a per-cluster u32 salt
+        traced in.  When a workload mix is attached, object ids are
+        skew-remapped and the per-OSD capacity is burst-modulated
+        before routing; the default (no mix) path emits today's exact
+        graph."""
         # deferred: workload.traffic imports recovery.peering, whose
         # package __init__ loads this module — a module-level import
         # would close that cycle
@@ -688,6 +719,7 @@ class EpochDriver:
         from ..workload.traffic import (
             _route,
             _scatter_load,
+            _skew_ids,
             _traffic_reduce,
         )
 
@@ -703,45 +735,73 @@ class EpochDriver:
         wpm = np.int32(self.write_permille)
         service_ms = np.float32(self.service_ms)
         cap_ops = np.float32(self.cap_ops)
-        salt_base = np.uint32((self.seed * 2654435761) & 0xFFFFFFFF)
+        mix = self._mix
+
+        # the TrafficEngine's per-step salt, u32 wraparound exact
+        salt = salt_base + step.astype(U32) * _SALT_STEP
+        ids = jnp.arange(n_ops, dtype=U32)
+        in_range = jnp.ones(n_ops, dtype=bool)
+        if mix is not None and mix.hot_permille > 0:
+            ids = _skew_ids(
+                ids, salt, mix.hot_permille, mix.hot_objects
+            )
+        if (mix is not None and mix.burst_factor > 1.0
+                and mix.burst_period_s > 0.0):
+            # bursty arrivals modelled as capacity headroom collapsing
+            # by burst_factor for burst_duty of every period (the
+            # offered load is the fixed op grid, so shrinking capacity
+            # is the same rho excursion as multiplying arrivals)
+            frac = state.now % mix.burst_period_s
+            in_burst = frac < (mix.burst_duty * mix.burst_period_s)
+            cap_eff = jnp.where(
+                in_burst, cap_ops / np.float32(mix.burst_factor),
+                cap_ops,
+            ).astype(F32)
+        else:
+            cap_eff = cap_ops
+        load = _scatter_load(
+            state.survivor_mask, state.n_alive,
+            state.acting_primary, ids, in_range,
+            salt, pg_b, pg_bmask, k, size, min_size, wpm, n_osds,
+        )
+        (counts, lat_hist, qd_hist, sums, max_rho, _written,
+         _deg_read) = _traffic_reduce(
+            state.survivor_mask, state.n_alive,
+            state.acting_primary, ids, in_range, load,
+            salt, pg_b, pg_bmask, k, size, min_size, wpm,
+            service_ms, cap_eff, self.rho_recovery, N_BUCKETS,
+            LAT_MIN_MS,
+        )
+        # the epoch series only needs the committed-write and
+        # degraded-read TOTALS: sum the route predicates directly
+        # (integer-exact equal to summing the per-PG scatter
+        # tables, whose [pg_num]-wide scatters then dead-code out
+        # of the epoch program — the scan's hot floor)
+        pg, prim, is_write, blocked, degraded, _cost = _route(
+            state.survivor_mask, state.n_alive,
+            state.acting_primary, ids,
+            salt, pg_b, pg_bmask, k, size, min_size, wpm,
+        )
+        ok = in_range & ~blocked
+        writes = jnp.sum(
+            jnp.where(ok & is_write, 1, 0).astype(I32)
+        ).astype(I32)
+        deg_reads = jnp.sum(
+            jnp.where(ok & degraded & ~is_write, 1, 0).astype(I32)
+        ).astype(I32)
+        return (counts, lat_hist, qd_hist, sums, max_rho,
+                writes, deg_reads)
+
+    @property
+    def _traffic_fn(self):
+        fn = getattr(self, "_traffic_fn_c", None)
+        if fn is not None:
+            return fn
+        salt_base = self.salt_base
 
         @jax.jit
         def traffic_fn(state: ClusterState, step):
-            # the TrafficEngine's per-step salt, u32 wraparound exact
-            salt = salt_base + step.astype(U32) * _SALT_STEP
-            ids = jnp.arange(n_ops, dtype=U32)
-            in_range = jnp.ones(n_ops, dtype=bool)
-            load = _scatter_load(
-                state.survivor_mask, state.n_alive,
-                state.acting_primary, ids, in_range,
-                salt, pg_b, pg_bmask, k, size, min_size, wpm, n_osds,
-            )
-            (counts, lat_hist, qd_hist, sums, max_rho, _written,
-             _deg_read) = _traffic_reduce(
-                state.survivor_mask, state.n_alive,
-                state.acting_primary, ids, in_range, load,
-                salt, pg_b, pg_bmask, k, size, min_size, wpm,
-                service_ms, cap_ops, 0.0, N_BUCKETS, LAT_MIN_MS,
-            )
-            # the epoch series only needs the committed-write and
-            # degraded-read TOTALS: sum the route predicates directly
-            # (integer-exact equal to summing the per-PG scatter
-            # tables, whose [pg_num]-wide scatters then dead-code out
-            # of the epoch program — the scan's hot floor)
-            pg, prim, is_write, blocked, degraded, _cost = _route(
-                state.survivor_mask, state.n_alive,
-                state.acting_primary, ids,
-                salt, pg_b, pg_bmask, k, size, min_size, wpm,
-            )
-            ok = in_range & ~blocked
-            writes = jnp.sum(
-                jnp.where(ok & is_write, 1, 0).astype(I32)
-            ).astype(I32)
-            deg_reads = jnp.sum(
-                jnp.where(ok & degraded & ~is_write, 1, 0).astype(I32)
-            ).astype(I32)
-            return (counts, lat_hist, qd_hist, sums, max_rho,
-                    writes, deg_reads)
+            return self._traffic_apply(state, step, salt_base)
 
         self._traffic_fn_c = traffic_fn
         return traffic_fn
@@ -799,6 +859,34 @@ class EpochDriver:
         )
         (counts, lat_hist, qd_hist, sums, max_rho, writes,
          deg_reads) = self._traffic_fn(state, step)
+        scrub_due = self._scrub_fn(prev_now, state.now)
+        row = (
+            state.now, state.epoch, dirty.astype(I32), state.pg_hist,
+            state.pg_aux, counts, lat_hist, qd_hist, sums, max_rho,
+            writes, deg_reads, down_total, nd, nu, no, down_ck,
+            scrub_due,
+        )
+        return state, row
+
+    def _epoch_step_with(self, state: ClusterState, step, tape,
+                         salt_base):
+        """The epoch body with the chaos tape and traffic salt as
+        traced *arguments* instead of baked-in constants — the fleet
+        superstep (:mod:`ceph_tpu.recovery.fleet`) vmaps this over
+        per-cluster tape slices and salts; the ops are the same
+        subgraphs :meth:`_epoch_step` composes, so each fleet lane is
+        bit-equal to a sequential run with that cluster's tape/seed."""
+        prev_now = state.now
+        state, tape_dirty = self._tape_apply(state, step, tape)
+        state, (nd, nu, no, down_total, down_ck, trans) = self._live_fn(
+            state
+        )
+        dirty = tape_dirty | trans
+        state = jax.lax.cond(
+            dirty, self._peer_hist_fn, lambda s: s, state
+        )
+        (counts, lat_hist, qd_hist, sums, max_rho, writes,
+         deg_reads) = self._traffic_apply(state, step, salt_base)
         scrub_due = self._scrub_fn(prev_now, state.now)
         row = (
             state.now, state.epoch, dirty.astype(I32), state.pg_hist,

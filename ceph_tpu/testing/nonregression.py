@@ -130,6 +130,11 @@ def compile_once_cases() -> dict[str, dict]:
       second same-shape epoch window must reuse the one compiled scan
       with ZERO device->host transfers inside it (the whole point of
       the superstep: host exits only at snapshot boundaries).
+    - ``fleet_superstep``: the vmapped scenario-fleet scan
+      (:mod:`ceph_tpu.recovery.fleet`) — growing the fleet within one
+      power-of-two pad bucket (3 -> 4 clusters) must reuse the one
+      compiled program with zero in-scan host transfers; fleet size is
+      a value, never a shape.
 
     Raises ``AssertionError`` (from
     :func:`ceph_tpu.analysis.runtime_guard.assert_no_recompile`) if
@@ -352,6 +357,26 @@ def compile_once_cases() -> dict[str, dict]:
     report["epoch_superstep"] = {
         "warm_compiles": warm_e.n_compiles, "second_compiles": 0,
         "in_scan_host_transfers": g_e.host_transfers,
+    }
+
+    # ---- fleet superstep: vmapped scan -> same pad bucket ---------------
+    from ..recovery.fleet import FleetDriver
+
+    fdrv = FleetDriver(m_e, seed=3, n_ops=64)
+    tls_a = fdrv.sample(3, "ssd-burst")
+    with CompileCounter() as warm_f:
+        fdrv.run_fleet(8, tls_a, pull=False)
+    # a fleet of 4 lands in the same power-of-two pad bucket as 3: the
+    # one vmapped scan executable is reused, and with pull=False the
+    # whole fleet window moves zero bytes to host
+    tls_b = fdrv.sample(4, "ssd-burst")
+    with assert_no_recompile("fleet superstep same pad bucket"):
+        with track() as g_f:
+            fdrv.run_fleet(8, tls_b, pull=False)
+    assert g_f.host_transfers == 0, g_f.host_transfers
+    report["fleet_superstep"] = {
+        "warm_compiles": warm_f.n_compiles, "second_compiles": 0,
+        "in_scan_host_transfers": g_f.host_transfers,
     }
     return report
 

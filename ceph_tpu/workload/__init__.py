@@ -22,8 +22,11 @@ from .histogram import (
 )
 from .qos import MClockArbiter, QoSClass
 from .traffic import (
+    TRAFFIC_MIXES,
     TrafficEngine,
+    TrafficMix,
     TrafficSample,
+    resolve_mix,
     sharded_traffic_step,
     traffic_step,
     workload_counters,
@@ -34,12 +37,15 @@ __all__ = [
     "MClockArbiter",
     "N_BUCKETS",
     "QoSClass",
+    "TRAFFIC_MIXES",
     "TrafficEngine",
+    "TrafficMix",
     "TrafficSample",
     "bucket_edges",
     "count_at_least",
     "percentile",
     "percentiles",
+    "resolve_mix",
     "sharded_traffic_step",
     "traffic_step",
     "workload_counters",

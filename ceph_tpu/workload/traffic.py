@@ -73,6 +73,69 @@ F32 = jnp.float32
 RHO_MAX = 0.97
 
 _SALT2 = np.uint32(0x9E3779B9)  # decorrelates the read/write coin
+_SALT3 = np.uint32(0x85EBCA6B)  # decorrelates the popularity-skew coin
+
+
+@dataclass(frozen=True)
+class TrafficMix:
+    """A named client-workload shape, grounded in the arXiv:1709.05365
+    characterization of online EC on large SSD arrays: a read/write
+    split, a skewed object-popularity remap (``hot_permille`` of ops
+    collapse onto a ``hot_objects``-wide hot set), and a bursty-arrival
+    duty cycle (capacity headroom divides by ``burst_factor`` for
+    ``burst_duty`` of every ``burst_period_s``).  The zero-valued
+    defaults are the uniform workload — consumers gate each knob
+    statically so a mix-less run traces today's exact graph."""
+
+    name: str
+    write_fraction: float = 0.25
+    hot_permille: int = 0
+    hot_objects: int = 64
+    burst_period_s: float = 0.0
+    burst_duty: float = 0.0
+    burst_factor: float = 1.0
+
+
+#: the named fleet workload mixes (selectable from ``config8_fleet``
+#: and the CLI; the names pair with the same-named chaos scenarios)
+TRAFFIC_MIXES = {
+    m.name: m
+    for m in (
+        # steady-state online EC: read-mostly with a warm working set
+        TrafficMix("ssd-steady", write_fraction=0.30,
+                   hot_permille=400, hot_objects=256),
+        # write-burst ingest: bursty arrivals on a write-heavy split
+        TrafficMix("ssd-burst", write_fraction=0.45,
+                   hot_permille=300, hot_objects=256,
+                   burst_period_s=4.0, burst_duty=0.25,
+                   burst_factor=3.0),
+        # read-hot-spot serving: most ops collapse onto a small hot set
+        TrafficMix("ssd-skew", write_fraction=0.10,
+                   hot_permille=800, hot_objects=64),
+    )
+}
+
+
+def resolve_mix(mix) -> TrafficMix | None:
+    """``None`` | mix name | :class:`TrafficMix` -> the mix (or None)."""
+    if mix is None or isinstance(mix, TrafficMix):
+        return mix
+    try:
+        return TRAFFIC_MIXES[mix]
+    except KeyError:
+        raise ValueError(
+            f"unknown traffic mix {mix!r}; known: "
+            f"{sorted(TRAFFIC_MIXES)}"
+        ) from None
+
+
+def _skew_ids(ids, salt, hot_permille: int, hot_objects: int):
+    """Skewed object popularity: ``hot_permille``/1000 of the op batch
+    remaps onto the first ``hot_objects`` object ids (a seeded hash
+    coin, decorrelated from the routing and read/write coins)."""
+    coin = crush_hash32_2(ids, salt ^ _SALT3)
+    hot = (coin % jnp.uint32(1000)).astype(I32) < jnp.int32(hot_permille)
+    return jnp.where(hot, ids % jnp.uint32(hot_objects), ids)
 
 
 def _traffic_reduce(
@@ -381,7 +444,8 @@ class TrafficEngine:
         min_size: int,
         *,
         ops_per_step: int = 65536,
-        write_fraction: float = 0.25,
+        write_fraction: float | None = None,
+        mix=None,
         service_ms: float = 0.5,
         osd_capacity_ops_per_s: float | None = None,
         recovery_capacity_bps: float | None = None,
@@ -408,6 +472,14 @@ class TrafficEngine:
         self.size = int(size)
         self.min_size = int(min_size)
         self.ops_per_step = int(ops_per_step)
+        # a named mix supplies the default read/write split (the
+        # engine's batch is otherwise uniform; the epoch superstep is
+        # where the skew/burst knobs land)
+        self.mix = resolve_mix(mix)
+        if write_fraction is None:
+            write_fraction = (
+                self.mix.write_fraction if self.mix is not None else 0.25
+            )
         self.write_permille = int(round(float(write_fraction) * 1000))
         self.service_ms = float(service_ms)
         # default capacity: 2x a uniform spread of one batch per second

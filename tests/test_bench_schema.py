@@ -475,6 +475,7 @@ def test_device_result_uses_headline_metric():
     assert out["value"] == 2_000_000
     assert out["vs_baseline"] == 10.0
     assert out["platform"] == "tpu"
+    assert out["status"] == "ok"
     assert "error" not in out
 
 
@@ -482,11 +483,14 @@ def test_cpu_fallback_is_unmistakable():
     out = bench.format_result(
         {"rate": 50_000.0, "platform": "cpu"}, 200_000.0, ["tpu attempt 1: timeout after 420s"]
     )
+    # the metric tag and typed status mark the fallback; the measured
+    # host rate is promoted to value so trajectory plots don't read a
+    # fallback run as a regression to zero
     assert out["metric"] == "crush_placements_per_sec_cpu_fallback"
-    # headline fields zeroed: a platform-blind reader sees no device rate
-    assert out["value"] == 0
-    assert out["vs_baseline"] == 0.0
-    # the honest CPU measurement lives in clearly-named side fields
+    assert out["status"] == "cpu_fallback"
+    assert out["value"] == 50_000
+    assert out["vs_baseline"] == 0.25
+    # the clearly-named side fields stay for older readers
     assert out["cpu_fallback_rate"] == 50_000
     assert out["cpu_fallback_vs_baseline"] == 0.25
     assert "error" in out
@@ -495,6 +499,7 @@ def test_cpu_fallback_is_unmistakable():
 def test_total_failure_still_emits_schema():
     out = bench.format_result(None, 0.0, ["tpu attempt 1: boom", "cpu fallback: boom"])
     assert out["metric"] == "crush_placements_per_sec_cpu_fallback"
+    assert out["status"] == "failed"
     assert out["value"] == 0
     assert out["vs_baseline"] == 0.0
     assert "cpu_fallback_rate" not in out
@@ -520,10 +525,12 @@ def test_fallback_carries_banked_silicon_result():
         ["tpu attempt 1: timeout after 420s"],
         banked=_BANKED,
     )
-    # the fallback stays unmistakable: headline fields still zeroed...
+    # the fallback stays unmistakable (metric tag + typed status), with
+    # the honest host rate promoted to value...
     assert out["metric"] == "crush_placements_per_sec_cpu_fallback"
-    assert out["value"] == 0
-    # ...but the banked silicon measurement rides along, fully attributed
+    assert out["status"] == "cpu_fallback"
+    assert out["value"] == 50_000
+    # ...and the banked silicon measurement rides along, fully attributed
     assert out["banked_value"] == 1_795_466
     assert out["banked_platform"] == "tpu"
     assert out["banked_timestamp_utc"] == "2026-07-31T03:50:00Z"
@@ -827,6 +834,144 @@ def test_timeout_records_skipped_by_harvests(tmp_path):
     assert g["epoch_loop_rate_per_sec"]["epoch_rate_superstep_per_sec"] == 19_990.4
     assert "recovery_decode_bytes_per_sec" not in g
     assert dd.harvest_aux([str(p)]) == {}
+
+
+# --- config8_fleet JSON schema (vmapped scenario fleets) --------------
+
+_CONFIG8 = os.path.join(os.path.dirname(_BENCH), "bench", "config8_fleet.py")
+_spec8 = importlib.util.spec_from_file_location("bench_config8", _CONFIG8)
+config8 = importlib.util.module_from_spec(_spec8)
+_spec8.loader.exec_module(config8)
+
+
+class _FakeFleetTape:
+    fleet_pad = 256
+    rows_pad = 16
+
+
+def _fleet_estimate():
+    from ceph_tpu.recovery.durability import DurabilityEstimate
+
+    return DurabilityEstimate(
+        scenario="ssd-burst", n_clusters=256, n_epochs=256,
+        mission_s=64.0, survival_fraction=0.99609375, n_lost=1,
+        mttdl_s=16384.0, mttdl_ci_lo_s=5461.333, mttdl_ci_hi_s=32768.0,
+        mttdl_censored=False, availability_mean=0.999,
+        availability_ci_lo=0.998, availability_ci_hi=1.0,
+        ttzd_mean_s=2.5, ttzd_ci_lo_s=2.0, ttzd_ci_hi_s=3.0,
+        worst_cluster=17, worst_availability=0.9213,
+        seed=0, n_boot=256, codec="reed-solomon", ec_k=4, ec_m=2,
+        placement="crush", down_out_interval_s=600.0,
+    )
+
+
+_FLEET_SWEEP = [
+    {"down_out_interval_s": 30.0, "recovery_wgt": 4.0,
+     "recovery_share": 0.727273, "survival_fraction": 1.0,
+     "availability_mean": 1.0, "ttzd_mean_s": 0.9375},
+    {"down_out_interval_s": 600.0, "recovery_wgt": 1.0,
+     "recovery_share": 0.4, "survival_fraction": 0.9375,
+     "availability_mean": 0.999, "ttzd_mean_s": 2.5},
+]
+
+
+def _fleet_record():
+    est = _fleet_estimate()
+    return config8.build_fleet_record(
+        "tpu", 9898.2, 36.5, 13720.4, True, True, _FakeFleetTape(),
+        est, [config8._panel_entry(est)], _FLEET_SWEEP, _FLEET_SWEEP[0],
+        31, 31, 0,
+    )
+
+
+def test_fleet_record_schema():
+    import json
+
+    rec = _fleet_record()
+    assert rec["metric"] == "fleet_epoch_rate_per_sec"
+    assert rec["status"] == "ok"
+    assert rec["value"] == 9898 and rec["unit"] == "cluster-epochs/s"
+    # the headline baseline is the pre-fleet cost of N distinct
+    # timelines: one tape-as-constants program each, compile included —
+    # typed so no reader mistakes it for a warm-vs-warm ratio...
+    assert rec["vs_baseline"] == round(9898.2 / 36.5, 2)
+    assert rec["fleet_aggregate_speedup"] == round(9898.2 / 36.5, 2)
+    assert rec["fleet_seq_includes_compile"] is True
+    # ...and the warm tape-as-argument rate rides along with its own
+    # honest (possibly < 1x) ratio
+    assert rec["fleet_seq_epoch_rate_warm_per_sec"] == 13720.4
+    assert rec["fleet_aggregate_speedup_warm"] == round(
+        9898.2 / 13720.4, 2
+    )
+    # the two in-record gates the acceptance bar reads
+    assert rec["fleet_bitequal"] is True
+    assert rec["fleet_same_bucket_zero_recompile"] is True
+    assert rec["fleet_pad"] == 256 and rec["fleet_rows_pad"] == 16
+    # sweep picks + grid, and the flat durability_* block
+    assert rec["fleet_best_down_out_interval_s"] == 30.0
+    assert rec["fleet_best_recovery_share"] == 0.727273
+    assert rec["fleet_sweep_grid"][1]["survival_fraction"] == 0.9375
+    assert rec["durability_mttdl_censored"] is False
+    assert rec["durability_codec"] == "reed-solomon"
+    assert rec["durability_ec_k"] == 4 and rec["durability_ec_m"] == 2
+    assert rec["fleet_scenario_panel"][0]["scenario"] == "ssd-burst"
+    assert rec["fleet_scenario_panel"][0]["worst_cluster"] == 17
+    assert rec["n_compiles"] == 31 and rec["n_compiles_first"] == 31
+    assert rec["host_transfers"] == 0
+    json.dumps(rec)  # one JSON line, always serializable
+
+
+def test_fleet_record_zero_baselines():
+    # failed baseline passes must not divide by zero or fake a win
+    est = _fleet_estimate()
+    rec = config8.build_fleet_record(
+        "cpu", 1000.0, 0.0, 0.0, False, False, _FakeFleetTape(),
+        est, [], [], None, 5, 4, 0,
+    )
+    assert rec["vs_baseline"] == 0.0
+    assert rec["fleet_aggregate_speedup"] == 0.0
+    assert rec["fleet_aggregate_speedup_warm"] == 0.0
+    assert rec["fleet_bitequal"] is False
+    assert "fleet_sweep_grid" not in rec
+    assert "fleet_best_down_out_interval_s" not in rec
+
+
+def test_fleet_record_harvested_by_decide_defaults(tmp_path):
+    import json
+
+    rec = _fleet_record()
+    p = tmp_path / "session.log"
+    p.write_text(json.dumps(rec) + "\n")
+    dd = _load_dd("fleet")
+    g = dd.harvest_guard([str(p)])["fleet_epoch_rate_per_sec"]
+    # typed FLEET_* fields: rates, the honest-baseline pair, gates
+    assert g["fleet_epoch_rate_per_sec"] == 9898.2
+    assert g["fleet_seq_epoch_rate_per_sec"] == 36.5
+    assert g["fleet_seq_epoch_rate_warm_per_sec"] == 13720.4
+    assert g["fleet_aggregate_speedup"] == round(9898.2 / 36.5, 2)
+    assert g["fleet_aggregate_speedup_warm"] == round(
+        9898.2 / 13720.4, 2
+    )
+    assert g["fleet_seq_includes_compile"] is True
+    assert g["fleet_bitequal"] is True
+    assert g["fleet_same_bucket_zero_recompile"] is True
+    assert g["fleet_scenario"] == "ssd-burst"
+    assert g["fleet_n_clusters"] == config8.FLEET
+    assert g["fleet_pad"] == 256 and g["fleet_rows_pad"] == 16
+    # the sweep picks decide_defaults turns into config defaults
+    assert g["fleet_best_down_out_interval_s"] == 30.0
+    assert g["fleet_best_recovery_share"] == 0.727273
+    # typed DURABILITY_* fields: the Monte Carlo verdict and its key
+    assert g["durability_survival_fraction"] == 0.99609375
+    assert g["durability_n_lost"] == 1
+    assert g["durability_mttdl_s"] == 16384.0
+    assert g["durability_mttdl_censored"] is False
+    assert g["durability_codec"] == "reed-solomon"
+    assert g["durability_ec_k"] == 4 and g["durability_ec_m"] == 2
+    assert g["durability_placement"] == "crush"
+    assert g["durability_down_out_interval_s"] == 600.0
+    assert g["durability_worst_cluster"] == 17
+    assert g["steady_state_clean"] is True
 
 
 def test_crush_record_provenance_harvested_by_decide_defaults(tmp_path):

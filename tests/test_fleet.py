@@ -1,0 +1,271 @@
+"""Vmapped scenario fleets vs their own sequential superstep runs.
+
+The fleet's contract is the superstep's, batched: every lane of the
+vmapped scan must be bit-equal — floats compared exactly, no
+tolerance — to a sequential run of that lane's timeline, even though
+the fleet hoists the dirty-gating ``lax.cond`` to fleet level (an
+epoch peers all lanes when ANY lane is dirty, with a per-lane select
+keeping clean lanes untouched).  The zoo below mixes the map-churning
+scenarios from the superstep tests with the arXiv:1709.05365 SSD
+workload scenarios this PR adds; the fleet is jittered, so lanes
+genuinely diverge (different tape rows, different dirty epochs).
+
+Shape discipline rides along: fleet size and tape length pad to
+power-of-two buckets, so growing a fleet within a bucket must reuse
+the compiled program exactly (zero compiles, the bench's
+``fleet_same_bucket_zero_recompile`` gate), and crossing a bucket
+boundary compiles exactly one new program.
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.analysis.runtime_guard import CompileCounter
+from ceph_tpu.core.cluster_state import (
+    ClusterState,
+    apply_incremental,
+    apply_incremental_fleet,
+    index_state,
+    stack_states,
+)
+from ceph_tpu.models.clusters import build_osdmap
+from ceph_tpu.osdmap.map import UP, Incremental
+from ceph_tpu.recovery import EpochDriver
+from ceph_tpu.recovery.durability import RULE_OF_THREE, estimate_durability
+from ceph_tpu.recovery.fleet import (
+    FleetDriver,
+    sample_timelines,
+    stack_tapes,
+)
+from ceph_tpu.recovery.superstep import compile_event_tape
+
+ZOO = ("flap", "rack-cascade", "mid-repair-loss", "ssd-burst")
+FLEET = 4
+EPOCHS = 16
+
+
+def _map():
+    return build_osdmap(32, pg_num=16, size=6, pool_kind="erasure")
+
+
+@pytest.fixture(scope="module")
+def fd():
+    # one driver for the whole module: jit's shape cache carries the
+    # compiled fleet scan across tests (same discipline the bench uses)
+    return FleetDriver(_map(), seed=7, n_ops=64)
+
+
+@pytest.mark.parametrize("scenario", ZOO)
+def test_fleet_bitequal_over_zoo(fd, scenario):
+    tls = fd.sample(FLEET, scenario)
+    fs = fd.run_fleet(EPOCHS, tls)
+    seqs = fd.run_sequential(EPOCHS, tls)
+    # every lane bit-equal to its own sequential run: PG-state
+    # histograms, liveness transitions, traffic outcomes, clocks
+    for k in range(FLEET):
+        assert fs.cluster(k).diff(seqs[k]) == [], (scenario, k)
+    # traffic conservation per lane per epoch
+    assert (fs.counts.sum(axis=2) == 64).all()
+
+
+def test_fleet_lane_matches_plain_epoch_driver(fd):
+    # anchor the fleet directly to the pre-fleet API: a plain
+    # EpochDriver with the lane's timeline baked in as jit constants
+    # (run_sequential is itself new code; this closes the triangle)
+    tls = fd.sample(FLEET, "ssd-burst")
+    fs = fd.run_fleet(EPOCHS, tls)
+    k = 2
+    d = EpochDriver(fd.m, tls[k], seed=fd.seed + k, n_ops=64)
+    assert fs.cluster(k).diff(d.run_superstep(EPOCHS)) == []
+
+
+def test_fleet_pad_bucket_compile_discipline(fd):
+    # jitter=0 keeps every tape the same length, so the rows bucket
+    # cannot move under the fleet-axis comparison
+    tls = fd.sample(5, "flap", jitter=0.0)
+    fd.run_fleet(EPOCHS, tls[:3])  # fleet of 3 pads to 4
+    with CompileCounter() as same:
+        fd.run_fleet(EPOCHS, tls[:4])  # 4 pads to 4: same program
+    assert same.n_compiles == 0, same.n_compiles
+    with CompileCounter() as grow:
+        fd.run_fleet(EPOCHS, tls)  # 5 pads to 8: one new bucket
+    assert grow.n_compiles >= 1
+
+
+def test_sample_timelines_deterministic_and_prefix_stable(fd):
+    m = fd.m
+
+    def sigs(tls):
+        tapes = [compile_event_tape(tl, m) for tl in tls]
+        return [
+            (tp.t.tobytes(), tp.kind.tobytes(), tp.osd.tobytes(),
+             tp.bump.tobytes())
+            for tp in tapes
+        ]
+
+    a = sigs(sample_timelines(11, 6, "ssd-burst", m))
+    b = sigs(sample_timelines(11, 6, "ssd-burst", m))
+    assert a == b
+    # cluster i depends on (seed, i) only: growing the fleet never
+    # changes existing members
+    c = sigs(sample_timelines(11, 3, "ssd-burst", m))
+    assert a[:3] == c
+    # a different seed draws a different fleet
+    d = sigs(sample_timelines(12, 6, "ssd-burst", m))
+    assert a != d
+    # jitter=0 yields n identical copies of the base scenario
+    z = sigs(sample_timelines(11, 3, "flap", m, jitter=0.0))
+    assert z[0] == z[1] == z[2]
+
+
+def test_stack_tapes_pads_and_crops():
+    m = _map()
+    tls = sample_timelines(3, 3, "flap", m)
+    ftape = stack_tapes([compile_event_tape(tl, m) for tl in tls])
+    assert ftape.n_clusters == 3
+    assert ftape.fleet_pad == 4
+    assert ftape.rows_pad & (ftape.rows_pad - 1) == 0
+    # pad rows (and the whole pad cluster) park at t=+inf, past every
+    # searchsorted window
+    assert np.isinf(ftape.t[3]).all()
+    for k, tl in enumerate(tls):
+        n = len(compile_event_tape(tl, m))
+        assert np.isinf(ftape.t[k, n:]).all()
+        assert np.isfinite(ftape.t[k, :n]).all()
+
+
+def test_fleet_incremental_matches_per_cluster():
+    m = _map()
+    base = ClusterState.from_osdmap(m)
+    fleet = stack_states([base] * 4)
+    # divergent per-cluster deltas, including an empty no-op lane (the
+    # pad-cluster case) — one vmapped scatter must match per-cluster
+    # apply_incremental exactly
+    incs = [
+        Incremental(epoch=m.epoch + 1, new_state={3: UP, 7: UP}),
+        Incremental(epoch=m.epoch + 1, new_weight={5: 0x8000, 9: 0}),
+        Incremental(epoch=m.epoch + 1,
+                    new_primary_affinity={2: 0x4000}),
+        Incremental(epoch=m.epoch + 1),
+    ]
+    out = apply_incremental_fleet(fleet, incs)
+    for i, inc in enumerate(incs):
+        want = apply_incremental(base, inc)
+        got = index_state(out, i)
+        for lane in ("osd_up", "osd_exists", "osd_weight",
+                     "primary_affinity"):
+            assert np.array_equal(
+                np.asarray(getattr(got.pool, lane)),
+                np.asarray(getattr(want.pool, lane)),
+            ), (i, lane)
+        assert int(got.epoch) == int(want.epoch)
+
+
+def test_stack_states_rejects_mixed_checksums():
+    m = _map()
+    a = ClusterState.from_osdmap(m)
+    pool = m.pools[min(m.pools)]
+    b = ClusterState.from_osdmap(
+        m, checksums=np.zeros((pool.pg_num, pool.size), np.uint32)
+    )
+    with pytest.raises(ValueError, match="checksum"):
+        stack_states([a, b])
+
+
+# --- Monte Carlo durability over synthetic fleets ---------------------
+
+
+class _FakeFleet:
+    def __init__(self, hist, counts):
+        self.hist = hist
+        self.counts = counts
+
+
+def _clean_fleet(n_epochs=8, n_clusters=4, pg_num=16):
+    from ceph_tpu.obs.pg_states import N_STATES, STATE_ACTIVE_CLEAN
+
+    hist = np.zeros((n_epochs, n_clusters, N_STATES), np.int32)
+    hist[:, :, STATE_ACTIVE_CLEAN] = pg_num
+    counts = np.zeros((n_epochs, n_clusters, 3), np.int32)
+    counts[:, :, 0] = 64  # all ops served
+    return hist, counts
+
+
+def test_durability_censored_rule_of_three():
+    hist, counts = _clean_fleet()
+    est = estimate_durability(
+        _FakeFleet(hist, counts), dt=0.25, scenario="synthetic",
+        seed=3, n_boot=32,
+    )
+    # zero losses: survival 1.0, MTTDL censored at the rule-of-three
+    # lower bound N*T/3, CI pinned there on both ends (no infinities)
+    exposure = 4 * 8 * 0.25
+    assert est.n_lost == 0 and est.survival_fraction == 1.0
+    assert est.mttdl_censored is True
+    assert est.mttdl_s == pytest.approx(exposure / RULE_OF_THREE)
+    assert est.mttdl_ci_lo_s == pytest.approx(exposure / RULE_OF_THREE)
+    assert est.mttdl_ci_hi_s == pytest.approx(exposure / RULE_OF_THREE)
+    assert est.availability_mean == 1.0
+    assert est.ttzd_mean_s == 0.0
+    d = est.to_dict()
+    assert d["durability_mttdl_censored"] is True
+    import json
+
+    json.dumps(d)
+
+
+def test_durability_detects_loss_and_worst_cluster():
+    from ceph_tpu.obs.pg_states import (
+        STATE_ACTIVE_CLEAN,
+        STATE_DEGRADED,
+        STATE_INACTIVE,
+    )
+
+    hist, counts = _clean_fleet()
+    # cluster 1 drops a PG below k for two epochs -> lost; cluster 2
+    # runs degraded-but-readable epochs 2..5 -> ttzd = 4 epochs;
+    # cluster 3 blocks half its ops in epoch 0 -> worst availability
+    hist[3:5, 1, STATE_INACTIVE] = 1
+    hist[3:5, 1, STATE_ACTIVE_CLEAN] = 15
+    hist[2:6, 2, STATE_DEGRADED] = 2
+    hist[2:6, 2, STATE_ACTIVE_CLEAN] = 14
+    counts[0, 3, 0] = 32
+    counts[0, 3, 2] = 32
+    est = estimate_durability(
+        _FakeFleet(hist, counts), dt=0.25, scenario="synthetic",
+        seed=3, n_boot=64,
+    )
+    exposure = 4 * 8 * 0.25
+    assert est.n_lost == 1
+    assert est.survival_fraction == 0.75
+    assert est.mttdl_censored is False
+    assert est.mttdl_s == pytest.approx(exposure / 1.0)
+    # observed failures floor the CI at half a failure, keeping both
+    # bounds finite with the lower below the point estimate
+    assert 0.0 < est.mttdl_ci_lo_s <= est.mttdl_s <= est.mttdl_ci_hi_s
+    assert est.worst_cluster == 3
+    assert est.worst_availability == pytest.approx(1.0 - 32 / (8 * 64))
+    # ttzd: cluster 1 spans epochs 3..4, cluster 2 spans 2..5
+    assert est.ttzd_mean_s == pytest.approx(
+        (0 + 2 * 0.25 + 4 * 0.25 + 0) / 4
+    )
+
+
+def test_durability_over_real_fleet(fd):
+    # end-to-end: a real jittered fleet reduces to a JSON-safe record
+    tls = fd.sample(FLEET, "ssd-burst")
+    fs = fd.run_fleet(EPOCHS, tls)
+    est = estimate_durability(
+        fs, dt=fd.driver.dt, scenario="ssd-burst", seed=fd.seed,
+        n_boot=32, codec="reed-solomon", ec_k=4, ec_m=2,
+        placement="crush", down_out_interval_s=600.0,
+    )
+    assert est.n_clusters == FLEET and est.n_epochs == EPOCHS
+    assert est.mission_s == pytest.approx(EPOCHS * fd.driver.dt)
+    assert 0.0 <= est.survival_fraction <= 1.0
+    assert 0.0 <= est.availability_mean <= 1.0
+    d = est.to_dict()
+    assert d["durability_codec"] == "reed-solomon"
+    import json
+
+    json.dumps(d)
