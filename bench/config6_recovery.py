@@ -53,6 +53,17 @@ suppressions, so EVERY map epoch comes from the detector — twice, with
 and without the markdown-log flap damper.  The ``liveness_*`` fields
 carry the detection latency, the damped vs undamped map-epoch churn,
 and the flap-damper/auto-out counters ``decide_defaults`` guards.
+
+``--divergent`` runs the multi-rank chaos variant: the seeded
+scenario timeline plus a cross-epoch ``rankdelay`` skew on rank 1,
+driven through :class:`ceph_tpu.recovery.DivergentDriver` — two rank
+views advancing through one compiled scan with lattice-join
+reconciliation rounds between them.  The headline ``value`` is the
+detection-to-convergence latency in reconcile rounds (how many rounds
+between the first round that saw the ranks disagree and the round
+they re-converged); the ``divergent_*`` fields carry per-round
+verdicts, retry/backoff totals, per-rank final progress, and the
+``SLO_RANK_STALL`` verdict ``decide_defaults`` guards.
 """
 
 import json
@@ -824,6 +835,128 @@ def run_liveness(scenario: str) -> None:
     )))
 
 
+#: divergent-pass tuning: the skew must cross reconcile cadences
+#: (reconcile_every_epochs x dt = 2 s at defaults) so at least one
+#: round observes rank 1 behind — that round is the detection point
+DIVERGENT_N_RANKS = 2
+DIVERGENT_EPOCHS = 48
+DIVERGENT_DELAY_MS = 2500
+DIVERGENT_SLO = dict(max_rank_stall_rounds=1)
+
+
+def build_divergent_record(
+    scenario: str,
+    result,
+    timeline,
+    report,
+    rate: float,
+    platform: str,
+    guard: dict,
+    warm: dict,
+    rank_states,
+) -> dict:
+    """The ``--divergent`` JSON line (pure: schema-tested without
+    running the bench).  ``result`` is a DivergentResult; ``timeline``
+    the HealthTimeline whose rank hooks the run fed; ``rank_states``
+    the per-rank host state copies the panel rows come from; ``rate``
+    the measured reconcile rounds/s."""
+    from ceph_tpu.recovery import view_fingerprint
+
+    d2c = result.detection_to_convergence_rounds()
+    return {
+        "metric": "divergent_detect_to_converge_rounds",
+        "value": 0 if d2c is None else int(d2c),
+        "unit": "rounds",
+        "platform": platform,
+        "n_compiles": int(guard["n_compiles"]),
+        "n_compiles_first": int(warm["n_compiles"]),
+        "host_transfers": int(guard["host_transfers"]),
+        "divergent_scenario": scenario,
+        "divergent_n_ranks": int(len(rank_states)),
+        "divergent_n_epochs": int(result.total_steps),
+        "divergent_rounds": int(len(result.rounds)),
+        "divergent_converged": bool(result.converged),
+        "divergent_laggy_ranks": [int(r) for r in result.laggy],
+        "divergent_stalled": bool(result.laggy),
+        "divergent_round_rate_per_sec": round(rate, 3),
+        "divergent_retries_total": int(
+            sum(r.retries for r in result.rounds)
+        ),
+        "divergent_backoff_epochs_total": int(
+            sum(r.backoff_epochs for r in result.rounds)
+        ),
+        "divergent_rank_panel": [
+            {
+                "rank": r,
+                "step": int(result.rounds[-1].steps[r]),
+                "epoch": int(s.epoch),
+                "fingerprint": int(view_fingerprint(s)),
+            }
+            for r, s in enumerate(rank_states)
+        ],
+        "divergent_health_status": report.status,
+        "divergent_slo_checks": {
+            c.name: c.status for c in report.checks
+        },
+        "divergent_rank_series": timeline.rank_series(),
+    }
+
+
+def run_divergent(scenario: str) -> None:
+    """The ``--divergent`` bench: two skewed rank views through the
+    compiled superstep with reconciliation rounds between them.  One
+    JSON line."""
+    from ceph_tpu.common.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    import jax
+
+    from ceph_tpu import recovery as rec
+    from ceph_tpu.analysis.runtime_guard import track
+    from ceph_tpu.common.config import Config
+    from ceph_tpu.models.clusters import build_osdmap
+    from ceph_tpu.obs import HealthTimeline, SLOSpec, evaluate
+    from ceph_tpu.recovery.chaos import ChaosEvent
+    from ceph_tpu.recovery.failure import parse_spec
+
+    cfg = Config(env={})
+    m = build_osdmap(N_OSDS, pg_num=PG_NUM, size=K + M,
+                     pool_kind="erasure")
+    base = rec.build_scenario(scenario, m)
+    skew = parse_spec(f"rankdelay:1.{DIVERGENT_DELAY_MS}")
+    tl = rec.ChaosTimeline(
+        list(base.events()) + [ChaosEvent(0.05, (skew,))]
+    )
+    spec = SLOSpec(**DIVERGENT_SLO)
+    timeline = HealthTimeline(lambda: 0.0, k=K)
+    d = rec.DivergentDriver(
+        m, tl, DIVERGENT_N_RANKS, config=cfg, seed=6, health=timeline,
+    )
+    with track() as guard:
+        d.reference_state(1)  # warm the tape-as-argument scan
+        warm = guard.snapshot()
+        t0 = time.perf_counter()
+        res = d.run(DIVERGENT_EPOCHS)
+        t_run = time.perf_counter() - t0
+    rate = len(res.rounds) / t_run if t_run > 0 else 0.0
+    report = evaluate(timeline, spec)
+    rank_states = [jax.device_get(s) for s in d.states]
+    d2c = res.detection_to_convergence_rounds()
+    print(
+        f"divergent {scenario}: {DIVERGENT_N_RANKS} ranks x "
+        f"{res.total_steps} epochs, {len(res.rounds)} reconcile rounds "
+        f"({rate:.1f}/s); detection->convergence "
+        f"{'-' if d2c is None else d2c} rounds; "
+        f"{'converged' if res.converged else 'DIVERGED'}, "
+        f"laggy={list(res.laggy)}; SLO {report.status}",
+        file=sys.stderr,
+    )
+    print(json.dumps(build_divergent_record(
+        scenario, res, timeline, report, rate, jax.default_backend(),
+        guard.snapshot(), warm, rank_states,
+    )))
+
+
 def main() -> None:
     from ceph_tpu.common.compile_cache import enable_persistent_cache
 
@@ -950,5 +1083,10 @@ if __name__ == "__main__":
         if "--chaos" in sys.argv:
             scenario = sys.argv[sys.argv.index("--chaos") + 1]
         run_liveness(scenario)
+    elif "--divergent" in sys.argv:
+        scenario = "flap"
+        if "--chaos" in sys.argv:
+            scenario = sys.argv[sys.argv.index("--chaos") + 1]
+        run_divergent(scenario)
     else:
         main()

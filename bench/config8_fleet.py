@@ -63,6 +63,11 @@ EC_K, EC_M = 4, 2
 #: into the traffic step's recovery utilization share)
 DOWN_OUT_GRID = (30.0, 120.0, 600.0)
 RECOVERY_WGT_GRID = (1.0, 4.0)
+#: scrub-stagger periods swept as the third axis: 0 = all PGs scrub in
+#: one window (the thundering-herd default), nonzero spreads scrub
+#: windows across the period so steady-state traffic never collides
+#: with a full-cluster scrub burst
+SCRUB_STAGGER_GRID = (0.0, 8.0)
 
 
 def build_fleet_record(platform, fleet_rate, seq_cold_rate,
@@ -120,6 +125,9 @@ def build_fleet_record(platform, fleet_rate, seq_cold_rate,
             best["down_out_interval_s"]
         )
         rec["fleet_best_recovery_share"] = float(best["recovery_share"])
+        rec["fleet_best_scrub_stagger_period_s"] = float(
+            best["scrub_stagger_period_s"]
+        )
     return rec
 
 
@@ -255,52 +263,57 @@ def main() -> None:
         )))
         print(f"panel {sc}: done", file=sys.stderr)
 
-    # -- decide_defaults sweep: down-out interval x mclock share -------
+    # -- decide_defaults sweep: down-out interval x mclock share x
+    #    scrub stagger ------------------------------------------------
     sweep_grid, best = [], None
     if SWEEP:
         for interval in DOWN_OUT_GRID:
             for rec_w in RECOVERY_WGT_GRID:
-                cfg = Config(env={})
-                cfg.set("mon_osd_down_out_interval", interval)
-                cfg.set("osd_mclock_recovery_wgt", rec_w)
-                share = rec_w / (
-                    float(cfg.get("osd_mclock_client_wgt"))
-                    + rec_w
-                    + float(cfg.get("osd_mclock_scrub_wgt"))
-                )
-                sfd = FleetDriver(
-                    m, seed=SEED, n_ops=N_OPS, config=cfg,
-                    rho_recovery=share,
-                )
-                s_fs = sfd.run_fleet(
-                    SWEEP_EPOCHS, sfd.sample(SWEEP_FLEET, SCENARIO)
-                )
-                s_est = estimate_durability(
-                    s_fs, dt=sfd.driver.dt, scenario=SCENARIO,
-                    seed=SEED, n_boot=64, codec="reed-solomon",
-                    ec_k=EC_K, ec_m=EC_M, placement="crush",
-                    down_out_interval_s=interval,
-                )
-                point = {
-                    "down_out_interval_s": interval,
-                    "recovery_wgt": rec_w,
-                    "recovery_share": round(share, 6),
-                    "survival_fraction": round(
-                        s_est.survival_fraction, 9
-                    ),
-                    "availability_mean": round(
-                        s_est.availability_mean, 9
-                    ),
-                    "ttzd_mean_s": round(s_est.ttzd_mean_s, 6),
-                }
-                sweep_grid.append(point)
-                print(
-                    f"sweep down_out={interval:g}s share={share:.3f}: "
-                    f"survival={point['survival_fraction']:.3f} "
-                    f"avail={point['availability_mean']:.6f} "
-                    f"ttzd={point['ttzd_mean_s']:.2f}s",
-                    file=sys.stderr,
-                )
+                for stag in SCRUB_STAGGER_GRID:
+                    cfg = Config(env={})
+                    cfg.set("mon_osd_down_out_interval", interval)
+                    cfg.set("osd_mclock_recovery_wgt", rec_w)
+                    cfg.set("osd_scrub_stagger_period", stag)
+                    share = rec_w / (
+                        float(cfg.get("osd_mclock_client_wgt"))
+                        + rec_w
+                        + float(cfg.get("osd_mclock_scrub_wgt"))
+                    )
+                    sfd = FleetDriver(
+                        m, seed=SEED, n_ops=N_OPS, config=cfg,
+                        rho_recovery=share,
+                    )
+                    s_fs = sfd.run_fleet(
+                        SWEEP_EPOCHS, sfd.sample(SWEEP_FLEET, SCENARIO)
+                    )
+                    s_est = estimate_durability(
+                        s_fs, dt=sfd.driver.dt, scenario=SCENARIO,
+                        seed=SEED, n_boot=64, codec="reed-solomon",
+                        ec_k=EC_K, ec_m=EC_M, placement="crush",
+                        down_out_interval_s=interval,
+                    )
+                    point = {
+                        "down_out_interval_s": interval,
+                        "recovery_wgt": rec_w,
+                        "recovery_share": round(share, 6),
+                        "scrub_stagger_period_s": stag,
+                        "survival_fraction": round(
+                            s_est.survival_fraction, 9
+                        ),
+                        "availability_mean": round(
+                            s_est.availability_mean, 9
+                        ),
+                        "ttzd_mean_s": round(s_est.ttzd_mean_s, 6),
+                    }
+                    sweep_grid.append(point)
+                    print(
+                        f"sweep down_out={interval:g}s "
+                        f"share={share:.3f} stagger={stag:g}s: "
+                        f"survival={point['survival_fraction']:.3f} "
+                        f"avail={point['availability_mean']:.6f} "
+                        f"ttzd={point['ttzd_mean_s']:.2f}s",
+                        file=sys.stderr,
+                    )
         # best = survive first, then serve, then recover fast
         best = max(
             sweep_grid,

@@ -193,7 +193,8 @@ FLEET_FLOAT_FIELDS = ("fleet_epoch_rate_per_sec",
                       "fleet_aggregate_speedup",
                       "fleet_aggregate_speedup_warm",
                       "fleet_best_down_out_interval_s",
-                      "fleet_best_recovery_share")
+                      "fleet_best_recovery_share",
+                      "fleet_best_scrub_stagger_period_s")
 FLEET_BOOL_FIELDS = ("fleet_bitequal",
                      "fleet_same_bucket_zero_recompile",
                      "fleet_seq_includes_compile")
@@ -223,6 +224,19 @@ DURABILITY_FLOAT_FIELDS = ("durability_mission_s",
 DURABILITY_BOOL_FIELDS = ("durability_mttdl_censored",)
 DURABILITY_STR_FIELDS = ("durability_scenario", "durability_codec",
                          "durability_placement")
+
+# Divergent multi-rank fields (config6_recovery --divergent): per-rank
+# chaos views driven through reconcile rounds.  ``divergent_converged``
+# gates the headline (the merged views must land bit-identical within
+# the bounded retry budget) and ``divergent_stalled`` records whether
+# any rank was still laggy at the end — a stalled-but-converged
+# survivor quorum is degraded service, not a failure.
+DIVERGENT_INT_FIELDS = ("divergent_n_ranks", "divergent_n_epochs",
+                        "divergent_rounds", "divergent_retries_total",
+                        "divergent_backoff_epochs_total")
+DIVERGENT_FLOAT_FIELDS = ("divergent_round_rate_per_sec",)
+DIVERGENT_BOOL_FIELDS = ("divergent_converged", "divergent_stalled")
+DIVERGENT_STR_FIELDS = ("divergent_scenario", "divergent_health_status")
 
 
 def harvest_aux(paths: list[str]) -> dict[str, int]:
@@ -369,6 +383,18 @@ def harvest_guard(paths: list[str]) -> dict[str, dict]:
             )
             fields.update(
                 {f: str(d[f]) for f in DURABILITY_STR_FIELDS if f in d}
+            )
+            fields.update(
+                {f: int(d[f]) for f in DIVERGENT_INT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: float(d[f]) for f in DIVERGENT_FLOAT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: bool(d[f]) for f in DIVERGENT_BOOL_FIELDS if f in d}
+            )
+            fields.update(
+                {f: str(d[f]) for f in DIVERGENT_STR_FIELDS if f in d}
             )
             # jaxlint per-rule counters (lint_active, lint_J007_active,
             # ...): dynamic key set — one field per registered rule, so

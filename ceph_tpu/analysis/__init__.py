@@ -68,6 +68,7 @@ from .runtime_guard import (
     CompileCounter,
     GuardStats,
     RankDivergenceError,
+    RankStalledError,
     TransferCounter,
     assert_no_recompile,
     assert_rank_identical,
@@ -92,6 +93,7 @@ __all__ = [
     "CompileCounter",
     "GuardStats",
     "RankDivergenceError",
+    "RankStalledError",
     "TransferCounter",
     "assert_no_recompile",
     "assert_rank_identical",

@@ -231,6 +231,18 @@ class RankDivergenceError(AssertionError):
     """Ranks disagree on data that must be rank-identical."""
 
 
+class RankStalledError(RuntimeError):
+    """A rank stopped advancing and exhausted the reconcile retry
+    budget.
+
+    Raised by :class:`~ceph_tpu.recovery.reconcile.RankReconciler` on
+    *every* rank at the same round: the verdict is computed from an
+    all-gathered per-rank epoch vector, so each process evaluates the
+    identical condition and raises in lockstep instead of the live
+    ranks hanging inside the next collective waiting on the dead one.
+    """
+
+
 #: fingerprints are folded into this many bits so n * h^2 stays far
 #: inside int64 for any plausible device count
 _HASH_BITS = 20

@@ -19,7 +19,11 @@ Commands: ``status`` (default; the ``ceph -s`` shape), ``health``
 daemon registered a journal), ``fleet`` (the Monte Carlo durability
 panel from the latest ``config8_fleet`` bench record — per-scenario
 survival fraction, MTTDL confidence interval, worst-cluster health;
-reads bench logs only, never runs a demo).
+reads bench logs only, never runs a demo), ``ranks`` (the divergent
+multi-rank panel from the latest ``config6_recovery.py --divergent``
+bench record — detection-to-convergence latency, per-round
+convergence/laggy verdicts, per-rank final progress; bench logs only,
+like ``fleet``).
 """
 
 from __future__ import annotations
@@ -29,7 +33,7 @@ import json
 import sys
 
 COMMANDS = ("status", "health", "timeline", "journal", "caches",
-            "fleet")
+            "fleet", "ranks")
 
 #: CLI command -> admin-socket prefix (identity unless listed)
 _SOCKET_PREFIX = {"caches": "dump_placement_caches"}
@@ -82,13 +86,13 @@ def _render(cmd: str, reply: dict, as_json: bool, out) -> None:
             print(json.dumps(r, sort_keys=True), file=out)
 
 
-def load_fleet_record(paths=None) -> dict | None:
-    """Latest ``config8_fleet`` JSON line from the bench logs.
+def _load_bench_record(metric: str, paths=None) -> dict | None:
+    """Latest JSON line with the given ``metric`` from the bench logs.
 
     ``paths`` defaults to ``BENCH*.json`` in the working directory
-    (the run_all output files); within them, the last
-    ``fleet_epoch_rate_per_sec`` line wins — the same
-    latest-record-per-metric discipline ``decide_defaults`` uses.
+    (the run_all output files); within them, the last matching line
+    wins — the same latest-record-per-metric discipline
+    ``decide_defaults`` uses.
     """
     import glob
 
@@ -108,9 +112,20 @@ def load_fleet_record(paths=None) -> dict | None:
                 d = json.loads(line)
             except json.JSONDecodeError:
                 continue
-            if d.get("metric") == "fleet_epoch_rate_per_sec":
+            if d.get("metric") == metric:
                 rec = d
     return rec
+
+
+def load_fleet_record(paths=None) -> dict | None:
+    """Latest ``config8_fleet`` record (see :func:`_load_bench_record`)."""
+    return _load_bench_record("fleet_epoch_rate_per_sec", paths)
+
+
+def load_divergent_record(paths=None) -> dict | None:
+    """Latest ``config6_recovery.py --divergent`` record."""
+    return _load_bench_record("divergent_detect_to_converge_rounds",
+                              paths)
 
 
 def render_fleet(rec: dict, out) -> None:
@@ -148,6 +163,37 @@ def render_fleet(rec: dict, out) -> None:
             f"mttdl={row.get('mttdl_s', 0):.4g}s {ci}{cens} "
             f"worst=#{row.get('worst_cluster', 0)} "
             f"avail={row.get('worst_availability', 0):.6f}",
+            file=out,
+        )
+
+
+def render_ranks(rec: dict, out) -> None:
+    """Text panel for one divergent-rank record: detection-to-
+    convergence headline plus the per-rank final progress rows."""
+    stalled = rec.get("divergent_stalled")
+    print(
+        f"ranks: {rec.get('divergent_n_ranks', '?')} rank views x "
+        f"{rec.get('divergent_n_epochs', '?')} epochs "
+        f"({rec.get('divergent_scenario', '?')}) on "
+        f"{rec.get('platform', '?')}: detection->convergence "
+        f"{rec.get('value', 0):g} rounds over "
+        f"{rec.get('divergent_rounds', '?')} total, "
+        f"converged={'yes' if rec.get('divergent_converged') else 'NO'}"
+        + (", RANK STALLED" if stalled else ""),
+        file=out,
+    )
+    if rec.get("divergent_retries_total") is not None:
+        print(
+            f"  retries={rec['divergent_retries_total']} "
+            f"backoff_epochs={rec.get('divergent_backoff_epochs_total', 0)} "
+            f"laggy={rec.get('divergent_laggy_ranks', [])}",
+            file=out,
+        )
+    for row in rec.get("divergent_rank_panel") or []:
+        print(
+            f"  rank {row.get('rank', '?')}: "
+            f"step={row.get('step', 0)} epoch={row.get('epoch', 0)} "
+            f"fingerprint={row.get('fingerprint', 0):#x}",
             file=out,
         )
 
@@ -391,6 +437,22 @@ def main(argv=None) -> int:
             print(json.dumps(rec, sort_keys=True), file=out)
         else:
             render_fleet(rec, out)
+        return 0
+
+    if args.command == "ranks":
+        rec = load_divergent_record(args.bench_log)
+        if rec is None:
+            print(
+                "status: no divergent record found (run "
+                "bench/config6_recovery.py --divergent or pass "
+                "--bench-log)",
+                file=sys.stderr,
+            )
+            return 1
+        if args.as_json:
+            print(json.dumps(rec, sort_keys=True), file=out)
+        else:
+            render_ranks(rec, out)
         return 0
 
     if args.socket is not None:

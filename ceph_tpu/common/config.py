@@ -223,6 +223,23 @@ SCHEMA: list[Option] = [
     Option("mon_osd_grace_doublings_max", OPT_FLOAT, 5.0, LEVEL_ADVANCED,
            "cap on markdown-log grace doublings (effective grace <= "
            "grace * 2^cap)", min=0.0),
+    Option("reconcile_every_epochs", OPT_INT, 8, LEVEL_ADVANCED,
+           "epochs each divergent rank advances its own device-resident "
+           "view between collective reconciliation rounds; smaller "
+           "values converge skewed observations faster at the cost of "
+           "more collective launches per simulated second "
+           "(bench/PERF_MODEL.md itemizes the trade)", min=1,
+           see_also=("reconcile_deadline_epochs", "debug_rank_checks")),
+    Option("reconcile_deadline_epochs", OPT_INT, 3, LEVEL_ADVANCED,
+           "consecutive reconciliation rounds a rank's contributed "
+           "epoch may sit still before the rank is marked laggy and "
+           "the survivors proceed on its last-merged view; once laggy, "
+           "recovery_retry_max further stalled rounds (with seeded "
+           "exponential backoff per recovery_backoff_base_ms) raise "
+           "RankStalledError on every rank instead of a collective "
+           "hang", min=1,
+           see_also=("reconcile_every_epochs", "recovery_retry_max",
+                     "recovery_backoff_base_ms")),
     Option("osd_scrub_stagger_period", OPT_FLOAT, 0.0, LEVEL_ADVANCED,
            "deep-scrub stagger period (seconds): each PG scrubs in a "
            "hashed phase window inside the period so pool-wide scrub "

@@ -229,6 +229,78 @@ class ClusterState:
 
 
 # ---------------------------------------------------------------------------
+# view deltas: what one resident view advanced past another
+
+
+@dataclass(frozen=True)
+class ViewDelta:
+    """Host-side summary of what separates two views of the SAME
+    geometry — the audit record the reconcile layer journals when a
+    stalled rank replays its missed window (the "delta tape": the
+    step/tape-cursor span below, driven back through the same
+    deterministic scan).  This is a *description* of the delta, not a
+    patch: replaying the missed steps reproduces the target view
+    bit-exactly, so no state injection is ever applied."""
+
+    epoch_from: int
+    epoch_to: int
+    step_from: int
+    step_to: int
+    tape_cursor_from: int
+    tape_cursor_to: int
+    n_up_changed: int      # osd_up lanes that differ
+    n_down_changed: int    # detector down bits that differ
+    n_out_changed: int     # out bookkeeping bits that differ
+    n_pgs_remapped: int    # PGs whose acting set differs
+
+    @property
+    def n_steps(self) -> int:
+        return self.step_to - self.step_from
+
+    @property
+    def n_tape_rows(self) -> int:
+        return self.tape_cursor_to - self.tape_cursor_from
+
+    def to_json(self) -> dict:
+        return {
+            "epoch_from": self.epoch_from, "epoch_to": self.epoch_to,
+            "step_from": self.step_from, "step_to": self.step_to,
+            "tape_rows": self.n_tape_rows, "n_steps": self.n_steps,
+            "n_up_changed": self.n_up_changed,
+            "n_down_changed": self.n_down_changed,
+            "n_out_changed": self.n_out_changed,
+            "n_pgs_remapped": self.n_pgs_remapped,
+        }
+
+
+def view_delta(old: ClusterState, new: ClusterState) -> ViewDelta:
+    """Diff two same-geometry views into a :class:`ViewDelta` (one
+    host pull per view; a between-rounds seam, never in-scan)."""
+    o, n = jax.device_get((old, new))
+    if o.up.shape != n.up.shape or o.down.shape != n.down.shape:
+        raise ValueError(
+            f"view geometries differ: up {o.up.shape} vs {n.up.shape}, "
+            f"down {o.down.shape} vs {n.down.shape}"
+        )
+    return ViewDelta(
+        epoch_from=int(o.epoch), epoch_to=int(n.epoch),
+        step_from=int(o.step), step_to=int(n.step),
+        tape_cursor_from=int(o.tape_cursor),
+        tape_cursor_to=int(n.tape_cursor),
+        n_up_changed=int(
+            np.sum(np.asarray(o.pool.osd_up) != np.asarray(n.pool.osd_up))
+        ),
+        n_down_changed=int(
+            np.sum(np.asarray(o.down) != np.asarray(n.down))
+        ),
+        n_out_changed=int(np.sum(np.asarray(o.out) != np.asarray(n.out))),
+        n_pgs_remapped=int(np.sum(
+            np.any(np.asarray(o.acting) != np.asarray(n.acting), axis=-1)
+        )),
+    )
+
+
+# ---------------------------------------------------------------------------
 # compiled O(delta) incremental application
 
 

@@ -14,12 +14,20 @@ spans share names.
 
 Records are kept in memory and, when ``path`` is given, appended as
 JSON lines — readable back with :meth:`EventJournal.read` for
-round-trip tests and the ``cli.status`` timeline view.
+round-trip tests and the ``cli.status`` timeline view.  Long soaks
+(fleet sweeps, divergent-rank chaos) can cap the on-disk footprint
+with ``max_bytes``: when the live file would exceed it, the journal
+rotates — ``path`` is renamed to ``path.1`` (older segments shifting
+to ``path.2``, ...), the newest ``max_segments - 1`` rotated segments
+are kept, and writing continues on a fresh ``path``.  Each segment is
+independently crash-tolerant (same torn-tail rule), and
+:meth:`EventJournal.read_rotated` stitches oldest-to-newest.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 from contextlib import contextmanager
 from typing import Callable
@@ -33,6 +41,9 @@ class EventJournal:
     ``clock`` is the virtual clock read (``() -> float``); ``trace_id``
     is injectable so seeded runs journal deterministically (default
     derives from the wall clock).  ``wall`` is injectable for tests.
+    ``max_bytes`` (0 = unbounded) caps the live file: crossing it
+    rotates keep-last-``max_segments`` style.  In-memory ``records``
+    are never rotated — the cap bounds disk, not correlation.
     """
 
     def __init__(
@@ -41,15 +52,29 @@ class EventJournal:
         clock: Callable[[], float] | None = None,
         trace_id: str | None = None,
         wall: Callable[[], float] = time.time,
+        max_bytes: int = 0,
+        max_segments: int = 4,
     ):
         self.path = str(path) if path is not None else None
         self.clock = clock or (lambda: 0.0)
         self.wall = wall
         self.trace_id = trace_id or f"{int(wall() * 1e6):x}"
+        self.max_bytes = int(max_bytes)
+        self.max_segments = int(max_segments)
+        if self.max_bytes < 0:
+            raise ValueError(f"max_bytes must be >= 0, got {max_bytes}")
+        if self.max_segments < 1:
+            raise ValueError(
+                f"max_segments must be >= 1, got {max_segments}"
+            )
         self.records: list[dict] = []
         self._next_span = 0
         self._open: list[int] = []  # span-id stack for parent linkage
         self._fh = open(self.path, "a") if self.path else None
+        self._size = (
+            os.path.getsize(self.path)
+            if self.path and os.path.exists(self.path) else 0
+        )
 
     def close(self) -> None:
         if self._fh is not None:
@@ -67,9 +92,37 @@ class EventJournal:
     def _emit(self, record: dict) -> dict:
         self.records.append(record)
         if self._fh is not None:
-            self._fh.write(json.dumps(record, sort_keys=True) + "\n")
+            line = json.dumps(record, sort_keys=True) + "\n"
+            if (
+                self.max_bytes
+                and self._size
+                and self._size + len(line) > self.max_bytes
+            ):
+                self._rotate()
+            self._fh.write(line)
             self._fh.flush()
+            self._size += len(line)
         return record
+
+    def _rotate(self) -> None:
+        """Shift ``path`` -> ``path.1`` -> ``path.2`` ... keeping the
+        newest ``max_segments - 1`` rotated segments, then reopen a
+        fresh live file.  Rename-based, so a crash mid-rotation never
+        tears a record — only whole segments move."""
+        self._fh.close()
+        oldest = self.path + f".{self.max_segments - 1}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for i in range(self.max_segments - 2, 0, -1):
+            src = self.path + f".{i}"
+            if os.path.exists(src):
+                os.replace(src, self.path + f".{i + 1}")
+        if self.max_segments > 1:
+            os.replace(self.path, self.path + ".1")
+        else:
+            os.remove(self.path)
+        self._fh = open(self.path, "a")
+        self._size = 0
 
     def _record(self, kind: str, name: str, **attrs) -> dict:
         span_id = self._next_span
@@ -141,4 +194,23 @@ class EventJournal:
                     "followed by valid records (not a torn tail)"
                 )
             out.append(record)
+        return out
+
+    @staticmethod
+    def read_rotated(path: str) -> list[dict]:
+        """Records across every surviving segment, oldest first:
+        ``path.<N>`` ... ``path.1`` then the live ``path``.  Each
+        segment keeps its own torn-tail tolerance — rotation moves
+        whole files, so only a segment's final line can ever be
+        torn."""
+        segs = []
+        i = 1
+        while os.path.exists(f"{path}.{i}"):
+            segs.append(f"{path}.{i}")
+            i += 1
+        out: list[dict] = []
+        for seg in reversed(segs):
+            out.extend(EventJournal.read(seg))
+        if os.path.exists(path):
+            out.extend(EventJournal.read(path))
         return out

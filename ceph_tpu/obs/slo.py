@@ -59,6 +59,11 @@ class SLOSpec:
       an OSD going silent and the failure detector marking it down
       (``SLO_DETECTION_LATENCY``, the ``osd_heartbeat_grace`` +
       reporter-quorum delay an operator actually waits through).
+    - ``max_rank_stall_rounds`` — ceiling on the consecutive
+      reconcile rounds any simulation rank may sit without progress
+      before the divergent-rank run counts as degraded
+      (``SLO_RANK_STALL``, the ``MON_DOWN`` analog: the cluster kept
+      serving, but on a shrunken quorum).
     """
 
     max_inactive_seconds: float | None = None
@@ -70,6 +75,7 @@ class SLOSpec:
     max_inconsistent_seconds: float | None = None
     max_scrub_age_s: float | None = None
     max_detection_latency_s: float | None = None
+    max_rank_stall_rounds: int | None = None
     warn_fraction: float = 0.8
 
     def sample_status(self, sample: HealthSample) -> str:
@@ -293,5 +299,20 @@ def evaluate(timeline: HealthTimeline, spec: SLOSpec) -> HealthReport:
         report._add(HealthCheck(
             "SLO_DETECTION_LATENCY", status, detail,
             observed, spec.max_detection_latency_s,
+        ))
+    if spec.max_rank_stall_rounds is not None:
+        observed = float(timeline.max_rank_stall_rounds())
+        budget = float(spec.max_rank_stall_rounds)
+        if not timeline.rank_rounds and not timeline.rank_stalls:
+            status, detail = HEALTH_OK, "no divergent-rank run to grade"
+        else:
+            status = _grade_max(observed, budget, spec.warn_fraction)
+            detail = (
+                f"worst rank stall {observed:g} consecutive reconcile "
+                f"rounds over {len(timeline.rank_rounds)} rounds "
+                f"(budget {budget:g})"
+            )
+        report._add(HealthCheck(
+            "SLO_RANK_STALL", status, detail, observed, budget,
         ))
     return report

@@ -126,6 +126,12 @@ class HealthTimeline:
         # failure-to-mark-down latencies (note_detection); the
         # SLO_DETECTION_LATENCY budget grades the worst one
         self.detection_latencies: list[float] = []
+        # divergent-rank reconciliation series (note_rank_round):
+        # per-round (n_live, n_laggy, diverged) triples, and the worst
+        # consecutive-stall count per rank (note_rank_stall); the
+        # SLO_RANK_STALL budget grades the latter
+        self.rank_rounds: list[tuple[int, int, bool]] = []
+        self.rank_stalls: dict[int, int] = {}
         self._classifier = PGStateClassifier(mesh)
 
     def __len__(self) -> int:
@@ -215,6 +221,9 @@ class HealthTimeline:
         if any(s.osds_down or s.osds_laggy for s in self.samples):
             cols["osds_down"] = [s.osds_down for s in self.samples]
             cols["osds_laggy"] = [s.osds_laggy for s in self.samples]
+        # reconcile-round columns ride along (their own cadence: one
+        # entry per round, not per sample)
+        cols.update(self.rank_series())
         if any(s.traffic is not None for s in self.samples):
             def _tcol(fn):
                 return [
@@ -272,6 +281,38 @@ class HealthTimeline:
         """Record one failure-detection latency (virtual seconds from
         heartbeat silence to the detector marking the OSD down)."""
         self.detection_latencies.append(float(latency_s))
+
+    def note_rank_round(
+        self, *, n_live: int, laggy: int, diverged: bool
+    ) -> None:
+        """Record one divergent-rank reconciliation round's verdict
+        (:class:`ceph_tpu.recovery.reconcile.ReconcileProtocol` calls
+        this after every round)."""
+        self.rank_rounds.append((int(n_live), int(laggy), bool(diverged)))
+
+    def note_rank_stall(self, rank: int, rounds: int) -> None:
+        """Record a rank crossing the laggy deadline after ``rounds``
+        consecutive no-progress reconcile rounds (worst count kept)."""
+        rank = int(rank)
+        self.rank_stalls[rank] = max(
+            self.rank_stalls.get(rank, 0), int(rounds)
+        )
+
+    def max_rank_stall_rounds(self) -> int:
+        """The worst consecutive-stall count any rank reached (0 when
+        no rank ever went laggy) — the SLO_RANK_STALL budget's input."""
+        return max(self.rank_stalls.values(), default=0)
+
+    def rank_series(self) -> dict:
+        """Column-oriented reconcile-round series (one entry per
+        round), empty dict when no divergent run rode this timeline."""
+        if not self.rank_rounds:
+            return {}
+        return {
+            "rank_n_live": [r[0] for r in self.rank_rounds],
+            "rank_n_laggy": [r[1] for r in self.rank_rounds],
+            "rank_diverged": [r[2] for r in self.rank_rounds],
+        }
 
     def max_detection_latency(self) -> float:
         """The worst failure-to-mark-down latency of the run (0 when

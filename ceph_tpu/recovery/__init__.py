@@ -38,12 +38,19 @@ The subsystem that closes the loop the standalone workloads left open
 - :mod:`~ceph_tpu.recovery.durability` — device-side Monte Carlo
   reduction of fleet outcomes into MTTDL / availability /
   time-to-zero-degraded estimates with seeded bootstrap CIs.
+- :mod:`~ceph_tpu.recovery.reconcile` — divergent multi-rank chaos:
+  per-rank skewed views (``rankdelay``/``rankdrop``/``rankstall``
+  specs), lattice-join reconciliation through collectives, and
+  stall-tolerant degradation (laggy marking, seeded virtual-time
+  backoff, :class:`~ceph_tpu.analysis.runtime_guard.RankStalledError`
+  on every rank instead of a collective hang).
 """
 
 from .chaos import (
     SCENARIOS,
     AppliedCorruption,
     AppliedEvent,
+    AppliedRankSpec,
     ChaosEngine,
     ChaosEvent,
     ChaosTimeline,
@@ -55,11 +62,14 @@ from .failure import (
     KNOWN_SCOPES,
     NET_ACTIONS,
     NET_SCOPES,
+    RANK_ACTIONS,
+    RANK_SCOPES,
     BitrotEvent,
     FailureSpec,
     FlapRecord,
     UnknownSpecKeyError,
     build_incremental,
+    check_rank,
     flap,
     inject,
     normalize,
@@ -136,6 +146,22 @@ from .fleet import (
     stack_tapes,
 )
 from .durability import DurabilityEstimate, estimate_durability
+from .reconcile import (
+    DivergentDriver,
+    DivergentResult,
+    RankReconciler,
+    RankSchedule,
+    RankStalledError,
+    RoundResult,
+    ViewMerger,
+    merge_stacked,
+    merge_views,
+    normalize_view,
+    rank_schedule,
+    rank_view_timeline,
+    strip_rank_specs,
+    view_fingerprint,
+)
 
 __all__ = [
     "ACTIONS",
@@ -218,4 +244,22 @@ __all__ = [
     "stack_tapes",
     "DurabilityEstimate",
     "estimate_durability",
+    "AppliedRankSpec",
+    "RANK_ACTIONS",
+    "RANK_SCOPES",
+    "check_rank",
+    "DivergentDriver",
+    "DivergentResult",
+    "RankReconciler",
+    "RankSchedule",
+    "RankStalledError",
+    "RoundResult",
+    "ViewMerger",
+    "merge_stacked",
+    "merge_views",
+    "normalize_view",
+    "rank_schedule",
+    "rank_view_timeline",
+    "strip_rank_specs",
+    "view_fingerprint",
 ]

@@ -327,6 +327,23 @@ class AppliedCorruption:
     event: BitrotEvent
 
 
+@dataclass
+class AppliedRankSpec:
+    """Audit-trail entry for one rank-scoped spec the engine saw.
+
+    Rank specs never mutate the map or the detector — they direct how
+    *one simulation rank observes* the shared timeline, and the actual
+    skew/stall/drop is enacted by
+    :mod:`ceph_tpu.recovery.reconcile` (``rank_view_timeline`` /
+    ``RankReconciler``).  The engine only journals and records them so
+    a single-process replay of a divergent scenario still leaves an
+    audit trail."""
+
+    t: float
+    epoch: int
+    spec: FailureSpec
+
+
 class ChaosEngine:
     """Owns the live map, the timeline, and the virtual clock.
 
@@ -364,6 +381,7 @@ class ChaosEngine:
         )
         self.applied: list[AppliedEvent] = []
         self.corruptions: list[AppliedCorruption] = []
+        self.rank_applied: list[AppliedRankSpec] = []
 
     @property
     def epoch(self) -> int:
@@ -386,8 +404,10 @@ class ChaosEngine:
         for ev in self.timeline.due(self.clock.now()):
             rot = [s for s in ev.specs if s.is_bitrot]
             net = [s for s in ev.specs if s.is_net]
+            rank = [s for s in ev.specs if s.is_rank]
             fail = tuple(
-                s for s in ev.specs if not s.is_bitrot and not s.is_net
+                s for s in ev.specs
+                if not s.is_bitrot and not s.is_net and not s.is_rank
             )
             if fail:
                 inc = inject(self.osdmap, list(fail))
@@ -406,6 +426,19 @@ class ChaosEngine:
                 if self.journal is not None:
                     self.journal.event(
                         "chaos.net",
+                        epoch=self.osdmap.epoch,
+                        sched_t=ev.t,
+                        spec=str(spec),
+                    )
+            for spec in rank:
+                # no map/detector effect — reconcile.py enacts the
+                # skew; this is the audit trail for replay tooling
+                self.rank_applied.append(
+                    AppliedRankSpec(ev.t, self.osdmap.epoch, spec)
+                )
+                if self.journal is not None:
+                    self.journal.event(
+                        "chaos.rank",
                         epoch=self.osdmap.epoch,
                         sched_t=ev.t,
                         spec=str(spec),

@@ -46,16 +46,39 @@ NET_SCOPES = ("netsplit", "slow")
 # ``restore`` ends it.
 NET_ACTIONS = ("drop", "restore")
 
+# Rank-scoped chaos: not a map edit and not even a *cluster* condition
+# — these shape how one simulation rank OBSERVES the shared timeline
+# (:mod:`ceph_tpu.recovery.reconcile`).  ``rankdelay:R.MS`` delays when
+# rank R sees every subsequent event by MS milliseconds;
+# ``rankdrop:R`` suppresses rank R's heartbeat reports entirely (its
+# down-evidence stops counting toward reporter quorums at merge);
+# ``rankstall:R.E`` freezes rank R's superstep for E epochs (E=0 =
+# permanently — the RankStalledError acceptance path).
+RANK_SCOPES = ("rankdelay", "rankdrop", "rankstall")
+
+# Allowed actions per rank scope (first entry is the default): skew /
+# drop|restore / stall.
+RANK_ACTIONS = {
+    "rankdelay": ("skew",),
+    "rankdrop": ("drop", "restore"),
+    "rankstall": ("stall",),
+}
+
+# How many dot-separated non-negative integers each rank scope's
+# target carries (rank[, milliseconds | epochs]).
+_RANK_TARGET_ARITY = {"rankdelay": 2, "rankdrop": 1, "rankstall": 2}
+
 # The scopes a spec may name: ``osd`` plus the reference's stock CRUSH
 # bucket types (``src/crush/CrushWrapper.cc`` default type set), plus
 # ``bitrot`` — silent shard corruption, which is not a map edit at all
 # (see :class:`BitrotEvent`) — plus the :data:`NET_SCOPES` heartbeat
-# conditions.  Maps with exotic custom type names can pass ``scopes=``
-# to parse_spec.
+# conditions and the :data:`RANK_SCOPES` observation-skew conditions.
+# Maps with exotic custom type names can pass ``scopes=`` to
+# parse_spec.
 KNOWN_SCOPES = (
     "osd", "host", "chassis", "rack", "row", "pdu", "pod", "room",
     "datacenter", "dc", "zone", "region", "root", "bitrot",
-) + NET_SCOPES
+) + NET_SCOPES + RANK_SCOPES
 
 # The keys a dict-form spec may carry (the JSON timeline surface).
 SPEC_KEYS = ("scope", "target", "action")
@@ -64,7 +87,9 @@ SPEC_KEYS = ("scope", "target", "action")
 class UnknownSpecKeyError(ValueError):
     """A dict-form failure spec carried a key outside
     :data:`SPEC_KEYS` — rejected loudly (a typo like ``"scop"`` must
-    not silently produce a default event)."""
+    not silently produce a default event).  Rank-scoped specs raise it
+    for malformed targets too (negative/zero delay, non-integer or
+    out-of-range rank): the same loud surface, the same reason."""
 
 
 @dataclass(frozen=True)
@@ -128,11 +153,73 @@ class FailureSpec:
         to the liveness detector, never to build_incremental."""
         return self.scope in NET_SCOPES
 
+    @property
+    def is_rank(self) -> bool:
+        """Rank-observation spec (rankdelay/rankdrop/rankstall): no
+        map edit and no cluster condition at all — routed to
+        :mod:`ceph_tpu.recovery.reconcile`, never to
+        build_incremental or the event tape."""
+        return self.scope in RANK_SCOPES
+
     def bitrot(self) -> BitrotEvent:
         """Decode a ``bitrot`` spec's target (raises for map scopes)."""
         if not self.is_bitrot:
             raise ValueError(f"{self} is not a bitrot spec")
         return BitrotEvent.from_target(self.target)
+
+    def rank(self) -> int:
+        """The simulation rank a rank-scoped spec targets (raises for
+        every other scope)."""
+        if not self.is_rank:
+            raise ValueError(f"{self} is not a rank-scoped spec")
+        return int(self.target.split(".")[0])
+
+    def rank_arg(self) -> int:
+        """The second target component of a rank-scoped spec: the
+        delay in milliseconds (``rankdelay``) or the stall length in
+        epochs (``rankstall``, 0 = permanent)."""
+        parts = self.target.split(".")
+        if not self.is_rank or len(parts) != 2:
+            raise ValueError(f"{self} carries no rank argument")
+        return int(parts[1])
+
+
+def _parse_rank_target(scope: str, target: str) -> str:
+    """Validate + canonicalize a rank-scoped target (loudly: the same
+    surface as dict-key typos).  Returns the canonical dotted form
+    with no leading zeros."""
+    want = _RANK_TARGET_ARITY[scope]
+    shape = {
+        "rankdelay": "RANK.DELAY_MS", "rankdrop": "RANK",
+        "rankstall": "RANK.EPOCHS",
+    }[scope]
+    parts = target.split(".")
+    if len(parts) != want or not all(p.isdigit() for p in parts):
+        raise UnknownSpecKeyError(
+            f"bad {scope} target {target!r} (want {shape}, "
+            f"{want} non-negative integer(s) — a negative rank, delay, "
+            "or epoch count is invalid)"
+        )
+    vals = [int(p) for p in parts]
+    if scope == "rankdelay" and vals[1] == 0:
+        raise UnknownSpecKeyError(
+            f"rankdelay of 0 ms in {target!r} is a no-op; schedule a "
+            "positive delay or drop the spec"
+        )
+    return ".".join(str(v) for v in vals)
+
+
+def check_rank(spec: FailureSpec, n_ranks: int) -> int:
+    """Range-check a rank-scoped spec against the process count it
+    will run under (the consumer-side twin of
+    :meth:`LivenessDetector.apply`'s OSD range check).  Returns the
+    rank."""
+    r = spec.rank()
+    if not 0 <= r < n_ranks:
+        raise UnknownSpecKeyError(
+            f"{spec}: rank {r} outside [0, {n_ranks})"
+        )
+    return r
 
 
 def parse_spec(text, scopes: tuple[str, ...] = KNOWN_SCOPES) -> FailureSpec:
@@ -173,6 +260,8 @@ def parse_spec(text, scopes: tuple[str, ...] = KNOWN_SCOPES) -> FailureSpec:
             action = BITROT_ACTION
         elif scope in NET_SCOPES:
             action = "drop"
+        elif scope in RANK_SCOPES:
+            action = RANK_ACTIONS[scope][0]
         else:
             action = "down"
     elif len(parts) == 3:
@@ -212,6 +301,13 @@ def parse_spec(text, scopes: tuple[str, ...] = KNOWN_SCOPES) -> FailureSpec:
                 f"got {action!r}"
             )
         return FailureSpec(scope, str(int(target)), action)
+    if scope in RANK_SCOPES:
+        if action not in RANK_ACTIONS[scope]:
+            raise ValueError(
+                f"{scope} specs only support actions "
+                f"{RANK_ACTIONS[scope]}, got {action!r}"
+            )
+        return FailureSpec(scope, _parse_rank_target(scope, target), action)
     if action not in ACTIONS:
         raise ValueError(f"bad action {action!r}; one of {ACTIONS}")
     return FailureSpec(scope, target, action)
@@ -250,6 +346,10 @@ def resolve_targets(m: OSDMap, spec: FailureSpec) -> list[int]:
     prefixed: ``rack:0`` -> ``rack0``) and collect its subtree."""
     if spec.is_bitrot:
         raise ValueError(f"{spec} targets shard bytes, not OSDs")
+    if spec.is_rank:
+        raise ValueError(
+            f"{spec} targets a simulation rank's observations, not OSDs"
+        )
     if spec.is_net:
         return [int(spec.target)]
     if spec.scope == "osd":
@@ -299,6 +399,13 @@ def build_incremental(m: OSDMap, specs) -> Incremental:
                 f"{spec} suppresses heartbeats, it is not a map edit; "
                 "route it through ChaosEngine's LivenessDetector — the "
                 "map changes only when detection fires"
+            )
+        if spec.is_rank:
+            raise ValueError(
+                f"{spec} skews one rank's observations, it is not a "
+                "map edit; route it through "
+                "ceph_tpu.recovery.reconcile (rank_view_timeline / "
+                "DivergentDriver)"
             )
         for osd in resolve_targets(m, spec):
             if spec.action in ("down", "down_out") and m.is_up(osd):

@@ -31,9 +31,10 @@ that gap (the reference's ``OSDMonitor`` heartbeat path: grace,
   marked down.
 
 :class:`ClusterFlags` is the tiny authoritative flag set
-(``noout``/``norecover``/``nobackfill``/``norebalance``/``pause``)
-that the executor and the traffic engine consult for graceful
-degradation.
+(``noout``/``norecover``/``nobackfill``/``norebalance``/``pause``,
+plus ``rankstalled`` raised by the reconcile layer when a simulation
+rank stops contributing) that the executor and the traffic engine
+consult for graceful degradation.
 """
 
 from __future__ import annotations
@@ -50,7 +51,8 @@ from .failure import FailureSpec
 I32 = jnp.int32
 F32 = jnp.float32
 
-KNOWN_FLAGS = ("noout", "norecover", "nobackfill", "norebalance", "pause")
+KNOWN_FLAGS = ("noout", "norecover", "nobackfill", "norebalance", "pause",
+               "rankstalled")
 
 #: laggy score above this counts the OSD in ``osds_laggy``
 LAGGY_THRESHOLD = 0.5

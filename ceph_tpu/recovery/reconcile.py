@@ -1,0 +1,1257 @@
+"""Divergent multi-rank chaos: per-rank views merged through collectives.
+
+Every multihost path so far replayed one identical timeline on every
+rank, so the PR-10 rank-divergence sanitizer guarded a failure mode the
+simulator never produced.  Real clusters are not so polite: monitors
+and OSDs *observe* the same failure at different times and converge
+through epoch-ordered map exchange.  This module closes that gap:
+
+- **Rank-scoped chaos specs** (parsed by :mod:`.failure`):
+  ``rankdelay:<rank>.<ms>`` delays when one simulation rank *sees*
+  every event from the spec's schedule time on; ``rankdrop:<rank>``
+  suppresses that rank's failure reports at the merge (its quorum
+  evidence stops counting); ``rankstall:<rank>.<epochs>`` freezes the
+  rank's superstep for a window of global epochs.
+- **Per-rank views**: each rank advances its OWN device-resident
+  :class:`~ceph_tpu.core.cluster_state.ClusterState` through the PR-12
+  compiled superstep, driven by its own *skewed* event tape
+  (:func:`rank_view_timeline`).  Local scans are never touched by
+  reconciliation — adoption of merged state would desync the rank's
+  ``tape_cursor`` from its own tape and double-apply epoch bumps, so
+  the merged view is a separate *consensus output*, identical on every
+  rank by construction.
+- **Reconciliation rounds**: every ``reconcile_every_epochs`` epochs
+  the views merge through element-wise lattice joins with proven
+  algebra (commutative, associative, idempotent on the normalized
+  domain — soaked in ``tests/fuzz_reconcile.py``): epoch/last-ack/
+  laggy lanes take ``max``; down bits merge under the reporter-quorum
+  rules of :mod:`.liveness` (gated by ``mon_osd_min_down_reporters``,
+  then OR — the consensus is deliberately pessimistic: a quorum-backed
+  down report survives until every contributor has observed the
+  recovery); ``down_since`` takes the earliest quorum-backed stamp;
+  map-owned lanes (pool tables, peering outputs, PG histograms) adopt
+  the highest-epoch owner, ties resolved by element-wise ``max`` (a
+  symmetric choice, so the join stays commutative).  In-process fleets
+  merge with ONE jitted program over stacked views
+  (:func:`merge_stacked`); real multihost merges run as one jitted
+  ``shard_map`` launch (:class:`ViewMerger`) whose joins are
+  ``lax.pmax``/``lax.pmin`` — duplication-insensitive, so each
+  process's local devices may all carry a copy of its view.
+- **Failure path** (the point of the exercise): a round that detects
+  divergence — live ranks at the same step and epoch with different
+  view fingerprints — retries with bounded seeded exponential backoff,
+  reusing the PR-3 knobs (``recovery_retry_max``,
+  ``recovery_backoff_base_ms``); backoff "sleeps" are *virtual*:
+  the live ranks advance ``ceil(backoff/dt)`` extra epochs, which
+  both drains in-flight observation skew and keeps wall clocks out of
+  the VirtualClock domain.  A rank whose step counter sits still for
+  ``reconcile_deadline_epochs`` consecutive rounds is marked **laggy**
+  — the ``rankstalled`` cluster flag is raised, the health timeline
+  records the stall, and the survivors proceed on its last-merged
+  view.  A revived rank catches up by replaying its OWN missed window
+  (its "delta tape": the step/tape span in the journaled
+  :class:`~ceph_tpu.core.cluster_state.ViewDelta`) through the same
+  deterministic scan — bit-exact, no state injection.  A rank still
+  frozen after ``recovery_retry_max`` further backoff rounds raises
+  :class:`RankStalledError` on EVERY rank at the same round: the
+  verdict comes from an all-gathered per-rank progress vector, so each
+  process evaluates the identical condition and raises in lockstep
+  instead of the survivors hanging inside the next collective.
+
+Convergence semantics: a ``rankdelay`` smaller than the epoch ``dt``
+that keeps an event inside its original epoch window yields views
+bit-identical to the unskewed reference at every epoch boundary (tape
+stamps are epoch-quantized).  Skew that crosses an epoch boundary
+converges rank-identically through the lattice join, but time-stamped
+observation lanes (``last_ack``, ``down_since``) may keep the latest
+observer's stamp — which is why the round fingerprint
+(:func:`view_fingerprint`) covers the epoch-versioned lanes only.
+
+Under ``debug_rank_checks``, :func:`assert_rank_identical` gates every
+multihost round's merged output, turning any host-side bookkeeping bug
+into a synchronized :class:`RankDivergenceError` instead of a hang.
+"""
+
+from __future__ import annotations
+
+import math
+import sys
+from dataclasses import dataclass, replace
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..analysis.runtime_guard import (
+    RankDivergenceError,
+    RankStalledError,
+    assert_rank_identical,
+    rank_checks_enabled,
+    rank_fingerprint,
+)
+from ..common.config import global_config
+from ..core.cluster_state import ClusterState, stack_states, view_delta
+from ..osdmap.map import OSDMap
+from .chaos import ChaosEvent, ChaosTimeline
+from .failure import FailureSpec, check_rank
+from .fleet import _pad_tape_arrays
+from .liveness import ClusterFlags
+from .superstep import EpochDriver, compile_event_tape
+
+I32 = jnp.int32
+
+__all__ = [
+    "DivergentDriver",
+    "DivergentResult",
+    "RankDivergenceError",
+    "RankReconciler",
+    "RankSchedule",
+    "RankStalledError",
+    "RoundResult",
+    "ViewMerger",
+    "merge_stacked",
+    "merge_views",
+    "normalize_view",
+    "rank_schedule",
+    "rank_view_timeline",
+    "strip_rank_specs",
+    "view_fingerprint",
+]
+
+
+# ---------------------------------------------------------------------------
+# rank-scoped spec extraction: skewed timelines and rank schedules
+
+
+@dataclass(frozen=True)
+class RankSchedule:
+    """One rank's observation-skew directives, decoded from the shared
+    timeline (every rank parses the same timeline, so schedules are
+    global knowledge — the property that keeps simulated stalls from
+    ever deadlocking a real collective)."""
+
+    rank: int
+    #: ``(t_sched, delay_s)`` — from ``t_sched`` on, this rank sees
+    #: events ``delay_s`` late; multiple directives accumulate
+    delays: tuple[tuple[float, float], ...]
+    #: ``(t_begin, t_end)`` — report suppression windows (``rankdrop``;
+    #: an unmatched ``drop`` runs to +inf)
+    drops: tuple[tuple[float, float], ...]
+    #: ``(t_sched, epochs)`` — superstep freeze windows (``rankstall``)
+    stalls: tuple[tuple[float, int], ...]
+
+    def skew_at(self, t: float) -> float:
+        """Total observation delay applied to an event scheduled at
+        ``t`` (the sum of every directive already in force)."""
+        return sum(d for ts, d in self.delays if ts <= t)
+
+    def reporting(self, t: float) -> bool:
+        """False while a ``rankdrop`` window covers ``t``."""
+        return not any(b <= t < e for b, e in self.drops)
+
+    def stall_windows(self, t0: float, dt: float) -> tuple[
+        tuple[int, int], ...
+    ]:
+        """Freeze windows in global step space: ``(s0, s0 + epochs)``
+        pairs — the rank executes no step ``s`` with ``s0 <= s < s1``
+        until the global step counter passes ``s1`` (then it replays
+        the whole missed span: the delta-tape catch-up)."""
+        out = []
+        for t, epochs in self.stalls:
+            s0 = max(int(math.ceil((t - t0) / dt)) - 1, 0)
+            # epochs == 0 means permanent (the documented rankstall
+            # encoding): the window never closes
+            s1 = s0 + int(epochs) if epochs else sys.maxsize
+            out.append((s0, s1))
+        return tuple(out)
+
+
+def _stall_allowed(
+    windows: tuple[tuple[int, int], ...], target: int
+) -> int:
+    """How far a rank may execute when the global step counter reads
+    ``target``: while ``target`` sits inside a freeze window the rank
+    parks at the window's start; once the counter passes the window's
+    end the whole missed span replays in one go (delta-tape catch-up).
+    Iterated to a fixpoint so chained windows compose."""
+    allowed = target
+    changed = True
+    while changed:
+        changed = False
+        for s0, s1 in windows:
+            if s0 < allowed < s1:
+                allowed = s0
+                changed = True
+    return allowed
+
+
+def _rank_events(timeline: ChaosTimeline, n_ranks: int):
+    """``(t, spec)`` pairs for every rank-scoped spec, validated
+    against ``n_ranks`` (loud, like every other spec family)."""
+    out = []
+    for ev in timeline.events():
+        for spec in ev.specs:
+            if spec.is_rank:
+                check_rank(spec, n_ranks)
+                out.append((ev.t, spec))
+    return out
+
+
+def strip_rank_specs(timeline: ChaosTimeline) -> ChaosTimeline:
+    """The shared cluster timeline with every rank-scoped spec removed
+    — the reference a converged run must be bit-equal to."""
+    events = []
+    for ev in timeline.events():
+        specs = tuple(s for s in ev.specs if not s.is_rank)
+        if specs:
+            events.append(ChaosEvent(ev.t, specs))
+    return ChaosTimeline(events)
+
+
+def rank_schedule(
+    timeline: ChaosTimeline, rank: int, n_ranks: int
+) -> RankSchedule:
+    """Decode one rank's skew/drop/stall directives from the shared
+    timeline (validating EVERY rank spec on the way, so a bad spec for
+    any rank fails every rank identically)."""
+    delays: list[tuple[float, float]] = []
+    drops: list[tuple[float, float]] = []
+    stalls: list[tuple[float, int]] = []
+    open_drop: float | None = None
+    for t, spec in _rank_events(timeline, n_ranks):
+        if spec.rank() != rank:
+            continue
+        if spec.scope == "rankdelay":
+            delays.append((t, spec.rank_arg() / 1000.0))
+        elif spec.scope == "rankdrop":
+            if spec.action == "drop":
+                if open_drop is None:
+                    open_drop = t
+            else:
+                if open_drop is not None:
+                    drops.append((open_drop, t))
+                    open_drop = None
+        elif spec.scope == "rankstall":
+            stalls.append((t, spec.rank_arg()))
+    if open_drop is not None:
+        drops.append((open_drop, float("inf")))
+    return RankSchedule(
+        rank=rank, delays=tuple(delays), drops=tuple(drops),
+        stalls=tuple(stalls),
+    )
+
+
+def rank_view_timeline(
+    timeline: ChaosTimeline, rank: int, n_ranks: int
+) -> ChaosTimeline:
+    """The cluster timeline as ONE rank observes it: rank specs
+    stripped, and every event scheduled at ``t`` shifted to
+    ``t + skew_at(t)`` (observation delay accumulates across
+    ``rankdelay`` directives already in force).  The shift is
+    non-decreasing in ``t``, so replay order is preserved."""
+    sched = rank_schedule(timeline, rank, n_ranks)
+    events = []
+    for ev in timeline.events():
+        specs = tuple(s for s in ev.specs if not s.is_rank)
+        if specs:
+            events.append(ChaosEvent(ev.t + sched.skew_at(ev.t), specs))
+    return ChaosTimeline(events)
+
+
+# ---------------------------------------------------------------------------
+# the merge algebra: normalize, then join on the normalized domain
+
+
+def _obs_bottom(x):
+    """The lattice bottom for a max-joined observation lane (what a
+    non-reporting contributor is neutralized to)."""
+    if x.dtype == jnp.bool_:
+        return jnp.zeros_like(x)
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        return jnp.full_like(x, jnp.finfo(x.dtype).min)
+    if jnp.issubdtype(x.dtype, jnp.unsignedinteger):
+        return jnp.zeros_like(x)
+    return jnp.full_like(x, jnp.iinfo(x.dtype).min)
+
+
+def _normalize(view: ClusterState, report, min_reporters) -> ClusterState:
+    """Project a view onto the merge domain: down bits gated by the
+    reporter quorum (the :mod:`.liveness` rule — injected downs carry
+    ``ALWAYS_REPORTED`` and always pass), ``down_since`` zeroed where
+    not down, and — when ``report`` is False (a ``rankdrop`` window) —
+    every observation lane collapsed to its lattice bottom so the
+    dropped rank's evidence stops counting.  A projection: applying it
+    twice is applying it once, which is what makes the join idempotent
+    on the normalized domain."""
+    quorum = view.reporters >= min_reporters
+    down = view.down & quorum & report
+    return replace(
+        view,
+        down=down,
+        down_since=jnp.where(down, view.down_since, 0.0).astype(
+            view.down_since.dtype
+        ),
+        last_ack=jnp.where(report, view.last_ack,
+                           _obs_bottom(view.last_ack)),
+        laggy=jnp.where(report, view.laggy, 0.0).astype(view.laggy.dtype),
+        markdowns=jnp.where(report, view.markdowns, 0.0).astype(
+            view.markdowns.dtype
+        ),
+        suppressed=view.suppressed & report,
+        slow=view.slow & report,
+        out=view.out & report,
+        reporters=jnp.where(report, view.reporters, 0).astype(
+            view.reporters.dtype
+        ),
+    )
+
+
+def _join(a: ClusterState, b: ClusterState) -> ClusterState:
+    """Element-wise lattice join of two NORMALIZED views.
+
+    Commutative and associative by construction (every lane is a max,
+    an OR, a quorum-masked min, or a lexicographic owner-select whose
+    tie-break is element-wise max); idempotent on the normalized
+    domain.  ``tests/fuzz_reconcile.py`` soaks all three laws."""
+    ka, kb = a.epoch, b.epoch
+
+    def own(x, y):
+        # map-owned lanes: the highest-epoch owner's value; ties take
+        # the element-wise max (symmetric, so the join commutes)
+        return jnp.where(ka > kb, x, jnp.where(kb > ka, y,
+                                               jnp.maximum(x, y)))
+
+    down = a.down | b.down
+    inf = jnp.asarray(jnp.inf, a.down_since.dtype)
+    cand = jnp.minimum(
+        jnp.where(a.down, a.down_since, inf),
+        jnp.where(b.down, b.down_since, inf),
+    )
+    if (a.checksums is None) != (b.checksums is None):
+        raise ValueError(
+            "cannot join a view with a checksum table into one without"
+        )
+    return replace(
+        a,
+        pool=jax.tree_util.tree_map(own, a.pool, b.pool),
+        last_ack=jnp.maximum(a.last_ack, b.last_ack),
+        laggy=jnp.maximum(a.laggy, b.laggy),
+        markdowns=jnp.maximum(a.markdowns, b.markdowns),
+        down=down,
+        down_since=jnp.where(down, cand, 0.0).astype(a.down_since.dtype),
+        suppressed=a.suppressed | b.suppressed,
+        slow=a.slow | b.slow,
+        out=a.out | b.out,
+        reporters=jnp.maximum(a.reporters, b.reporters),
+        up=own(a.up, b.up),
+        up_primary=own(a.up_primary, b.up_primary),
+        acting=own(a.acting, b.acting),
+        acting_primary=own(a.acting_primary, b.acting_primary),
+        flags=own(a.flags, b.flags),
+        survivor_mask=own(a.survivor_mask, b.survivor_mask),
+        n_alive=own(a.n_alive, b.n_alive),
+        pg_hist=own(a.pg_hist, b.pg_hist),
+        pg_aux=own(a.pg_aux, b.pg_aux),
+        checksums=(
+            None if a.checksums is None else own(a.checksums, b.checksums)
+        ),
+        epoch=jnp.maximum(a.epoch, b.epoch),
+        now=jnp.maximum(a.now, b.now),
+        last_tick=jnp.maximum(a.last_tick, b.last_tick),
+        # rank-local cursors: meaningless in a consensus view (each
+        # rank's cursor indexes its OWN skewed tape) — max keeps the
+        # algebra total and the output rank-identical
+        tape_cursor=jnp.maximum(a.tape_cursor, b.tape_cursor),
+        step=jnp.maximum(a.step, b.step),
+    )
+
+
+@jax.jit
+def _merge_pair(a, b, report_a, report_b, min_reporters):
+    return _join(
+        _normalize(a, report_a, min_reporters),
+        _normalize(b, report_b, min_reporters),
+    )
+
+
+def normalize_view(
+    view: ClusterState, *, min_reporters: int = 1, report: bool = True
+) -> ClusterState:
+    """Public projection onto the merge domain (see :func:`_normalize`;
+    jitted via the pairwise merge path)."""
+    return _normalize(
+        view, jnp.asarray(bool(report)), jnp.int32(min_reporters)
+    )
+
+
+def merge_views(
+    a: ClusterState,
+    b: ClusterState,
+    *,
+    min_reporters: int = 1,
+    report_a: bool = True,
+    report_b: bool = True,
+) -> ClusterState:
+    """Merge two rank views: normalize each (quorum gating + rankdrop
+    masking), then join.  One jitted program; order-free —
+    ``merge(a, b) == merge(b, a)``, and any reduction order over N
+    views lands on the same consensus (the fuzz soak's subject)."""
+    return _merge_pair(
+        a, b, jnp.asarray(bool(report_a)), jnp.asarray(bool(report_b)),
+        jnp.int32(min_reporters),
+    )
+
+
+@jax.jit
+def merge_stacked(stacked: ClusterState, report, min_reporters):
+    """Merge R stacked views (:func:`stack_states` layout: every leaf
+    ``[R, ...]``) into one consensus view as ONE jitted program — the
+    in-process fleet's merge launch (the ``reconcile_round``
+    nonregression scenario pins it compile-once with zero in-round
+    host transfers).  ``report`` is a ``[R]`` bool lane (False = the
+    rank is inside a ``rankdrop`` window)."""
+    n = int(stacked.epoch.shape[0])
+    views = [
+        jax.tree_util.tree_map(lambda x, i=i: x[i], stacked)
+        for i in range(n)
+    ]
+    merged = _normalize(views[0], report[0], min_reporters)
+    for i in range(1, n):
+        merged = _join(merged, _normalize(views[i], report[i],
+                                          min_reporters))
+    return merged
+
+
+#: epoch-versioned lanes a converged rank must agree on bit-exactly —
+#: time-stamped observation lanes (last_ack/down_since/laggy/markdowns/
+#: last_tick) are deliberately excluded: cross-epoch skew leaves them
+#: carrying the observer's stamp (documented merge semantics), while
+#: these lanes are pure functions of the applied event prefix
+_FP_LANES = (
+    "down", "suppressed", "slow", "out",
+    "up", "up_primary", "acting", "acting_primary",
+    "flags", "survivor_mask", "n_alive", "pg_hist", "pg_aux",
+    "epoch", "step",
+)
+
+
+def view_fingerprint(state_h) -> int:
+    """Convergence fingerprint of a HOST copy of one rank's view
+    (``jax.device_get(state)`` — the between-rounds seam): CRC over
+    the epoch-versioned lanes plus the pool mapping tables."""
+    pool = state_h.pool
+    return rank_fingerprint(
+        pool.osd_up, pool.osd_exists, pool.osd_weight,
+        pool.primary_affinity,
+        *(getattr(state_h, f) for f in _FP_LANES),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the reconciliation protocol (shared verbatim by the in-process fleet
+# and the multihost reconciler, so verdicts cannot drift between them)
+
+
+@dataclass(frozen=True)
+class RoundResult:
+    """One reconciliation round's verdict (identical on every rank:
+    computed from the gathered per-rank progress/fingerprint vectors)."""
+
+    round: int
+    target_step: int
+    steps: tuple[int, ...]         # per-rank executed-step counters
+    epochs: tuple[int, ...]        # per-rank map epochs
+    fingerprints: tuple[int, ...]  # per-rank view fingerprints
+    laggy: tuple[int, ...]         # ranks currently marked laggy
+    converged: bool                # live ranks agree on (step,epoch,fp)
+    diverged: bool                 # live ranks at same (step, epoch)
+    #                                but different fingerprints after
+    #                                the bounded retry loop
+    retries: int                   # divergence retries spent
+    backoff_epochs: int            # extra epochs the retries advanced
+
+
+@dataclass
+class DivergentResult:
+    """A full divergent run: per-round audit plus the final consensus."""
+
+    rounds: list[RoundResult]
+    merged: ClusterState
+    states: list[ClusterState]
+    converged: bool
+    laggy: tuple[int, ...]
+    total_steps: int
+
+    def detection_to_convergence_rounds(self) -> int | None:
+        """Rounds from the first skew-visible round (live ranks not in
+        agreement) to the next agreeing round — the detection-to-
+        convergence latency ``config6 --divergent`` records.  None when
+        no round ever diverged."""
+        first = next(
+            (r.round for r in self.rounds if not r.converged), None
+        )
+        if first is None:
+            return None
+        after = next(
+            (r.round for r in self.rounds
+             if r.round > first and r.converged), None,
+        )
+        if after is None:
+            return len(self.rounds) - first
+        return after - first
+
+
+class ReconcileProtocol:
+    """Host-side round bookkeeping: stall counting, laggy marking, the
+    ``rankstalled`` flag, journal/health notes, and the seeded backoff
+    schedule.  Fed only rank-identical inputs (the gathered progress
+    vectors), so every process that runs it reaches the same verdict
+    at the same round — the property that turns a dead rank into a
+    synchronized :class:`RankStalledError` instead of a hang."""
+
+    def __init__(
+        self,
+        n_ranks: int,
+        *,
+        config=None,
+        seed: int = 0,
+        journal=None,
+        health=None,
+        flags: ClusterFlags | None = None,
+    ):
+        cfg = config or global_config()
+        self.n_ranks = int(n_ranks)
+        self.every = int(cfg.get("reconcile_every_epochs"))
+        self.deadline = int(cfg.get("reconcile_deadline_epochs"))
+        self.retry_max = int(cfg.get("recovery_retry_max"))
+        self.backoff_base_s = (
+            float(cfg.get("recovery_backoff_base_ms")) / 1000.0
+        )
+        self.journal = journal
+        self.health = health
+        self.flags = flags if flags is not None else ClusterFlags()
+        self.rng = np.random.default_rng(seed)
+        self.stall_rounds = np.zeros(self.n_ranks, np.int64)
+        self.laggy: set[int] = set()
+        self._prev_steps: np.ndarray | None = None
+
+    def backoff_epochs(self, attempt: int, dt: float) -> int:
+        """Seeded exponential backoff, expressed in epochs of virtual
+        time (the executor's formula over ``dt``-sized steps): every
+        rank draws the same seeded sequence, so backoff windows agree
+        across processes."""
+        b = (
+            self.backoff_base_s
+            * (2.0 ** max(attempt - 1, 0))
+            * (1.0 + self.rng.random())
+        )
+        return max(1, int(math.ceil(b / max(dt, 1e-9))))
+
+    def live(self) -> list[int]:
+        return [r for r in range(self.n_ranks) if r not in self.laggy]
+
+    def agreement(self, steps, epochs, fps) -> tuple[bool, bool]:
+        """(converged, divergence_candidate) over the live ranks:
+        converged = all agree on (step, epoch, fingerprint); a
+        divergence candidate agrees on progress but not on content
+        (same step AND epoch, different fingerprints) — lattice
+        staleness (one rank behind) is neither."""
+        live = self.live()
+        if len(live) <= 1:
+            return True, False
+        s0, e0, f0 = steps[live[0]], epochs[live[0]], fps[live[0]]
+        same_progress = all(
+            steps[r] == s0 and epochs[r] == e0 for r in live[1:]
+        )
+        same_fp = all(fps[r] == f0 for r in live[1:])
+        return (same_progress and same_fp), (same_progress and not same_fp)
+
+    def observe(
+        self, round_idx: int, target_step: int,
+        steps, epochs, fps, now: float,
+        *, retries: int = 0, backoff: int = 0,
+    ) -> RoundResult:
+        """Fold one round's gathered vectors into the protocol state:
+        stall counters, laggy transitions, flag/journal/health
+        surfacing — and the verdict.  Raises on a permanently-dead
+        rank (every caller of this method raises at the same round)."""
+        steps = np.asarray(steps, np.int64)
+        epochs = np.asarray(epochs, np.int64)
+        fps = np.asarray(fps, np.int64)
+        if self._prev_steps is not None:
+            advanced = steps > self._prev_steps
+            self.stall_rounds = np.where(
+                advanced, 0, self.stall_rounds + 1
+            )
+            for r in sorted(self.laggy):
+                if advanced[r]:
+                    self.laggy.discard(r)
+                    if self.journal is not None:
+                        self.journal.event(
+                            "reconcile.revived", rank=r, t=now,
+                            round=round_idx, step=int(steps[r]),
+                        )
+            if not self.laggy and "rankstalled" in self.flags:
+                self.flags.clear("rankstalled")
+        self._prev_steps = steps
+        for r in range(self.n_ranks):
+            if r in self.laggy:
+                continue
+            if int(self.stall_rounds[r]) >= self.deadline:
+                self.laggy.add(r)
+                self.flags.set("rankstalled")
+                if self.journal is not None:
+                    self.journal.event(
+                        "reconcile.laggy", rank=r, t=now,
+                        round=round_idx,
+                        stalled_rounds=int(self.stall_rounds[r]),
+                    )
+                if self.health is not None:
+                    self.health.note_rank_stall(
+                        r, int(self.stall_rounds[r])
+                    )
+        dead = sorted(
+            r for r in self.laggy
+            if int(self.stall_rounds[r]) >= self.deadline + self.retry_max
+        )
+        converged, diverged = self.agreement(steps, epochs, fps)
+        result = RoundResult(
+            round=round_idx, target_step=int(target_step),
+            steps=tuple(int(s) for s in steps),
+            epochs=tuple(int(e) for e in epochs),
+            fingerprints=tuple(int(f) for f in fps),
+            laggy=tuple(sorted(self.laggy)),
+            converged=converged, diverged=diverged,
+            retries=retries, backoff_epochs=backoff,
+        )
+        if self.health is not None:
+            self.health.note_rank_round(
+                n_live=len(self.live()),
+                laggy=len(self.laggy), diverged=diverged,
+            )
+        if self.journal is not None:
+            self.journal.event(
+                "reconcile.round", round=round_idx, t=now,
+                target_step=int(target_step),
+                steps=[int(s) for s in steps],
+                epochs=[int(e) for e in epochs],
+                laggy=sorted(self.laggy), converged=converged,
+                diverged=diverged, retries=retries,
+            )
+        if dead:
+            if self.journal is not None:
+                self.journal.event(
+                    "reconcile.stalled", ranks=dead, t=now,
+                    round=round_idx,
+                    stalled_rounds=[
+                        int(self.stall_rounds[r]) for r in dead
+                    ],
+                )
+            raise RankStalledError(
+                f"rank(s) {dead} made no progress for "
+                f"{int(self.stall_rounds[dead[0]])} reconcile rounds "
+                f"(deadline {self.deadline} + {self.retry_max} backoff "
+                f"retries exhausted) — every rank raises this at round "
+                f"{round_idx}; survivors hold the last merged view"
+            )
+        return result
+
+
+# ---------------------------------------------------------------------------
+# in-process divergent fleet: R rank views in one process, one scan
+
+
+class DivergentDriver:
+    """R simulated ranks in ONE process: each advances its own
+    :class:`ClusterState` through a single compiled tape-as-argument
+    scan (the fleet's ``_epoch_step_with`` pattern — per-rank skewed
+    tapes trace in as arguments, so R ranks share one program), and
+    reconciliation rounds merge the views with :func:`merge_stacked`
+    (one jitted launch).  All protocol bookkeeping lives in
+    :class:`ReconcileProtocol`, shared verbatim with the multihost
+    :class:`RankReconciler`."""
+
+    def __init__(
+        self,
+        m: OSDMap,
+        timeline: ChaosTimeline,
+        n_ranks: int,
+        *,
+        config=None,
+        journal=None,
+        health=None,
+        flags: ClusterFlags | None = None,
+        seed: int = 0,
+        **driver_kwargs,
+    ):
+        cfg = config or global_config()
+        self.n_ranks = int(n_ranks)
+        if self.n_ranks < 1:
+            raise ValueError(f"need >= 1 rank, got {n_ranks}")
+        self.schedules = [
+            rank_schedule(timeline, r, self.n_ranks)
+            for r in range(self.n_ranks)
+        ]
+        base = strip_rank_specs(timeline)
+        self.driver = EpochDriver(
+            m, base, seed=seed, config=cfg, **driver_kwargs
+        )
+        tapes = [
+            compile_event_tape(
+                rank_view_timeline(timeline, r, self.n_ranks), m
+            )
+            for r in range(self.n_ranks)
+        ]
+        r_pad = 1
+        while r_pad < max(max(len(tp) for tp in tapes), 1):
+            r_pad <<= 1
+        self._r_pad = r_pad
+        self._tapes = [
+            tuple(jnp.asarray(a) for a in _pad_tape_arrays(tp, r_pad))
+            for tp in tapes
+        ]
+        self._salt = jnp.asarray(self.driver.salt_base)
+        self._scan = None
+        self.states = [
+            self.driver._init_state for _ in range(self.n_ranks)
+        ]
+        self.cur = [0] * self.n_ranks
+        self.min_reporters = int(cfg.get("mon_osd_min_down_reporters"))
+        self.protocol = ReconcileProtocol(
+            self.n_ranks, config=cfg, seed=seed, journal=journal,
+            health=health, flags=flags,
+        )
+        self.journal = journal
+        self.merged: ClusterState | None = None
+
+    # -- the one compiled scan ----------------------------------------
+
+    def _scan_fn(self):
+        if self._scan is None:
+            body = self.driver._epoch_step_with
+
+            @jax.jit
+            def scan_fn(state, steps, t, kind, osd, bump, salt):
+                def sbody(carry, step):
+                    return body(carry, step, (t, kind, osd, bump), salt)
+
+                return jax.lax.scan(sbody, state, steps)
+
+            self._scan = scan_fn
+        return self._scan
+
+    # -- stall-aware advance ------------------------------------------
+
+    def _allowed(self, rank: int, target: int) -> int:
+        return _stall_allowed(
+            self.schedules[rank].stall_windows(
+                self.driver.t0, self.driver.dt
+            ),
+            target,
+        )
+
+    def _advance(self, rank: int, target: int) -> None:
+        allowed = self._allowed(rank, target)
+        if allowed <= self.cur[rank]:
+            return
+        catch_up = rank in self.protocol.laggy
+        old = self.states[rank] if catch_up else None
+        # arange(len) + start, not arange(start, stop): a non-zero
+        # start lowers through a fresh host constant (one tiny compile
+        # per distinct offset), while the offset-add is a value under
+        # the one cached program
+        steps = (
+            jnp.arange(allowed - self.cur[rank], dtype=I32)
+            + jnp.int32(self.cur[rank])
+        )
+        state, _rows = self._scan_fn()(
+            self.states[rank], steps, *self._tapes[rank], self._salt
+        )
+        self.states[rank] = state
+        self.cur[rank] = allowed
+        if catch_up and self.journal is not None:
+            self.journal.event(
+                "reconcile.catchup", rank=rank,
+                **view_delta(old, state).to_json(),
+            )
+
+    def _now_at(self, target: int) -> float:
+        return self.driver.t0 + target * self.driver.dt
+
+    # -- one round -----------------------------------------------------
+
+    def _merge(self, now: float) -> ClusterState:
+        report = jnp.asarray([
+            self.schedules[r].reporting(now)
+            for r in range(self.n_ranks)
+        ])
+        return merge_stacked(
+            stack_states(self.states), report,
+            jnp.int32(self.min_reporters),
+        )
+
+    def _gather(self):
+        """(steps, epochs, fingerprints) per rank, host-side (the
+        between-rounds seam: one pull per rank per round)."""
+        hosts = [jax.device_get(s) for s in self.states]
+        steps = [self.cur[r] for r in range(self.n_ranks)]
+        epochs = [int(h.epoch) for h in hosts]
+        fps = [view_fingerprint(h) for h in hosts]
+        return steps, epochs, fps
+
+    def reconcile_round(
+        self, round_idx: int, target: int
+    ) -> RoundResult:
+        """Advance every rank toward ``target``, merge, and fold the
+        round into the protocol — with the bounded divergence-retry
+        loop: live ranks at the same progress but different content
+        re-advance under seeded backoff until they agree or the retry
+        budget drains."""
+        proto = self.protocol
+        for r in range(self.n_ranks):
+            self._advance(r, target)
+        now = self._now_at(target)
+        self.merged = self._merge(now)
+        steps, epochs, fps = self._gather()
+        retries = 0
+        backoff_total = 0
+        converged, diverged = proto.agreement(steps, epochs, fps)
+        while diverged and retries < proto.retry_max:
+            retries += 1
+            extra = proto.backoff_epochs(retries, self.driver.dt)
+            backoff_total += extra
+            target += extra
+            for r in proto.live():
+                self._advance(r, target)
+            now = self._now_at(target)
+            self.merged = self._merge(now)
+            steps, epochs, fps = self._gather()
+            converged, diverged = proto.agreement(steps, epochs, fps)
+        result = proto.observe(
+            round_idx, target, steps, epochs, fps, now,
+            retries=retries, backoff=backoff_total,
+        )
+        if result.diverged and rank_checks_enabled():
+            raise RankDivergenceError(
+                f"round {round_idx}: live ranks at step "
+                f"{result.steps} / epoch {result.epochs} hold "
+                f"different views after {retries} backoff retries "
+                f"(fingerprints {result.fingerprints})"
+            )
+        return result
+
+    # -- the run -------------------------------------------------------
+
+    def run(self, n_epochs: int) -> DivergentResult:
+        """Drive all ranks ``n_epochs`` epochs with a reconciliation
+        round every ``reconcile_every_epochs``.  While a rank is
+        laggy, extra backoff rounds continue past the epoch budget
+        (bounded by ``recovery_retry_max``) so a permanent stall
+        surfaces as :class:`RankStalledError` rather than silence."""
+        proto = self.protocol
+        rounds: list[RoundResult] = []
+        target = 0
+        round_idx = 0
+        n_epochs = int(n_epochs)
+        while target < n_epochs:
+            target = min(target + proto.every, n_epochs)
+            rounds.append(self.reconcile_round(round_idx, target))
+            target = max(target, max(self.cur))
+            round_idx += 1
+        # drive to resolution: while a rank lags (stalled but not yet
+        # past the deadline, laggy awaiting revival, or views not yet
+        # in agreement) the survivors keep advancing under seeded
+        # backoff — virtual-time sleep — until the rank catches up,
+        # the views agree, or the protocol raises RankStalledError.
+        # Bounded: stall counters cap the laggy branch, the extra-
+        # round counter caps the rest.
+        extra_rounds = 0
+        while rounds and (proto.laggy or not rounds[-1].converged):
+            if proto.laggy:
+                attempt = max(1, max(
+                    int(proto.stall_rounds[r]) - proto.deadline + 1
+                    for r in sorted(proto.laggy)
+                ))
+            else:
+                extra_rounds += 1
+                if extra_rounds > proto.deadline + proto.retry_max:
+                    break
+                attempt = extra_rounds
+            target += proto.backoff_epochs(attempt, self.driver.dt)
+            rounds.append(self.reconcile_round(round_idx, target))
+            target = max(target, max(self.cur))
+            round_idx += 1
+        last = rounds[-1] if rounds else None
+        return DivergentResult(
+            rounds=rounds,
+            merged=self.merged,
+            states=list(self.states),
+            converged=bool(last.converged) if last else True,
+            laggy=tuple(sorted(proto.laggy)),
+            total_steps=max(self.cur) if self.cur else 0,
+        )
+
+    def reference_state(self, n_epochs: int) -> ClusterState:
+        """The single-rank unskewed reference: the stripped timeline
+        driven through the SAME compiled scan (so a converged rank's
+        view must be bit-equal to it)."""
+        tape = tuple(
+            jnp.asarray(a) for a in _pad_tape_arrays(
+                self.driver.tape, self._r_pad
+            )
+        )
+        steps = jnp.arange(0, int(n_epochs), dtype=I32)
+        state, _rows = self._scan_fn()(
+            self.driver._init_state, steps, *tape, self._salt
+        )
+        return state
+
+
+# ---------------------------------------------------------------------------
+# multihost: one process per rank, merged through shard_map collectives
+
+
+class ViewMerger:
+    """The one-launch multihost merge program for a (mesh, axis).
+
+    Every device holds a COPY of its process's local view (the stacked
+    ``[n_dev, ...]`` operand; lattice joins are duplication-insensitive
+    — unlike a psum, a pmax over R distinct values repeated ``local``
+    times each is exactly the R-way join).  ``merge`` runs the
+    normalize-then-join algebra as ``lax.pmax``/``pmin`` collectives
+    inside ONE jitted ``shard_map``; ``gather`` all-gathers the small
+    per-rank progress rows the protocol's verdicts come from."""
+
+    def __init__(self, mesh, axis: str | None = None):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.placement import shard_map
+
+        self.mesh = mesh
+        self.axis = axis or mesh.axis_names[0]
+        self.n_dev = int(mesh.devices.size)
+        ax = self.axis
+        self._sharding = NamedSharding(mesh, P(ax))
+
+        def sel_max(lane, keep):
+            # owner-select join: mask non-owners to the dtype's bottom,
+            # then pmax — ties among owners take element-wise max
+            if lane.dtype == jnp.bool_:
+                v = jnp.where(
+                    keep, lane.astype(jnp.int32),
+                    jnp.iinfo(jnp.int32).min,
+                )
+                return lax.pmax(v, ax) > 0
+            # dtype dispatch is static at trace time, not a traced branch
+            if jnp.issubdtype(lane.dtype, jnp.unsignedinteger):  # jaxlint: disable=J001
+                bottom = jnp.zeros_like(lane)
+            elif jnp.issubdtype(lane.dtype, jnp.floating):  # jaxlint: disable=J001
+                bottom = jnp.full_like(lane, -jnp.inf)
+            else:
+                bottom = jnp.full_like(lane, jnp.iinfo(lane.dtype).min)
+            return lax.pmax(jnp.where(keep, lane, bottom), ax)
+
+        def pmax_(x):
+            if x.dtype == jnp.bool_:
+                return lax.pmax(x.astype(jnp.int32), ax) > 0
+            return lax.pmax(x, ax)
+
+        def local(stacked, report, min_reporters):
+            v = jax.tree_util.tree_map(lambda x: x[0], stacked)
+            n = _normalize(v, report[0], min_reporters)
+            kmax = lax.pmax(n.epoch, ax)
+            owner = n.epoch == kmax
+            down = pmax_(n.down)
+            inf = jnp.asarray(jnp.inf, n.down_since.dtype)
+            cand = lax.pmin(
+                jnp.where(n.down, n.down_since, inf), ax
+            )
+            return replace(
+                n,
+                pool=jax.tree_util.tree_map(
+                    lambda x: sel_max(x, owner), n.pool
+                ),
+                last_ack=pmax_(n.last_ack),
+                laggy=pmax_(n.laggy),
+                markdowns=pmax_(n.markdowns),
+                down=down,
+                down_since=jnp.where(down, cand, 0.0).astype(
+                    n.down_since.dtype
+                ),
+                suppressed=pmax_(n.suppressed),
+                slow=pmax_(n.slow),
+                out=pmax_(n.out),
+                reporters=pmax_(n.reporters),
+                up=sel_max(n.up, owner),
+                up_primary=sel_max(n.up_primary, owner),
+                acting=sel_max(n.acting, owner),
+                acting_primary=sel_max(n.acting_primary, owner),
+                flags=sel_max(n.flags, owner),
+                survivor_mask=sel_max(n.survivor_mask, owner),
+                n_alive=sel_max(n.n_alive, owner),
+                pg_hist=sel_max(n.pg_hist, owner),
+                pg_aux=sel_max(n.pg_aux, owner),
+                checksums=(
+                    None if n.checksums is None
+                    else sel_max(n.checksums, owner)
+                ),
+                epoch=kmax,
+                now=pmax_(n.now),
+                last_tick=pmax_(n.last_tick),
+                tape_cursor=pmax_(n.tape_cursor),
+                step=pmax_(n.step),
+            )
+
+        self._merge = jax.jit(
+            shard_map(
+                local, mesh=mesh,
+                in_specs=(P(ax), P(ax), P()),
+                out_specs=P(),
+            )
+        )
+
+        def gather(rows):
+            return lax.all_gather(rows[0], ax)
+
+        self._gather = jax.jit(
+            shard_map(
+                gather, mesh=mesh, in_specs=(P(ax),), out_specs=P()
+            )
+        )
+
+    def _operand(self, leaf: np.ndarray):
+        leaf = np.asarray(leaf)
+        n = self.n_dev
+
+        def cb(idx):
+            start, stop, _ = idx[0].indices(n)
+            return np.broadcast_to(
+                leaf, (stop - start,) + leaf.shape
+            )
+
+        return jax.make_array_from_callback(
+            (n,) + leaf.shape, self._sharding, cb
+        )
+
+    def merge(
+        self, state_h, report_by_dev: np.ndarray, min_reporters: int
+    ) -> ClusterState:
+        """One merge launch: ``state_h`` is a HOST copy of this
+        process's view (``jax.device_get``), ``report_by_dev`` a
+        ``[n_dev]`` bool row every process computes identically from
+        the shared rank schedules."""
+        stacked = jax.tree_util.tree_map(self._operand, state_h)
+        # unlike the view operand (same value on every local device),
+        # the report row is already per-device: shard it so each
+        # device's block carries ITS OWN report bit
+        rep = np.asarray(report_by_dev, bool)
+        report = jax.make_array_from_callback(
+            (self.n_dev,), self._sharding, lambda idx: rep[idx]
+        )
+        return self._merge(stacked, report, jnp.int32(min_reporters))
+
+    def gather_rows(self, row: np.ndarray) -> np.ndarray:
+        """All-gather one small i64 row per device -> ``[n_dev, k]``
+        on every process (the protocol's rank-identical input)."""
+        op = self._operand(np.asarray(row, np.int64))
+        return np.asarray(jax.device_get(self._gather(op)))
+
+
+class RankReconciler:
+    """One PROCESS-rank's side of the divergent protocol: advances its
+    own skewed view through the compiled superstep scan and joins
+    every reconciliation round's collectives.  All verdicts derive
+    from all-gathered progress rows, so laggy marking, backoff
+    schedules, and :class:`RankStalledError` land on every process at
+    the same round — the stall-tolerant degradation contract."""
+
+    def __init__(
+        self,
+        m: OSDMap,
+        timeline: ChaosTimeline,
+        *,
+        rank: int,
+        n_ranks: int,
+        mesh=None,
+        config=None,
+        journal=None,
+        health=None,
+        flags: ClusterFlags | None = None,
+        seed: int = 0,
+        **driver_kwargs,
+    ):
+        from ..parallel import multihost
+
+        cfg = config or global_config()
+        self.rank = int(rank)
+        self.n_ranks = int(n_ranks)
+        check_rank(FailureSpec("rankdrop", str(self.rank), "drop"),
+                   self.n_ranks)
+        self.mesh = mesh if mesh is not None else multihost.global_mesh()
+        self.merger = ViewMerger(self.mesh)
+        if self.merger.n_dev % self.n_ranks:
+            raise ValueError(
+                f"{self.merger.n_dev} devices do not divide over "
+                f"{self.n_ranks} ranks"
+            )
+        self._local = self.merger.n_dev // self.n_ranks
+        # every rank decodes EVERY schedule (global knowledge: the
+        # report mask and stall windows must be rank-identical inputs)
+        self.schedules = [
+            rank_schedule(timeline, r, self.n_ranks)
+            for r in range(self.n_ranks)
+        ]
+        self.driver = EpochDriver(
+            m, rank_view_timeline(timeline, self.rank, self.n_ranks),
+            seed=seed, config=cfg, **driver_kwargs,
+        )
+        self._scan = self.driver.compile_superstep()
+        self.state = self.driver._init_state
+        self.cur = 0
+        self.min_reporters = int(cfg.get("mon_osd_min_down_reporters"))
+        self.protocol = ReconcileProtocol(
+            self.n_ranks, config=cfg, seed=seed, journal=journal,
+            health=health, flags=flags,
+        )
+        self.journal = journal
+        self.merged: ClusterState | None = None
+
+    def _allowed(self, target: int) -> int:
+        return _stall_allowed(
+            self.schedules[self.rank].stall_windows(
+                self.driver.t0, self.driver.dt
+            ),
+            target,
+        )
+
+    def _advance(self, target: int) -> None:
+        allowed = self._allowed(target)
+        if allowed <= self.cur:
+            return
+        catch_up = self.rank in self.protocol.laggy
+        old = self.state if catch_up else None
+        # arange(len) + start: same compile-once contract as the
+        # in-process driver's _advance
+        steps = jnp.arange(allowed - self.cur, dtype=I32) + jnp.int32(
+            self.cur
+        )
+        self.state, _rows = self._scan(self.state, steps)
+        self.cur = allowed
+        if catch_up and self.journal is not None:
+            self.journal.event(
+                "reconcile.catchup", rank=self.rank,
+                **view_delta(old, self.state).to_json(),
+            )
+
+    def _round_io(self, now: float):
+        """One round's collectives: merge + progress gather.  Every
+        rank enters BOTH collectives every round (a simulated stall
+        freezes the view's content, never the process's participation
+        — that is what keeps stalls from deadlocking)."""
+        state_h = jax.device_get(self.state)
+        report = np.zeros(self.merger.n_dev, bool)
+        for r in range(self.n_ranks):
+            report[r * self._local:(r + 1) * self._local] = (
+                self.schedules[r].reporting(now)
+            )
+        self.merged = self.merger.merge(
+            state_h, report, self.min_reporters
+        )
+        row = np.asarray(
+            [self.cur, int(state_h.epoch), view_fingerprint(state_h)],
+            np.int64,
+        )
+        rows = self.merger.gather_rows(row)
+        # process-major device order: rank r's rows sit at
+        # [r*local, (r+1)*local) — take each rank's first copy
+        per_rank = rows[:: self._local]
+        if rank_checks_enabled():
+            assert_rank_identical(
+                "reconcile.merged",
+                *(jax.device_get(x) for x in (
+                    self.merged.epoch, self.merged.down,
+                    self.merged.acting, self.merged.pg_hist,
+                )),
+                mesh=self.mesh,
+            )
+        return (
+            per_rank[:, 0].tolist(),
+            per_rank[:, 1].tolist(),
+            per_rank[:, 2].tolist(),
+        )
+
+    def _now_at(self, target: int) -> float:
+        return self.driver.t0 + target * self.driver.dt
+
+    def reconcile_round(self, round_idx: int, target: int) -> RoundResult:
+        proto = self.protocol
+        self._advance(target)
+        now = self._now_at(target)
+        steps, epochs, fps = self._round_io(now)
+        retries = 0
+        backoff_total = 0
+        converged, diverged = proto.agreement(steps, epochs, fps)
+        while diverged and retries < proto.retry_max:
+            retries += 1
+            extra = proto.backoff_epochs(retries, self.driver.dt)
+            backoff_total += extra
+            target += extra
+            if self.rank in proto.live():
+                self._advance(target)
+            now = self._now_at(target)
+            steps, epochs, fps = self._round_io(now)
+            converged, diverged = proto.agreement(steps, epochs, fps)
+        result = proto.observe(
+            round_idx, target, steps, epochs, fps, now,
+            retries=retries, backoff=backoff_total,
+        )
+        if result.diverged and rank_checks_enabled():
+            raise RankDivergenceError(
+                f"round {round_idx}: live ranks at step "
+                f"{result.steps} / epoch {result.epochs} hold "
+                f"different views after {retries} backoff retries "
+                f"(fingerprints {result.fingerprints})"
+            )
+        return result
+
+    def run(self, n_epochs: int) -> DivergentResult:
+        proto = self.protocol
+        rounds: list[RoundResult] = []
+        target = 0
+        round_idx = 0
+        n_epochs = int(n_epochs)
+        while target < n_epochs:
+            target = min(target + proto.every, n_epochs)
+            rounds.append(self.reconcile_round(round_idx, target))
+            target = max(target, rounds[-1].target_step)
+            round_idx += 1
+        # drive to resolution (see DivergentDriver.run): every process
+        # computes the same loop condition from the gathered rounds,
+        # so all ranks take the same number of extra rounds
+        extra_rounds = 0
+        while rounds and (proto.laggy or not rounds[-1].converged):
+            if proto.laggy:
+                attempt = max(1, max(
+                    int(proto.stall_rounds[r]) - proto.deadline + 1
+                    for r in sorted(proto.laggy)
+                ))
+            else:
+                extra_rounds += 1
+                if extra_rounds > proto.deadline + proto.retry_max:
+                    break
+                attempt = extra_rounds
+            target += proto.backoff_epochs(attempt, self.driver.dt)
+            rounds.append(self.reconcile_round(round_idx, target))
+            target = max(target, rounds[-1].target_step)
+            round_idx += 1
+        last = rounds[-1] if rounds else None
+        return DivergentResult(
+            rounds=rounds,
+            merged=self.merged,
+            states=[self.state],
+            converged=bool(last.converged) if last else True,
+            laggy=tuple(sorted(proto.laggy)),
+            total_steps=self.cur,
+        )

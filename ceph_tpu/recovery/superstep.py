@@ -173,6 +173,13 @@ def compile_event_tape(timeline: ChaosTimeline, m: OSDMap) -> EventTape:
         map_rows: list[tuple[int, int]] = []
         net_rows: list[tuple[int, int]] = []
         for spec in ev.specs:
+            if spec.is_rank:
+                raise ValueError(
+                    f"{spec} is rank-scoped observation skew, not a "
+                    "cluster event; strip it with "
+                    "recovery.reconcile.rank_view_timeline before "
+                    "compiling a per-rank tape"
+                )
             if spec.is_bitrot:
                 n_bitrot += 1
                 continue

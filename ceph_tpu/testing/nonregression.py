@@ -135,6 +135,12 @@ def compile_once_cases() -> dict[str, dict]:
       power-of-two pad bucket (3 -> 4 clusters) must reuse the one
       compiled program with zero in-scan host transfers; fleet size is
       a value, never a shape.
+    - ``reconcile_round``: the divergent two-rank round
+      (:mod:`ceph_tpu.recovery.reconcile`) — per-rank uniform-length
+      chunk advances plus the one-launch ``merge_stacked`` join; a
+      second same-length chunk + merge must reuse both executables
+      with zero in-round host transfers (the per-round gather is the
+      deliberate host seam, outside this region).
 
     Raises ``AssertionError`` (from
     :func:`ceph_tpu.analysis.runtime_guard.assert_no_recompile`) if
@@ -377,6 +383,37 @@ def compile_once_cases() -> dict[str, dict]:
     report["fleet_superstep"] = {
         "warm_compiles": warm_f.n_compiles, "second_compiles": 0,
         "in_scan_host_transfers": g_f.host_transfers,
+    }
+
+    # ---- reconcile round: 2-rank chunks -> merge -> same-shape chunks --
+    from ..recovery.reconcile import DivergentDriver, merge_stacked
+
+    tl_r = ChaosTimeline([
+        ChaosEvent(0.3, (parse_spec("osd:5:down_out"),)),
+        ChaosEvent(0.4, (parse_spec("rankdelay:1.40"),)),
+    ])
+    ddrv = DivergentDriver(m_e, tl_r, 2, n_ops=64)
+    # same-shape merges elsewhere in the process would serve the warm
+    # round from merge_stacked's cache and void the warm_compiles claim
+    merge_stacked.clear_cache()
+    with CompileCounter() as warm_r:
+        for r in range(2):
+            ddrv._advance(r, 8)
+        ddrv._merge(ddrv._now_at(8))
+    # a second uniform-length chunk per rank plus the merge: step
+    # windows and skewed tapes are values, never shapes, so the one
+    # scan and the one merge executable are reused — and nothing in
+    # the round syncs to host (the per-round gather is the deliberate
+    # host seam, outside this region)
+    with assert_no_recompile("reconcile round second chunk"):
+        with track() as g_r:
+            for r in range(2):
+                ddrv._advance(r, 16)
+            ddrv._merge(ddrv._now_at(16))
+    assert g_r.host_transfers == 0, g_r.host_transfers
+    report["reconcile_round"] = {
+        "warm_compiles": warm_r.n_compiles, "second_compiles": 0,
+        "in_round_host_transfers": g_r.host_transfers,
     }
     return report
 

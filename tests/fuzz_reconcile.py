@@ -1,0 +1,193 @@
+"""Merge-algebra property soak: random rank views (real driver states
+with randomly perturbed observation lanes, epoch bumps, and pool-table
+edits) through the reconciliation lattice, asserting the three laws the
+``_join`` docstring promises on every trial — the merge commutes
+(``merge(a, b) == merge(b, a)`` bit-exactly on every leaf), any
+reduction order over N views lands on the same consensus (left fold ==
+right fold == shuffled fold == the one-launch ``merge_stacked``), and
+the result is a fixpoint (``merge(m, m) == m``, and ``normalize`` is a
+projection).  Report flags and the reporter quorum are randomized too,
+so ``rankdrop`` masking and quorum gating are inside the soak.
+
+NOT collected by pytest — run manually:
+
+    env -u PYTHONPATH CEPH_TPU_TEST_REEXEC=1 PYTHONPATH=/root/repo \\
+      JAX_PLATFORMS=cpu python tests/fuzz_reconcile.py
+
+Budget via CEPH_TPU_FUZZ_SECONDS (default 900).
+"""
+
+import os
+import sys
+import time
+from dataclasses import replace
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import jax  # noqa: E402
+
+from ceph_tpu.core.cluster_state import stack_states  # noqa: E402
+from ceph_tpu.models.clusters import build_osdmap  # noqa: E402
+from ceph_tpu.recovery import (  # noqa: E402
+    ChaosTimeline,
+    DivergentDriver,
+    merge_stacked,
+    merge_views,
+    normalize_view,
+)
+
+
+def _leaves(state):
+    return [np.asarray(x) for x in
+            jax.tree_util.tree_leaves(jax.device_get(state))]
+
+
+def _assert_equal(a, b, law):
+    la, lb = _leaves(a), _leaves(b)
+    assert len(la) == len(lb), (law, len(la), len(lb))
+    bad = [i for i, (x, y) in enumerate(zip(la, lb))
+           if not np.array_equal(x, y)]
+    assert not bad, f"{law}: leaves {bad} differ"
+
+
+def _base_state(rng):
+    """A real post-scan driver state (reporters seeded to live-peer
+    counts, peering tables populated) — the perturbations below start
+    from the domain the merge actually sees, not from zeros."""
+    n_osd = int(rng.integers(24, 64))
+    pg_num = int(rng.integers(16, 64))
+    m = build_osdmap(n_osd, pg_num=pg_num, size=6, pool_kind="erasure")
+    pairs = [(0.3, f"osd:{int(rng.integers(0, n_osd))}:down_out"),
+             (0.5, f"osd:{int(rng.integers(0, n_osd))}:down")]
+    d = DivergentDriver(m, ChaosTimeline.from_pairs(pairs), 1, n_ops=16)
+    d._advance(0, int(rng.integers(3, 9)))
+    return jax.device_get(d.states[0]), n_osd
+
+
+def _perturb(base, n_osd, rng):
+    """One random rank view: independent noise on every lane class the
+    lattice joins — OR'd bits, max'd observation stamps, quorum-gated
+    downs, and epoch-owned map tables (an epoch bump plus a pool edit,
+    so owner-select and its elementwise-max tie-break both fire)."""
+    def bits(p):
+        return np.asarray(rng.random(n_osd) < p)
+
+    down = np.asarray(base.down) | bits(0.2)
+    pool = base.pool
+    bump = int(rng.integers(0, 3))  # 0 keeps ties common
+    if bump:
+        pool = replace(
+            pool,
+            osd_up=np.asarray(pool.osd_up) & ~bits(0.1),
+            osd_weight=np.where(
+                bits(0.1), 0, np.asarray(pool.osd_weight)
+            ).astype(np.asarray(pool.osd_weight).dtype),
+        )
+    f32 = np.float32
+    return replace(
+        base,
+        pool=pool,
+        down=down,
+        down_since=np.where(down, rng.uniform(0, 9, n_osd), 0.0)
+        .astype(f32),
+        reporters=rng.integers(0, 5, n_osd).astype(np.int32),
+        suppressed=bits(0.1),
+        slow=bits(0.1),
+        out=np.asarray(base.out) | bits(0.1),
+        last_ack=rng.uniform(0, 9, n_osd).astype(f32),
+        laggy=rng.uniform(0, 2, n_osd).astype(f32),
+        markdowns=rng.uniform(0, 3, n_osd).astype(f32),
+        epoch=np.int32(int(base.epoch) + bump),
+    )
+
+
+def _fold(views, reports, q, order):
+    """Pairwise-merge reduction in the given index order: the raw view
+    carries its own report flag; once merged, the consensus always
+    reports (it is nobody's rankdrop window)."""
+    i = order[0]
+    m, seen = views[i], reports[i]
+    for i in order[1:]:
+        m = merge_views(m, views[i], min_reporters=q,
+                        report_a=seen, report_b=reports[i])
+        seen = True
+    if len(order) == 1:
+        m = normalize_view(m, min_reporters=q, report=seen)
+    return m
+
+
+def _one_trial(rng, rounds=6):
+    """One base cluster, several independent view-set rounds (the map
+    build and scan compile dominate a round, so amortizing them buys
+    ~6x more law checks per second)."""
+    base, n_osd = _base_state(rng)
+    for _ in range(rounds):
+        n, q = _one_round(base, n_osd, rng)
+    return n, q
+
+
+def _one_round(base, n_osd, rng):
+    n = int(rng.integers(2, 6))
+    q = int(rng.integers(0, 4))
+    views = [_perturb(base, n_osd, rng) for _ in range(n)]
+    # at most one dropped rank per trial keeps the common case common
+    reports = [True] * n
+    if rng.random() < 0.4:
+        reports[int(rng.integers(0, n))] = False
+
+    # law 1: the pairwise merge commutes
+    i, j = rng.choice(n, size=2, replace=False)
+    ab = merge_views(views[i], views[j], min_reporters=q,
+                     report_a=reports[i], report_b=reports[j])
+    ba = merge_views(views[j], views[i], min_reporters=q,
+                     report_a=reports[j], report_b=reports[i])
+    _assert_equal(ab, ba, "commutativity")
+
+    # law 2: reduction order is irrelevant — left fold, right fold, a
+    # shuffled fold, and the one-launch stacked merge all agree
+    left = _fold(views, reports, q, list(range(n)))
+    right = _fold(list(reversed(views)), list(reversed(reports)), q,
+                  list(range(n)))
+    shuf = list(rng.permutation(n))
+    _assert_equal(left, right, "associativity (right fold)")
+    _assert_equal(left, _fold(views, reports, q, shuf),
+                  f"associativity (order {shuf})")
+    stacked = merge_stacked(
+        stack_states(views), np.asarray(reports), np.int32(q)
+    )
+    _assert_equal(left, stacked, "associativity (merge_stacked)")
+
+    # law 3: the consensus is a fixpoint, and normalize is a projection
+    _assert_equal(
+        merge_views(left, left, min_reporters=q), left, "idempotence"
+    )
+    k = int(rng.integers(0, n))
+    once = normalize_view(views[k], min_reporters=q, report=reports[k])
+    _assert_equal(normalize_view(once, min_reporters=q), once,
+                  "normalize projection")
+    return n, q
+
+
+def main() -> int:
+    seed = int(time.time())
+    rng = np.random.default_rng(seed)
+    print(f"reconcile fuzz seed {seed}", flush=True)
+    budget = int(os.environ.get("CEPH_TPU_FUZZ_SECONDS", "900"))
+    t0 = time.time()
+    trial = 0
+    while time.time() - t0 < budget:
+        trial += 1
+        n, q = _one_trial(np.random.default_rng(int(rng.integers(0, 2**31))))
+        if trial % 10 == 0:
+            print(f"trial {trial} ok ({time.time() - t0:.0f}s, "
+                  f"{n} views, quorum {q})", flush=True)
+    print(f"DONE: {trial} trials clean in {time.time() - t0:.0f}s",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

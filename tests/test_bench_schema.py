@@ -867,10 +867,12 @@ def _fleet_estimate():
 
 _FLEET_SWEEP = [
     {"down_out_interval_s": 30.0, "recovery_wgt": 4.0,
-     "recovery_share": 0.727273, "survival_fraction": 1.0,
+     "recovery_share": 0.727273, "scrub_stagger_period_s": 8.0,
+     "survival_fraction": 1.0,
      "availability_mean": 1.0, "ttzd_mean_s": 0.9375},
     {"down_out_interval_s": 600.0, "recovery_wgt": 1.0,
-     "recovery_share": 0.4, "survival_fraction": 0.9375,
+     "recovery_share": 0.4, "scrub_stagger_period_s": 0.0,
+     "survival_fraction": 0.9375,
      "availability_mean": 0.999, "ttzd_mean_s": 2.5},
 ]
 
@@ -910,6 +912,7 @@ def test_fleet_record_schema():
     # sweep picks + grid, and the flat durability_* block
     assert rec["fleet_best_down_out_interval_s"] == 30.0
     assert rec["fleet_best_recovery_share"] == 0.727273
+    assert rec["fleet_best_scrub_stagger_period_s"] == 8.0
     assert rec["fleet_sweep_grid"][1]["survival_fraction"] == 0.9375
     assert rec["durability_mttdl_censored"] is False
     assert rec["durability_codec"] == "reed-solomon"
@@ -961,6 +964,7 @@ def test_fleet_record_harvested_by_decide_defaults(tmp_path):
     # the sweep picks decide_defaults turns into config defaults
     assert g["fleet_best_down_out_interval_s"] == 30.0
     assert g["fleet_best_recovery_share"] == 0.727273
+    assert g["fleet_best_scrub_stagger_period_s"] == 8.0
     # typed DURABILITY_* fields: the Monte Carlo verdict and its key
     assert g["durability_survival_fraction"] == 0.99609375
     assert g["durability_n_lost"] == 1
@@ -993,3 +997,131 @@ def test_crush_record_provenance_harvested_by_decide_defaults(tmp_path):
     assert g["kernel_mode_source"] == "defaults_file"
     assert "kernel_gate" not in g  # only present when the gate decided
     assert g["fused_pipeline"] is False
+
+
+# --- config6_recovery --divergent JSON schema ---
+
+
+def _divergent_result(*, converged=True, laggy=()):
+    from ceph_tpu.recovery.reconcile import DivergentResult, RoundResult
+
+    rounds = [
+        RoundResult(round=0, target_step=8, steps=(8, 8), epochs=(4, 4),
+                    fingerprints=(11, 11), laggy=(), converged=True,
+                    diverged=False, retries=0, backoff_epochs=0),
+        RoundResult(round=1, target_step=16, steps=(16, 12),
+                    epochs=(6, 5), fingerprints=(12, 13), laggy=(),
+                    converged=False, diverged=False, retries=1,
+                    backoff_epochs=2),
+        RoundResult(round=2, target_step=18, steps=(18, 18),
+                    epochs=(7, 7), fingerprints=(14, 14), laggy=laggy,
+                    converged=converged, diverged=False, retries=0,
+                    backoff_epochs=0),
+    ]
+    return DivergentResult(
+        rounds=rounds, merged=None, states=[], converged=converged,
+        laggy=tuple(laggy), total_steps=18,
+    )
+
+
+def _fake_rank_state():
+    from types import SimpleNamespace
+
+    import numpy as np
+
+    from ceph_tpu.recovery import reconcile
+
+    lanes = {
+        f: np.full(4, 1, np.int32) for f in reconcile._FP_LANES
+    }
+    lanes["epoch"] = np.int64(7)
+    lanes["step"] = np.int64(18)
+    pool = SimpleNamespace(
+        osd_up=np.ones(4, np.bool_),
+        osd_exists=np.ones(4, np.bool_),
+        osd_weight=np.full(4, 0x10000, np.uint32),
+        primary_affinity=np.full(4, 0x10000, np.uint32),
+    )
+    return SimpleNamespace(pool=pool, **lanes)
+
+
+class _FakeRankTimeline:
+    @staticmethod
+    def rank_series():
+        return {"rank_n_live": [2, 2, 2], "rank_n_laggy": [0, 0, 0],
+                "rank_diverged": [0, 1, 0]}
+
+
+class _FakeRankReport:
+    from types import SimpleNamespace as _NS
+
+    status = "HEALTH_OK"
+    checks = [_NS(name="SLO_RANK_STALL", status="HEALTH_OK")]
+
+
+def _divergent_record(**kw):
+    return config6.build_divergent_record(
+        "flap", _divergent_result(**kw), _FakeRankTimeline(),
+        _FakeRankReport(), 52.5, "tpu",
+        {"n_compiles": 9, "host_transfers": 6}, {"n_compiles": 9},
+        [_fake_rank_state(), _fake_rank_state()],
+    )
+
+
+def test_divergent_record_schema():
+    import json
+
+    rec = _divergent_record()
+    assert rec["metric"] == "divergent_detect_to_converge_rounds"
+    # round 1 disagreed, round 2 agreed: one-round convergence latency
+    assert rec["value"] == 1 and rec["unit"] == "rounds"
+    assert rec["divergent_scenario"] == "flap"
+    assert rec["divergent_n_ranks"] == 2
+    assert rec["divergent_n_epochs"] == 18
+    assert rec["divergent_rounds"] == 3
+    assert rec["divergent_converged"] is True
+    assert rec["divergent_laggy_ranks"] == []
+    assert rec["divergent_stalled"] is False
+    assert rec["divergent_round_rate_per_sec"] == 52.5
+    assert rec["divergent_retries_total"] == 1
+    assert rec["divergent_backoff_epochs_total"] == 2
+    # identical fake states fingerprint identically: the converged bar
+    panel = rec["divergent_rank_panel"]
+    assert [p["rank"] for p in panel] == [0, 1]
+    assert panel[0]["step"] == 18 and panel[0]["epoch"] == 7
+    assert panel[0]["fingerprint"] == panel[1]["fingerprint"] > 0
+    assert rec["divergent_health_status"] == "HEALTH_OK"
+    assert rec["divergent_slo_checks"] == {"SLO_RANK_STALL": "HEALTH_OK"}
+    assert rec["divergent_rank_series"]["rank_diverged"] == [0, 1, 0]
+    assert rec["n_compiles"] == 9 and rec["host_transfers"] == 6
+    json.dumps(rec)  # one JSON line, always serializable
+
+
+def test_divergent_record_stalled():
+    rec = _divergent_record(converged=False, laggy=(1,))
+    assert rec["divergent_stalled"] is True
+    assert rec["divergent_laggy_ranks"] == [1]
+    assert rec["divergent_converged"] is False
+    # never re-converged: latency pinned at rounds-since-detection
+    assert rec["value"] == 2
+
+
+def test_divergent_record_harvested_by_decide_defaults(tmp_path):
+    import json
+
+    rec = _divergent_record()
+    p = tmp_path / "session.log"
+    p.write_text(json.dumps(rec) + "\n")
+    dd = _load_dd("divergent")
+    g = dd.harvest_guard([str(p)])["divergent_detect_to_converge_rounds"]
+    assert g["divergent_n_ranks"] == 2
+    assert g["divergent_n_epochs"] == 18
+    assert g["divergent_rounds"] == 3
+    assert g["divergent_retries_total"] == 1
+    assert g["divergent_backoff_epochs_total"] == 2
+    assert g["divergent_round_rate_per_sec"] == 52.5
+    assert g["divergent_converged"] is True
+    assert g["divergent_stalled"] is False
+    assert g["divergent_scenario"] == "flap"
+    assert g["divergent_health_status"] == "HEALTH_OK"
+    assert g["steady_state_clean"] is True

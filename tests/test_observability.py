@@ -789,3 +789,105 @@ def test_two_process_identical_health_series_with_slo_transition():
     # moves and returns to zero
     undersized = r0["series"]["undersized"]
     assert max(undersized) > 0 and undersized[-1] == 0
+
+
+# ---- journal rotation (satellite) ------------------------------------
+
+
+def test_journal_rotation_keeps_newest_segments(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    with EventJournal(path=path, max_bytes=400, max_segments=3) as j:
+        for i in range(60):
+            j.event("tick", i=i)
+        # in-memory records are never rotated away
+        assert len(j.by_name("tick")) == 60
+    # the live file stays under the cap; rotated segments exist
+    assert os.path.getsize(path) <= 400
+    assert os.path.exists(path + ".1") and os.path.exists(path + ".2")
+    # max_segments bounds disk: never a .3
+    assert not os.path.exists(path + ".3")
+    back = EventJournal.read_rotated(path)
+    idx = [r["attrs"]["i"] for r in back if r["name"] == "tick"]
+    # oldest-first concatenation, newest records always survive
+    assert idx == sorted(idx)
+    assert idx[-1] == 59
+    # the oldest rotated-away prefix is gone, the kept tail contiguous
+    assert idx == list(range(idx[0], 60))
+
+
+def test_journal_rotation_single_segment_truncates(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    with EventJournal(path=path, max_bytes=200, max_segments=1) as j:
+        for i in range(40):
+            j.event("tick", i=i)
+    assert not os.path.exists(path + ".1")
+    back = EventJournal.read_rotated(path)
+    assert back and back[-1]["attrs"]["i"] == 39
+
+
+def test_journal_rotation_validation():
+    with pytest.raises(ValueError):
+        EventJournal(max_bytes=-1)
+    with pytest.raises(ValueError):
+        EventJournal(max_bytes=10, max_segments=0)
+
+
+def test_journal_rotation_resumes_size_accounting(tmp_path):
+    # reopening an existing journal seeds the size counter from disk,
+    # so the cap holds across process restarts
+    path = str(tmp_path / "j.jsonl")
+    with EventJournal(path=path, max_bytes=300) as j:
+        for i in range(10):
+            j.event("tick", i=i)
+    with EventJournal(path=path, max_bytes=300) as j:
+        for i in range(10, 40):
+            j.event("tick", i=i)
+    assert os.path.getsize(path) <= 300
+    assert EventJournal.read_rotated(path)[-1]["attrs"]["i"] == 39
+
+
+def test_journal_unbounded_never_rotates(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    with EventJournal(path=path) as j:
+        for i in range(200):
+            j.event("tick", i=i)
+    assert not os.path.exists(path + ".1")
+    assert len(EventJournal.read(path)) == 200
+
+
+# ---- divergent-rank timeline hooks + SLO_RANK_STALL (satellite) ------
+
+
+def test_health_timeline_rank_hooks_and_series():
+    tl = HealthTimeline(lambda: 0.0, k=4)
+    assert tl.rank_series() == {}
+    assert tl.max_rank_stall_rounds() == 0
+    tl.note_rank_round(n_live=2, laggy=0, diverged=False)
+    tl.note_rank_round(n_live=1, laggy=1, diverged=True)
+    tl.note_rank_stall(1, 3)
+    tl.note_rank_stall(1, 5)   # keeps the max
+    tl.note_rank_stall(0, 2)
+    cols = tl.rank_series()
+    assert cols["rank_n_live"] == [2, 1]
+    assert cols["rank_n_laggy"] == [0, 1]
+    assert cols["rank_diverged"] == [0, 1]
+    assert tl.max_rank_stall_rounds() == 5
+    # rank columns ride along in the full series dict
+    assert "rank_n_live" in tl.series()
+
+
+def test_slo_rank_stall_grades():
+    spec = SLOSpec(max_rank_stall_rounds=2)
+    # no divergent-rank run: vacuously OK with an explicit detail
+    tl = HealthTimeline(lambda: 0.0, k=4)
+    rep = evaluate(tl, spec)
+    c = rep.check("SLO_RANK_STALL")
+    assert c.status == HEALTH_OK and "no divergent-rank" in c.detail
+    # stalls inside the budget: OK; beyond: ERR
+    tl.note_rank_round(n_live=2, laggy=0, diverged=False)
+    tl.note_rank_stall(1, 1)
+    assert evaluate(tl, spec).check("SLO_RANK_STALL").status == HEALTH_OK
+    tl.note_rank_stall(1, 7)
+    rep = evaluate(tl, spec)
+    assert rep.check("SLO_RANK_STALL").status == HEALTH_ERR
+    assert rep.status == HEALTH_ERR

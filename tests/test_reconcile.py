@@ -1,0 +1,640 @@
+"""Divergent multi-rank chaos: rank-scoped specs, the view-merge
+lattice, and the stall-tolerant reconciliation protocol.
+
+Fast tier: spec parsing/validation, schedule decoding, the stall-window
+fixpoint, merge-algebra unit laws plus the reporter-quorum regression
+fixture, and the in-process :class:`DivergentDriver` acceptance runs
+(sub-epoch skew bit-equal to the single-rank reference; cross-epoch
+skew detected then re-converged; finite stall -> laggy -> revival with
+delta-tape catch-up; permanent stall -> :class:`RankStalledError` +
+``rankstalled`` flag + ``SLO_RANK_STALL`` breach).  Slow tier: two OS
+processes under ``debug_rank_checks`` run the multihost
+:class:`RankReconciler` to bit-equal convergence, and a permanent
+``rankstall:`` raises on BOTH ranks within the bounded retry budget.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from dataclasses import replace
+
+import numpy as np
+import pytest
+
+import jax
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.models.clusters import build_osdmap
+from ceph_tpu.obs import (
+    HEALTH_ERR,
+    HEALTH_OK,
+    EventJournal,
+    HealthTimeline,
+    SLOSpec,
+    evaluate,
+)
+from ceph_tpu.recovery.chaos import ChaosEvent, ChaosTimeline
+from ceph_tpu.recovery.failure import (
+    UnknownSpecKeyError,
+    check_rank,
+    parse_spec,
+)
+from ceph_tpu.recovery.liveness import ClusterFlags
+from ceph_tpu.recovery.reconcile import (
+    DivergentDriver,
+    RankStalledError,
+    _stall_allowed,
+    merge_views,
+    normalize_view,
+    rank_schedule,
+    rank_view_timeline,
+    strip_rank_specs,
+    view_fingerprint,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _map(n_osd=64, pg_num=128):
+    return build_osdmap(n_osd, pg_num=pg_num, size=6, pool_kind="erasure")
+
+
+def _cfg(**kw):
+    cfg = Config(env={})
+    cfg.set("reconcile_every_epochs", 4)
+    for k, v in kw.items():
+        cfg.set(k, v)
+    return cfg
+
+
+def _leaves_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    return [
+        i for i, (x, y) in enumerate(zip(la, lb))
+        if not np.array_equal(np.asarray(x), np.asarray(y))
+    ]
+
+
+# ---- rank-scoped spec parsing (satellite: loud validation) -----------
+
+
+def test_rank_spec_roundtrip():
+    s = parse_spec("rankdelay:1.2500")
+    assert s.scope == "rankdelay" and s.is_rank
+    assert s.rank() == 1 and s.rank_arg() == 2500
+    assert s.action == "skew"
+    # canonicalized: leading zeros collapse to one event identity
+    assert str(parse_spec("rankdelay:01.040")) == str(
+        parse_spec("rankdelay:1.40")
+    )
+    d = parse_spec("rankdrop:0")
+    assert d.rank() == 0 and d.action == "drop"
+    assert parse_spec("rankdrop:0:restore").action == "restore"
+    st = parse_spec("rankstall:1.0")
+    assert st.rank() == 1 and st.rank_arg() == 0  # 0 = permanent
+
+
+def test_rank_spec_invalid_is_loud():
+    # four invalid shapes, each a loud UnknownSpecKeyError at parse
+    with pytest.raises(UnknownSpecKeyError):
+        parse_spec("rankdelay:1")          # missing DELAY_MS
+    with pytest.raises(UnknownSpecKeyError):
+        parse_spec("rankdelay:1.0")        # 0 ms delay is a no-op
+    with pytest.raises(UnknownSpecKeyError):
+        parse_spec("rankstall:-1.5")       # negative rank
+    with pytest.raises(UnknownSpecKeyError):
+        parse_spec("rankdrop:0.5")         # rankdrop takes RANK only
+    # range check against the process count: loud on every consumer
+    with pytest.raises(UnknownSpecKeyError):
+        check_rank(parse_spec("rankdrop:5"), 2)
+    assert check_rank(parse_spec("rankdrop:1"), 2) == 1
+
+
+def test_rank_spec_rejected_by_tape_compiler():
+    from ceph_tpu.recovery.superstep import compile_event_tape
+
+    tl = ChaosTimeline([
+        ChaosEvent(0.1, (parse_spec("rankdelay:0.40"),)),
+    ])
+    with pytest.raises(ValueError):
+        compile_event_tape(tl, _map(16, 32))
+
+
+# ---- schedule decoding ------------------------------------------------
+
+
+def _sched_timeline():
+    return ChaosTimeline([
+        ChaosEvent(1.0, (parse_spec("rankdelay:1.1000"),)),
+        ChaosEvent(2.0, (parse_spec("rankdrop:0"),
+                         parse_spec("rankstall:1.4"))),
+        ChaosEvent(3.0, (parse_spec("rankdrop:0:restore"),)),
+        ChaosEvent(0.5, (parse_spec("osd:3:down_out"),)),
+    ])
+
+
+def test_rank_schedule_decodes_directives():
+    tl = _sched_timeline()
+    s1 = rank_schedule(tl, 1, 2)
+    assert s1.delays == ((1.0, 1.0),)
+    assert s1.stalls == ((2.0, 4),)
+    assert s1.drops == ()
+    # skew accumulates only from directives already in force
+    assert s1.skew_at(0.5) == 0.0
+    assert s1.skew_at(1.5) == 1.0
+    s0 = rank_schedule(tl, 0, 2)
+    assert s0.drops == ((2.0, 3.0),)
+    assert s0.reporting(1.9) and not s0.reporting(2.5)
+    assert s0.reporting(3.0)  # half-open window
+
+
+def test_rank_schedule_unmatched_drop_runs_forever():
+    tl = ChaosTimeline([ChaosEvent(1.0, (parse_spec("rankdrop:0"),))])
+    s = rank_schedule(tl, 0, 1)
+    assert s.drops == ((1.0, float("inf")),)
+    assert not s.reporting(1e9)
+
+
+def test_rank_view_timeline_shifts_and_strips():
+    tl = _sched_timeline()
+    # rank 0 has no delay: the cluster event keeps its time
+    v0 = rank_view_timeline(tl, 0, 2)
+    assert [ev.t for ev in v0.events()] == [0.5]
+    assert all(
+        not s.is_rank for ev in v0.events() for s in ev.specs
+    )
+    # rank 1 sees events after t=1.0 one second late; the t=0.5 event
+    # predates the directive and is unshifted
+    tl2 = ChaosTimeline(
+        list(tl.events()) + [ChaosEvent(4.0, (parse_spec("slow:7"),))]
+    )
+    v1 = rank_view_timeline(tl2, 1, 2)
+    assert [ev.t for ev in v1.events()] == [0.5, 5.0]
+    stripped = strip_rank_specs(tl2)
+    assert [ev.t for ev in stripped.events()] == [0.5, 4.0]
+
+
+def test_stall_allowed_fixpoint():
+    # inside the window: park at its start; past it: full catch-up
+    assert _stall_allowed(((4, 8),), 6) == 4
+    assert _stall_allowed(((4, 8),), 8) == 8
+    assert _stall_allowed(((4, 8),), 3) == 3
+    # chained windows compose through the fixpoint: parking inside one
+    # window can land inside an earlier one, which parks again
+    assert _stall_allowed(((2, 4), (4, 6)), 5) == 4
+    assert _stall_allowed(((3, 5), (1, 4)), 4) == 1
+    # permanent window (rankstall:R.0) never releases
+    assert _stall_allowed(((3, sys.maxsize),), 10**9) == 3
+
+
+# ---- merge algebra ----------------------------------------------------
+
+
+def _two_rank_driver(tl=None, **kw):
+    tl = tl if tl is not None else ChaosTimeline([])
+    return DivergentDriver(
+        _map(32, 64), tl, 2, config=_cfg(), seed=2, n_ops=32, **kw
+    )
+
+
+def test_quorum_merge_regression():
+    """The equal-epoch conflicting-down-bits fixture: two ranks at the
+    same map epoch disagree on a detector down bit.  Quorum rules
+    decide — a claim backed by >= min_reporters survives the merge
+    (pessimistic union), a single-reporter claim is filtered, and a
+    rankdrop window voids the dropped rank's evidence entirely."""
+    d = _two_rank_driver()
+    base = d.states[0]
+    a = replace(
+        base,
+        down=base.down.at[3].set(True),
+        down_since=base.down_since.at[3].set(1.0),
+        reporters=base.reporters.at[3].set(2),
+    )
+    b = replace(
+        base,
+        down=base.down.at[7].set(True),
+        down_since=base.down_since.at[7].set(2.0),
+        reporters=base.reporters.at[7].set(2),
+    )
+    # both claims reach quorum: the join is the pessimistic union,
+    # and it commutes
+    for x, y in ((a, b), (b, a)):
+        m = jax.device_get(merge_views(x, y, min_reporters=2))
+        assert bool(m.down[3]) and bool(m.down[7])
+        assert m.down_since[3] == 1.0 and m.down_since[7] == 2.0
+    # a single reporter misses the quorum: the claim dies in normalize
+    a1 = replace(a, reporters=base.reporters.at[3].set(1))
+    m = jax.device_get(merge_views(a1, b, min_reporters=2))
+    assert not bool(m.down[3]) and m.down_since[3] == 0.0
+    assert bool(m.down[7])
+    # a rankdrop window collapses the dropped rank's whole observation
+    m = jax.device_get(merge_views(a, b, min_reporters=2,
+                                   report_b=False))
+    assert bool(m.down[3]) and not bool(m.down[7])
+    assert m.down_since[7] == 0.0
+
+
+def test_merge_idempotent_on_normalized_domain():
+    d = _two_rank_driver()
+    base = d.states[0]
+    a = replace(
+        base,
+        down=base.down.at[5].set(True),
+        down_since=base.down_since.at[5].set(3.0),
+        reporters=base.reporters.at[5].set(1),
+    )
+    m = merge_views(a, base)
+    again = merge_views(m, m)
+    assert _leaves_equal(
+        jax.device_get(m), jax.device_get(again)
+    ) == []
+
+
+def test_normalize_is_a_projection():
+    d = _two_rank_driver()
+    base = d.states[0]
+    a = replace(
+        base,
+        down=base.down.at[2].set(True),
+        down_since=base.down_since.at[2].set(4.0),
+        reporters=base.reporters.at[2].set(0),
+    )
+    once = normalize_view(a, min_reporters=1)
+    twice = normalize_view(once, min_reporters=1)
+    assert _leaves_equal(
+        jax.device_get(once), jax.device_get(twice)
+    ) == []
+    # zero witnesses: min_reporters=1 filters the unwitnessed claim
+    assert not bool(jax.device_get(once.down)[2])
+    assert jax.device_get(once.down_since)[2] == 0.0
+
+
+# ---- in-process divergent runs ---------------------------------------
+
+
+def test_subepoch_skew_bitequal_all_leaves():
+    """A 40 ms observation skew never crosses an epoch boundary
+    (dt=250 ms): both ranks apply every event on the same step, so
+    every round converges and each rank's final state is bit-equal to
+    the single-rank reference on EVERY leaf."""
+    tl = ChaosTimeline([
+        ChaosEvent(0.05, (parse_spec("rankdelay:1.40"),)),
+        ChaosEvent(0.30, (parse_spec("osd:3:down_out"),)),
+        ChaosEvent(1.30, (parse_spec("osd:7:down_out"),)),
+    ])
+    d = DivergentDriver(_map(), tl, 2, config=_cfg(), seed=3, n_ops=64)
+    res = d.run(16)
+    assert res.converged and res.laggy == ()
+    assert all(r.converged for r in res.rounds)
+    assert res.detection_to_convergence_rounds() is None
+    ref = jax.device_get(d.reference_state(res.total_steps))
+    for s in res.states:
+        sh = jax.device_get(s)
+        assert _leaves_equal(sh, ref) == []
+        assert view_fingerprint(sh) == view_fingerprint(ref)
+    # the injected downs arrived via the map, not the detector
+    assert not jax.device_get(res.states[0]).pool.osd_up[3]
+    # the merged consensus carries the same epoch-versioned content
+    assert view_fingerprint(jax.device_get(res.merged)) == (
+        view_fingerprint(ref)
+    )
+
+
+def test_cross_epoch_skew_detected_then_reconverges():
+    """A 2.5 s skew (10 epochs) makes rank 1 observably stale at
+    intermediate rounds — staleness, not divergence, so no retries
+    burn — and once the skewed tape drains the views re-converge
+    bit-equal to the reference."""
+    tl = ChaosTimeline([
+        ChaosEvent(0.05, (parse_spec("rankdelay:1.2500"),)),
+        ChaosEvent(0.30, (parse_spec("osd:3:down_out"),)),
+        ChaosEvent(0.80, (parse_spec("osd:9:down_out"),)),
+    ])
+    d = DivergentDriver(_map(), tl, 2, config=_cfg(), seed=4, n_ops=64)
+    res = d.run(24)
+    assert res.converged and res.laggy == ()
+    d2c = res.detection_to_convergence_rounds()
+    assert d2c is not None and d2c >= 1
+    # staleness never trips the divergence-retry loop
+    assert all(r.retries == 0 and not r.diverged for r in res.rounds)
+    ref = jax.device_get(d.reference_state(res.total_steps))
+    for s in res.states:
+        sh = jax.device_get(s)
+        assert view_fingerprint(sh) == view_fingerprint(ref)
+        assert not sh.pool.osd_up[3] and not sh.pool.osd_up[9]
+
+
+def test_finite_stall_marks_laggy_then_revives(tmp_path):
+    """A 20-epoch rankstall parks rank 1 past the laggy deadline; the
+    survivor keeps reconciling, and when the window releases the rank
+    replays the whole missed span (delta-tape catch-up), re-converges
+    bit-equal, and clears the rankstalled flag."""
+    jpath = str(tmp_path / "reconcile.jsonl")
+    journal = EventJournal(path=jpath)
+    flags = ClusterFlags()
+    health = HealthTimeline(lambda: 0.0, k=4)
+    tl = ChaosTimeline([
+        ChaosEvent(0.30, (parse_spec("osd:3:down_out"),)),
+        ChaosEvent(1.00, (parse_spec("rankstall:1.20"),)),
+    ])
+    d = DivergentDriver(
+        _map(), tl, 2, config=_cfg(), seed=5, n_ops=64,
+        journal=journal, flags=flags, health=health,
+    )
+    res = d.run(32)
+    assert res.converged and res.laggy == ()
+    assert "rankstalled" not in flags
+    # the stall was visible: some round carried rank 1 as laggy
+    assert any(1 in r.laggy for r in res.rounds)
+    names = [r["name"] for r in journal.records]
+    assert "reconcile.laggy" in names
+    assert "reconcile.revived" in names
+    assert "reconcile.catchup" in names
+    # the catch-up delta spans the missed window in one replay
+    catchup = journal.by_name("reconcile.catchup")[0]["attrs"]
+    assert catchup["rank"] == 1 and catchup["n_steps"] > 1
+    # revival replays to bit-equality with the reference
+    ref = jax.device_get(d.reference_state(res.total_steps))
+    for s in res.states:
+        assert view_fingerprint(jax.device_get(s)) == (
+            view_fingerprint(ref)
+        )
+    # the health timeline saw the stall, inside a generous budget
+    assert health.max_rank_stall_rounds() >= 3
+    assert evaluate(
+        health, SLOSpec(max_rank_stall_rounds=100)
+    ).check("SLO_RANK_STALL").status == HEALTH_OK
+
+
+def test_permanent_stall_raises_with_flag_and_slo_breach(tmp_path):
+    """``rankstall:1.0`` (permanent): the survivor proceeds for the
+    deadline + retry budget, then the protocol raises a typed
+    :class:`RankStalledError` — no hang — with the ``rankstalled``
+    cluster flag set and ``SLO_RANK_STALL`` breached."""
+    jpath = str(tmp_path / "stall.jsonl")
+    journal = EventJournal(path=jpath)
+    flags = ClusterFlags()
+    health = HealthTimeline(lambda: 0.0, k=4)
+    tl = ChaosTimeline([
+        ChaosEvent(0.30, (parse_spec("osd:3:down_out"),)),
+        ChaosEvent(1.00, (parse_spec("rankstall:1.0"),)),
+    ])
+    d = DivergentDriver(
+        _map(), tl, 2, config=_cfg(), seed=6, n_ops=64,
+        journal=journal, flags=flags, health=health,
+    )
+    with pytest.raises(RankStalledError) as e:
+        d.run(16)
+    assert "rank(s) [1]" in str(e.value)
+    assert "rankstalled" in flags
+    # bounded: the dead verdict lands at deadline + retry_max rounds
+    # of zero progress, never later
+    proto = d.protocol
+    assert int(proto.stall_rounds[1]) == proto.deadline + proto.retry_max
+    names = [r["name"] for r in journal.records]
+    assert "reconcile.laggy" in names and "reconcile.stalled" in names
+    assert "reconcile.revived" not in names
+    # SLO breach on the recorded timeline
+    rep = evaluate(health, SLOSpec(max_rank_stall_rounds=1))
+    assert rep.check("SLO_RANK_STALL").status == HEALTH_ERR
+    assert rep.status == HEALTH_ERR
+    # the survivor's view kept advancing past the stall point
+    assert d.cur[0] > d.cur[1] == 3
+
+
+def test_rankdrop_window_gates_merge_evidence():
+    """A rank inside a rankdrop window still advances and still joins
+    rounds (participation never stops), but its observation lanes are
+    voided in the merged view while the window is open."""
+    tl = ChaosTimeline([
+        ChaosEvent(0.30, (parse_spec("osd:3:down_out"),)),
+        ChaosEvent(0.50, (parse_spec("rankdrop:1"),)),
+    ])
+    d = DivergentDriver(_map(), tl, 2, config=_cfg(), seed=7, n_ops=64)
+    res = d.run(8)
+    # map-owned lanes flow from the highest-epoch owner regardless of
+    # the drop; the run converges (both ranks applied the same tape)
+    assert res.converged
+    assert not jax.device_get(res.merged).pool.osd_up[3]
+
+
+def test_single_rank_degenerates_to_plain_driver():
+    tl = ChaosTimeline([
+        ChaosEvent(0.30, (parse_spec("osd:3:down_out"),)),
+    ])
+    d = DivergentDriver(_map(), tl, 1, config=_cfg(), seed=8, n_ops=64)
+    res = d.run(8)
+    assert res.converged and res.laggy == ()
+    ref = jax.device_get(d.reference_state(res.total_steps))
+    assert _leaves_equal(jax.device_get(res.states[0]), ref) == []
+
+
+def test_driver_validates_rank_specs_loudly():
+    tl = ChaosTimeline([
+        ChaosEvent(0.1, (parse_spec("rankdelay:3.40"),)),
+    ])
+    with pytest.raises(UnknownSpecKeyError):
+        DivergentDriver(_map(16, 32), tl, 2, config=_cfg(), n_ops=16)
+    with pytest.raises(ValueError):
+        DivergentDriver(_map(16, 32), tl, 0, config=_cfg(), n_ops=16)
+
+
+# ---- two-process multihost acceptance (slow tier) --------------------
+
+_CHILD_CONVERGE = r"""
+import json, os, sys
+import numpy as np
+from ceph_tpu.parallel import multihost
+
+rank = int(sys.argv[1])
+multihost.init(coordinator=sys.argv[2], num_processes=2, process_id=rank)
+import jax
+assert jax.process_count() == 2, jax.process_count()
+
+from ceph_tpu.common.config import global_config
+from ceph_tpu.models.clusters import build_osdmap
+from ceph_tpu.recovery.chaos import ChaosEvent, ChaosTimeline
+from ceph_tpu.recovery.failure import parse_spec
+from ceph_tpu.recovery.reconcile import (
+    RankReconciler, strip_rank_specs, view_fingerprint,
+)
+from ceph_tpu.recovery.superstep import EpochDriver
+
+cfg = global_config()
+cfg.set("debug_rank_checks", True)
+cfg.set("reconcile_every_epochs", 4)
+
+m = build_osdmap(32, pg_num=64, size=6, pool_kind="erasure")
+tl = ChaosTimeline([
+    ChaosEvent(0.05, (parse_spec("rankdelay:1.2500"),)),
+    ChaosEvent(0.30, (parse_spec("osd:3:down_out"),)),
+    ChaosEvent(0.80, (parse_spec("osd:9:down_out"),)),
+])
+rr = RankReconciler(m, tl, rank=rank, n_ranks=2, seed=5, n_ops=32)
+res = rr.run(24)
+
+# the single-rank unskewed reference, through the same superstep
+ref_d = EpochDriver(m, strip_rank_specs(tl), seed=5, n_ops=32)
+scan = ref_d.compile_superstep()
+import jax.numpy as jnp
+ref, _ = scan(ref_d._init_state, jnp.arange(res.total_steps,
+                                            dtype=jnp.int32))
+ref_h = jax.device_get(ref)
+mine = jax.device_get(res.states[0])
+
+print("CHILD_RESULT " + json.dumps({
+    "rank": rank,
+    "converged": bool(res.converged),
+    "laggy": list(res.laggy),
+    "rounds": len(res.rounds),
+    "d2c": res.detection_to_convergence_rounds(),
+    "fp": view_fingerprint(mine),
+    "fp_ref": view_fingerprint(ref_h),
+    "osd3_up": bool(mine.pool.osd_up[3]),
+}), flush=True)
+"""
+
+_CHILD_STALL = r"""
+import json, os, sys
+import numpy as np
+from ceph_tpu.parallel import multihost
+
+rank = int(sys.argv[1])
+multihost.init(coordinator=sys.argv[2], num_processes=2, process_id=rank)
+import jax
+
+from ceph_tpu.common.config import global_config
+from ceph_tpu.models.clusters import build_osdmap
+from ceph_tpu.obs import HealthTimeline, SLOSpec, evaluate
+from ceph_tpu.recovery.chaos import ChaosEvent, ChaosTimeline
+from ceph_tpu.recovery.failure import parse_spec
+from ceph_tpu.recovery.liveness import ClusterFlags
+from ceph_tpu.recovery.reconcile import RankReconciler, RankStalledError
+
+cfg = global_config()
+cfg.set("debug_rank_checks", True)
+cfg.set("reconcile_every_epochs", 4)
+
+m = build_osdmap(32, pg_num=64, size=6, pool_kind="erasure")
+tl = ChaosTimeline([
+    ChaosEvent(0.30, (parse_spec("osd:3:down_out"),)),
+    ChaosEvent(1.00, (parse_spec("rankstall:1.0"),)),
+])
+flags = ClusterFlags()
+health = HealthTimeline(lambda: 0.0, k=4)
+rr = RankReconciler(m, tl, rank=rank, n_ranks=2, seed=6, n_ops=32,
+                    flags=flags, health=health)
+caught = False
+try:
+    rr.run(16)
+except RankStalledError:
+    caught = True
+
+rep = evaluate(health, SLOSpec(max_rank_stall_rounds=1))
+print("CHILD_RESULT " + json.dumps({
+    "rank": rank,
+    "caught": caught,
+    "flag": "rankstalled" in flags,
+    "slo": rep.check("SLO_RANK_STALL").status,
+    "stall_rounds": int(rr.protocol.stall_rounds[1]),
+    "budget": rr.protocol.deadline + rr.protocol.retry_max,
+}), flush=True)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_pair(child_src):
+    from ceph_tpu.common.hermetic import scrubbed_env
+
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    env = scrubbed_env(_REPO, n_devices=4)
+    # file-backed output: PIPE could deadlock a collective if one
+    # child fills its pipe while the other blocks in a pmax
+    import tempfile
+
+    outs = []
+    with tempfile.TemporaryDirectory() as td:
+        files = [open(os.path.join(td, f"r{r}.out"), "w+") for r in (0, 1)]
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", child_src, str(rank), coord],
+                env=env,
+                cwd=_REPO,
+                stdout=files[rank],
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+            for rank in range(2)
+        ]
+        rcs = []
+        try:
+            for p in procs:
+                rcs.append(p.wait(timeout=300))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            for f in files:
+                f.seek(0)
+                outs.append(f.read())
+                f.close()
+            if rcs != [0, 0]:
+                print("child logs:\n" + "\n".join(o[-2000:] for o in outs))
+        assert rcs == [0, 0], f"children failed {rcs}"
+
+    recs = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("CHILD_RESULT "):
+                rec = json.loads(line[len("CHILD_RESULT "):])
+                recs[rec["rank"]] = rec
+    assert set(recs) == {0, 1}
+    return recs
+
+
+@pytest.mark.slow
+def test_two_process_skewed_ranks_converge_bitequal():
+    """Acceptance: two OS processes with a 10-epoch observation skew
+    between them converge bit-equal to the single-rank reference under
+    ``debug_rank_checks`` (the merged-view sanitizer passes every
+    round on both ranks)."""
+    recs = _run_pair(_CHILD_CONVERGE)
+    r0, r1 = recs[0], recs[1]
+    assert r0["converged"] and r1["converged"]
+    assert r0["laggy"] == [] and r1["laggy"] == []
+    # both ranks reached the same verdict at the same round count
+    assert r0["rounds"] == r1["rounds"]
+    assert r0["d2c"] == r1["d2c"] and r0["d2c"] >= 1
+    # each rank's view is bit-equal to its own unskewed reference,
+    # and the two references agree (one deterministic superstep)
+    assert r0["fp"] == r0["fp_ref"]
+    assert r1["fp"] == r1["fp_ref"]
+    assert r0["fp"] == r1["fp"]
+    assert not r0["osd3_up"] and not r1["osd3_up"]
+
+
+@pytest.mark.slow
+def test_two_process_permanent_stall_raises_on_both_ranks():
+    """Acceptance: an injected permanent ``rankstall:`` produces a
+    typed RankStalledError AND an SLO breach on BOTH ranks within the
+    bounded retry budget — no collective hang."""
+    recs = _run_pair(_CHILD_STALL)
+    for r in (0, 1):
+        assert recs[r]["caught"], recs[r]
+        assert recs[r]["flag"]
+        assert recs[r]["slo"] == "HEALTH_ERR"
+        # bounded: the verdict landed exactly at the budget
+        assert recs[r]["stall_rounds"] == recs[r]["budget"]
