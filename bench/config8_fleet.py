@@ -69,11 +69,26 @@ RECOVERY_WGT_GRID = (1.0, 4.0)
 #: with a full-cluster scrub burst
 SCRUB_STAGGER_GRID = (0.0, 8.0)
 
+#: the geometry sweep: (codec, k, m, placement) axes the ROADMAP
+#: listed as remaining — each point builds its OWN OSDMap (pool kind,
+#: stripe width, CRUSH topology), so durability is compared across
+#: real placement geometries, not just config knobs on one map.
+#: ``crush`` is the default single-rack host-failure-domain tree;
+#: ``crush-multirack`` shrinks hosts_per_rack so the same OSDs spread
+#: over four racks (wider blast-radius isolation, same capacity).
+GEOMETRY_GRID = (
+    ("reed-solomon", 4, 2, "crush"),
+    ("reed-solomon", 2, 2, "crush"),
+    ("replica", 1, 2, "crush"),
+    ("reed-solomon", 4, 2, "crush-multirack"),
+)
+
 
 def build_fleet_record(platform, fleet_rate, seq_cold_rate,
                        seq_warm_rate, bitequal, same_bucket_zero,
                        ftape, est, panel, sweep_grid, best,
-                       n_compiles, n_compiles_first, host_transfers):
+                       n_compiles, n_compiles_first, host_transfers,
+                       geometry_grid=None, geometry_best=None):
     """One JSON line for the fleet headline.
 
     ``value`` is aggregate cluster-epochs/s of the vmapped fleet scan;
@@ -128,6 +143,12 @@ def build_fleet_record(platform, fleet_rate, seq_cold_rate,
         rec["fleet_best_scrub_stagger_period_s"] = float(
             best["scrub_stagger_period_s"]
         )
+    if geometry_grid:
+        rec["fleet_geometry_grid"] = geometry_grid
+        rec["fleet_best_codec"] = str(geometry_best["codec"])
+        rec["fleet_best_ec_k"] = int(geometry_best["ec_k"])
+        rec["fleet_best_ec_m"] = int(geometry_best["ec_m"])
+        rec["fleet_best_placement"] = str(geometry_best["placement"])
     return rec
 
 
@@ -323,6 +344,63 @@ def main() -> None:
             ),
         )
 
+    # -- geometry sweep: codec x k/m x placement ----------------------
+    # each point is its own OSDMap (pool kind, stripe width, CRUSH
+    # topology) driven over the same sampled scenario
+    geometry_grid, geometry_best = [], None
+    if SWEEP:
+        for codec, kk_, mm_, placement in GEOMETRY_GRID:
+            gm = build_osdmap(
+                N_OSDS,
+                pg_num=PG_NUM,
+                size=kk_ + mm_,
+                pool_kind=(
+                    "replicated" if codec == "replica" else "erasure"
+                ),
+                hosts_per_rack=(
+                    2 if placement == "crush-multirack" else 8
+                ),
+            )
+            gfd = FleetDriver(gm, seed=SEED, n_ops=N_OPS)
+            g_fs = gfd.run_fleet(
+                SWEEP_EPOCHS, gfd.sample(SWEEP_FLEET, SCENARIO)
+            )
+            g_est = estimate_durability(
+                g_fs, dt=gfd.driver.dt, scenario=SCENARIO, seed=SEED,
+                n_boot=64, codec=codec, ec_k=kk_, ec_m=mm_,
+                placement=placement,
+                down_out_interval_s=down_out_default,
+            )
+            point = {
+                "codec": codec,
+                "ec_k": kk_,
+                "ec_m": mm_,
+                "placement": placement,
+                "survival_fraction": round(
+                    g_est.survival_fraction, 9
+                ),
+                "availability_mean": round(
+                    g_est.availability_mean, 9
+                ),
+                "ttzd_mean_s": round(g_est.ttzd_mean_s, 6),
+                "mttdl_s": round(g_est.mttdl_s, 3),
+            }
+            geometry_grid.append(point)
+            print(
+                f"geometry {codec} k={kk_} m={mm_} {placement}: "
+                f"survival={point['survival_fraction']:.3f} "
+                f"avail={point['availability_mean']:.6f} "
+                f"ttzd={point['ttzd_mean_s']:.2f}s",
+                file=sys.stderr,
+            )
+        geometry_best = max(
+            geometry_grid,
+            key=lambda p: (
+                p["survival_fraction"], p["availability_mean"],
+                -p["ttzd_mean_s"],
+            ),
+        )
+
     print(
         f"fleet {SCENARIO}: {FLEET} clusters x {EPOCHS} epochs "
         f"({N_OSDS} OSDs / {PG_NUM} PGs / {N_OPS} ops): "
@@ -340,7 +418,7 @@ def main() -> None:
         jax.default_backend(), fleet_rate, seq_cold_rate,
         seq_warm_rate, bitequal, same_bucket_zero, ftape, est, panel,
         sweep_grid, best, guard.n_compiles, warm["n_compiles"],
-        guard.host_transfers,
+        guard.host_transfers, geometry_grid, geometry_best,
     )))
 
 

@@ -1,0 +1,214 @@
+"""BASELINE config 9: crash-consistent checkpoint/restore costs.
+
+Measures the durable-snapshot subsystem
+(:mod:`ceph_tpu.recovery.checkpoint`) on a superstep run:
+
+- **write bandwidth** — bytes of CRC32C-verified lane payload
+  committed per second of wall time across the run's snapshots
+  (tmp + fsync + rename + manifest append included: the durable
+  cost, not the serialization cost);
+- **restore + replay** — wall time to come back from a kill at the
+  run's midpoint: manifest walk + CRC verify + unflatten
+  (``checkpoint_load_s``) and the deterministic tape replay of the
+  discarded tail (``checkpoint_replay_s``);
+- **steady-state overhead** — run time at each ``snapshot_every``
+  interval vs the checkpoint-free baseline
+  (``checkpoint_overhead_panel``, the ``cli.status checkpoint``
+  panel's rows; ``bench/PERF_MODEL.md`` derives the roofline).
+
+Everything is gated on ``checkpoint_bitequal`` — the resumed run's
+:class:`EpochSeries` must exactly match the uninterrupted one over
+all 18 lanes — and ``checkpoint_torn_fallback_ok`` — a corrupted
+newest snapshot must fall back to the previous valid one with a
+``checkpoint.torn`` journal event, never a crash.  Emits one JSON
+line.
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+N_OSDS = int(os.environ.get("CEPH_TPU_BENCH_CKPT_OSDS", 64))
+PG_NUM = int(os.environ.get("CEPH_TPU_BENCH_CKPT_PGS", 128))
+N_OPS = int(os.environ.get("CEPH_TPU_BENCH_CKPT_OPS", 256))
+EPOCHS = int(os.environ.get("CEPH_TPU_BENCH_CKPT_EPOCHS", 256))
+SCENARIO = os.environ.get("CEPH_TPU_BENCH_CKPT_SCENARIO", "flap")
+SEED = int(os.environ.get("CEPH_TPU_BENCH_CKPT_SEED", 0))
+EC_K, EC_M = 4, 2
+#: snapshot intervals for the overhead panel (epochs between commits)
+EVERY_GRID = tuple(
+    int(x) for x in os.environ.get(
+        "CEPH_TPU_BENCH_CKPT_GRID", "16,64"
+    ).split(",") if x
+)
+#: the interval the headline bandwidth / restore legs use
+EVERY = int(os.environ.get("CEPH_TPU_BENCH_CKPT_EVERY", 16))
+
+
+def build_checkpoint_record(platform, bandwidth, write_s, snap_bytes,
+                            n_snaps, load_s, replay_s, bitequal,
+                            torn_ok, overhead_panel, headline_overhead):
+    """One JSON line for the checkpoint headline.
+
+    ``value`` is durable write bandwidth in bytes/s;
+    ``checkpoint_restore_s`` splits into load (manifest walk + CRC +
+    unflatten) and replay (recompute of the discarded tail through
+    the compiled scan).  ``checkpoint_overhead_panel`` carries one
+    row per swept ``snapshot_every``.
+    """
+    return {
+        "metric": "checkpoint_write_bandwidth_bps",
+        "status": "ok",
+        "value": round(bandwidth),
+        "unit": "B/s",
+        "platform": platform,
+        "checkpoint_scenario": SCENARIO,
+        "checkpoint_n_epochs": int(EPOCHS),
+        "checkpoint_snapshot_every": int(EVERY),
+        "checkpoint_snapshot_bytes": int(snap_bytes),
+        "checkpoint_n_snapshots": int(n_snaps),
+        "checkpoint_write_bandwidth_bps": round(bandwidth, 1),
+        "checkpoint_write_s": round(write_s, 6),
+        "checkpoint_restore_s": round(load_s + replay_s, 6),
+        "checkpoint_load_s": round(load_s, 6),
+        "checkpoint_replay_s": round(replay_s, 6),
+        "checkpoint_overhead_fraction": round(headline_overhead, 6),
+        "checkpoint_bitequal": bool(bitequal),
+        "checkpoint_torn_fallback_ok": bool(torn_ok),
+        "checkpoint_overhead_panel": overhead_panel,
+    }
+
+
+def main() -> None:
+    from ceph_tpu.common.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    import jax
+
+    from ceph_tpu.models.clusters import build_osdmap
+    from ceph_tpu.obs.journal import EventJournal
+    from ceph_tpu.recovery.checkpoint import (
+        CheckpointStore,
+        CrashPoint,
+        SimulatedCrash,
+        checkpointed_superstep,
+    )
+    from ceph_tpu.recovery.chaos import build_scenario
+    from ceph_tpu.recovery.superstep import EpochDriver
+
+    m = build_osdmap(
+        N_OSDS, pg_num=PG_NUM, size=EC_K + EC_M, pool_kind="erasure"
+    )
+    d = EpochDriver(m, build_scenario(SCENARIO, m), seed=SEED,
+                    n_ops=N_OPS)
+    root = tempfile.mkdtemp(prefix="ckpt-bench-")
+
+    # warm the compiled scan so every timed leg below measures the
+    # checkpoint machinery, not XLA compiles
+    ref = d.run_superstep(EPOCHS)
+    t0 = time.perf_counter()
+    ref = d.run_superstep(EPOCHS)
+    baseline_s = time.perf_counter() - t0
+
+    # -- headline: write bandwidth at EVERY ----------------------------
+    store = CheckpointStore(os.path.join(root, "headline"))
+    t0 = time.perf_counter()
+    series = checkpointed_superstep(
+        d, EPOCHS, store=store, snapshot_every=EVERY
+    )
+    headline_s = time.perf_counter() - t0
+    n_snaps = len(store.entries())
+    snap_bytes = store.bytes_written // max(n_snaps, 1)
+    # durable cost of the snapshots = run time beyond the baseline
+    write_s = max(headline_s - baseline_s, 1e-9)
+    bandwidth = store.bytes_written / write_s
+    bitequal = ref.diff(series) == []
+    headline_overhead = headline_s / baseline_s - 1.0
+
+    # -- restore + replay: kill at the midpoint, time the comeback ----
+    kill_root = os.path.join(root, "restore")
+    kstore = CheckpointStore(kill_root)
+    try:
+        checkpointed_superstep(
+            d, EPOCHS, store=kstore, snapshot_every=EVERY,
+            crashes=(CrashPoint(EPOCHS // 2, "after"),),
+        )
+        raise AssertionError("seeded crash never fired")
+    except SimulatedCrash:
+        pass
+    rstore = CheckpointStore(kill_root)
+    t0 = time.perf_counter()
+    resumed = rstore.load_latest(d._init_state, with_series=True)
+    load_s = time.perf_counter() - t0
+    assert resumed is not None
+    rstore2 = CheckpointStore(kill_root)
+    t0 = time.perf_counter()
+    series2 = checkpointed_superstep(
+        d, EPOCHS, store=rstore2, snapshot_every=EVERY
+    )
+    replay_s = max(time.perf_counter() - t0 - load_s, 0.0)
+    bitequal = bitequal and ref.diff(series2) == []
+
+    # -- torn-write fallback: corrupt the newest snapshot -------------
+    journal = EventJournal()
+    tstore = CheckpointStore(kill_root, journal=journal)
+    newest = tstore.entries()[-1]["file"]
+    path = os.path.join(kill_root, newest)
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[: len(blob) // 2])
+    torn_ok = (
+        tstore.load_latest(d._init_state) is not None
+        and len(journal.by_name("checkpoint.torn")) == 1
+        and len(journal.by_name("checkpoint.restore")) == 1
+    )
+
+    # -- overhead panel: run time vs snapshot_every --------------------
+    overhead_panel = []
+    for every in EVERY_GRID:
+        proot = os.path.join(root, f"panel-{every}")
+        pstore = CheckpointStore(proot)
+        t0 = time.perf_counter()
+        pseries = checkpointed_superstep(
+            d, EPOCHS, store=pstore, snapshot_every=every
+        )
+        run_s = time.perf_counter() - t0
+        bitequal = bitequal and ref.diff(pseries) == []
+        overhead_panel.append({
+            "snapshot_every": int(every),
+            "n_snapshots": len(pstore.entries()),
+            "run_s": round(run_s, 6),
+            "baseline_s": round(baseline_s, 6),
+            "overhead_fraction": round(run_s / baseline_s - 1.0, 6),
+        })
+        print(
+            f"overhead every={every}: {run_s:.3f}s vs "
+            f"{baseline_s:.3f}s baseline "
+            f"({run_s / baseline_s - 1.0:+.3f})",
+            file=sys.stderr,
+        )
+
+    shutil.rmtree(root, ignore_errors=True)
+    print(
+        f"checkpoint {SCENARIO}: {EPOCHS} epochs every {EVERY}: "
+        f"{bandwidth:,.0f} B/s durable ({snap_bytes:,} B/snapshot x "
+        f"{n_snaps}), restore {load_s + replay_s:.3f}s "
+        f"(load {load_s:.3f}s + replay {replay_s:.3f}s), "
+        f"bitequal={'ok' if bitequal else 'FAIL'}, "
+        f"torn_fallback={'ok' if torn_ok else 'FAIL'}",
+        file=sys.stderr,
+    )
+    print(json.dumps(build_checkpoint_record(
+        jax.default_backend(), bandwidth, write_s, snap_bytes,
+        n_snaps, load_s, replay_s, bitequal, torn_ok, overhead_panel,
+        headline_overhead,
+    )))
+
+
+if __name__ == "__main__":
+    main()

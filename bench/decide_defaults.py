@@ -186,7 +186,8 @@ EPOCH_BOOL_FIELDS = ("epoch_bitequal", "epoch_superstep_enabled")
 FLEET_INT_FIELDS = ("fleet_n_clusters", "fleet_n_epochs",
                     "fleet_n_osds", "fleet_pg_num", "fleet_n_ops",
                     "fleet_pad", "fleet_rows_pad",
-                    "fleet_seq_clusters_measured")
+                    "fleet_seq_clusters_measured",
+                    "fleet_best_ec_k", "fleet_best_ec_m")
 FLEET_FLOAT_FIELDS = ("fleet_epoch_rate_per_sec",
                       "fleet_seq_epoch_rate_per_sec",
                       "fleet_seq_epoch_rate_warm_per_sec",
@@ -198,7 +199,8 @@ FLEET_FLOAT_FIELDS = ("fleet_epoch_rate_per_sec",
 FLEET_BOOL_FIELDS = ("fleet_bitequal",
                      "fleet_same_bucket_zero_recompile",
                      "fleet_seq_includes_compile")
-FLEET_STR_FIELDS = ("fleet_scenario",)
+FLEET_STR_FIELDS = ("fleet_scenario", "fleet_best_codec",
+                    "fleet_best_placement")
 
 # Monte Carlo durability fields (config8_fleet): the
 # ``DurabilityEstimate.to_dict`` surface — survival / MTTDL with
@@ -237,6 +239,28 @@ DIVERGENT_INT_FIELDS = ("divergent_n_ranks", "divergent_n_epochs",
 DIVERGENT_FLOAT_FIELDS = ("divergent_round_rate_per_sec",)
 DIVERGENT_BOOL_FIELDS = ("divergent_converged", "divergent_stalled")
 DIVERGENT_STR_FIELDS = ("divergent_scenario", "divergent_health_status")
+
+# Checkpoint/restore fields (config9_checkpoint): durable-snapshot
+# write bandwidth, restore (load + WAL/tape replay) wall time, and the
+# steady-state overhead each ``snapshot_every`` interval costs a
+# superstep run.  ``checkpoint_bitequal`` gates everything (a resumed
+# run that is not bit-equal to the uninterrupted one is corruption,
+# not a checkpoint), and ``checkpoint_torn_fallback_ok`` pins the
+# torn-write contract: a damaged newest snapshot falls back to the
+# previous valid one, never crashes.
+CHECKPOINT_INT_FIELDS = ("checkpoint_n_epochs",
+                        "checkpoint_snapshot_every",
+                        "checkpoint_snapshot_bytes",
+                        "checkpoint_n_snapshots")
+CHECKPOINT_FLOAT_FIELDS = ("checkpoint_write_bandwidth_bps",
+                           "checkpoint_write_s",
+                           "checkpoint_restore_s",
+                           "checkpoint_load_s",
+                           "checkpoint_replay_s",
+                           "checkpoint_overhead_fraction")
+CHECKPOINT_BOOL_FIELDS = ("checkpoint_bitequal",
+                          "checkpoint_torn_fallback_ok")
+CHECKPOINT_STR_FIELDS = ("checkpoint_scenario",)
 
 
 def harvest_aux(paths: list[str]) -> dict[str, int]:
@@ -395,6 +419,20 @@ def harvest_guard(paths: list[str]) -> dict[str, dict]:
             )
             fields.update(
                 {f: str(d[f]) for f in DIVERGENT_STR_FIELDS if f in d}
+            )
+            fields.update(
+                {f: int(d[f]) for f in CHECKPOINT_INT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: float(d[f])
+                 for f in CHECKPOINT_FLOAT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: bool(d[f])
+                 for f in CHECKPOINT_BOOL_FIELDS if f in d}
+            )
+            fields.update(
+                {f: str(d[f]) for f in CHECKPOINT_STR_FIELDS if f in d}
             )
             # jaxlint per-rule counters (lint_active, lint_J007_active,
             # ...): dynamic key set — one field per registered rule, so

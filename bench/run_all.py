@@ -46,6 +46,7 @@ CONFIGS = [
      ("--liveness",)),
     ("config7_epoch_loop", "bench/config7_epoch_loop.py"),
     ("config8_fleet", "bench/config8_fleet.py"),
+    ("config9_checkpoint", "bench/config9_checkpoint.py"),
     ("tpu_tier", "bench/tpu_tier.py"),
 ]
 

@@ -23,7 +23,10 @@ reads bench logs only, never runs a demo), ``ranks`` (the divergent
 multi-rank panel from the latest ``config6_recovery.py --divergent``
 bench record — detection-to-convergence latency, per-round
 convergence/laggy verdicts, per-rank final progress; bench logs only,
-like ``fleet``).
+like ``fleet``), ``checkpoint`` (the durable-snapshot panel from the
+latest ``config9_checkpoint`` bench record — write bandwidth,
+restore+replay time, steady-state overhead vs ``snapshot_every``;
+bench logs only, like ``fleet``).
 """
 
 from __future__ import annotations
@@ -33,7 +36,7 @@ import json
 import sys
 
 COMMANDS = ("status", "health", "timeline", "journal", "caches",
-            "fleet", "ranks")
+            "fleet", "ranks", "checkpoint")
 
 #: CLI command -> admin-socket prefix (identity unless listed)
 _SOCKET_PREFIX = {"caches": "dump_placement_caches"}
@@ -194,6 +197,43 @@ def render_ranks(rec: dict, out) -> None:
             f"  rank {row.get('rank', '?')}: "
             f"step={row.get('step', 0)} epoch={row.get('epoch', 0)} "
             f"fingerprint={row.get('fingerprint', 0):#x}",
+            file=out,
+        )
+
+
+def load_checkpoint_record(paths=None) -> dict | None:
+    """Latest ``config9_checkpoint`` record."""
+    return _load_bench_record("checkpoint_write_bandwidth_bps", paths)
+
+
+def render_checkpoint(rec: dict, out) -> None:
+    """Text panel for one ``config9_checkpoint`` record: write
+    bandwidth headline, restore+replay split, and the per-interval
+    overhead rows."""
+    print(
+        f"checkpoint: {rec.get('checkpoint_n_epochs', '?')} epochs "
+        f"({rec.get('checkpoint_scenario', '?')}) on "
+        f"{rec.get('platform', '?')}: "
+        f"{rec.get('value', 0):,.0f} B/s write bandwidth, "
+        f"{rec.get('checkpoint_snapshot_bytes', 0):,} B/snapshot",
+        file=out,
+    )
+    if rec.get("checkpoint_restore_s") is not None:
+        print(
+            f"  restore={rec['checkpoint_restore_s']:.4f}s "
+            f"(load {rec.get('checkpoint_load_s', 0):.4f}s + replay "
+            f"{rec.get('checkpoint_replay_s', 0):.4f}s), "
+            f"bitequal="
+            f"{'ok' if rec.get('checkpoint_bitequal') else 'FAIL'}",
+            file=out,
+        )
+    for row in rec.get("checkpoint_overhead_panel") or []:
+        print(
+            f"  snapshot_every={row.get('snapshot_every', '?'):>4} "
+            f"overhead={row.get('overhead_fraction', 0):+.4f} "
+            f"({row.get('run_s', 0):.3f}s vs "
+            f"{row.get('baseline_s', 0):.3f}s baseline, "
+            f"{row.get('n_snapshots', 0)} snapshots)",
             file=out,
         )
 
@@ -453,6 +493,21 @@ def main(argv=None) -> int:
             print(json.dumps(rec, sort_keys=True), file=out)
         else:
             render_ranks(rec, out)
+        return 0
+
+    if args.command == "checkpoint":
+        rec = load_checkpoint_record(args.bench_log)
+        if rec is None:
+            print(
+                "status: no config9_checkpoint record found (run "
+                "bench/config9_checkpoint.py or pass --bench-log)",
+                file=sys.stderr,
+            )
+            return 1
+        if args.as_json:
+            print(json.dumps(rec, sort_keys=True), file=out)
+        else:
+            render_checkpoint(rec, out)
         return 0
 
     if args.socket is not None:

@@ -70,11 +70,46 @@ class EventJournal:
         self.records: list[dict] = []
         self._next_span = 0
         self._open: list[int] = []  # span-id stack for parent linkage
-        self._fh = open(self.path, "a") if self.path else None
-        self._size = (
-            os.path.getsize(self.path)
-            if self.path and os.path.exists(self.path) else 0
-        )
+        self._fh = None
+        self._size = 0
+        if self.path:
+            self._resume()
+
+    def _resume(self) -> None:
+        """Open the path for append — the process-restart seam.
+
+        Three resume guarantees: a torn final line left by a crash is
+        truncated away (appending after it would turn a tolerable
+        torn tail into mid-file corruption and poison every later
+        :meth:`read`), rotated segments past the current
+        ``max_segments`` budget are trimmed (the disk cap must count
+        segments a PREVIOUS process rotated, not only ones this one
+        will), and size accounting reseeds from the repaired live
+        file."""
+        if os.path.exists(self.path):
+            self._repair_torn_tail(self.path)
+        base = os.path.basename(self.path)
+        d = os.path.dirname(self.path) or "."
+        for fn in sorted(os.listdir(d)):
+            if not fn.startswith(base + "."):
+                continue
+            suffix = fn[len(base) + 1:]
+            if suffix.isdigit() and int(suffix) >= self.max_segments:
+                os.remove(os.path.join(d, fn))
+        self._fh = open(self.path, "a")
+        self._size = os.path.getsize(self.path)
+
+    @staticmethod
+    def _repair_torn_tail(path: str) -> None:
+        """Truncate a partial final line (no trailing newline — the
+        only shape a torn single-write append can leave)."""
+        with open(path, "rb") as fh:
+            data = fh.read()
+        if not data or data.endswith(b"\n"):
+            return
+        keep = data.rfind(b"\n") + 1
+        with open(path, "rb+") as fh:
+            fh.truncate(keep)
 
     def close(self) -> None:
         if self._fh is not None:
@@ -166,7 +201,7 @@ class EventJournal:
         return [r for r in self.records if r["name"] == name]
 
     @staticmethod
-    def read(path: str) -> list[dict]:
+    def read(path: str, *, tolerate_torn: bool = True) -> list[dict]:
         """Parse a journal file back into records — crash-tolerant.
 
         Every record is flushed as it is emitted, so the only damage a
@@ -174,7 +209,10 @@ class EventJournal:
         tail is skipped, not raised: post-mortem replay of everything
         that made it to disk is exactly the journal's job.  A
         malformed line with valid records AFTER it is real corruption
-        and still raises, with the line number."""
+        and still raises, with the line number.  ``tolerate_torn=False``
+        raises on the torn tail too — :meth:`read_rotated` uses it for
+        segments that are NOT the stream's final one, where a torn
+        line can only mean corruption (rotation moves whole files)."""
         out = []
         with open(path) as fh:
             lines = fh.readlines()
@@ -194,23 +232,43 @@ class EventJournal:
                     "followed by valid records (not a torn tail)"
                 )
             out.append(record)
+        if torn_at is not None and not tolerate_torn:
+            raise ValueError(
+                f"{path}:{torn_at + 1}: torn line in a non-final "
+                "journal segment (rotation moves whole files, so "
+                "only the stream's last segment may end torn)"
+            )
         return out
 
     @staticmethod
     def read_rotated(path: str) -> list[dict]:
         """Records across every surviving segment, oldest first:
-        ``path.<N>`` ... ``path.1`` then the live ``path``.  Each
-        segment keeps its own torn-tail tolerance — rotation moves
-        whole files, so only a segment's final line can ever be
-        torn."""
+        ``path.<N>`` ... ``path.1`` then the live ``path``.
+
+        Torn-tail tolerance is STREAM-level, not per-segment: only
+        the stream's final segment may legitimately end torn.  That
+        is the live ``path`` when it has content — but when a crash
+        lands exactly between rotation and the first fresh append,
+        the live file is empty (or missing) and the stream's true
+        tail is the newest ROTATED segment ``path.1``, so tolerance
+        extends there.  A torn line in any older segment is real
+        corruption and raises."""
         segs = []
         i = 1
         while os.path.exists(f"{path}.{i}"):
             segs.append(f"{path}.{i}")
             i += 1
+        live = os.path.exists(path)
+        stream = list(reversed(segs)) + ([path] if live else [])
+        if live and os.path.getsize(path) > 0:
+            tail = path
+        elif segs:
+            tail = segs[0]  # newest rotated segment
+        else:
+            tail = path
         out: list[dict] = []
-        for seg in reversed(segs):
-            out.extend(EventJournal.read(seg))
-        if os.path.exists(path):
-            out.extend(EventJournal.read(path))
+        for seg in stream:
+            out.extend(
+                EventJournal.read(seg, tolerate_torn=(seg == tail))
+            )
         return out

@@ -64,6 +64,10 @@ class SLOSpec:
       before the divergent-rank run counts as degraded
       (``SLO_RANK_STALL``, the ``MON_DOWN`` analog: the cluster kept
       serving, but on a shrunken quorum).
+    - ``max_checkpoint_age_s`` — the longest interval the run may go
+      without a committed checkpoint (``SLO_CHECKPOINT_AGE``: the
+      worst-case simulated time a process kill would discard — the
+      RPO of the run).
     """
 
     max_inactive_seconds: float | None = None
@@ -76,6 +80,7 @@ class SLOSpec:
     max_scrub_age_s: float | None = None
     max_detection_latency_s: float | None = None
     max_rank_stall_rounds: int | None = None
+    max_checkpoint_age_s: float | None = None
     warn_fraction: float = 0.8
 
     def sample_status(self, sample: HealthSample) -> str:
@@ -314,5 +319,28 @@ def evaluate(timeline: HealthTimeline, spec: SLOSpec) -> HealthReport:
             )
         report._add(HealthCheck(
             "SLO_RANK_STALL", status, detail, observed, budget,
+        ))
+    if spec.max_checkpoint_age_s is not None:
+        observed = timeline.max_checkpoint_age()
+        if not timeline.checkpoint_times:
+            status = HEALTH_ERR if timeline.samples else HEALTH_OK
+            detail = (
+                "no checkpoint ever committed (a kill discards the "
+                "whole run)" if timeline.samples
+                else "no samples to grade"
+            )
+        else:
+            status = _grade_max(
+                observed, spec.max_checkpoint_age_s, spec.warn_fraction
+            )
+            detail = (
+                f"longest interval without a committed checkpoint "
+                f"{observed:g}s over "
+                f"{len(timeline.checkpoint_times)} commits "
+                f"(budget {spec.max_checkpoint_age_s:g}s)"
+            )
+        report._add(HealthCheck(
+            "SLO_CHECKPOINT_AGE", status, detail,
+            observed, spec.max_checkpoint_age_s,
         ))
     return report

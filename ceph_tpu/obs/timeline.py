@@ -132,6 +132,10 @@ class HealthTimeline:
         # SLO_RANK_STALL budget grades the latter
         self.rank_rounds: list[tuple[int, int, bool]] = []
         self.rank_stalls: dict[int, int] = {}
+        # virtual times of committed checkpoints (note_checkpoint);
+        # the SLO_CHECKPOINT_AGE budget grades the largest gap — the
+        # simulated time a kill at the worst moment would discard
+        self.checkpoint_times: list[float] = []
         self._classifier = PGStateClassifier(mesh)
 
     def __len__(self) -> int:
@@ -276,6 +280,28 @@ class HealthTimeline:
     def note_scrub(self) -> None:
         """Mark a completed scrub pass at the current virtual time."""
         self.scrub_times.append(float(self.clock()))
+
+    def note_checkpoint(self) -> None:
+        """Mark a committed (durable, manifest-chained) checkpoint at
+        the current virtual time
+        (:meth:`ceph_tpu.recovery.checkpoint.CheckpointStore.save`
+        calls this when given a health timeline)."""
+        self.checkpoint_times.append(float(self.clock()))
+
+    def max_checkpoint_age(self) -> float:
+        """The longest virtual-time interval the run went without a
+        committed checkpoint — run start to first commit, between
+        commits, and last commit to the final sample: the worst-case
+        simulated time a kill would discard.  With no checkpoints at
+        all this is the whole run."""
+        if not self.samples:
+            return 0.0
+        pts = [
+            self.samples[0].t,
+            *sorted(self.checkpoint_times),
+            self.samples[-1].t,
+        ]
+        return max(b - a for a, b in zip(pts, pts[1:]))
 
     def note_detection(self, latency_s: float) -> None:
         """Record one failure-detection latency (virtual seconds from

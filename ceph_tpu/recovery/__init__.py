@@ -44,11 +44,20 @@ The subsystem that closes the loop the standalone workloads left open
   stall-tolerant degradation (laggy marking, seeded virtual-time
   backoff, :class:`~ceph_tpu.analysis.runtime_guard.RankStalledError`
   on every rank instead of a collective hang).
+- :mod:`~ceph_tpu.recovery.checkpoint` — crash-consistent
+  checkpoint/restore: durable CRC32C-verified snapshots of
+  device-resident state (single cluster, fleets, rank views) with
+  atomic commits and manifest chaining, a write-ahead log replayed
+  through ``apply_incremental``, checkpointed superstep/fleet runners
+  that resume bit-equal after a kill, and ``crash:`` chaos points
+  (``python -m ceph_tpu.recovery._crashbox`` SIGKILLs a real process
+  at them).
 """
 
 from .chaos import (
     SCENARIOS,
     AppliedCorruption,
+    AppliedCrashSpec,
     AppliedEvent,
     AppliedRankSpec,
     ChaosEngine,
@@ -57,8 +66,24 @@ from .chaos import (
     VirtualClock,
     build_scenario,
 )
+from .checkpoint import (
+    CheckpointError,
+    CheckpointStore,
+    CrashPoint,
+    SimulatedCrash,
+    WriteAheadLog,
+    checkpointed_fleet,
+    checkpointed_superstep,
+    crash_points,
+    diff_states,
+    restore_divergent,
+    save_divergent,
+    strip_crash_specs,
+)
 from .failure import (
     ACTIONS,
+    CRASH_ACTIONS,
+    CRASH_SCOPE,
     KNOWN_SCOPES,
     NET_ACTIONS,
     NET_SCOPES,
@@ -248,6 +273,21 @@ __all__ = [
     "RANK_ACTIONS",
     "RANK_SCOPES",
     "check_rank",
+    "AppliedCrashSpec",
+    "CRASH_ACTIONS",
+    "CRASH_SCOPE",
+    "CheckpointError",
+    "CheckpointStore",
+    "CrashPoint",
+    "SimulatedCrash",
+    "WriteAheadLog",
+    "checkpointed_fleet",
+    "checkpointed_superstep",
+    "crash_points",
+    "diff_states",
+    "restore_divergent",
+    "save_divergent",
+    "strip_crash_specs",
     "DivergentDriver",
     "DivergentResult",
     "RankReconciler",

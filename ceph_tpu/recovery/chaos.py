@@ -328,6 +328,21 @@ class AppliedCorruption:
 
 
 @dataclass
+class AppliedCrashSpec:
+    """Audit-trail entry for one crash-scoped spec the engine saw.
+
+    Crash specs never touch the map, the detector, or even the
+    simulated cluster — they kill the *driving process*, and only the
+    checkpointed runners (:mod:`ceph_tpu.recovery.checkpoint`) enact
+    them.  The engine journals and records them so a non-checkpointed
+    replay of a kill scenario still leaves an audit trail."""
+
+    t: float
+    epoch: int
+    spec: FailureSpec
+
+
+@dataclass
 class AppliedRankSpec:
     """Audit-trail entry for one rank-scoped spec the engine saw.
 
@@ -382,6 +397,7 @@ class ChaosEngine:
         self.applied: list[AppliedEvent] = []
         self.corruptions: list[AppliedCorruption] = []
         self.rank_applied: list[AppliedRankSpec] = []
+        self.crash_applied: list[AppliedCrashSpec] = []
 
     @property
     def epoch(self) -> int:
@@ -405,9 +421,11 @@ class ChaosEngine:
             rot = [s for s in ev.specs if s.is_bitrot]
             net = [s for s in ev.specs if s.is_net]
             rank = [s for s in ev.specs if s.is_rank]
+            crash = [s for s in ev.specs if s.is_crash]
             fail = tuple(
                 s for s in ev.specs
-                if not s.is_bitrot and not s.is_net and not s.is_rank
+                if not s.is_bitrot and not s.is_net
+                and not s.is_rank and not s.is_crash
             )
             if fail:
                 inc = inject(self.osdmap, list(fail))
@@ -426,6 +444,19 @@ class ChaosEngine:
                 if self.journal is not None:
                     self.journal.event(
                         "chaos.net",
+                        epoch=self.osdmap.epoch,
+                        sched_t=ev.t,
+                        spec=str(spec),
+                    )
+            for spec in crash:
+                # no map/detector effect — checkpoint.py enacts the
+                # kill; this is the audit trail for replay tooling
+                self.crash_applied.append(
+                    AppliedCrashSpec(ev.t, self.osdmap.epoch, spec)
+                )
+                if self.journal is not None:
+                    self.journal.event(
+                        "chaos.crash",
                         epoch=self.osdmap.epoch,
                         sched_t=ev.t,
                         spec=str(spec),

@@ -1,0 +1,853 @@
+"""Crash-consistent checkpoint/restore for device-resident state.
+
+Every simulated scenario used to die with the Python process: the
+device-resident :class:`~ceph_tpu.core.cluster_state.ClusterState`,
+fleet lanes, and per-rank views had no durable form, so a preemption
+or OOM-kill discarded hours of simulated cluster time — the exact
+failure mode the reference Ceph survives via its mon store and OSD
+write-ahead journal.  This module closes that loop:
+
+- :class:`CheckpointStore` — durable snapshots of any state pytree
+  (one cluster, a stacked fleet, stacked rank views).  Each snapshot
+  is one file: a versioned JSON header naming every lane (dtype,
+  shape, CRC32C — the same Castagnoli table the scrubber uses) plus
+  the raw lane payloads.  Commits are crash-consistent: tmp file →
+  flush → fsync → atomic rename → directory fsync → fsync'd manifest
+  append.  The manifest chains snapshots, so a torn write at ANY
+  point falls back to the previous valid snapshot (a
+  ``checkpoint.torn`` journal event, never a crash, never silent
+  corruption).
+- :class:`WriteAheadLog` — an fsync-per-append JSONL of applied
+  :class:`~ceph_tpu.osdmap.map.Incremental`\\ s and event-tape cursors
+  between snapshots.  Restore = last valid checkpoint + replay of the
+  WAL tail through the existing
+  :func:`~ceph_tpu.core.cluster_state.apply_incremental` (host-driven
+  flows) or the delta tape itself (superstep flows: the tape is the
+  WAL — the stored step index replays it deterministically).
+- :func:`checkpointed_superstep` / :func:`checkpointed_fleet` — the
+  chunked scan loops with a durable snapshot (state + the series so
+  far) at every ``snapshot_every`` boundary.  A killed run resumes
+  from the last valid snapshot and lands **bit-equal** (exact
+  :meth:`EpochSeries.diff` over all 18 lanes) to an uninterrupted
+  run: the scan body is deterministic and ``steps`` carry absolute
+  epoch indices, so the resumed chunks recompute exactly the tail the
+  crash discarded.
+- Process-kill chaos — ``crash:EPOCH[:PHASE]`` failure specs
+  (:data:`~ceph_tpu.recovery.failure.CRASH_ACTIONS`) lower to
+  :class:`CrashPoint`\\ s that either raise :class:`SimulatedCrash`
+  in-process or SIGKILL the process outright, positioned before,
+  during (mid-write: a torn tmp file), or after the checkpoint write
+  at the first boundary at or past EPOCH.  The subprocess driver
+  (``python -m ceph_tpu.recovery._crashbox``) runs a configured
+  checkpointed scenario and kills itself at the seeded point; rerun
+  it against the same store and it resumes to completion.
+- Multi-rank coordination — :func:`save_divergent` /
+  :func:`restore_divergent` snapshot every rank's view (one stacked
+  pytree) plus the reconcile protocol's verdict state at a
+  reconciliation boundary;
+  :meth:`~ceph_tpu.recovery.reconcile.DivergentDriver.run` calls them
+  when given a store.  A revived rank restores from the
+  fleet-consistent snapshot, guarded by recomputed view fingerprints.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+from contextlib import nullcontext
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.cluster_state import apply_incremental, index_state, stack_states
+from ..osdmap.map import Incremental
+from .chaos import ChaosEvent, ChaosTimeline
+from .failure import CRASH_ACTIONS
+from .scrub import crc32c
+from .superstep import _SERIES_FIELDS, EpochSeries
+
+I32 = jnp.int32
+
+MAGIC = "ceph-tpu-ckpt"
+VERSION = 1
+MANIFEST = "MANIFEST"
+
+
+class CheckpointError(ValueError):
+    """A snapshot failed validation (bad magic/version, lane CRC
+    mismatch, truncated payload, or a shape/dtype that does not match
+    the restore template).  The loader treats it as a torn write and
+    falls back to the previous manifest entry — it only ever escapes
+    to a caller through :func:`restore_divergent`'s fingerprint
+    guard, where silently dropping a rank's view would be worse."""
+
+
+class SimulatedCrash(RuntimeError):
+    """An in-process ``crash:`` spec fired: the run must stop HERE, as
+    if the process had been killed.  Carries the seeded epoch and the
+    checkpoint-relative phase so harnesses can assert where they
+    died."""
+
+    def __init__(self, epoch: int, phase: str):
+        super().__init__(
+            f"simulated crash at epoch {epoch} ({phase} checkpoint "
+            "write)"
+        )
+        self.epoch = int(epoch)
+        self.phase = str(phase)
+
+
+@dataclass(frozen=True)
+class CrashPoint:
+    """One seeded kill: fire at the first snapshot boundary at or past
+    ``epoch``, positioned ``before``/``during``/``after`` that
+    boundary's checkpoint write.  ``action`` picks the mechanism:
+    ``raise`` (default) throws :class:`SimulatedCrash`, ``sigkill``
+    SIGKILLs the process outright — no atexit, no flush, the honest
+    preemption (the ``_crashbox`` child uses it)."""
+
+    epoch: int
+    phase: str = "before"
+    action: str = "raise"
+
+    def __post_init__(self):
+        if self.phase not in CRASH_ACTIONS:
+            raise ValueError(
+                f"crash phase must be one of {CRASH_ACTIONS}, "
+                f"got {self.phase!r}"
+            )
+        if self.action not in ("raise", "sigkill"):
+            raise ValueError(f"bad crash action {self.action!r}")
+
+    def fire(self) -> None:
+        if self.action == "sigkill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        raise SimulatedCrash(self.epoch, self.phase)
+
+
+def crash_points(
+    timeline: ChaosTimeline, action: str = "raise"
+) -> tuple[CrashPoint, ...]:
+    """The :class:`CrashPoint`\\ s a timeline's ``crash:`` specs lower
+    to, in epoch order."""
+    pts = [
+        CrashPoint(spec.crash_epoch(), spec.action, action)
+        for ev in timeline.events()
+        for spec in ev.specs
+        if spec.is_crash
+    ]
+    return tuple(sorted(pts, key=lambda p: p.epoch))
+
+
+def strip_crash_specs(timeline: ChaosTimeline) -> ChaosTimeline:
+    """The timeline with every ``crash:`` spec removed — what the tape
+    compiler (which rejects them loudly) may consume."""
+    events = []
+    for ev in timeline.events():
+        specs = tuple(s for s in ev.specs if not s.is_crash)
+        if specs:
+            events.append(ChaosEvent(ev.t, specs))
+    return ChaosTimeline(events)
+
+
+class _CrashSchedule:
+    """Fire-once bookkeeping for a run's crash points: each point
+    fires at the FIRST boundary whose end epoch reaches it, in its
+    declared phase, then never again (a resumed run passes the
+    remaining points — usually none)."""
+
+    def __init__(self, crashes):
+        self.points = [
+            c if isinstance(c, CrashPoint) else CrashPoint(*c)
+            for c in crashes
+        ]
+        self._fired: set[int] = set()
+
+    def due(self, end_epoch: int, phase: str) -> CrashPoint | None:
+        for i, cp in enumerate(self.points):
+            if i in self._fired or cp.phase != phase:
+                continue
+            if cp.epoch <= end_epoch:
+                self._fired.add(i)
+                return cp
+        return None
+
+    def fire(self, end_epoch: int, phase: str) -> None:
+        cp = self.due(end_epoch, phase)
+        if cp is not None:
+            cp.fire()
+
+
+# ---------------------------------------------------------------------------
+# the durable snapshot store
+
+
+def _read_jsonl_tolerant(path: str) -> list[dict]:
+    """JSONL records, tolerating a torn FINAL line (the only damage an
+    fsync-per-line writer can take from a crash).  A malformed line
+    followed by valid records is real corruption and raises."""
+    out: list[dict] = []
+    try:
+        with open(path) as fh:
+            lines = fh.readlines()
+    except OSError:
+        return out
+    torn_at: int | None = None
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            torn_at = i
+            continue
+        if torn_at is not None:
+            raise ValueError(
+                f"{path}:{torn_at + 1}: corrupt line followed by "
+                "valid records (not a torn tail)"
+            )
+        out.append(rec)
+    return out
+
+
+def _repair_torn_tail(path: str) -> None:
+    """Truncate a partial final line (no trailing newline — the only
+    shape a torn single-write append can leave)."""
+    try:
+        with open(path, "rb") as fh:
+            data = fh.read()
+    except OSError:
+        return
+    if not data or data.endswith(b"\n"):
+        return
+    keep = data.rfind(b"\n") + 1
+    with open(path, "rb+") as fh:
+        fh.truncate(keep)
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class CheckpointStore:
+    """Durable, crash-consistent snapshots of a state pytree.
+
+    One directory per run.  Each snapshot is ``ckpt-<seq>.bin`` — a
+    one-line JSON header (magic, version, seq, caller meta, and a lane
+    table: name/dtype/shape/nbytes/CRC32C per flattened leaf and per
+    series column) followed by the concatenated raw lane payloads.
+    The commit order is the crash-consistency argument:
+
+    1. payloads stream into ``.tmp-ckpt-<seq>`` (a crash here leaves a
+       tmp file the next save sweeps away — the manifest never saw it);
+    2. flush + fsync + atomic :func:`os.replace` to the final name +
+       directory fsync (a crash between rename and manifest append
+       leaves a valid orphan the loader simply never consults);
+    3. one fsync'd JSONL manifest append chaining to the previous
+       snapshot (a crash mid-append leaves a torn final line the
+       manifest reader tolerates).
+
+    :meth:`load_latest` walks the manifest newest-first, fully
+    CRC-verifying each candidate against the restore template; any
+    damage emits a ``checkpoint.torn`` journal event and falls back to
+    the previous entry.  ``journal``/``health`` are optional
+    observability rides (``checkpoint.write``/``restore``/``torn``
+    spans and :meth:`HealthTimeline.note_checkpoint`)."""
+
+    def __init__(self, root: str, *, journal=None, health=None):
+        self.root = str(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.journal = journal
+        self.health = health
+        #: test/chaos seam: ``callable(phase: str)`` invoked mid-write
+        #: (after a partial payload flush, before the rename) — the
+        #: ``crash:N:during`` hook point
+        self._crash_hook = None
+        #: snapshots the loader rejected, for post-mortems
+        self.torn: list[str] = []
+        self.bytes_written = 0
+
+    # -- manifest -----------------------------------------------------
+
+    @property
+    def manifest_path(self) -> str:
+        return os.path.join(self.root, MANIFEST)
+
+    def entries(self) -> list[dict]:
+        """Committed manifest entries, oldest first (torn final line
+        tolerated)."""
+        return _read_jsonl_tolerant(self.manifest_path)
+
+    def next_seq(self) -> int:
+        ents = self.entries()
+        return int(ents[-1]["seq"]) + 1 if ents else 0
+
+    # -- write --------------------------------------------------------
+
+    def save(self, state, *, meta: dict | None = None,
+             series: dict | None = None) -> str:
+        """Commit one snapshot; returns the committed path.
+
+        ``state`` is any pytree of arrays (a ``ClusterState``, a
+        stacked fleet, stacked rank views); ``series`` an optional
+        ``{column: ndarray}`` payload (the run's
+        :class:`EpochSeries`/``FleetSeries`` columns so far, restored
+        verbatim so a resumed run's full series is bit-equal);
+        ``meta`` small JSON-able bookkeeping (the resume cursor)."""
+        for fn in os.listdir(self.root):
+            if fn.startswith(".tmp-"):
+                os.remove(os.path.join(self.root, fn))
+        seq = self.next_seq()
+        leaves = jax.device_get(jax.tree_util.tree_flatten(state)[0])
+        # np.asarray, NOT ascontiguousarray: the latter promotes 0-d
+        # leaves (epoch, now, tape_cursor) to shape (1,), which would
+        # fail the template shape check on every restore
+        lanes = [
+            (f"state.{i:03d}", np.asarray(a))
+            for i, a in enumerate(leaves)
+        ]
+        for name in sorted(series or {}):
+            lanes.append((f"series.{name}", np.asarray(series[name])))
+        table = [
+            {
+                "name": name,
+                "dtype": str(a.dtype),
+                "shape": list(a.shape),
+                "nbytes": int(a.nbytes),
+                "crc": crc32c(np.frombuffer(a.tobytes(), np.uint8)),
+            }
+            for name, a in lanes
+        ]
+        header = {
+            "magic": MAGIC, "version": VERSION, "seq": seq,
+            "meta": meta or {}, "lanes": table,
+        }
+        fname = f"ckpt-{seq:08d}.bin"
+        final = os.path.join(self.root, fname)
+        tmp = os.path.join(self.root, f".tmp-{fname}")
+        total = sum(t["nbytes"] for t in table)
+        span = (
+            self.journal.span(
+                "checkpoint.write", seq=seq, bytes=total,
+                lanes=len(table),
+            )
+            if self.journal is not None else nullcontext()
+        )
+        with span:
+            with open(tmp, "wb") as fh:
+                fh.write(
+                    (json.dumps(header, sort_keys=True) + "\n").encode()
+                )
+                for i, (_, a) in enumerate(lanes):
+                    fh.write(a.tobytes())
+                    if i == 0 and self._crash_hook is not None:
+                        # the mid-write seam: header + a partial
+                        # payload are durable, the commit rename is not
+                        fh.flush()
+                        os.fsync(fh.fileno())
+                        self._crash_hook("during")
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, final)
+            _fsync_dir(self.root)
+            ents = self.entries()
+            prev = ents[-1]["file"] if ents else None
+            # a crash mid-append can leave a torn final line; appending
+            # after it would glue the new entry onto the fragment and
+            # corrupt BOTH, so truncate the tail first
+            _repair_torn_tail(self.manifest_path)
+            with open(self.manifest_path, "a") as fh:
+                fh.write(json.dumps(
+                    {"seq": seq, "file": fname, "prev": prev},
+                    sort_keys=True,
+                ) + "\n")
+                fh.flush()
+                os.fsync(fh.fileno())
+        self.bytes_written += total
+        if self.health is not None:
+            self.health.note_checkpoint()
+        return final
+
+    # -- read ---------------------------------------------------------
+
+    def load_latest(self, template, *, with_series: bool = False):
+        """Newest fully-valid snapshot, or ``None`` when no committed
+        snapshot survives validation (the caller starts fresh — replay
+        from epoch 0 is always correct, only slower).
+
+        ``template`` supplies the pytree structure and per-leaf
+        dtype/shape the payload must match (a driver's initial state).
+        Returns ``(meta, state)`` or — ``with_series=True`` —
+        ``(meta, state, series_dict)``."""
+        for ent in reversed(self.entries()):
+            fname = str(ent.get("file", ""))
+            path = os.path.join(self.root, fname)
+            try:
+                meta, state, series = self._load_file(path, template)
+            except (OSError, ValueError, KeyError) as e:
+                self.torn.append(f"{fname}: {e}")
+                if self.journal is not None:
+                    self.journal.event(
+                        "checkpoint.torn", file=fname,
+                        seq=ent.get("seq"), error=str(e)[:200],
+                    )
+                continue
+            if self.journal is not None:
+                self.journal.event(
+                    "checkpoint.restore", file=fname,
+                    seq=ent.get("seq"),
+                )
+            if with_series:
+                return meta, state, series
+            return meta, state
+        return None
+
+    def _load_file(self, path: str, template):
+        with open(path, "rb") as fh:
+            blob = fh.read()
+        nl = blob.find(b"\n")
+        if nl < 0:
+            raise CheckpointError("no header line")
+        header = json.loads(blob[:nl].decode())
+        if header.get("magic") != MAGIC:
+            raise CheckpointError(f"bad magic {header.get('magic')!r}")
+        if int(header.get("version", -1)) != VERSION:
+            raise CheckpointError(
+                f"unsupported version {header.get('version')!r}"
+            )
+        payload = blob[nl + 1:]
+        off = 0
+        arrays: dict[str, np.ndarray] = {}
+        for lane in header["lanes"]:
+            n = int(lane["nbytes"])
+            raw = payload[off:off + n]
+            off += n
+            if len(raw) != n:
+                raise CheckpointError(
+                    f"lane {lane['name']} truncated "
+                    f"({len(raw)}/{n} bytes)"
+                )
+            if crc32c(np.frombuffer(raw, np.uint8)) != int(lane["crc"]):
+                raise CheckpointError(
+                    f"lane {lane['name']} CRC mismatch"
+                )
+            arrays[lane["name"]] = np.frombuffer(
+                raw, np.dtype(lane["dtype"])
+            ).reshape(tuple(lane["shape"]))
+        t_leaves, treedef = jax.tree_util.tree_flatten(template)
+        state_lanes = sorted(
+            k for k in arrays if k.startswith("state.")
+        )
+        if len(state_lanes) != len(t_leaves):
+            raise CheckpointError(
+                f"{len(state_lanes)} state lanes for a "
+                f"{len(t_leaves)}-leaf template"
+            )
+        leaves = []
+        for k, ref in zip(state_lanes, t_leaves):
+            a = arrays[k]
+            want_shape = tuple(np.shape(ref))
+            want_dtype = np.dtype(ref.dtype)
+            if a.shape != want_shape or a.dtype != want_dtype:
+                raise CheckpointError(
+                    f"lane {k}: {a.dtype}{list(a.shape)} does not "
+                    f"match template {want_dtype}{list(want_shape)}"
+                )
+            leaves.append(jnp.asarray(a))
+        state = jax.tree_util.tree_unflatten(treedef, leaves)
+        series = {
+            k[len("series."):]: arrays[k]
+            for k in arrays if k.startswith("series.")
+        }
+        return header.get("meta", {}), state, series
+
+
+# ---------------------------------------------------------------------------
+# the write-ahead log
+
+
+class WriteAheadLog:
+    """Fsync-per-append JSONL of what happened since the last
+    snapshot: applied :class:`Incremental`\\ s (host-driven flows —
+    ChaosEngine / direct ``inject``) and event-tape cursors (superstep
+    flows, where the pre-staged tape itself is the authoritative log
+    and the cursor just names the replay point).  Reads tolerate a
+    torn final line; :meth:`replay` drives the incremental tail
+    through the existing
+    :func:`~ceph_tpu.core.cluster_state.apply_incremental`."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        # restart seam: appending after a torn final line would glue
+        # the new record onto the fragment and corrupt both
+        _repair_torn_tail(self.path)
+        self._fh = open(self.path, "a")
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self) -> "WriteAheadLog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _write(self, rec: dict) -> None:
+        self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    def append_incremental(self, inc: Incremental, *, t: float = 0.0):
+        """Log one applied epoch delta (the lanes
+        ``apply_incremental`` consumes; structural edits raise there,
+        so they never belong in a WAL either)."""
+        self._write({
+            "kind": "inc", "t": float(t), "epoch": int(inc.epoch),
+            "new_state": {str(k): int(v)
+                          for k, v in sorted(inc.new_state.items())},
+            "new_weight": {str(k): int(v)
+                           for k, v in sorted(inc.new_weight.items())},
+            "new_primary_affinity": {
+                str(k): int(v)
+                for k, v in sorted(inc.new_primary_affinity.items())
+            },
+        })
+
+    def append_cursor(self, *, step: int, tape_cursor: int,
+                      now: float) -> None:
+        """Log the superstep replay point: the next step index and the
+        tape cursor / virtual clock that go with it."""
+        self._write({
+            "kind": "cursor", "step": int(step),
+            "tape_cursor": int(tape_cursor), "now": float(now),
+        })
+
+    def reset(self) -> None:
+        """Truncate after a snapshot commits: everything in the log is
+        now covered by the checkpoint."""
+        self.close()
+        with open(self.path, "w") as fh:
+            fh.flush()
+            os.fsync(fh.fileno())
+        self._fh = open(self.path, "a")
+
+    @staticmethod
+    def read(path: str) -> list[dict]:
+        """All committed records (torn final line tolerated)."""
+        return _read_jsonl_tolerant(path)
+
+    @staticmethod
+    def _to_incremental(rec: dict) -> Incremental:
+        return Incremental(
+            epoch=int(rec["epoch"]),
+            new_state={int(k): int(v)
+                       for k, v in rec.get("new_state", {}).items()},
+            new_weight={int(k): int(v)
+                        for k, v in rec.get("new_weight", {}).items()},
+            new_primary_affinity={
+                int(k): int(v)
+                for k, v in rec.get("new_primary_affinity", {}).items()
+            },
+        )
+
+    def replay(self, state, *, records: list[dict] | None = None):
+        """Apply the log's incremental tail to ``state`` (records past
+        the state's epoch only: replay is idempotent across a
+        checkpoint that already absorbed a prefix)."""
+        recs = self.read(self.path) if records is None else records
+        epoch = int(jax.device_get(state.epoch))
+        for rec in recs:
+            if rec.get("kind") != "inc":
+                continue
+            if int(rec["epoch"]) <= epoch:
+                continue
+            state = apply_incremental(
+                state, self._to_incremental(rec)
+            )
+        return state
+
+    def cursor(self) -> dict | None:
+        """The newest cursor record, or None."""
+        recs = [r for r in self.read(self.path)
+                if r.get("kind") == "cursor"]
+        return recs[-1] if recs else None
+
+
+# ---------------------------------------------------------------------------
+# checkpointed runners
+
+
+def _aligned_end(start: int, n_epochs: int, every: int) -> int:
+    """The next snapshot boundary: absolute multiples of ``every`` (so
+    a resumed run re-aligns with the uninterrupted run's boundaries),
+    clamped to the run length."""
+    return min(int(n_epochs), ((int(start) // every) + 1) * every)
+
+
+def checkpointed_superstep(
+    driver,
+    n_epochs: int,
+    *,
+    store: CheckpointStore,
+    snapshot_every: int = 0,
+    crashes=(),
+    wal: WriteAheadLog | None = None,
+) -> EpochSeries:
+    """:meth:`EpochDriver.run_superstep` with a durable snapshot at
+    every boundary and resume-from-store on entry.
+
+    Each boundary commits the device state plus the full series so
+    far; restore therefore reproduces the whole run's
+    :class:`EpochSeries` bit-equal to an uninterrupted one (the
+    acceptance contract ``tests/test_checkpoint.py`` pins across the
+    chaos zoo and every kill phase).  ``crashes`` are
+    :class:`CrashPoint`\\ s (or ``(epoch, phase[, action])`` tuples) —
+    pass the points still pending; a resumed run normally passes
+    none."""
+    n_epochs = int(n_epochs)
+    every = int(snapshot_every) or max(n_epochs, 1)
+    sched = _CrashSchedule(crashes)
+    scan_fn = driver.compile_superstep()
+    resume = store.load_latest(driver._init_state, with_series=True)
+    if resume is None:
+        state, start = driver._init_state, 0
+        cols = None
+    else:
+        meta, state, series = resume
+        start = int(meta.get("next_epoch", 0))
+        cols = {f: series[f] for f in _SERIES_FIELDS} if series else None
+    if start == 0:
+        cols = None
+    while start < n_epochs:
+        end = _aligned_end(start, n_epochs, every)
+        steps = jnp.arange(start, end, dtype=I32)
+        state, rows = scan_fn(state, steps)
+        part = EpochSeries.from_device(rows)
+        cols = {
+            f: (np.concatenate([cols[f], getattr(part, f)])
+                if cols is not None else getattr(part, f))
+            for f in _SERIES_FIELDS
+        }
+        sched.fire(end, "before")
+        during = sched.due(end, "during")
+        if during is not None:
+            store._crash_hook = lambda phase: during.fire()
+        try:
+            store.save(
+                state,
+                meta={"next_epoch": end, "n_epochs": n_epochs},
+                series=cols,
+            )
+        finally:
+            store._crash_hook = None
+        if wal is not None:
+            wal.reset()
+            wal.append_cursor(
+                step=end,
+                tape_cursor=int(jax.device_get(state.tape_cursor)),
+                now=float(jax.device_get(state.now)),
+            )
+        sched.fire(end, "after")
+        start = end
+    driver.final_state = state
+    if cols is None:
+        # zero-epoch run: one empty scan pull gives correctly-shaped
+        # zero-length columns
+        _, rows = scan_fn(
+            driver._init_state, jnp.arange(0, 0, dtype=I32)
+        )
+        return EpochSeries.from_device(rows)
+    return EpochSeries(**cols)
+
+
+def checkpointed_fleet(
+    fdriver,
+    n_epochs: int,
+    timelines,
+    *,
+    store: CheckpointStore,
+    snapshot_every: int = 0,
+    seeds=None,
+    crashes=(),
+):
+    """:meth:`FleetDriver.run_fleet` chunked over snapshot boundaries
+    with a durable stacked-fleet snapshot at each; resume-from-store
+    on entry.  Returns the cropped ``FleetSeries`` — every lane
+    bit-equal to the uninterrupted fleet run's."""
+    from .fleet import FleetSeries, compile_event_tape, stack_tapes
+
+    n_epochs = int(n_epochs)
+    every = int(snapshot_every) or max(n_epochs, 1)
+    sched = _CrashSchedule(crashes)
+    tls = list(timelines)
+    tapes = [compile_event_tape(tl, fdriver.m) for tl in tls]
+    ftape = stack_tapes(tapes)
+    salts = fdriver._salts(len(tls), ftape.fleet_pad, seeds)
+    template = fdriver._fleet_state(ftape.fleet_pad)
+    scan_fn = fdriver._fleet_scan_fn()
+    resume = store.load_latest(template, with_series=True)
+    if resume is None:
+        fstate, start, cols = template, 0, None
+    else:
+        meta, fstate, series = resume
+        start = int(meta.get("next_epoch", 0))
+        cols = {f: series[f] for f in _SERIES_FIELDS} if series else None
+    if start == 0:
+        cols = None
+    while start < n_epochs:
+        end = _aligned_end(start, n_epochs, every)
+        steps = jnp.arange(start, end, dtype=I32)
+        fstate, rows = scan_fn(
+            fstate, steps, *ftape.device(), salts
+        )
+        part = FleetSeries.from_device(rows, len(tls))
+        cols = {
+            f: (np.concatenate([cols[f], getattr(part, f)])
+                if cols is not None else getattr(part, f))
+            for f in _SERIES_FIELDS
+        }
+        sched.fire(end, "before")
+        during = sched.due(end, "during")
+        if during is not None:
+            store._crash_hook = lambda phase: during.fire()
+        try:
+            store.save(
+                fstate,
+                meta={
+                    "next_epoch": end, "n_epochs": n_epochs,
+                    "fleet_pad": int(ftape.fleet_pad),
+                    "n_clusters": len(tls),
+                },
+                series=cols,
+            )
+        finally:
+            store._crash_hook = None
+        sched.fire(end, "after")
+        start = end
+    fdriver.final_state = fstate
+    if cols is None:
+        _, rows = scan_fn(
+            template, jnp.arange(0, 0, dtype=I32), *ftape.device(),
+            salts,
+        )
+        return FleetSeries.from_device(rows, len(tls))
+    return FleetSeries(**cols)
+
+
+# ---------------------------------------------------------------------------
+# multi-rank coordination (DivergentDriver hooks; reconcile.py calls
+# these at reconciliation boundaries)
+
+
+def save_divergent(store: CheckpointStore, driver, *, round_idx: int,
+                   target: int, extra_rounds: int, rounds) -> str:
+    """Snapshot every rank's view (one stacked pytree) plus the
+    reconcile protocol's verdict state at a reconciliation boundary —
+    the fleet-consistent snapshot a revived rank restores from."""
+    proto = driver.protocol
+    hosts = [jax.device_get(s) for s in driver.states]
+    from .reconcile import view_fingerprint
+
+    meta = {
+        "round_idx": int(round_idx),
+        "target": int(target),
+        "extra_rounds": int(extra_rounds),
+        "cur": [int(c) for c in driver.cur],
+        "n_ranks": int(driver.n_ranks),
+        "fingerprints": [view_fingerprint(h) for h in hosts],
+        "stall_rounds": [int(v) for v in proto.stall_rounds],
+        "laggy": sorted(int(r) for r in proto.laggy),
+        "prev_steps": (
+            [int(v) for v in proto._prev_steps]
+            if proto._prev_steps is not None else None
+        ),
+        "rng_state": proto.rng.bit_generator.state,
+        "rounds": [
+            {
+                "round": r.round, "target_step": r.target_step,
+                "steps": list(r.steps), "epochs": list(r.epochs),
+                "fingerprints": list(r.fingerprints),
+                "laggy": list(r.laggy), "converged": r.converged,
+                "diverged": r.diverged, "retries": r.retries,
+                "backoff_epochs": r.backoff_epochs,
+            }
+            for r in rounds
+        ],
+    }
+    return store.save(stack_states(driver.states), meta=meta)
+
+
+def restore_divergent(store: CheckpointStore, driver) -> dict | None:
+    """Restore a :class:`DivergentDriver`'s rank views and protocol
+    state from the newest valid snapshot; returns the snapshot meta
+    (the resume cursor + serialized rounds) or ``None``.
+
+    The restored views are re-fingerprinted and checked against the
+    snapshot's recorded fingerprints — the ``assert_rank_identical``
+    analog for the restore seam: a rank whose revived view drifted
+    from the fleet-consistent snapshot raises
+    :class:`CheckpointError` instead of silently reconverging."""
+    template = stack_states(
+        [driver.driver._init_state] * driver.n_ranks
+    )
+    out = store.load_latest(template)
+    if out is None:
+        return None
+    meta, fleet = out
+    if int(meta.get("n_ranks", -1)) != driver.n_ranks:
+        raise CheckpointError(
+            f"snapshot holds {meta.get('n_ranks')} rank views, "
+            f"driver has {driver.n_ranks}"
+        )
+    from .reconcile import view_fingerprint
+
+    states = [index_state(fleet, r) for r in range(driver.n_ranks)]
+    fps = [
+        view_fingerprint(jax.device_get(s)) for s in states
+    ]
+    want = [int(f) for f in meta.get("fingerprints", [])]
+    if fps != want:
+        raise CheckpointError(
+            f"restored rank views fingerprint {fps}, snapshot "
+            f"recorded {want} — refusing a divergent revival"
+        )
+    driver.states = states
+    driver.cur = [int(c) for c in meta["cur"]]
+    proto = driver.protocol
+    proto.stall_rounds = np.asarray(meta["stall_rounds"], np.int64)
+    proto.laggy = set(int(r) for r in meta["laggy"])
+    proto._prev_steps = (
+        np.asarray(meta["prev_steps"], np.int64)
+        if meta.get("prev_steps") is not None else None
+    )
+    proto.rng.bit_generator.state = meta["rng_state"]
+    return meta
+
+
+def diff_states(a, b) -> list[str]:
+    """Leaf indices (as strings) where two state pytrees differ
+    bit-for-bit — the exact-compare surface for restored cluster
+    state (floats compared exactly, like :meth:`EpochSeries.diff`)."""
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    if ta != tb:
+        return ["<treedef>"]
+    out = []
+    for i, (x, y) in enumerate(zip(jax.device_get(la),
+                                   jax.device_get(lb))):
+        x, y = np.asarray(x), np.asarray(y)
+        if x.shape != y.shape or x.dtype != y.dtype \
+                or not np.array_equal(x, y):
+            out.append(f"leaf{i}")
+    return out

@@ -68,6 +68,19 @@ RANK_ACTIONS = {
 # target carries (rank[, milliseconds | epochs]).
 _RANK_TARGET_ARITY = {"rankdelay": 2, "rankdrop": 1, "rankstall": 2}
 
+# Process-lifetime chaos: ``crash:EPOCH[:PHASE]`` kills the *driving
+# process* at a simulated-epoch boundary.  Not a map edit, not a
+# cluster condition, not an observation skew — the simulated cluster
+# never sees it; what it tests is the checkpoint/restore subsystem
+# (:mod:`ceph_tpu.recovery.checkpoint`).  PHASE positions the crash
+# relative to the checkpoint write at the first snapshot boundary at
+# or past EPOCH: ``before`` the write starts (default), ``during`` it
+# (a torn write), or ``after`` it commits.  Only the checkpointed
+# runners consume crash specs; every other consumer rejects them
+# loudly.
+CRASH_SCOPE = "crash"
+CRASH_ACTIONS = ("before", "during", "after")
+
 # The scopes a spec may name: ``osd`` plus the reference's stock CRUSH
 # bucket types (``src/crush/CrushWrapper.cc`` default type set), plus
 # ``bitrot`` — silent shard corruption, which is not a map edit at all
@@ -78,7 +91,7 @@ _RANK_TARGET_ARITY = {"rankdelay": 2, "rankdrop": 1, "rankstall": 2}
 KNOWN_SCOPES = (
     "osd", "host", "chassis", "rack", "row", "pdu", "pod", "room",
     "datacenter", "dc", "zone", "region", "root", "bitrot",
-) + NET_SCOPES + RANK_SCOPES
+) + NET_SCOPES + RANK_SCOPES + (CRASH_SCOPE,)
 
 # The keys a dict-form spec may carry (the JSON timeline surface).
 SPEC_KEYS = ("scope", "target", "action")
@@ -161,6 +174,14 @@ class FailureSpec:
         build_incremental or the event tape."""
         return self.scope in RANK_SCOPES
 
+    @property
+    def is_crash(self) -> bool:
+        """Process-kill spec (``crash:EPOCH[:PHASE]``): kills the
+        driving process itself — routed to
+        :mod:`ceph_tpu.recovery.checkpoint`, never to
+        build_incremental or the event tape."""
+        return self.scope == CRASH_SCOPE
+
     def bitrot(self) -> BitrotEvent:
         """Decode a ``bitrot`` spec's target (raises for map scopes)."""
         if not self.is_bitrot:
@@ -182,6 +203,13 @@ class FailureSpec:
         if not self.is_rank or len(parts) != 2:
             raise ValueError(f"{self} carries no rank argument")
         return int(parts[1])
+
+    def crash_epoch(self) -> int:
+        """The simulated epoch a crash spec fires at (raises for every
+        other scope)."""
+        if not self.is_crash:
+            raise ValueError(f"{self} is not a crash spec")
+        return int(self.target)
 
 
 def _parse_rank_target(scope: str, target: str) -> str:
@@ -308,6 +336,20 @@ def parse_spec(text, scopes: tuple[str, ...] = KNOWN_SCOPES) -> FailureSpec:
                 f"{RANK_ACTIONS[scope]}, got {action!r}"
             )
         return FailureSpec(scope, _parse_rank_target(scope, target), action)
+    if scope == CRASH_SCOPE:
+        if len(parts) == 2:
+            action = CRASH_ACTIONS[0]
+        if action not in CRASH_ACTIONS:
+            raise ValueError(
+                f"{CRASH_SCOPE} specs only support actions "
+                f"{CRASH_ACTIONS}, got {action!r}"
+            )
+        if not target.isdigit():
+            raise UnknownSpecKeyError(
+                f"bad {CRASH_SCOPE} target {target!r} (want a "
+                "non-negative simulated-epoch index)"
+            )
+        return FailureSpec(scope, str(int(target)), action)
     if action not in ACTIONS:
         raise ValueError(f"bad action {action!r}; one of {ACTIONS}")
     return FailureSpec(scope, target, action)
@@ -349,6 +391,10 @@ def resolve_targets(m: OSDMap, spec: FailureSpec) -> list[int]:
     if spec.is_rank:
         raise ValueError(
             f"{spec} targets a simulation rank's observations, not OSDs"
+        )
+    if spec.is_crash:
+        raise ValueError(
+            f"{spec} kills the driving process, it touches no OSDs"
         )
     if spec.is_net:
         return [int(spec.target)]
@@ -406,6 +452,12 @@ def build_incremental(m: OSDMap, specs) -> Incremental:
                 "map edit; route it through "
                 "ceph_tpu.recovery.reconcile (rank_view_timeline / "
                 "DivergentDriver)"
+            )
+        if spec.is_crash:
+            raise ValueError(
+                f"{spec} kills the driving process, it is not a map "
+                "edit; route it through a checkpointed runner "
+                "(ceph_tpu.recovery.checkpoint)"
             )
         for osd in resolve_targets(m, spec):
             if spec.action in ("down", "down_out") and m.is_up(osd):

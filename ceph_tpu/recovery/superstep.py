@@ -180,6 +180,14 @@ def compile_event_tape(timeline: ChaosTimeline, m: OSDMap) -> EventTape:
                     "recovery.reconcile.rank_view_timeline before "
                     "compiling a per-rank tape"
                 )
+            if spec.is_crash:
+                raise ValueError(
+                    f"{spec} kills the driving process, not the "
+                    "simulated cluster; strip it with "
+                    "recovery.checkpoint.strip_crash_specs (the "
+                    "checkpointed runners consume it) before "
+                    "compiling a tape"
+                )
             if spec.is_bitrot:
                 n_bitrot += 1
                 continue
@@ -930,6 +938,16 @@ class EpochDriver:
         zero-host-transfer path the nonregression scenario pins)."""
         scan_fn = self.compile_superstep()
         state = self._init_state
+        if int(n_epochs) <= 0:
+            # zero-epoch corner: the scan over an empty step vector
+            # still yields every lane with its real dtype/trailing
+            # shape, so callers get a typed length-0 series instead of
+            # a concat([]) crash
+            state, rows = scan_fn(state, jnp.arange(0, dtype=I32))
+            self.final_state = state
+            if not pull and on_snapshot is None:
+                return state, rows
+            return EpochSeries.from_device(rows)
         chunk = int(snapshot_every) or int(n_epochs)
         parts: list[EpochSeries] = []
         dev_rows = None
@@ -958,6 +976,15 @@ class EpochDriver:
         between them — today's per-epoch Python round-trip, kept
         behind ``CEPH_TPU_EPOCH_SUPERSTEP=0``."""
         state = self._init_state
+        if int(n_epochs) <= 0:
+            # same typed-empty contract as the superstep path; the
+            # kill switch changes execution strategy, never the data,
+            # and a zero-epoch run has no stages to launch
+            state, rows = self.compile_superstep()(
+                state, jnp.arange(0, dtype=I32)
+            )
+            self.final_state = state
+            return EpochSeries.from_device(rows)
         rows = []
         parts: list[EpochSeries] = []
         flushed = 0

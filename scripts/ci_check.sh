@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# CI gate: jaxlint annotations + the tier-1 test suite.
+#
+# Mirrors ROADMAP.md's tier-1 verify line exactly so a local run and
+# the CI run can never drift.  The lint pass emits GitHub workflow
+# annotations (::error/::warning file=...) so findings land inline on
+# PRs; it is also enforced as a test (tests/test_lint_clean.py), so a
+# lint failure here is the same failure the suite would report —
+# surfaced earlier and annotated.
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+
+rc=0
+
+echo "== jaxlint (ceph_tpu/, GitHub annotations) =="
+python -m ceph_tpu.cli.lint ceph_tpu/ --format github || rc=$?
+
+echo "== tier-1 tests =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_t1.log
+t1=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)"
+[ "$t1" -ne 0 ] && rc=$t1
+
+exit $rc

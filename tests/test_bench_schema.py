@@ -1125,3 +1125,135 @@ def test_divergent_record_harvested_by_decide_defaults(tmp_path):
     assert g["divergent_scenario"] == "flap"
     assert g["divergent_health_status"] == "HEALTH_OK"
     assert g["steady_state_clean"] is True
+
+
+# --- config8 geometry sweep (codec/k/m/placement axes) ----------------
+
+
+_GEOMETRY_GRID = [
+    {"codec": "reed-solomon", "ec_k": 4, "ec_m": 2,
+     "placement": "crush", "survival_fraction": 0.9375,
+     "availability_mean": 0.999, "ttzd_mean_s": 2.5},
+    {"codec": "replica", "ec_k": 1, "ec_m": 2,
+     "placement": "crush-multirack", "survival_fraction": 1.0,
+     "availability_mean": 1.0, "ttzd_mean_s": 0.9375},
+]
+
+
+def _fleet_record_with_geometry():
+    est = _fleet_estimate()
+    return config8.build_fleet_record(
+        "tpu", 9898.2, 36.5, 13720.4, True, True, _FakeFleetTape(),
+        est, [config8._panel_entry(est)], _FLEET_SWEEP, _FLEET_SWEEP[0],
+        31, 31, 0,
+        geometry_grid=_GEOMETRY_GRID, geometry_best=_GEOMETRY_GRID[1],
+    )
+
+
+def test_fleet_record_geometry_schema():
+    import json
+
+    rec = _fleet_record_with_geometry()
+    assert rec["fleet_geometry_grid"] == _GEOMETRY_GRID
+    assert rec["fleet_best_codec"] == "replica"
+    assert rec["fleet_best_ec_k"] == 1 and rec["fleet_best_ec_m"] == 2
+    assert rec["fleet_best_placement"] == "crush-multirack"
+    json.dumps(rec)  # one JSON line, always serializable
+
+
+def test_fleet_geometry_harvested_by_decide_defaults(tmp_path):
+    import json
+
+    rec = _fleet_record_with_geometry()
+    p = tmp_path / "session.log"
+    p.write_text(json.dumps(rec) + "\n")
+    dd = _load_dd("fleet_geometry")
+    g = dd.harvest_guard([str(p)])["fleet_epoch_rate_per_sec"]
+    # typed FLEET_* geometry picks: what decide_defaults would promote
+    assert g["fleet_best_codec"] == "replica"
+    assert g["fleet_best_ec_k"] == 1
+    assert g["fleet_best_ec_m"] == 2
+    assert g["fleet_best_placement"] == "crush-multirack"
+
+
+def test_fleet_record_without_geometry_omits_picks():
+    rec = _fleet_record()
+    assert "fleet_geometry_grid" not in rec
+    assert "fleet_best_codec" not in rec
+
+
+# --- config9_checkpoint JSON schema (crash-consistent snapshots) ------
+
+_CONFIG9 = os.path.join(
+    os.path.dirname(_BENCH), "bench", "config9_checkpoint.py"
+)
+_spec9 = importlib.util.spec_from_file_location("bench_config9", _CONFIG9)
+config9 = importlib.util.module_from_spec(_spec9)
+_spec9.loader.exec_module(config9)
+
+
+_CKPT_PANEL = [
+    {"snapshot_every": 16, "n_snapshots": 16, "run_s": 1.25,
+     "baseline_s": 1.0, "overhead_fraction": 0.25},
+    {"snapshot_every": 64, "n_snapshots": 4, "run_s": 1.0625,
+     "baseline_s": 1.0, "overhead_fraction": 0.0625},
+]
+
+
+def _checkpoint_record():
+    return config9.build_checkpoint_record(
+        "tpu", 4_194_304.7, 0.375, 98_304, 16, 0.03125, 0.5,
+        True, True, _CKPT_PANEL, 0.25,
+    )
+
+
+def test_checkpoint_record_schema():
+    import json
+
+    rec = _checkpoint_record()
+    assert rec["metric"] == "checkpoint_write_bandwidth_bps"
+    assert rec["status"] == "ok"
+    assert rec["value"] == 4194305 and rec["unit"] == "B/s"
+    assert rec["checkpoint_scenario"] == config9.SCENARIO
+    assert rec["checkpoint_n_epochs"] == config9.EPOCHS
+    assert rec["checkpoint_snapshot_every"] == config9.EVERY
+    assert rec["checkpoint_snapshot_bytes"] == 98_304
+    assert rec["checkpoint_n_snapshots"] == 16
+    assert rec["checkpoint_write_s"] == 0.375
+    # restore splits into manifest-walk load and compiled-tail replay
+    assert rec["checkpoint_load_s"] == 0.03125
+    assert rec["checkpoint_replay_s"] == 0.5
+    assert rec["checkpoint_restore_s"] == 0.53125
+    assert rec["checkpoint_overhead_fraction"] == 0.25
+    # the two gates the acceptance bar reads
+    assert rec["checkpoint_bitequal"] is True
+    assert rec["checkpoint_torn_fallback_ok"] is True
+    assert rec["checkpoint_overhead_panel"][1]["snapshot_every"] == 64
+    json.dumps(rec)  # one JSON line, always serializable
+
+
+def test_checkpoint_record_harvested_by_decide_defaults(tmp_path):
+    import json
+
+    rec = _checkpoint_record()
+    p = tmp_path / "session.log"
+    p.write_text(json.dumps(rec) + "\n")
+    dd = _load_dd("checkpoint")
+    g = dd.harvest_guard([str(p)])["checkpoint_write_bandwidth_bps"]
+    # typed CHECKPOINT_* fields: costs, gates, and the run geometry
+    assert g["checkpoint_write_bandwidth_bps"] == 4_194_304.7
+    assert g["checkpoint_write_s"] == 0.375
+    assert g["checkpoint_restore_s"] == 0.53125
+    assert g["checkpoint_load_s"] == 0.03125
+    assert g["checkpoint_replay_s"] == 0.5
+    assert g["checkpoint_overhead_fraction"] == 0.25
+    assert g["checkpoint_n_epochs"] == config9.EPOCHS
+    assert g["checkpoint_snapshot_every"] == config9.EVERY
+    assert g["checkpoint_snapshot_bytes"] == 98_304
+    assert g["checkpoint_n_snapshots"] == 16
+    assert g["checkpoint_bitequal"] is True
+    assert g["checkpoint_torn_fallback_ok"] is True
+    assert g["checkpoint_scenario"] == config9.SCENARIO
+    # no compile-guard counters in this record: the derived
+    # steady_state_clean gate must stay absent, not default to a lie
+    assert "steady_state_clean" not in g

@@ -1,0 +1,625 @@
+"""Crash-consistent checkpoint/restore: durable snapshots, WAL
+replay, and process-kill chaos.
+
+The acceptance contract pinned here: a kill at ANY seeded point —
+``before``, ``during`` (mid-checkpoint-write: a torn tmp file on
+disk), or ``after`` a snapshot commit — followed by a restore from
+the store yields a series bit-equal over all 18 lanes to the
+uninterrupted run, across the chaos zoo, for the single-cluster
+superstep, a fleet lane, and a 2-rank divergent run.  Torn
+checkpoints fall back to the previous valid snapshot with a
+``checkpoint.torn`` journal event — never a crash, never silent
+corruption.  In-process kills use the ``raise`` action
+(:class:`SimulatedCrash`); the subprocess legs use real ``SIGKILL``
+through :mod:`ceph_tpu.recovery._crashbox`.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import signal
+
+import jax
+import numpy as np
+import pytest
+
+from ceph_tpu import recovery as rec
+from ceph_tpu.core.cluster_state import ClusterState, apply_incremental
+from ceph_tpu.models.clusters import build_osdmap
+from ceph_tpu.obs.journal import EventJournal
+from ceph_tpu.osdmap.map import UP, Incremental
+from ceph_tpu.recovery import EpochDriver, build_scenario
+from ceph_tpu.recovery._crashbox import _timeline as crashbox_timeline
+from ceph_tpu.recovery.chaos import ChaosTimeline
+from ceph_tpu.recovery.checkpoint import (
+    CheckpointError,
+    CheckpointStore,
+    CrashPoint,
+    SimulatedCrash,
+    WriteAheadLog,
+    _read_jsonl_tolerant,
+    checkpointed_fleet,
+    checkpointed_superstep,
+    crash_points,
+    diff_states,
+    restore_divergent,
+    strip_crash_specs,
+)
+from ceph_tpu.recovery.failure import (
+    build_incremental,
+    parse_spec,
+    resolve_targets,
+)
+from ceph_tpu.recovery.fleet import FleetDriver
+from ceph_tpu.recovery.reconcile import DivergentDriver
+from ceph_tpu.recovery.superstep import _SERIES_FIELDS, compile_event_tape
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ZOO = (
+    "flap",
+    "rack-cascade",
+    "mid-repair-loss",
+    "silent-bitrot",
+    "scrub-storm",
+    "flapping-osd",
+)
+N_EPOCHS = 16
+EVERY = 4
+# not boundary-aligned on purpose: the schedule must fire at the FIRST
+# boundary whose end >= the crash epoch (epoch 8 here)
+CRASH_EPOCH = 6
+
+
+def _map(n_osd=32, pg_num=64):
+    return build_osdmap(n_osd, pg_num=pg_num, size=6, pool_kind="erasure")
+
+
+# one driver + uninterrupted reference per scenario: the compiled scan
+# is cached per driver instance, so the whole kill matrix reuses one
+# XLA program per scenario
+_zoo_cache: dict = {}
+
+
+def _zoo(scenario):
+    if scenario not in _zoo_cache:
+        m = _map()
+        d = EpochDriver(m, build_scenario(scenario, m), n_ops=64)
+        ref = d.run_superstep(N_EPOCHS, snapshot_every=EVERY)
+        _zoo_cache[scenario] = (d, ref)
+    return _zoo_cache[scenario]
+
+
+# ---- crash-scoped failure specs --------------------------------------
+
+
+def test_crash_spec_parse_roundtrip_and_rejections():
+    s = parse_spec("crash:8")
+    assert s.is_crash and s.crash_epoch() == 8
+    assert parse_spec(str(s)).crash_epoch() == 8
+    assert parse_spec("crash:8:during").action == "during"
+    with pytest.raises(ValueError):
+        parse_spec("crash:8:boom")
+    with pytest.raises(ValueError):
+        parse_spec("crash:nope")
+    # crash specs kill the driving process: every map-facing consumer
+    # refuses them loudly instead of silently dropping the kill
+    m = _map()
+    with pytest.raises(ValueError, match="no OSDs"):
+        resolve_targets(m, s)
+    with pytest.raises(ValueError):
+        build_incremental(m, [s])
+    tl = ChaosTimeline.from_pairs([(0.5, s)])
+    with pytest.raises(ValueError, match="strip_crash_specs"):
+        compile_event_tape(tl, m)
+
+
+def test_crash_points_and_strip():
+    tl = ChaosTimeline.from_pairs([
+        (0.5, parse_spec("osd:3")),
+        (1.0, parse_spec("crash:8:during")),
+        (2.0, parse_spec("crash:4")),
+    ])
+    cps = crash_points(tl)
+    assert [(c.epoch, c.phase, c.action) for c in cps] == [
+        (4, "before", "raise"), (8, "during", "raise"),
+    ]
+    assert all(c.action == "sigkill" for c in crash_points(tl, "sigkill"))
+    stripped = strip_crash_specs(tl)
+    assert not any(
+        s.is_crash for ev in stripped.events() for s in ev.specs
+    )
+    # crash-only events vanish entirely; the map event survives and
+    # the stripped timeline compiles
+    assert len(stripped.events()) == 1
+    compile_event_tape(stripped, _map())
+
+
+def test_chaos_engine_audits_crash_specs():
+    m = _map()
+    j = EventJournal()
+    tl = ChaosTimeline.from_pairs([
+        (0.5, parse_spec("crash:8:during")),
+        (0.5, parse_spec("osd:3")),
+    ])
+    eng = rec.ChaosEngine(m, tl, journal=j)
+    eng.clock.advance(1.0)
+    incs = eng.poll()
+    # the map event became an epoch; the crash spec touched nothing
+    # but left its audit trail
+    assert len(incs) == 1
+    assert len(eng.crash_applied) == 1
+    assert eng.crash_applied[0].spec.crash_epoch() == 8
+    assert len(j.by_name("chaos.crash")) == 1
+
+
+def test_crashpoint_validation_and_fire():
+    with pytest.raises(ValueError):
+        CrashPoint(3, "nope")
+    with pytest.raises(ValueError):
+        CrashPoint(3, "before", "explode")
+    with pytest.raises(SimulatedCrash) as ei:
+        CrashPoint(3, "during").fire()
+    assert ei.value.epoch == 3 and ei.value.phase == "during"
+    assert "epoch 3" in str(ei.value)
+
+
+# ---- CheckpointStore durability --------------------------------------
+
+
+def test_store_roundtrip_state_and_series(tmp_path):
+    d, _ = _zoo("flap")
+    j = EventJournal()
+    store = CheckpointStore(str(tmp_path), journal=j)
+    series = {"now": np.arange(3, dtype=np.float32)}
+    store.save(d._init_state, meta={"next_epoch": 3}, series=series)
+    assert store.bytes_written > 0
+    assert len(store.entries()) == 1
+    assert len(j.by_name("checkpoint.write")) == 1
+    out = store.load_latest(d._init_state, with_series=True)
+    assert out is not None
+    meta, state, got = out
+    assert meta["next_epoch"] == 3
+    assert diff_states(state, d._init_state) == []
+    assert np.array_equal(got["now"], series["now"])
+    assert len(j.by_name("checkpoint.restore")) == 1
+
+
+def test_store_torn_newest_falls_back(tmp_path):
+    d, _ = _zoo("flap")
+    j = EventJournal()
+    store = CheckpointStore(str(tmp_path), journal=j)
+    store.save(d._init_state, meta={"n": 1})
+    store.save(d._init_state, meta={"n": 2})
+    newest = store.entries()[-1]["file"]
+    blob = open(tmp_path / newest, "rb").read()
+    open(tmp_path / newest, "wb").write(blob[: len(blob) // 2])
+    out = store.load_latest(d._init_state)
+    assert out is not None and out[0]["n"] == 1
+    assert len(store.torn) == 1 and store.torn[0].startswith(newest)
+    torn = j.by_name("checkpoint.torn")
+    assert len(torn) == 1 and torn[0]["attrs"]["file"] == newest
+    # every snapshot damaged -> None, still no crash
+    oldest = store.entries()[0]["file"]
+    open(tmp_path / oldest, "wb").write(b"")
+    store2 = CheckpointStore(str(tmp_path))
+    assert store2.load_latest(d._init_state) is None
+    assert len(store2.torn) == 2
+
+
+def test_store_crc_catches_payload_bitflip(tmp_path):
+    d, _ = _zoo("flap")
+    store = CheckpointStore(str(tmp_path))
+    store.save(d._init_state)
+    path = tmp_path / store.entries()[0]["file"]
+    blob = bytearray(open(path, "rb").read())
+    blob[-10] ^= 0x40  # one flipped bit deep in the last lane
+    open(path, "wb").write(bytes(blob))
+    assert store.load_latest(d._init_state) is None
+    assert store.torn
+
+
+def test_store_manifest_chains_and_tolerates_torn_tail(tmp_path):
+    d, _ = _zoo("flap")
+    store = CheckpointStore(str(tmp_path))
+    store.save(d._init_state, meta={"n": 1})
+    store.save(d._init_state, meta={"n": 2})
+    ents = store.entries()
+    assert [e["seq"] for e in ents] == [0, 1]
+    assert ents[1]["prev"] == ents[0]["file"]
+    # a torn manifest append (crash mid-commit) is tolerated and the
+    # next commit continues the chain past it
+    with open(store.manifest_path, "a") as fh:
+        fh.write('{"seq": 99, "fi')
+    store2 = CheckpointStore(str(tmp_path))
+    assert [e["seq"] for e in store2.entries()] == [0, 1]
+    store2.save(d._init_state, meta={"n": 3})
+    assert [e["seq"] for e in store2.entries()] == [0, 1, 2]
+    out = store2.load_latest(d._init_state)
+    assert out is not None and out[0]["n"] == 3
+
+
+def test_store_sweeps_stale_tmp_files(tmp_path):
+    d, _ = _zoo("flap")
+    stale = tmp_path / ".tmp-ckpt-00000007.bin"
+    stale.write_bytes(b"half a snapshot")
+    store = CheckpointStore(str(tmp_path))
+    store.save(d._init_state)
+    assert not stale.exists()
+    assert not glob.glob(str(tmp_path / ".tmp-*"))
+
+
+def test_store_template_mismatch_is_torn_not_crash(tmp_path):
+    d, _ = _zoo("flap")
+    store = CheckpointStore(str(tmp_path))
+    store.save(d._init_state)
+    # restoring into a template with a different pytree is damage,
+    # not an exception: fall back like any other torn snapshot
+    assert store.load_latest({"x": np.zeros(3)}) is None
+    assert store.torn
+
+
+# ---- write-ahead log -------------------------------------------------
+
+
+def test_wal_roundtrip_replay_cursor_reset(tmp_path):
+    m = _map()
+    state = ClusterState.from_osdmap(m)
+    incs = [
+        Incremental(epoch=m.epoch + 1, new_state={3: UP, 7: UP}),
+        Incremental(epoch=m.epoch + 2, new_weight={5: 0x8000},
+                    new_primary_affinity={2: 0}),
+    ]
+    want = state
+    for inc in incs:
+        want = apply_incremental(want, inc)
+    path = str(tmp_path / "wal.jsonl")
+    with WriteAheadLog(path) as wal:
+        wal.append_incremental(incs[0], t=0.5)
+        wal.append_incremental(incs[1], t=1.0)
+        wal.append_cursor(step=8, tape_cursor=2, now=2.0)
+        assert len(wal.read(path)) == 3
+        got = wal.replay(state)
+        assert diff_states(got, want) == []
+        # idempotent: records at-or-below the state's epoch are skipped
+        assert diff_states(wal.replay(got), want) == []
+        assert wal.cursor()["step"] == 8
+        wal.reset()
+        assert wal.read(path) == [] and wal.cursor() is None
+
+
+def test_wal_and_jsonl_torn_tail_tolerance(tmp_path):
+    path = str(tmp_path / "wal.jsonl")
+    with WriteAheadLog(path) as wal:
+        wal.append_cursor(step=4, tape_cursor=1, now=1.0)
+        wal.append_cursor(step=8, tape_cursor=2, now=2.0)
+    with open(path, "a") as fh:
+        fh.write('{"kind": "curs')  # torn final append
+    recs = WriteAheadLog.read(path)
+    assert [r["step"] for r in recs] == [4, 8]
+    # a malformed line FOLLOWED by valid records is corruption, not a
+    # torn tail, and raises with the line number
+    bad = str(tmp_path / "bad.jsonl")
+    with open(bad, "w") as fh:
+        fh.write('{"kind": "curs\n{"kind": "cursor", "step": 4}\n')
+    with pytest.raises(ValueError, match="bad.jsonl:1"):
+        _read_jsonl_tolerant(bad)
+    assert _read_jsonl_tolerant(str(tmp_path / "missing.jsonl")) == []
+
+
+# ---- checkpointed superstep: resume + kill matrix --------------------
+
+
+def test_checkpointed_superstep_matches_plain_run(tmp_path):
+    d, ref = _zoo("flap")
+    store = CheckpointStore(str(tmp_path))
+    wal = WriteAheadLog(str(tmp_path / "wal.jsonl"))
+    series = checkpointed_superstep(
+        d, N_EPOCHS, store=store, snapshot_every=EVERY, wal=wal
+    )
+    assert ref.diff(series) == []
+    assert len(store.entries()) == N_EPOCHS // EVERY
+    # the WAL holds exactly the post-snapshot cursor
+    assert wal.cursor()["step"] == N_EPOCHS
+    # a second entry restores from the store without scanning anything
+    # and returns the identical series
+    again = checkpointed_superstep(
+        d, N_EPOCHS, store=store, snapshot_every=EVERY
+    )
+    assert ref.diff(again) == []
+    assert len(store.entries()) == N_EPOCHS // EVERY
+
+
+def test_checkpointed_superstep_zero_epochs(tmp_path):
+    d, _ = _zoo("flap")
+    store = CheckpointStore(str(tmp_path))
+    series = checkpointed_superstep(d, 0, store=store, snapshot_every=EVERY)
+    assert len(series) == 0
+    assert store.entries() == []
+
+
+@pytest.mark.parametrize("scenario", ZOO)
+@pytest.mark.parametrize("phase", ("before", "during", "after"))
+def test_kill_and_restore_bitequal_over_zoo(tmp_path, scenario, phase):
+    d, ref = _zoo(scenario)
+    store = CheckpointStore(str(tmp_path))
+    with pytest.raises(SimulatedCrash) as ei:
+        checkpointed_superstep(
+            d, N_EPOCHS, store=store, snapshot_every=EVERY,
+            crashes=(CrashPoint(CRASH_EPOCH, phase),),
+        )
+    assert ei.value.epoch == CRASH_EPOCH and ei.value.phase == phase
+    # disk evidence per phase: the epoch-8 snapshot is committed only
+    # when the kill lands after the commit; a mid-write kill leaves a
+    # torn tmp file the resume sweeps
+    assert len(store.entries()) == (2 if phase == "after" else 1)
+    if phase == "during":
+        assert glob.glob(str(tmp_path / ".tmp-*"))
+    resumed = CheckpointStore(str(tmp_path))
+    out = checkpointed_superstep(
+        d, N_EPOCHS, store=resumed, snapshot_every=EVERY
+    )
+    assert ref.diff(out) == [], (scenario, phase)
+    assert len(resumed.entries()) == N_EPOCHS // EVERY
+    assert not glob.glob(str(tmp_path / ".tmp-*"))
+
+
+def test_kill_tuple_coercion_and_final_epoch(tmp_path):
+    # (epoch, phase) tuples coerce to CrashPoints, and a crash seeded
+    # exactly at the final epoch fires at the last boundary
+    d, ref = _zoo("flap")
+    store = CheckpointStore(str(tmp_path))
+    with pytest.raises(SimulatedCrash):
+        checkpointed_superstep(
+            d, N_EPOCHS, store=store, snapshot_every=EVERY,
+            crashes=((N_EPOCHS, "before"),),
+        )
+    out = checkpointed_superstep(
+        d, N_EPOCHS, store=store, snapshot_every=EVERY
+    )
+    assert ref.diff(out) == []
+
+
+# ---- snapshot-boundary edge cases (satellite) ------------------------
+
+
+def test_run_superstep_zero_epochs_typed_empty():
+    d, _ = _zoo("flap")
+    empty = d.run_superstep(0)
+    assert len(empty) == 0
+    for f in _SERIES_FIELDS:
+        assert getattr(empty, f).shape[0] == 0, f
+    # the staged reference honors the same typed-empty contract
+    staged = d.run_staged(0)
+    assert empty.diff(staged) == []
+
+
+def test_run_superstep_boundary_at_final_epoch():
+    d, ref = _zoo("flap")
+    seen = []
+    # snapshot_every == n_epochs: exactly one boundary, at the end
+    series = d.run_superstep(
+        EVERY, snapshot_every=EVERY,
+        on_snapshot=lambda start, part: seen.append((start, len(part))),
+    )
+    assert seen == [(0, EVERY)]
+    # snapshot_every past the run length degrades to the same single
+    # final-epoch boundary
+    seen2 = []
+    series2 = d.run_superstep(
+        EVERY, snapshot_every=EVERY + 1,
+        on_snapshot=lambda start, part: seen2.append((start, len(part))),
+    )
+    assert seen2 == [(0, EVERY)]
+    for f in _SERIES_FIELDS:
+        assert np.array_equal(getattr(series, f), getattr(ref, f)[:EVERY])
+        assert np.array_equal(getattr(series2, f), getattr(ref, f)[:EVERY])
+
+
+def test_on_snapshot_raising_fails_loudly():
+    d, _ = _zoo("flap")
+    seen = []
+
+    def boom(start, part):
+        seen.append(start)
+        if start >= EVERY:
+            raise RuntimeError("journal sink failed")
+
+    with pytest.raises(RuntimeError, match="journal sink failed"):
+        d.run_superstep(3 * EVERY, snapshot_every=EVERY, on_snapshot=boom)
+    # it failed at the second boundary, after delivering the first
+    assert seen == [0, EVERY]
+
+
+# ---- fleet -----------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet_story():
+    m = _map()
+    fd = FleetDriver(m, seed=0, n_ops=64)
+    tls = fd.sample(2, "flap")
+    ref = fd.run_fleet(N_EPOCHS, tls)
+    return fd, tls, ref
+
+
+def test_fleet_kill_and_restore_bitequal(tmp_path, fleet_story):
+    fd, tls, ref = fleet_story
+    store = CheckpointStore(str(tmp_path))
+    with pytest.raises(SimulatedCrash):
+        checkpointed_fleet(
+            fd, N_EPOCHS, tls, store=store, snapshot_every=EVERY,
+            crashes=(CrashPoint(CRASH_EPOCH, "during"),),
+        )
+    assert glob.glob(str(tmp_path / ".tmp-*"))
+    resumed = CheckpointStore(str(tmp_path))
+    fs = checkpointed_fleet(
+        fd, N_EPOCHS, tls, store=resumed, snapshot_every=EVERY
+    )
+    for i in range(len(tls)):
+        assert ref.cluster(i).diff(fs.cluster(i)) == [], i
+
+
+# ---- divergent multi-rank --------------------------------------------
+
+_DIVERGENT_CFG = {
+    "scenario": "flap",
+    "rank_specs": [[0.5, "rankdelay:1.2500"]],
+}
+
+
+def _divergent_driver(m, n_ranks=2):
+    # EXACTLY the _crashbox construction, so the subprocess leg can
+    # compare against the same in-process reference
+    return DivergentDriver(
+        m, crashbox_timeline(_DIVERGENT_CFG, m), n_ranks,
+        seed=0, n_ops=64,
+    )
+
+
+@pytest.fixture(scope="module")
+def divergent_story(tmp_path_factory):
+    root = tmp_path_factory.mktemp("divergent")
+    m = _map()
+    ref = _divergent_driver(m)
+    ref_res = ref.run(N_EPOCHS)
+    store = CheckpointStore(str(root / "store"))
+    crashed = _divergent_driver(m)
+    with pytest.raises(SimulatedCrash):
+        crashed.run(
+            N_EPOCHS, store=store,
+            crashes=(CrashPoint(CRASH_EPOCH, "during"),),
+        )
+    revived = _divergent_driver(m)
+    res = revived.run(N_EPOCHS, store=store)
+    return m, ref, ref_res, revived, res, store
+
+
+def test_divergent_kill_and_restore_bitequal(divergent_story):
+    _, ref, ref_res, revived, res, _ = divergent_story
+    assert res.converged == ref_res.converged
+    assert len(res.rounds) == len(ref_res.rounds)
+    assert res.rounds[-1].fingerprints == ref_res.rounds[-1].fingerprints
+    assert revived.cur == ref.cur
+    for r, (a, b) in enumerate(zip(ref_res.states, res.states)):
+        assert diff_states(a, b) == [], f"rank {r}"
+
+
+def test_divergent_fingerprint_guard_refuses_drift(divergent_story):
+    m, _, _, _, _, store = divergent_story
+    newest = store.entries()[-1]["file"]
+    path = os.path.join(store.root, newest)
+    blob = open(path, "rb").read()
+    header, payload = blob.split(b"\n", 1)
+    hdr = json.loads(header)
+    hdr["meta"]["fingerprints"][0] ^= 1
+    open(path, "wb").write(
+        json.dumps(hdr, sort_keys=True).encode() + b"\n" + payload
+    )
+    probe = _divergent_driver(m)
+    with pytest.raises(CheckpointError, match="divergent revival"):
+        restore_divergent(store, probe)
+    # restore the snapshot for any later test using the fixture store
+    open(path, "wb").write(blob)
+
+
+def test_divergent_rank_count_guard(divergent_story):
+    m, _, _, _, _, store = divergent_story
+    probe = _divergent_driver(m, n_ranks=3)
+    # a 3-rank driver cannot revive from a 2-rank fleet snapshot: the
+    # stacked template mismatch surfaces as no-valid-snapshot, never a
+    # silent partial restore
+    assert restore_divergent(store, probe) is None
+
+
+# ---- process-kill chaos: real SIGKILL through _crashbox --------------
+
+
+def _crashbox_cfg(tmp_path, mode, kill):
+    return {
+        "mode": mode,
+        "store": str(tmp_path / "store"),
+        "out": str(tmp_path / "out.npz"),
+        "n_osds": 32, "pg_num": 64, "size": 6,
+        "pool_kind": "erasure",
+        "scenario": "flap",
+        "n_epochs": N_EPOCHS, "snapshot_every": EVERY,
+        "n_ops": 64, "seed": 0,
+        "kill": kill,
+    }
+
+
+def _run_crashbox(tmp_path, cfg):
+    from ceph_tpu.common.hermetic import scrubbed_env
+
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(json.dumps(cfg))
+    proc = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.recovery._crashbox",
+         str(cfg_path)],
+        cwd=_REPO, env=scrubbed_env(_REPO, n_devices=8),
+        capture_output=True, text=True, timeout=300,
+    )
+    return proc
+
+
+def test_sigkill_superstep_subprocess_bitequal(tmp_path):
+    """Acceptance: a real SIGKILL mid-checkpoint-write, then a rerun
+    of the same config, lands bit-equal to the uninterrupted run."""
+    cfg = _crashbox_cfg(tmp_path, "superstep",
+                        {"epoch": CRASH_EPOCH, "phase": "during"})
+    killed = _run_crashbox(tmp_path, cfg)
+    assert killed.returncode == -signal.SIGKILL, killed.stderr[-2000:]
+    # the kill landed mid-write: a torn tmp file proves it
+    assert glob.glob(os.path.join(cfg["store"], ".tmp-*"))
+    cfg["kill"] = None
+    resumed = _run_crashbox(tmp_path, cfg)
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    _, ref = _zoo("flap")
+    out = np.load(cfg["out"])
+    for f in _SERIES_FIELDS:
+        assert np.array_equal(out[f], getattr(ref, f)), f
+
+
+@pytest.mark.slow
+def test_sigkill_fleet_subprocess_bitequal(tmp_path, fleet_story):
+    _, _, ref = fleet_story
+    cfg = _crashbox_cfg(tmp_path, "fleet",
+                        {"epoch": CRASH_EPOCH, "phase": "during"})
+    cfg["fleet_n"] = 2
+    cfg["lane"] = 1
+    killed = _run_crashbox(tmp_path, cfg)
+    assert killed.returncode == -signal.SIGKILL, killed.stderr[-2000:]
+    cfg["kill"] = None
+    resumed = _run_crashbox(tmp_path, cfg)
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    lane = ref.cluster(1)
+    out = np.load(cfg["out"])
+    for f in _SERIES_FIELDS:
+        assert np.array_equal(out[f], getattr(lane, f)), f
+
+
+@pytest.mark.slow
+def test_sigkill_divergent_subprocess_bitequal(tmp_path, divergent_story):
+    _, _, ref_res, _, _, _ = divergent_story
+    cfg = _crashbox_cfg(tmp_path, "divergent",
+                        {"epoch": CRASH_EPOCH, "phase": "during"})
+    cfg["n_ranks"] = 2
+    cfg["rank_specs"] = _DIVERGENT_CFG["rank_specs"]
+    killed = _run_crashbox(tmp_path, cfg)
+    assert killed.returncode == -signal.SIGKILL, killed.stderr[-2000:]
+    cfg["kill"] = None
+    resumed = _run_crashbox(tmp_path, cfg)
+    assert resumed.returncode == 0, resumed.stderr[-2000:]
+    out = np.load(cfg["out"])
+    assert bool(out["converged"][0]) == ref_res.converged
+    assert tuple(out["fingerprints"][-1]) == (
+        ref_res.rounds[-1].fingerprints
+    )
+    for r, state in enumerate(ref_res.states):
+        leaves = jax.device_get(jax.tree_util.tree_flatten(state)[0])
+        for i, leaf in enumerate(leaves):
+            key = f"rank{r}_leaf{i:03d}"
+            assert np.array_equal(out[key], np.asarray(leaf)), key

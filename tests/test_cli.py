@@ -757,3 +757,36 @@ def test_status_cli_socket_error(tmp_path, capsys):
     assert scli.main(["status", "--socket",
                       str(tmp_path / "absent.asok")]) == 1
     assert "status:" in capsys.readouterr().err
+
+
+def test_status_cli_checkpoint_panel(tmp_path, capsys):
+    from ceph_tpu.cli import status as scli
+
+    rec = {
+        "metric": "checkpoint_write_bandwidth_bps",
+        "status": "ok", "value": 123456789, "platform": "cpu",
+        "checkpoint_scenario": "flap", "checkpoint_n_epochs": 256,
+        "checkpoint_snapshot_every": 16,
+        "checkpoint_snapshot_bytes": 98304,
+        "checkpoint_n_snapshots": 16,
+        "checkpoint_restore_s": 0.25, "checkpoint_load_s": 0.05,
+        "checkpoint_replay_s": 0.2, "checkpoint_bitequal": True,
+        "checkpoint_torn_fallback_ok": True,
+        "checkpoint_overhead_panel": [
+            {"snapshot_every": 16, "n_snapshots": 16, "run_s": 1.1,
+             "baseline_s": 1.0, "overhead_fraction": 0.1},
+        ],
+    }
+    log = tmp_path / "BENCH_LOG.json"
+    log.write_text(json.dumps(rec) + "\n")
+    assert scli.main(["checkpoint", "--bench-log", str(log)]) == 0
+    out = capsys.readouterr().out
+    assert "checkpoint: 256 epochs (flap)" in out
+    assert "98,304 B/snapshot" in out
+    assert "bitequal=ok" in out
+    assert "snapshot_every=  16" in out
+    # no record anywhere -> loud exit, not an empty panel
+    empty = tmp_path / "EMPTY.json"
+    empty.write_text("")
+    assert scli.main(["checkpoint", "--bench-log", str(empty)]) == 1
+    assert "config9_checkpoint" in capsys.readouterr().err

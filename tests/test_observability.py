@@ -855,6 +855,64 @@ def test_journal_unbounded_never_rotates(tmp_path):
     assert len(EventJournal.read(path)) == 200
 
 
+def test_journal_resume_repairs_torn_tail(tmp_path):
+    # regression: a crash mid-append leaves a torn final line; a
+    # restarted journal must truncate it BEFORE appending, or the new
+    # record glues onto the fragment and poisons every later read
+    path = str(tmp_path / "j.jsonl")
+    with EventJournal(path=path) as j:
+        for i in range(3):
+            j.event("tick", i=i)
+    with open(path, "a") as fh:
+        fh.write('{"kind": "event", "name": "to')
+    with EventJournal(path=path) as j:
+        j.event("after-restart")
+    back = EventJournal.read(path)
+    assert [r["name"] for r in back] == [
+        "tick", "tick", "tick", "after-restart",
+    ]
+
+
+def test_journal_resume_trims_preexisting_rotated_segments(tmp_path):
+    # regression: the disk cap must count segments a PREVIOUS process
+    # rotated — a restart with a tighter max_segments trims the excess
+    path = str(tmp_path / "j.jsonl")
+    for n in (1, 2, 3):
+        with open(f"{path}.{n}", "w") as fh:
+            fh.write(json.dumps({"kind": "event", "name": f"old{n}"}) + "\n")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"kind": "event", "name": "live"}) + "\n")
+    with EventJournal(path=path, max_bytes=10_000, max_segments=2):
+        pass
+    assert os.path.exists(path + ".1")
+    assert not os.path.exists(path + ".2")
+    assert not os.path.exists(path + ".3")
+    back = EventJournal.read_rotated(path)
+    assert [r["name"] for r in back] == ["old1", "live"]
+
+
+def test_read_rotated_tolerates_torn_tail_of_stream_only(tmp_path):
+    # regression: only the newest segment of the STITCHED stream may
+    # end torn.  With an empty live file that newest segment is the
+    # newest rotated one; a torn line in any OLDER segment is real
+    # corruption and raises
+    path = str(tmp_path / "j.jsonl")
+    with open(f"{path}.2", "w") as fh:
+        fh.write(json.dumps({"kind": "event", "name": "oldest"}) + "\n")
+    with open(f"{path}.1", "w") as fh:
+        fh.write(json.dumps({"kind": "event", "name": "newer"}) + "\n")
+        fh.write('{"kind": "ev')  # torn tail of the stream
+    open(path, "w").close()
+    back = EventJournal.read_rotated(path)
+    assert [r["name"] for r in back] == ["oldest", "newer"]
+    # a non-empty live file makes .1 a NON-final segment: now its torn
+    # line must raise instead of being silently skipped
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"kind": "event", "name": "live"}) + "\n")
+    with pytest.raises(ValueError, match="non-final"):
+        EventJournal.read_rotated(path)
+
+
 # ---- divergent-rank timeline hooks + SLO_RANK_STALL (satellite) ------
 
 
@@ -891,3 +949,54 @@ def test_slo_rank_stall_grades():
     rep = evaluate(tl, spec)
     assert rep.check("SLO_RANK_STALL").status == HEALTH_ERR
     assert rep.status == HEALTH_ERR
+
+
+# ---- checkpoint-age timeline hook + SLO_CHECKPOINT_AGE (satellite) ---
+
+
+def test_health_timeline_checkpoint_age():
+    clock = rec.VirtualClock()
+    tl = HealthTimeline(clock.now)
+    assert tl.max_checkpoint_age() == 0.0  # no samples yet
+    tl.snapshot(_synth([0b1111], [4], [0]))          # t=0
+    clock.advance(2.0)
+    tl.note_checkpoint()                             # t=2
+    clock.advance(5.0)
+    tl.note_checkpoint()                             # t=7
+    clock.advance(1.0)
+    tl.snapshot(_synth([0b1111], [4], [0]))          # t=8
+    # gaps: start->2, 2->7, 7->end = 2, 5, 1
+    assert tl.max_checkpoint_age() == 5.0
+    assert tl.checkpoint_times == [2.0, 7.0]
+
+
+def test_slo_checkpoint_age_grades():
+    spec = SLOSpec(max_checkpoint_age_s=6.0)
+    # no samples at all: vacuously OK
+    clock = rec.VirtualClock()
+    tl = HealthTimeline(clock.now)
+    c = evaluate(tl, spec).check("SLO_CHECKPOINT_AGE")
+    assert c.status == HEALTH_OK and "no samples" in c.detail
+    # samples but no commit ever: the whole run is at risk -> ERR
+    tl.snapshot(_synth([0b1111], [4], [0]))
+    rep = evaluate(tl, spec)
+    c = rep.check("SLO_CHECKPOINT_AGE")
+    assert c.status == HEALTH_ERR and "no checkpoint" in c.detail
+    # commits inside the budget: OK, with the RPO in the detail
+    clock.advance(2.0)
+    tl.note_checkpoint()
+    clock.advance(1.0)
+    tl.snapshot(_synth([0b1111], [4], [0]))
+    c = evaluate(tl, spec).check("SLO_CHECKPOINT_AGE")
+    assert c.status == HEALTH_OK and "budget 6s" in c.detail
+    # a long commit-free interval blows the budget -> ERR
+    clock.advance(9.0)
+    tl.snapshot(_synth([0b1111], [4], [0]))
+    rep = evaluate(tl, spec)
+    assert rep.check("SLO_CHECKPOINT_AGE").status == HEALTH_ERR
+    assert rep.check("SLO_CHECKPOINT_AGE").observed == 10.0
+    # warn band just under the budget
+    c = evaluate(
+        tl, SLOSpec(max_checkpoint_age_s=11.0)
+    ).check("SLO_CHECKPOINT_AGE")
+    assert c.status == HEALTH_WARN
