@@ -1,0 +1,223 @@
+"""BASELINE config 10: the online EC write path.
+
+Measures the device-resident stripe buffer + parity-delta subsystem
+(:mod:`ceph_tpu.ec.online` / :mod:`ceph_tpu.workload.writepath`) under
+the three SSD traffic mixes:
+
+- **encoded GB/s** — bytes of parity-producing encode work (footprint
+  delta programs + whole-stripe encodes) per second of wall time over
+  the fused superstep scan, per mix and as the headline best;
+- **stripe-cache hit rate** — the fraction of committed writes served
+  from a resident stripe (arXiv:1709.05365's dominant small-write
+  cost factor: a miss pays a whole-stripe install encode, a hit pays
+  only its footprint delta);
+- **parity-delta vs full-stripe bytes** — the split the
+  ``cli.status writepath`` panel renders.
+
+Everything is gated in-record on ``writepath_bitequal``: for EVERY
+minimal-density family (liberation, blaum_roth, liber8tion), the
+cauchy-good expansion and RS-w8, parity after a seeded sequence of
+delta updates must be byte-identical to a dense full-stripe re-encode
+— a wrong delta program zeroes the headline.  Emits one JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+N_OSDS = int(os.environ.get("CEPH_TPU_BENCH_WP_OSDS", 64))
+PG_NUM = int(os.environ.get("CEPH_TPU_BENCH_WP_PGS", 128))
+N_OPS = int(os.environ.get("CEPH_TPU_BENCH_WP_OPS", 256))
+EPOCHS = int(os.environ.get("CEPH_TPU_BENCH_WP_EPOCHS", 128))
+SCENARIO = os.environ.get("CEPH_TPU_BENCH_WP_SCENARIO", "flap")
+SEED = int(os.environ.get("CEPH_TPU_BENCH_WP_SEED", 0))
+N_SETS = int(os.environ.get("CEPH_TPU_BENCH_WP_SETS", 64))
+WAYS = int(os.environ.get("CEPH_TPU_BENCH_WP_WAYS", 4))
+GROUPS = int(os.environ.get("CEPH_TPU_BENCH_WP_GROUPS", 8))
+#: delta updates per family in the bit-equality gate
+GATE_UPDATES = int(os.environ.get("CEPH_TPU_BENCH_WP_GATE_N", 64))
+MIXES = tuple(
+    x for x in os.environ.get(
+        "CEPH_TPU_BENCH_WP_MIXES", "ssd-steady,ssd-burst,ssd-skew"
+    ).split(",") if x
+)
+EC_K, EC_M = 4, 2
+
+
+def gate_families():
+    """(name, bitmatrix, w) for every codec family the bit-equality
+    gate must hold on: the minimal-density RAID-6 codes plus the
+    cauchy-good and RS-w8 GF(2^8) expansions."""
+    from ceph_tpu.ec import gf, gfw
+
+    return (
+        ("liberation", gfw.liberation_bitmatrix(4, 7), 7),
+        ("blaum_roth", gfw.blaum_roth_bitmatrix(4, 6), 6),
+        ("liber8tion", gfw.liber8tion_bitmatrix(4), 8),
+        ("cauchy", gf.matrix_to_bitmatrix(
+            gf.cauchy_good_matrix(EC_K, EC_M)), 8),
+        ("rs_w8", gf.matrix_to_bitmatrix(
+            gf.vandermonde_matrix(EC_K, EC_M)), 8),
+    )
+
+
+def bitequal_gate(n_updates: int = GATE_UPDATES, seed: int = SEED):
+    """The ``writepath_bitequal`` verdict: per family, apply a seeded
+    sequence of random-footprint delta updates through the cached
+    Paar-CSE delta programs and require the final parity to be
+    byte-identical to the dense full re-encode of the final data."""
+    import numpy as np
+
+    from ceph_tpu.ec.online import ParityDeltaEngine
+
+    rng = np.random.default_rng(seed)
+    verdicts = {}
+    for name, bits, w in gate_families():
+        eng = ParityDeltaEngine(bits, w=w, packetsize=8)
+        size = 2 * w * eng.packetsize
+        data = rng.integers(0, 256, (eng.k, size), dtype=np.uint8)
+        parity = eng.encode(data)
+        ok = bool(np.array_equal(parity, eng.dense_parity(data)))
+        for _ in range(n_updates):
+            nf = int(rng.integers(1, eng.k + 1))
+            fp = tuple(sorted(
+                rng.choice(eng.k, nf, replace=False).tolist()
+            ))
+            new = rng.integers(
+                0, 256, (len(fp), size), dtype=np.uint8
+            )
+            parity = eng.apply_delta(parity, fp, data[list(fp)], new)
+            data[list(fp)] = new
+        ok = ok and bool(
+            np.array_equal(parity, eng.dense_parity(data))
+        )
+        verdicts[name] = ok
+    return verdicts
+
+
+def build_writepath_record(platform, value, hit_rate, bitequal,
+                           families, totals, sched_entries, mix_panel,
+                           batch):
+    """One JSON line for the write-path headline.
+
+    ``value`` is the best per-mix encoded bandwidth in bytes/s;
+    ``writepath_mix_panel`` carries one row per traffic mix (the
+    ``cli.status writepath`` panel's rows); ``writepath_bitequal``
+    gates the record on the delta-vs-dense byte equality across every
+    codec family in ``writepath_families``.
+    """
+    return {
+        "metric": "writepath_encoded_bytes_per_sec",
+        "status": "ok",
+        "value": round(value),
+        "unit": "B/s",
+        "platform": platform,
+        "writepath_scenario": SCENARIO,
+        "writepath_n_epochs": int(EPOCHS),
+        "writepath_batch": int(batch),
+        "writepath_n_sets": int(N_SETS),
+        "writepath_ways": int(WAYS),
+        "writepath_hit_rate": round(hit_rate, 6),
+        "writepath_bitequal": bool(bitequal),
+        "writepath_families": ",".join(families),
+        "writepath_stripe_hits": int(totals["hits"]),
+        "writepath_stripe_misses": int(totals["misses"]),
+        "writepath_stripe_evictions": int(totals["evictions"]),
+        "writepath_delta_bytes": 4 * int(totals["delta_words"]),
+        "writepath_full_bytes": 4 * int(totals["full_words"]),
+        "writepath_schedule_entries": int(sched_entries),
+        "writepath_mix_panel": mix_panel,
+    }
+
+
+def main() -> None:
+    from ceph_tpu.common.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    import jax
+
+    from ceph_tpu.models.clusters import build_osdmap
+    from ceph_tpu.recovery.chaos import build_scenario
+    from ceph_tpu.recovery.superstep import EpochDriver
+    from ceph_tpu.workload.writepath import WritepathDriver
+
+    # -- the gate first: a wrong delta must zero the headline ----------
+    verdicts = bitequal_gate()
+    bitequal = all(verdicts.values())
+    for name, ok in verdicts.items():
+        print(f"bitequal {name}: {'ok' if ok else 'FAIL'}",
+              file=sys.stderr)
+
+    # -- per-mix measured runs -----------------------------------------
+    mix_panel = []
+    best = 0.0
+    best_wd = None
+    agg = None
+    for mix in MIXES:
+        m = build_osdmap(
+            N_OSDS, pg_num=PG_NUM, size=EC_K + EC_M,
+            pool_kind="erasure",
+        )
+        d = EpochDriver(
+            m, build_scenario(SCENARIO, m), seed=SEED, n_ops=N_OPS,
+            mix=mix,
+        )
+        wd = WritepathDriver(d, n_sets=N_SETS, ways=WAYS, groups=GROUPS)
+        wd.run_superstep(EPOCHS)  # warm the compiled scan
+        t0 = time.perf_counter()
+        _, wsup = wd.run_superstep(EPOCHS)
+        run_s = time.perf_counter() - t0
+        tot = wsup.totals()
+        agg = (
+            tot if agg is None
+            else {k: agg[k] + v for k, v in tot.items()}
+        )
+        enc_bytes = 4 * (tot["delta_words"] + tot["full_words"])
+        bps = enc_bytes / max(run_s, 1e-9)
+        lookups = tot["hits"] + tot["misses"]
+        hit_rate = tot["hits"] / max(lookups, 1)
+        if bps > best:
+            best, best_wd = bps, wd
+        mix_panel.append({
+            "mix": mix,
+            "hit_rate": round(hit_rate, 6),
+            "encoded_bytes_per_sec": round(bps, 1),
+            "delta_bytes": 4 * int(tot["delta_words"]),
+            "full_bytes": 4 * int(tot["full_words"]),
+            "delta_writes": int(tot["delta_writes"]),
+            "full_writes": int(tot["full_writes"]),
+            "run_s": round(run_s, 6),
+        })
+        print(
+            f"{mix}: {bps / 1e9:.4f} GB/s encoded, "
+            f"hit_rate={hit_rate:.4f} "
+            f"({tot['hits']:,}/{lookups:,}), "
+            f"delta={4 * tot['delta_words']:,}B "
+            f"full={4 * tot['full_words']:,}B in {run_s:.3f}s",
+            file=sys.stderr,
+        )
+
+    lookups = agg["hits"] + agg["misses"]
+    hit_rate = agg["hits"] / max(lookups, 1)
+    sched_entries = len(
+        best_wd.engine.cache.dump().get("entries", [])
+    )
+    print(
+        f"writepath {SCENARIO}: best {best / 1e9:.4f} GB/s, "
+        f"aggregate hit_rate={hit_rate:.4f}, "
+        f"bitequal={'ok' if bitequal else 'FAIL'}",
+        file=sys.stderr,
+    )
+    print(json.dumps(build_writepath_record(
+        jax.default_backend(), best, hit_rate, bitequal,
+        [name for name, _, _ in gate_families()], agg, sched_entries,
+        mix_panel, best_wd.batch_size,
+    )))
+
+
+if __name__ == "__main__":
+    main()

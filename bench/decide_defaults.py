@@ -262,6 +262,28 @@ CHECKPOINT_BOOL_FIELDS = ("checkpoint_bitequal",
                           "checkpoint_torn_fallback_ok")
 CHECKPOINT_STR_FIELDS = ("checkpoint_scenario",)
 
+# config10_online_ec.py (PR 16): the online EC write path — what the
+# device-resident stripe cache and footprint-compiled parity-delta
+# programs deliver in encoded bytes/s, and how hit-rate-dominated the
+# small-write cost is (arXiv:1709.05365).  ``writepath_bitequal``
+# gates everything: parity after a seeded delta sequence must be
+# byte-identical to the dense full re-encode for every codec family
+# in ``writepath_families`` — a wrong delta is corruption, not a
+# measurement.
+WRITEPATH_INT_FIELDS = ("writepath_n_epochs",
+                        "writepath_batch",
+                        "writepath_n_sets",
+                        "writepath_ways",
+                        "writepath_stripe_hits",
+                        "writepath_stripe_misses",
+                        "writepath_stripe_evictions",
+                        "writepath_delta_bytes",
+                        "writepath_full_bytes",
+                        "writepath_schedule_entries")
+WRITEPATH_FLOAT_FIELDS = ("writepath_hit_rate",)
+WRITEPATH_BOOL_FIELDS = ("writepath_bitequal",)
+WRITEPATH_STR_FIELDS = ("writepath_scenario", "writepath_families")
+
 
 def harvest_aux(paths: list[str]) -> dict[str, int]:
     """Collect auxiliary metric -> best value from the logs.
@@ -433,6 +455,20 @@ def harvest_guard(paths: list[str]) -> dict[str, dict]:
             )
             fields.update(
                 {f: str(d[f]) for f in CHECKPOINT_STR_FIELDS if f in d}
+            )
+            fields.update(
+                {f: int(d[f]) for f in WRITEPATH_INT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: float(d[f])
+                 for f in WRITEPATH_FLOAT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: bool(d[f])
+                 for f in WRITEPATH_BOOL_FIELDS if f in d}
+            )
+            fields.update(
+                {f: str(d[f]) for f in WRITEPATH_STR_FIELDS if f in d}
             )
             # jaxlint per-rule counters (lint_active, lint_J007_active,
             # ...): dynamic key set — one field per registered rule, so

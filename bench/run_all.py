@@ -47,6 +47,7 @@ CONFIGS = [
     ("config7_epoch_loop", "bench/config7_epoch_loop.py"),
     ("config8_fleet", "bench/config8_fleet.py"),
     ("config9_checkpoint", "bench/config9_checkpoint.py"),
+    ("config10_online_ec", "bench/config10_online_ec.py"),
     ("tpu_tier", "bench/tpu_tier.py"),
 ]
 

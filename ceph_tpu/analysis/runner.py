@@ -25,14 +25,14 @@ from .findings import RULES, Finding, Suppressions
 HOT_SEGMENTS = frozenset(
     {"crush", "ec", "recovery", "osdmap", "balancer", "cli", "core",
      "parallel", "obs", "workload", "liveness", "superstep", "fleet",
-     "durability", "reconcile"}
+     "durability", "reconcile", "online", "writepath"}
 )
 
 #: path segments whose modules run on the VirtualClock (J010): real
 #: wall-clock reads there need a justified suppression
 VCLOCK_SEGMENTS = frozenset(
     {"recovery", "workload", "chaos", "liveness", "superstep", "fleet",
-     "durability", "reconcile"}
+     "durability", "reconcile", "online", "writepath"}
 )
 
 

@@ -26,7 +26,11 @@ convergence/laggy verdicts, per-rank final progress; bench logs only,
 like ``fleet``), ``checkpoint`` (the durable-snapshot panel from the
 latest ``config9_checkpoint`` bench record — write bandwidth,
 restore+replay time, steady-state overhead vs ``snapshot_every``;
-bench logs only, like ``fleet``).
+bench logs only, like ``fleet``), ``writepath`` (the online-EC
+write-path panel: stripe-cache hit/miss/evict, parity-delta vs
+full-stripe bytes, and encoded GB/s — from the latest
+``config10_online_ec`` bench record, or live from a daemon's
+``dump_stripe_cache`` hook when ``--socket`` is given).
 """
 
 from __future__ import annotations
@@ -36,10 +40,13 @@ import json
 import sys
 
 COMMANDS = ("status", "health", "timeline", "journal", "caches",
-            "fleet", "ranks", "checkpoint")
+            "fleet", "ranks", "checkpoint", "writepath")
 
 #: CLI command -> admin-socket prefix (identity unless listed)
-_SOCKET_PREFIX = {"caches": "dump_placement_caches"}
+_SOCKET_PREFIX = {
+    "caches": "dump_placement_caches",
+    "writepath": "dump_stripe_cache",
+}
 
 
 def _render(cmd: str, reply: dict, as_json: bool, out) -> None:
@@ -64,6 +71,20 @@ def _render(cmd: str, reply: dict, as_json: bool, out) -> None:
                 f"{c.get('misses', 0)} misses, "
                 f"{c.get('evictions', 0)} evictions"
                 + (f", {c['entries']} entries" if "entries" in c else ""),
+                file=out,
+            )
+    elif cmd == "writepath":
+        # live dump_stripe_cache reply: one row per registered buffer
+        for b in reply.get("buffers", []):
+            print(
+                f"{b.get('name', '?')}: "
+                f"{b.get('occupied', 0)}/{b.get('n_sets', 0) * b.get('ways', 0)}"
+                f" slots ({b.get('dirty_slots', 0)} dirty), "
+                f"hit_rate={b.get('hit_rate', 0):.4f} "
+                f"({b.get('hits', 0)} hits / {b.get('misses', 0)} misses"
+                f" / {b.get('evictions', 0)} evictions), "
+                f"delta={b.get('delta_bytes', 0):,}B "
+                f"full={b.get('full_bytes', 0):,}B",
                 file=out,
             )
     elif cmd == "timeline":
@@ -234,6 +255,49 @@ def render_checkpoint(rec: dict, out) -> None:
             f"({row.get('run_s', 0):.3f}s vs "
             f"{row.get('baseline_s', 0):.3f}s baseline, "
             f"{row.get('n_snapshots', 0)} snapshots)",
+            file=out,
+        )
+
+
+def load_writepath_record(paths=None) -> dict | None:
+    """Latest ``config10_online_ec`` record."""
+    return _load_bench_record("writepath_encoded_bytes_per_sec", paths)
+
+
+def render_writepath(rec: dict, out) -> None:
+    """Text panel for one ``config10_online_ec`` record: encoded-GB/s
+    headline with the bit-equality gate verdict, then per-mix
+    stripe-cache hit/miss/evict and parity-delta vs full-stripe byte
+    rows."""
+    bitequal = rec.get("writepath_bitequal")
+    print(
+        f"writepath: {rec.get('writepath_n_epochs', '?')} epochs x "
+        f"{rec.get('writepath_batch', '?')}-op write batches on "
+        f"{rec.get('platform', '?')}: "
+        f"{rec.get('value', 0) / 1e9:.4f} GB/s encoded, "
+        f"hit_rate={rec.get('writepath_hit_rate', 0):.4f}, "
+        f"bitequal={'ok' if bitequal else 'FAIL'} "
+        f"({rec.get('writepath_families', '?')})",
+        file=out,
+    )
+    print(
+        f"  stripe cache: {rec.get('writepath_stripe_hits', 0):,} hits "
+        f"/ {rec.get('writepath_stripe_misses', 0):,} misses "
+        f"/ {rec.get('writepath_stripe_evictions', 0):,} evictions, "
+        f"delta={rec.get('writepath_delta_bytes', 0):,}B "
+        f"full={rec.get('writepath_full_bytes', 0):,}B, "
+        f"{rec.get('writepath_schedule_entries', 0)} cached programs",
+        file=out,
+    )
+    for row in rec.get("writepath_mix_panel") or []:
+        print(
+            f"  {row.get('mix', '?'):<12} "
+            f"hit_rate={row.get('hit_rate', 0):.4f} "
+            f"encoded={row.get('encoded_bytes_per_sec', 0) / 1e9:.4f}GB/s "
+            f"delta={row.get('delta_bytes', 0):,}B "
+            f"full={row.get('full_bytes', 0):,}B "
+            f"({row.get('delta_writes', 0):,} delta / "
+            f"{row.get('full_writes', 0):,} full writes)",
             file=out,
         )
 
@@ -508,6 +572,22 @@ def main(argv=None) -> int:
             print(json.dumps(rec, sort_keys=True), file=out)
         else:
             render_checkpoint(rec, out)
+        return 0
+
+    if args.command == "writepath" and args.socket is None:
+        rec = load_writepath_record(args.bench_log)
+        if rec is None:
+            print(
+                "status: no config10_online_ec record found (run "
+                "bench/config10_online_ec.py, pass --bench-log, or "
+                "--socket for a live dump_stripe_cache)",
+                file=sys.stderr,
+            )
+            return 1
+        if args.as_json:
+            print(json.dumps(rec, sort_keys=True), file=out)
+        else:
+            render_writepath(rec, out)
         return 0
 
     if args.socket is not None:

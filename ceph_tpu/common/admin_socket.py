@@ -38,6 +38,7 @@ class AdminSocket:
         self.register(
             "dump_placement_caches", self._dump_placement_caches
         )
+        self.register("dump_stripe_cache", self._dump_stripe_cache)
         self.register("help", lambda cmd: {"commands": sorted(self._hooks)})
 
     @staticmethod
@@ -54,6 +55,13 @@ class AdminSocket:
         from ..recovery.pipeline import dump_placement_caches
 
         return dump_placement_caches()
+
+    @staticmethod
+    def _dump_stripe_cache(cmd: dict) -> dict:
+        # lazy import, same reason as _dump_ec_schedules
+        from ..ec.online import dump_stripe_cache
+
+        return dump_stripe_cache()
 
     def _config_set(self, cmd: dict) -> dict:
         self.config.set(cmd["key"], cmd["value"])

@@ -1,0 +1,542 @@
+"""Online EC write path: device-resident stripe buffer + parity deltas.
+
+The traffic engine classifies write outcomes and models latency, but
+until now no write ever encoded a byte.  This module supplies the
+data-plane half of the online write path (arXiv:1709.05365's online-EC
+result: stripe-buffer hit rate dominates small-write cost on SSD
+arrays):
+
+- :class:`StripeBufferState` — an HBM-held stripe cache as one
+  fixed-shape pytree: power-of-two-bucketed sets x ways of slots keyed
+  by a packed ``(pg, stripe)`` id, each slot holding the stripe's data
+  and parity as packed u32 word rows (the XOR-schedule packet layout),
+  with per-slot dirty chunk masks and an LRU tick lane.  Being a pure
+  pytree it rides ``lax.scan`` carries and checkpoint snapshots
+  unchanged.
+- :func:`stripe_buffer_step` — one epoch's write batch absorbed on
+  device: a ``fori_loop`` does the cache maintenance (lookup, LRU
+  victim choice, install-from-backing-store, delta accumulation), then
+  ONE vmapped XOR-schedule application turns the accumulated per-slot
+  ``Δdata`` into ``Δparity = encode(Δdata)`` for every slot at once.
+  Installs and full-stripe writes zero the slot parity and stage the
+  whole stripe as a delta-from-zero, so the same fixed program covers
+  full-stripe encodes and read-modify-write parity deltas — encoding
+  is linear over GF(2), so ``new_parity = old_parity ^ encode(old ^
+  new)`` and ``encode(data) = 0 ^ encode(data - 0)`` are the same
+  algebra (arXiv:2108.02692's XOR programs, reused verbatim).
+- :class:`ParityDeltaEngine` — the host-facing small-write engine:
+  for an update footprint (the set of touched data chunks) the parity
+  delta is the generator sub-bitmatrix restricted to those chunk
+  columns, lowered through :func:`~ceph_tpu.ec.schedule
+  .compile_schedule`'s Paar CSE and cached in a
+  :class:`~ceph_tpu.ec.schedule.ScheduleCache` per
+  ``(codec, footprint)`` — repeated small-write shapes never
+  recompile, and the cache's counters/eviction/quarantine machinery
+  comes along for free.
+- ``dump_stripe_cache`` — the admin-socket hook body: every live
+  stripe buffer's occupancy and hit/miss/evict/byte counters, plus
+  the ``ec_writepath`` perf component.
+
+Scrub coverage for delta-updated parity (a wrong delta must be caught,
+not silently committed) lives in :mod:`ceph_tpu.recovery.scrub`
+(:meth:`Scrubber.note_stripe_writes` / ``scrub_stripe_buffer``), built
+on :func:`dense_parity_words` — an independent dense GF(2) product, so
+a miscompiled or corrupted delta program cannot verify itself.
+"""
+
+from __future__ import annotations
+
+import weakref
+from dataclasses import dataclass, replace
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..common.perf_counters import PerfCounters, PerfCountersBuilder, registry
+from ..core.hashes import crush_hash32_2
+from .schedule import ScheduleCache, XorScheduleEncoder, _xla_apply
+
+I32 = jnp.int32
+I64 = jnp.int64
+U32 = jnp.uint32
+
+#: decorrelate the set-index hash from the routing/payload hashes
+_SET_SALT = np.uint32(0xB5297A4D)
+#: per-op payload content seed salt
+_PAYLOAD_SALT = np.uint32(0x68E31DA4)
+#: backing-store stripe content salt (miss installs regenerate from it)
+_BASE_SALT = np.uint32(0x1B56C4E9)
+
+#: the per-epoch stripe-buffer output lanes, in row order
+WP_LANES = (
+    "hits", "misses", "evictions", "delta_writes", "full_writes",
+    "delta_words", "full_words", "touched_slots",
+)
+
+
+# ---------------------------------------------------------------------------
+# the device-resident stripe buffer
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass(frozen=True)
+class StripeBufferState:
+    """The HBM-held stripe cache as one fixed-shape pytree.
+
+    ``n_sets`` (a power of two — the set index is a hash masked by
+    ``n_sets - 1``) x ``ways`` slots; each slot caches one stripe's
+    data and parity as packed u32 word rows in the XOR-schedule packet
+    layout (``k*w`` data rows, ``m*w`` parity rows, ``words`` u32 each).
+    All leaves are fixed-shape device arrays and every update returns a
+    new instance, so the buffer is a valid ``lax.scan`` carry and
+    checkpoint payload.
+    """
+
+    keys: jnp.ndarray    # i32 [n_sets, ways]  packed stripe key, -1 empty
+    data: jnp.ndarray    # u32 [n_sets, ways, k*w, words]
+    parity: jnp.ndarray  # u32 [n_sets, ways, m*w, words]
+    dirty: jnp.ndarray   # u32 [n_sets, ways]  bitmask over k data chunks
+    lru: jnp.ndarray     # i32 [n_sets, ways]  last-access tick, -1 empty
+    tick: jnp.ndarray    # i32 []  access counter (the LRU clock)
+    totals: jnp.ndarray  # i64 [len(WP_LANES)]  cumulative counters
+
+    def tree_flatten(self):
+        return (
+            (self.keys, self.data, self.parity, self.dirty, self.lru,
+             self.tick, self.totals),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def n_sets(self) -> int:
+        return int(self.keys.shape[0])
+
+    @property
+    def ways(self) -> int:
+        return int(self.keys.shape[1])
+
+    @property
+    def words(self) -> int:
+        return int(self.data.shape[3])
+
+
+def empty_stripe_buffer(
+    n_sets: int, ways: int, kw: int, mw: int, words: int
+) -> StripeBufferState:
+    """A cold buffer: all slots empty (``keys == -1``, LRU ``-1`` so
+    victim choice fills empties before evicting)."""
+    n_sets, ways = int(n_sets), int(ways)
+    if n_sets <= 0 or n_sets & (n_sets - 1):
+        raise ValueError(f"n_sets must be a power of two, got {n_sets}")
+    return StripeBufferState(
+        keys=jnp.full((n_sets, ways), -1, I32),
+        data=jnp.zeros((n_sets, ways, int(kw), int(words)), U32),
+        parity=jnp.zeros((n_sets, ways, int(mw), int(words)), U32),
+        dirty=jnp.zeros((n_sets, ways), U32),
+        lru=jnp.full((n_sets, ways), -1, I32),
+        tick=jnp.zeros((), I32),
+        totals=jnp.zeros((len(WP_LANES),), I64),
+    )
+
+
+def _hash_rows(seed, salt: np.uint32, n_rows: int, words: int):
+    """Deterministic u32 content rows for one stripe/payload: the
+    simulated byte source (and backing store — a re-install after
+    eviction regenerates the identical stripe)."""
+    grid = jnp.arange(n_rows * words, dtype=U32).reshape(n_rows, words)
+    return crush_hash32_2(grid, seed.astype(U32) ^ salt)
+
+
+def stripe_base_rows(key, kw: int, words: int):
+    """The backing store's data rows for stripe ``key`` ([kw, words])."""
+    return _hash_rows(key, _BASE_SALT, kw, words)
+
+
+def payload_rows(seed, kw: int, words: int):
+    """One write op's content rows ([kw, words]; small writes mask to
+    their chunk's ``w`` rows)."""
+    return _hash_rows(seed, _PAYLOAD_SALT, kw, words)
+
+
+def stripe_buffer_step(
+    buf: StripeBufferState,
+    steps,
+    n_out: int,
+    n_bufs: int,
+    k: int,
+    w: int,
+    keys,
+    chunks,
+    fulls,
+    seeds,
+    valid,
+):
+    """Absorb one epoch's fixed-shape write batch; returns the updated
+    buffer and the per-epoch counter row (``WP_LANES`` order, i64).
+
+    ``steps`` is the codec's compiled XOR schedule table (device i32
+    [n_steps, 2]); ``keys/chunks/fulls/seeds/valid`` are the batch
+    lanes (``[B]`` each; invalid lanes are no-ops, so any write count
+    up to ``B`` runs through this one program).  Phase 1 is a
+    ``fori_loop`` doing cache maintenance and accumulating per-slot
+    ``Δdata``; phase 2 XORs ``encode(Δdata)`` into every slot's parity
+    with one vmapped schedule application.
+    """
+    n_sets, ways, kw, words = buf.data.shape
+    mw = int(buf.parity.shape[2])
+    set_mask = np.uint32(n_sets - 1)
+    full_dirty = np.uint32((1 << k) - 1)
+    w_words = np.int64(w * words)
+    kw_words = np.int64(kw * words)
+
+    def body(i, st):
+        (keys_a, data, parity, dirty, lru, tick, ddata, row) = st
+        key = keys[i]
+        val = valid[i]
+        set_i = (
+            crush_hash32_2(key.astype(U32), _SET_SALT) & set_mask
+        ).astype(I32)
+        row_keys = keys_a[set_i]
+        eq = row_keys == key
+        hit = val & jnp.any(eq)
+        victim = jnp.argmin(lru[set_i]).astype(I32)
+        way = jnp.where(hit, jnp.argmax(eq).astype(I32), victim)
+        install = val & ~hit
+        evict = install & (row_keys[way] >= 0)
+
+        # install: slot becomes the backing stripe staged as a
+        # delta-from-zero (parity 0, Δdata = data), so phase 2's single
+        # encode yields the full-stripe parity
+        base = stripe_base_rows(key, kw, words)
+        data_s = jnp.where(install, base, data[set_i, way])
+        parity_s = jnp.where(
+            install, jnp.zeros((mw, words), U32), parity[set_i, way]
+        )
+        dd_s = jnp.where(install, base, ddata[set_i, way])
+        dirty_s = jnp.where(install, jnp.uint32(0), dirty[set_i, way])
+
+        # the write itself: full-stripe replaces the slot (again a
+        # delta-from-zero), a small overwrite XORs its chunk's w rows
+        full = fulls[i]
+        content = payload_rows(seeds[i], kw, words)
+        rowsel = ((jnp.arange(kw, dtype=I32) // w) == chunks[i])
+        small = jnp.where(rowsel[:, None], content, jnp.uint32(0))
+        data_n = jnp.where(full, content, data_s ^ small)
+        dd_n = jnp.where(full, content, dd_s ^ small)
+        parity_n = jnp.where(
+            full, jnp.zeros((mw, words), U32), parity_s
+        )
+        dirty_n = jnp.where(
+            full, full_dirty,
+            dirty_s | (jnp.uint32(1) << chunks[i].astype(U32)),
+        )
+
+        keep = data[set_i, way]
+        data = data.at[set_i, way].set(jnp.where(val, data_n, keep))
+        parity = parity.at[set_i, way].set(
+            jnp.where(val, parity_n, parity[set_i, way])
+        )
+        ddata = ddata.at[set_i, way].set(
+            jnp.where(val, dd_n, ddata[set_i, way])
+        )
+        dirty = dirty.at[set_i, way].set(
+            jnp.where(val, dirty_n, dirty[set_i, way])
+        )
+        keys_a = keys_a.at[set_i, way].set(
+            jnp.where(val, key, row_keys[way])
+        )
+        lru = lru.at[set_i, way].set(
+            jnp.where(val, tick, lru[set_i, way])
+        )
+        tick = tick + val.astype(I32)
+
+        # words by ENCODE type: a full write or an install costs a
+        # whole-stripe encode; only a small overwrite on a hit is a
+        # w-row parity delta
+        d_enc = val & ~full & hit
+        f_enc = val & (full | ~hit)
+        row = row + jnp.stack([
+            hit.astype(I64), install.astype(I64), evict.astype(I64),
+            (val & ~full).astype(I64), (val & full).astype(I64),
+            jnp.where(d_enc, w_words, np.int64(0)),
+            jnp.where(f_enc, kw_words, np.int64(0)),
+            jnp.int64(0),
+        ])
+        return (keys_a, data, parity, dirty, lru, tick, ddata, row)
+
+    ddata0 = jnp.zeros_like(buf.data)
+    row0 = jnp.zeros((len(WP_LANES),), I64)
+    (keys_a, data, parity, dirty, lru, tick, ddata, row) = (
+        jax.lax.fori_loop(
+            jnp.int32(0), jnp.int32(keys.shape[0]), body,
+            (buf.keys, buf.data, buf.parity, buf.dirty, buf.lru,
+             buf.tick, ddata0, row0),
+        )
+    )
+
+    # phase 2: Δparity = encode(Δdata) for every slot in one vmapped
+    # schedule application (untouched slots carry Δdata = 0, whose
+    # schedule output is 0 — parity unchanged)
+    dd_flat = ddata.reshape(n_sets * ways, kw, words)
+    dpar = jax.vmap(
+        lambda wds: _xla_apply(steps, wds, n_out, n_bufs)
+    )(dd_flat)
+    parity = parity ^ dpar.reshape(n_sets, ways, mw, words)
+    touched = jnp.sum(
+        jnp.any(dd_flat != 0, axis=(1, 2)).astype(I64)
+    )
+    row = row.at[len(WP_LANES) - 1].set(touched)
+    out = replace(
+        buf, keys=keys_a, data=data, parity=parity, dirty=dirty,
+        lru=lru, tick=tick, totals=buf.totals + row,
+    )
+    return out, row
+
+
+# ---------------------------------------------------------------------------
+# host-facing parity-delta engine (footprint-compiled XOR programs)
+
+
+def dense_parity_words(bitmatrix: np.ndarray, data_words: np.ndarray):
+    """Independent dense GF(2) product over packed u32 word rows:
+    ``[mw, kw] x [kw, NW] -> [mw, NW]``.  The scrub re-encode reference
+    — no shared code with the schedule compiler, so a wrong delta
+    program cannot verify itself."""
+    bm = (np.asarray(bitmatrix) & 1).astype(bool)
+    sel = np.where(
+        bm[:, :, None], np.asarray(data_words, np.uint32)[None, :, :],
+        np.uint32(0),
+    )
+    return np.bitwise_xor.reduce(sel, axis=1)
+
+
+class ParityDeltaEngine:
+    """Read-modify-write parity deltas for one codec bitmatrix.
+
+    Encoding is linear over GF(2), so overwriting chunks ``F`` turns
+    the parity update into ``Δparity = encode_F(old_F ^ new_F)`` where
+    ``encode_F`` is the generator bitmatrix restricted to ``F``'s
+    chunk columns.  Each footprint's program lowers through the Paar
+    CSE compiler once and is cached per ``(codec, footprint)`` in a
+    :class:`~ceph_tpu.ec.schedule.ScheduleCache` — repeated small-write
+    shapes never recompile, and cache hits/evictions land in the
+    shared ``ec_schedule`` counters.
+    """
+
+    def __init__(
+        self,
+        bitmatrix: np.ndarray,
+        w: int = 8,
+        packetsize: int = 8,
+        cache: ScheduleCache | None = None,
+        name: str = "writepath",
+    ):
+        self.bitmatrix = np.asarray(bitmatrix, np.uint8) & 1
+        self.w = int(w)
+        self.packetsize = int(packetsize)
+        self.mw, self.kw = self.bitmatrix.shape
+        if self.kw % self.w or self.mw % self.w:
+            raise ValueError(
+                f"bitmatrix {self.bitmatrix.shape} not a multiple of "
+                f"w={self.w}"
+            )
+        self.k = self.kw // self.w
+        self.m = self.mw // self.w
+        # stable cache key half: the generator's content fingerprint
+        from ..recovery.scrub import crc32c
+
+        self.codec_id = (
+            self.k, self.m, self.w,
+            crc32c(np.ascontiguousarray(self.bitmatrix).reshape(-1)),
+        )
+        self.cache = cache if cache is not None else ScheduleCache(
+            name=name
+        )
+
+    def _footprint(self, footprint) -> tuple[int, ...]:
+        fp = tuple(sorted({int(c) for c in footprint}))
+        if not fp or fp[0] < 0 or fp[-1] >= self.k:
+            raise ValueError(
+                f"footprint {fp} out of range for k={self.k}"
+            )
+        return fp
+
+    def delta_bitmatrix(self, footprint) -> np.ndarray:
+        """The generator sub-bitmatrix for an update footprint: the
+        column blocks of the touched data chunks."""
+        fp = self._footprint(footprint)
+        cols = np.concatenate(
+            [np.arange(c * self.w, (c + 1) * self.w) for c in fp]
+        )
+        return np.ascontiguousarray(self.bitmatrix[:, cols])
+
+    def encoder_for(self, footprint) -> XorScheduleEncoder:
+        """The compiled delta program for one footprint (cached)."""
+        fp = self._footprint(footprint)
+        return self.cache.get(
+            ("delta", self.codec_id, fp),
+            lambda: XorScheduleEncoder(
+                self.delta_bitmatrix(fp), layout="packet",
+                w=self.w, packetsize=self.packetsize,
+            ),
+        )
+
+    def full_encoder(self) -> XorScheduleEncoder:
+        """The full-stripe encode program (cached once per codec)."""
+        return self.cache.get(
+            ("full", self.codec_id),
+            lambda: XorScheduleEncoder(
+                self.bitmatrix, layout="packet",
+                w=self.w, packetsize=self.packetsize,
+            ),
+        )
+
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        """Full-stripe parity ``[k, S] u8 -> [m, S] u8`` through the
+        schedule path (the batched full-stripe write engine)."""
+        return self.full_encoder().encode(data)
+
+    def dense_parity(self, data: np.ndarray) -> np.ndarray:
+        """Dense reference parity (independent execution path — the
+        bit-equality gate's and scrub's comparison side)."""
+        from .backend import BitmatrixEncoder
+
+        return BitmatrixEncoder(
+            self.bitmatrix, self.packetsize, self.w
+        ).encode(data)
+
+    def apply_delta(
+        self, parity: np.ndarray, footprint, old_chunks: np.ndarray,
+        new_chunks: np.ndarray,
+    ) -> np.ndarray:
+        """One read-modify-write: ``parity ^ encode_F(old ^ new)``.
+
+        ``old_chunks``/``new_chunks`` are ``[len(F), S] u8`` in
+        footprint order; returns the ``[m, S]`` updated parity."""
+        fp = self._footprint(footprint)
+        old = np.asarray(old_chunks, np.uint8)
+        new = np.asarray(new_chunks, np.uint8)
+        if old.shape != new.shape or old.shape[0] != len(fp):
+            raise ValueError(
+                f"delta chunks {old.shape}/{new.shape} do not match "
+                f"footprint {fp}"
+            )
+        dparity = self.encoder_for(fp).encode(old ^ new)
+        return np.asarray(parity, np.uint8) ^ dparity
+
+    def pc_inc(self, counters: "PerfCounters", row) -> None:
+        """Fold one epoch row (``WP_LANES`` order) into the
+        ``ec_writepath`` perf component."""
+        vals = [int(v) for v in np.asarray(row).reshape(-1)]
+        for lane, v in zip(WP_LANES, vals):
+            name = _COUNTER_OF.get(lane)
+            if name is not None and v:
+                counters.inc(name, v)
+
+
+# ---------------------------------------------------------------------------
+# observability: counters + the dump_stripe_cache admin hook
+
+
+_COUNTER_OF = {
+    "hits": "stripe_hits",
+    "misses": "stripe_misses",
+    "evictions": "stripe_evictions",
+    "delta_writes": "delta_writes",
+    "full_writes": "full_writes",
+    "delta_words": "delta_words",
+    "full_words": "full_words",
+}
+
+
+def _build_counters() -> PerfCounters:
+    return (
+        PerfCountersBuilder("ec_writepath")
+        .add_u64_counter(
+            "stripe_hits", "write ops served from a resident stripe"
+        )
+        .add_u64_counter(
+            "stripe_misses",
+            "write ops that installed their stripe from the backing "
+            "store",
+        )
+        .add_u64_counter(
+            "stripe_evictions",
+            "resident stripes displaced by an LRU victim choice",
+        )
+        .add_u64_counter(
+            "delta_writes", "small overwrites absorbed as parity deltas"
+        )
+        .add_u64_counter(
+            "full_writes", "full-stripe writes batched through encode"
+        )
+        .add_u64_counter(
+            "delta_words",
+            "u32 words encoded through footprint delta programs",
+        )
+        .add_u64_counter(
+            "full_words",
+            "u32 words encoded as whole-stripe parity (installs + "
+            "full-stripe writes)",
+        )
+        .create_perf_counters()
+    )
+
+
+def writepath_counters() -> PerfCounters:
+    """The process-wide ``ec_writepath`` perf-counter component."""
+    return registry().get("ec_writepath") or _build_counters()
+
+
+# every live stripe buffer owner, for the dump_stripe_cache admin hook
+_LIVE_STRIPE_CACHES: weakref.WeakSet = weakref.WeakSet()
+
+
+def register_stripe_cache(owner) -> None:
+    """Self-register an object exposing ``dump_stripe_cache() ->
+    dict`` (the :class:`~ceph_tpu.workload.writepath.WritepathDriver`
+    does this on construction)."""
+    _LIVE_STRIPE_CACHES.add(owner)
+
+
+def summarize_buffer(buf: StripeBufferState) -> dict:
+    """Host summary of one buffer's occupancy and counters (the admin
+    hook payload; a cold-path host pull, never inside the scan)."""
+    keys, dirty, totals = jax.device_get(
+        (buf.keys, buf.dirty, buf.totals)
+    )
+    totals = {
+        lane: int(v) for lane, v in zip(WP_LANES, totals.reshape(-1))
+    }
+    lookups = totals["hits"] + totals["misses"]
+    return {
+        "n_sets": int(keys.shape[0]),
+        "ways": int(keys.shape[1]),
+        "occupied": int((keys >= 0).sum()),
+        "dirty_slots": int((dirty != 0).sum()),
+        "hit_rate": (
+            round(totals["hits"] / lookups, 4) if lookups else 0.0
+        ),
+        "delta_bytes": 4 * totals["delta_words"],
+        "full_bytes": 4 * totals["full_words"],
+        **totals,
+    }
+
+
+def dump_stripe_cache() -> dict:
+    """Admin-socket hook body: every live stripe buffer plus the
+    aggregate ``ec_writepath`` counters."""
+    return {
+        "buffers": sorted(
+            (o.dump_stripe_cache() for o in _LIVE_STRIPE_CACHES),
+            key=lambda d: str(d.get("name", "")),
+        ),
+        "counters": writepath_counters().dump(),
+    }

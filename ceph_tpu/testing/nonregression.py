@@ -135,6 +135,12 @@ def compile_once_cases() -> dict[str, dict]:
       power-of-two pad bucket (3 -> 4 clusters) must reuse the one
       compiled program with zero in-scan host transfers; fleet size is
       a value, never a shape.
+    - ``online_write_batch``: the fused write-path scan
+      (:mod:`ceph_tpu.workload.writepath`) — the per-epoch write cap
+      is a traced scalar and the batch buffer is its power-of-two
+      bucket, so varying write-batch sizes inside one bucket must
+      reuse the one compiled scan (stripe lookups, LRU, parity deltas
+      and all) with zero in-scan host transfers.
     - ``reconcile_round``: the divergent two-rank round
       (:mod:`ceph_tpu.recovery.reconcile`) — per-rank uniform-length
       chunk advances plus the one-launch ``merge_stacked`` join; a
@@ -383,6 +389,29 @@ def compile_once_cases() -> dict[str, dict]:
     report["fleet_superstep"] = {
         "warm_compiles": warm_f.n_compiles, "second_compiles": 0,
         "in_scan_host_transfers": g_f.host_transfers,
+    }
+
+    # ---- online write batch: scan -> smaller cap, same bucket ----------
+    from ..workload.writepath import WritepathDriver
+
+    wdrv = WritepathDriver(
+        EpochDriver(m_e, tape, n_ops=64), n_sets=8, ways=2,
+        max_writes=8,
+    )
+    with CompileCounter() as warm_w:
+        wdrv.run_superstep(8, cap=5, pull=False)
+    # a different write-batch size inside the same power-of-two bucket
+    # (7 <= 8 slots) is a VALUE of the traced cap, never a shape: the
+    # one fused scan — epoch pieces, stripe lookups, LRU maintenance,
+    # vmapped parity-delta encode — is reused with zero in-scan host
+    # transfers
+    with assert_no_recompile("online write batch same bucket"):
+        with track() as g_w:
+            wdrv.run_superstep(8, cap=7, pull=False)
+    assert g_w.host_transfers == 0, g_w.host_transfers
+    report["online_write_batch"] = {
+        "warm_compiles": warm_w.n_compiles, "second_compiles": 0,
+        "in_scan_host_transfers": g_w.host_transfers,
     }
 
     # ---- reconcile round: 2-rank chunks -> merge -> same-shape chunks --

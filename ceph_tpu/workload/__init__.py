@@ -10,6 +10,11 @@ mclock QoS arbiter that shares bandwidth between clients and recovery.
   reservation/weight/limit admission gate (dmClock analog).
 - :mod:`~ceph_tpu.workload.histogram` — the log2 bucket ladder and the
   host-side percentile merge.
+- :mod:`~ceph_tpu.workload.writepath` — the online EC write path:
+  the traffic step's committed writes drawn into fixed-shape batches
+  and absorbed by the device-resident stripe buffer
+  (:mod:`ceph_tpu.ec.online`) as a per-epoch encode stage inside the
+  superstep scan.
 """
 
 from .histogram import (
@@ -31,6 +36,12 @@ from .traffic import (
     traffic_step,
     workload_counters,
 )
+from .writepath import (
+    WritepathDriver,
+    WritepathSeries,
+    checkpointed_writepath,
+    default_bitmatrix,
+)
 
 __all__ = [
     "LAT_MIN_MS",
@@ -41,7 +52,11 @@ __all__ = [
     "TrafficEngine",
     "TrafficMix",
     "TrafficSample",
+    "WritepathDriver",
+    "WritepathSeries",
     "bucket_edges",
+    "checkpointed_writepath",
+    "default_bitmatrix",
     "count_at_least",
     "percentile",
     "percentiles",

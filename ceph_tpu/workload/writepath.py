@@ -1,0 +1,499 @@
+"""The online write path as a per-epoch encode stage in the superstep.
+
+:class:`WritepathDriver` wraps an
+:class:`~ceph_tpu.recovery.superstep.EpochDriver` and extends its
+``lax.scan`` body with the data plane the traffic engine only modeled:
+each epoch's committed client writes — the SAME routed, classified op
+batch the traffic step counts (identical salt, identical ``_route``
+predicates against the post-peering survivor masks) — are compacted
+into a fixed-shape write batch and absorbed by the device-resident
+stripe buffer (:mod:`ceph_tpu.ec.online`).  Full-stripe writes batch
+through the codec's compiled XOR-schedule encoder; small overwrites
+become read-modify-write parity deltas.  The epoch lanes the wrapped
+driver emits are bit-identical to an unwrapped run (the write stage
+reads cluster state, never writes it), and the buffer rides the scan
+carry, so checkpoint snapshots of ``(ClusterState, StripeBufferState)``
+resume bit-equal with a warm cache.
+
+Compile-once discipline: the write-batch buffer is sized to the
+power-of-two bucket of ``max_writes`` and the per-epoch write cap is a
+*traced* scalar, so varying write-batch sizes inside one bucket reuse
+ONE compiled program with zero in-scan host transfers (the
+``online_write_batch`` nonregression scenario pins this).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ec.online import (
+    WP_LANES,
+    ParityDeltaEngine,
+    StripeBufferState,
+    empty_stripe_buffer,
+    register_stripe_cache,
+    stripe_buffer_step,
+    summarize_buffer,
+    writepath_counters,
+)
+from ..recovery.superstep import _SALT_STEP, _SERIES_FIELDS, EpochSeries
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+#: decorrelate the stripe-index, chunk-index, full-stripe and payload
+#: coins from each other and from the routing/skew hashes
+_STRIPE_SALT = np.uint32(0x7FEB352D)
+_CHUNK_SALT = np.uint32(0x846CA68B)
+_FULL_SALT = np.uint32(0x9E485565)
+_SEED_SALT = np.uint32(0xE2D0D4CB)
+
+
+def _pow2_bucket(n: int) -> int:
+    """The power-of-two batch bucket holding ``n`` write slots."""
+    return 1 << max(int(n) - 1, 0).bit_length() if n > 1 else 1
+
+
+def default_bitmatrix(k: int, m: int, w: int | None = None):
+    """The write-path codec for a ``k+m`` pool: a minimal-density
+    RAID-6 code when ``m == 2`` (liberation — the cheapest XOR
+    programs), else the cauchy-good w=8 expansion.  Returns
+    ``(bitmatrix, w)``."""
+    from ..ec import gf, gfw
+
+    if int(m) == 2:
+        if w is None:
+            w = next(p for p in (7, 11, 13, 17, 19, 23)
+                     if p >= int(k))
+        return gfw.liberation_bitmatrix(int(k), int(w)), int(w)
+    return gf.matrix_to_bitmatrix(
+        gf.cauchy_good_matrix(int(k), int(m))
+    ), 8
+
+
+@dataclass(frozen=True)
+class WritepathSeries:
+    """Per-epoch write-path lanes (``WP_LANES`` order), host numpy —
+    the stripe buffer's journal payload and the differential test's
+    comparison surface."""
+
+    lanes: np.ndarray  # i64 [n, len(WP_LANES)]
+
+    def __len__(self) -> int:
+        return int(self.lanes.shape[0])
+
+    @classmethod
+    def from_device(cls, wrows) -> "WritepathSeries":
+        return cls(lanes=np.asarray(jax.device_get(wrows)))
+
+    @classmethod
+    def concat(cls, parts: list["WritepathSeries"]) -> "WritepathSeries":
+        if len(parts) == 1:
+            return parts[0]
+        return cls(lanes=np.concatenate([p.lanes for p in parts]))
+
+    def lane(self, name: str) -> np.ndarray:
+        return self.lanes[:, WP_LANES.index(name)]
+
+    def totals(self) -> dict:
+        tot = self.lanes.sum(axis=0) if len(self) else np.zeros(
+            len(WP_LANES), np.int64
+        )
+        return {n: int(v) for n, v in zip(WP_LANES, tot)}
+
+    def diff(self, other: "WritepathSeries") -> list[str]:
+        """Lane names where the two series differ bit-for-bit."""
+        if self.lanes.shape != other.lanes.shape:
+            return ["<shape>"]
+        return [
+            n for i, n in enumerate(WP_LANES)
+            if not np.array_equal(self.lanes[:, i], other.lanes[:, i])
+        ]
+
+
+class WritepathDriver:
+    """Online EC write path over a built epoch driver.
+
+    ``n_sets`` x ``ways`` is the stripe-buffer geometry (``n_sets`` a
+    power of two); ``stripes_per_pg`` shapes the stripe key space
+    (``key = pg * stripes_per_pg + stripe``); ``full_permille`` is the
+    full-stripe share of committed writes (the rest are single-chunk
+    small overwrites); ``groups`` scales the chunk size
+    (``chunk_bytes = groups * w * packetsize``).  ``max_writes`` caps
+    the per-epoch write batch; the batch buffer is its power-of-two
+    bucket and the live cap is traced, so any cap inside the bucket
+    runs through one compiled scan.
+    """
+
+    def __init__(
+        self,
+        driver,
+        *,
+        bitmatrix: np.ndarray | None = None,
+        w: int | None = None,
+        packetsize: int = 8,
+        groups: int = 1,
+        n_sets: int = 16,
+        ways: int = 4,
+        stripes_per_pg: int = 4,
+        full_permille: int = 125,
+        max_writes: int | None = None,
+        cache=None,
+        name: str = "writepath",
+    ):
+        self.driver = driver
+        if packetsize % 4:
+            raise ValueError(
+                f"packetsize must be u32-aligned on the device path, "
+                f"got {packetsize}"
+            )
+        if bitmatrix is None:
+            k = int(driver.k)
+            m = max(int(driver.size) - k, 1)
+            bitmatrix, w = default_bitmatrix(k, m, w)
+        self.engine = ParityDeltaEngine(
+            np.asarray(bitmatrix), w=int(w or 8),
+            packetsize=int(packetsize), cache=cache, name=name,
+        )
+        self.k = self.engine.k
+        self.m = self.engine.m
+        self.w = self.engine.w
+        self.packetsize = self.engine.packetsize
+        self.groups = int(groups)
+        self.chunk_bytes = self.groups * self.w * self.packetsize
+        #: u32 words per packed row (packetsize is u32-aligned, so the
+        #: packet layout is a pure reshape — no tail pad)
+        self.words = self.groups * (self.packetsize // 4)
+        enc = self.engine.full_encoder()
+        self.schedule = enc.schedule
+        self._steps_dev = jnp.asarray(self.schedule.steps)
+        self.n_sets = int(n_sets)
+        self.ways = int(ways)
+        self.stripes_per_pg = int(stripes_per_pg)
+        self.full_permille = int(full_permille)
+        self.max_writes = int(
+            max_writes if max_writes is not None else driver.n_ops
+        )
+        self.batch_size = _pow2_bucket(self.max_writes)
+        self._init_buf = empty_stripe_buffer(
+            self.n_sets, self.ways, self.k * self.w, self.m * self.w,
+            self.words,
+        )
+        self.name = str(name)
+        self.pc = writepath_counters()
+        self._scan_fn = None
+        self._one_fn = None
+        self.final_state = None
+        self.final_buf: StripeBufferState | None = None
+        register_stripe_cache(self)
+
+    # -- the per-epoch write batch (drawn from the traffic step) -------
+
+    def _write_batch(self, state, step, cap):
+        """Compact this epoch's committed writes into the fixed-shape
+        batch: the SAME ids, salt and ``_route`` predicates the traffic
+        step counted, so ``sum(valid)`` (uncapped) equals the epoch
+        row's ``writes`` lane."""
+        from .traffic import _route, _skew_ids
+
+        drv = self.driver
+        n_ops = drv.n_ops
+        B = self.batch_size
+        salt = drv.salt_base + step.astype(U32) * _SALT_STEP
+        ids = jnp.arange(n_ops, dtype=U32)
+        mix = drv._mix
+        if mix is not None and mix.hot_permille > 0:
+            ids = _skew_ids(
+                ids, salt, mix.hot_permille, mix.hot_objects
+            )
+        pg_b = np.uint32(drv.pg_num)
+        pg_bmask = np.uint32(
+            (1 << max(drv.pg_num - 1, 1).bit_length()) - 1
+        )
+        pg, _prim, is_write, blocked, _deg, _cost = _route(
+            state.survivor_mask, state.n_alive, state.acting_primary,
+            ids, salt, pg_b, pg_bmask, np.int32(drv.k),
+            np.int32(drv.size), np.int32(drv.min_size),
+            np.int32(drv.write_permille),
+        )
+        okw = ~blocked & is_write
+        pos = jnp.cumsum(okw.astype(I32)) - 1
+        lim = jnp.minimum(cap.astype(I32), jnp.int32(B))
+        take = okw & (pos < lim)
+        # rejected lanes all dump identical sentinels on scratch slot B,
+        # so the scatter is order-free and fully deterministic
+        slot = jnp.where(take, pos, jnp.int32(B))
+        stripe = (
+            crush_hash32_2(ids, salt ^ _STRIPE_SALT)
+            % jnp.uint32(self.stripes_per_pg)
+        ).astype(I32)
+        key = pg * np.int32(self.stripes_per_pg) + stripe
+        chunk = (
+            crush_hash32_2(ids, salt ^ _CHUNK_SALT)
+            % jnp.uint32(self.k)
+        ).astype(I32)
+        full = (
+            (crush_hash32_2(ids, salt ^ _FULL_SALT)
+             % jnp.uint32(1000)).astype(I32)
+            < np.int32(self.full_permille)
+        )
+        seed = crush_hash32_2(ids, salt ^ _SEED_SALT)
+
+        def compact(vals, fill):
+            out = jnp.full((B + 1,), fill, vals.dtype)
+            return out.at[slot].set(
+                jnp.where(take, vals, fill)
+            )[:B]
+
+        bkeys = compact(key, np.int32(-1))
+        bchunks = compact(chunk, np.int32(0))
+        bfulls = compact(full, False)
+        bseeds = compact(seed, np.uint32(0))
+        bvalid = compact(
+            jnp.ones(n_ops, bool), False
+        ) & (bkeys >= 0)
+        n_writes = jnp.sum(okw.astype(I32))
+        return bkeys, bchunks, bfulls, bseeds, bvalid, n_writes
+
+    # -- the extended epoch body ---------------------------------------
+
+    def _wp_epoch(self, carry, step, cap):
+        state, buf = carry
+        state, row = self.driver._epoch_step(state, step)
+        bkeys, bchunks, bfulls, bseeds, bvalid, _nw = (
+            self._write_batch(state, step, cap)
+        )
+        buf, wrow = stripe_buffer_step(
+            buf, self._steps_dev, self.schedule.n_out,
+            self.schedule.n_bufs, self.k, self.w,
+            bkeys, bchunks, bfulls, bseeds, bvalid,
+        )
+        return (state, buf), (row, wrow)
+
+    # -- drivers -------------------------------------------------------
+
+    def compile_writepath(self):
+        """The ONE jitted program: ``(state, buf, steps, cap) ->
+        (state, buf, rows, wrows)`` — the wrapped driver's epoch scan
+        with the encode stage fused in.  ``cap`` is traced, so every
+        write-batch size inside the bucket reuses this executable."""
+        if self._scan_fn is None:
+
+            @jax.jit
+            def scan_fn(state, buf, steps, cap):
+                def body(carry, step):
+                    return self._wp_epoch(carry, step, cap)
+
+                (state, buf), (rows, wrows) = jax.lax.scan(
+                    body, (state, buf), steps
+                )
+                return state, buf, rows, wrows
+
+            self._scan_fn = scan_fn
+        return self._scan_fn
+
+    def _note_totals(self, wseries: WritepathSeries) -> None:
+        self.engine.pc_inc(self.pc, wseries.lanes.sum(axis=0))
+
+    def run_superstep(
+        self, n_epochs: int, *, cap: int | None = None,
+        snapshot_every: int = 0, pull: bool = True,
+        buf: StripeBufferState | None = None, start_epoch: int = 0,
+    ):
+        """Drive the fused scan; mirrors
+        :meth:`EpochDriver.run_superstep` (host exits only at snapshot
+        boundaries; ``pull=False`` returns device-resident
+        ``(state, buf, rows, wrows)``)."""
+        scan_fn = self.compile_writepath()
+        state = self.driver._init_state
+        buf = self._init_buf if buf is None else buf
+        cap_t = jnp.int32(self.max_writes if cap is None else cap)
+        n_epochs = int(n_epochs)
+        if n_epochs <= 0:
+            state, buf, rows, wrows = scan_fn(
+                state, buf, jnp.arange(0, dtype=I32), cap_t
+            )
+            self.final_state, self.final_buf = state, buf
+            self.driver.final_state = state
+            if not pull:
+                return state, buf, rows, wrows
+            return (
+                EpochSeries.from_device(rows),
+                WritepathSeries.from_device(wrows),
+            )
+        chunk = int(snapshot_every) or n_epochs
+        parts: list[EpochSeries] = []
+        wparts: list[WritepathSeries] = []
+        dev = None
+        start = int(start_epoch)
+        end_at = start + n_epochs
+        while start < end_at:
+            size = min(chunk, end_at - start)
+            steps = jnp.arange(start, start + size, dtype=I32)
+            state, buf, rows, wrows = scan_fn(
+                state, buf, steps, cap_t
+            )
+            if pull:
+                parts.append(EpochSeries.from_device(rows))
+                wparts.append(WritepathSeries.from_device(wrows))
+            else:
+                dev = (rows, wrows)
+            start += size
+        self.final_state, self.final_buf = state, buf
+        self.driver.final_state = state
+        if not pull:
+            return state, buf, dev[0], dev[1]
+        wseries = WritepathSeries.concat(wparts)
+        self._note_totals(wseries)
+        return EpochSeries.concat(parts), wseries
+
+    def run_staged(
+        self, n_epochs: int, *, cap: int | None = None
+    ):
+        """The differential reference: the SAME fused epoch body,
+        launched once per epoch with host pulls between launches —
+        bit-equal to the scan by construction."""
+        if self._one_fn is None:
+
+            @jax.jit
+            def one_fn(state, buf, step, cap):
+                (state, buf), (row, wrow) = self._wp_epoch(
+                    (state, buf), step, cap
+                )
+                return state, buf, row, wrow
+
+            self._one_fn = one_fn
+        state = self.driver._init_state
+        buf = self._init_buf
+        cap_t = jnp.int32(self.max_writes if cap is None else cap)
+        rows, wrows = [], []
+        for e in range(int(n_epochs)):
+            state, buf, row, wrow = self._one_fn(
+                state, buf, jnp.int32(e), cap_t
+            )
+            rows.append(tuple(np.asarray(v) for v in row))
+            wrows.append(np.asarray(wrow))
+        self.final_state, self.final_buf = state, buf
+        self.driver.final_state = state
+        series = EpochSeries(**{
+            f: np.stack([r[i] for r in rows])
+            for i, f in enumerate(_SERIES_FIELDS)
+        }) if rows else EpochSeries(**{
+            f: np.zeros((0,)) for f in _SERIES_FIELDS
+        })
+        wseries = WritepathSeries(
+            lanes=np.stack(wrows) if wrows
+            else np.zeros((0, len(WP_LANES)), np.int64)
+        )
+        return series, wseries
+
+    # -- observability -------------------------------------------------
+
+    def dump_stripe_cache(self) -> dict:
+        """This driver's panel for the ``dump_stripe_cache`` admin
+        hook: buffer occupancy + counters + the footprint-program
+        cache."""
+        buf = self.final_buf if self.final_buf is not None else (
+            self._init_buf
+        )
+        return {
+            "name": self.name,
+            **summarize_buffer(buf),
+            "schedule_cache": self.engine.cache.dump(),
+        }
+
+
+# deferred to module bottom: core.hashes is import-light, but keeping
+# the jnp-facing import near its sole non-batch consumer documents the
+# seam the batch builder shares with the traffic router
+from ..core.hashes import crush_hash32_2  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integration: durable snapshots of (cluster, stripe buffer)
+
+
+def checkpointed_writepath(
+    wdrv: WritepathDriver,
+    n_epochs: int,
+    *,
+    store,
+    snapshot_every: int = 0,
+    cap: int | None = None,
+    crashes=(),
+):
+    """:meth:`WritepathDriver.run_superstep` with a durable snapshot at
+    every boundary and resume-from-store on entry — the
+    :func:`~ceph_tpu.recovery.checkpoint.checkpointed_superstep`
+    contract extended to the write path: each boundary commits the
+    ``(ClusterState, StripeBufferState)`` pytree plus both series so
+    far, so a killed run resumes with a WARM stripe buffer and lands
+    bit-equal (exact :meth:`EpochSeries.diff` and
+    :meth:`WritepathSeries.diff`) to an uninterrupted run."""
+    from ..recovery.checkpoint import _aligned_end, _CrashSchedule
+
+    n_epochs = int(n_epochs)
+    every = int(snapshot_every) or max(n_epochs, 1)
+    sched = _CrashSchedule(crashes)
+    scan_fn = wdrv.compile_writepath()
+    cap_t = jnp.int32(wdrv.max_writes if cap is None else cap)
+    template = (wdrv.driver._init_state, wdrv._init_buf)
+    resume = store.load_latest(template, with_series=True)
+    if resume is None:
+        (state, buf), start, cols, wlanes = template, 0, None, None
+    else:
+        meta, (state, buf), series = resume
+        start = int(meta.get("next_epoch", 0))
+        cols = (
+            {f: series[f] for f in _SERIES_FIELDS} if series else None
+        )
+        wlanes = series.get("wp_lanes") if series else None
+    if start == 0:
+        cols, wlanes = None, None
+    while start < n_epochs:
+        end = _aligned_end(start, n_epochs, every)
+        steps = jnp.arange(start, end, dtype=I32)
+        state, buf, rows, wrows = scan_fn(state, buf, steps, cap_t)
+        part = EpochSeries.from_device(rows)
+        wpart = WritepathSeries.from_device(wrows)
+        cols = {
+            f: (np.concatenate([cols[f], getattr(part, f)])
+                if cols is not None else getattr(part, f))
+            for f in _SERIES_FIELDS
+        }
+        wlanes = (
+            np.concatenate([wlanes, wpart.lanes])
+            if wlanes is not None else wpart.lanes
+        )
+        sched.fire(end, "before")
+        during = sched.due(end, "during")
+        if during is not None:
+            store._crash_hook = lambda phase: during.fire()
+        try:
+            store.save(
+                (state, buf),
+                meta={"next_epoch": end, "n_epochs": n_epochs},
+                series={**cols, "wp_lanes": wlanes},
+            )
+        finally:
+            store._crash_hook = None
+        sched.fire(end, "after")
+        start = end
+    wdrv.final_state, wdrv.final_buf = state, buf
+    wdrv.driver.final_state = state
+    if cols is None:
+        state, buf, rows, wrows = scan_fn(
+            *template, jnp.arange(0, 0, dtype=I32), cap_t
+        )
+        return (
+            EpochSeries.from_device(rows),
+            WritepathSeries.from_device(wrows),
+        )
+    wseries = WritepathSeries(lanes=wlanes)
+    wdrv._note_totals(wseries)
+    return EpochSeries(**cols), wseries

@@ -141,6 +141,36 @@ def schedule_trial(rng) -> tuple:
     return key
 
 
+def delta_trial(rng) -> tuple:
+    """Property: a random sequence of small-overwrite parity deltas
+    applied through the cached footprint programs leaves parity
+    byte-identical to a dense full-stripe re-encode of the final data
+    — on a random (codec family, k, m, w) draw and random packet
+    sizes, including non-u32 ones (the word-pad path)."""
+    from ceph_tpu.ec.online import ParityDeltaEngine
+
+    bits, w = gen_bitmatrix(rng)
+    ps = int(rng.choice([3, 4, 5, 8, 9, 16]))
+    eng = ParityDeltaEngine(bits, w=w, packetsize=ps)
+    size = int(rng.integers(1, 4)) * w * ps
+    data = rng.integers(0, 256, (eng.k, size), dtype=np.uint8)
+    parity = eng.encode(data)
+    key = (eng.k, eng.m, w, ps, size)
+    assert np.array_equal(parity, eng.dense_parity(data)), key
+    n_updates = int(rng.integers(1, 12))
+    for _ in range(n_updates):
+        nf = int(rng.integers(1, eng.k + 1))
+        fp = tuple(sorted(
+            rng.choice(eng.k, nf, replace=False).tolist()
+        ))
+        new = rng.integers(0, 256, (len(fp), size), dtype=np.uint8)
+        parity = eng.apply_delta(parity, fp, data[list(fp)], new)
+        data[list(fp)] = new
+    want = eng.dense_parity(data)
+    assert np.array_equal(parity, want), (key, n_updates)
+    return key
+
+
 def main() -> int:
     seed = int(time.time())
     rng = np.random.default_rng(seed)
@@ -190,8 +220,10 @@ def main() -> int:
             out = ec.decode_concat(dict(avail))
             assert out[: len(obj)] == obj.tobytes(), \
                 (profile, sorted(erased))
-        # schedule-vs-dense property draw rides every trial
+        # schedule-vs-dense and delta-vs-dense property draws ride
+        # every trial
         schedule_trial(rng)
+        delta_trial(rng)
         if trial % 20 == 0:
             print(f"trial {trial} ok ({time.time() - t0:.0f}s) "
                   f"last: {profile}", flush=True)

@@ -1257,3 +1257,117 @@ def test_checkpoint_record_harvested_by_decide_defaults(tmp_path):
     # no compile-guard counters in this record: the derived
     # steady_state_clean gate must stay absent, not default to a lie
     assert "steady_state_clean" not in g
+
+
+# --- config10_online_ec JSON schema (online EC write path) ------------
+
+_CONFIG10 = os.path.join(
+    os.path.dirname(_BENCH), "bench", "config10_online_ec.py"
+)
+_spec10 = importlib.util.spec_from_file_location(
+    "bench_config10", _CONFIG10
+)
+config10 = importlib.util.module_from_spec(_spec10)
+_spec10.loader.exec_module(config10)
+
+
+_WP_PANEL = [
+    {"mix": "ssd-steady", "hit_rate": 0.5125,
+     "encoded_bytes_per_sec": 2_147_483_648.5, "delta_bytes": 65_536,
+     "full_bytes": 1_048_576, "delta_writes": 512, "full_writes": 64,
+     "run_s": 0.25},
+    {"mix": "ssd-skew", "hit_rate": 0.9375,
+     "encoded_bytes_per_sec": 1_073_741_824.0, "delta_bytes": 131_072,
+     "full_bytes": 262_144, "delta_writes": 1024, "full_writes": 16,
+     "run_s": 0.125},
+]
+
+_WP_TOTALS = {
+    "hits": 1536, "misses": 512, "evictions": 448,
+    "delta_writes": 1536, "full_writes": 80,
+    "delta_words": 49_152, "full_words": 327_680,
+    "touched_slots": 96,
+}
+
+
+def _writepath_record():
+    return config10.build_writepath_record(
+        "tpu", 2_147_483_648.5, 0.75, True,
+        ["liberation", "blaum_roth", "liber8tion", "cauchy", "rs_w8"],
+        _WP_TOTALS, 7, _WP_PANEL, 256,
+    )
+
+
+def test_writepath_record_schema():
+    import json
+
+    rec = _writepath_record()
+    assert rec["metric"] == "writepath_encoded_bytes_per_sec"
+    assert rec["status"] == "ok"
+    assert rec["value"] == 2147483648 and rec["unit"] == "B/s"
+    assert rec["writepath_scenario"] == config10.SCENARIO
+    assert rec["writepath_n_epochs"] == config10.EPOCHS
+    assert rec["writepath_batch"] == 256
+    assert rec["writepath_n_sets"] == config10.N_SETS
+    assert rec["writepath_ways"] == config10.WAYS
+    assert rec["writepath_hit_rate"] == 0.75
+    # the acceptance gate: every codec family byte-equal, in-record
+    assert rec["writepath_bitequal"] is True
+    assert rec["writepath_families"] == (
+        "liberation,blaum_roth,liber8tion,cauchy,rs_w8"
+    )
+    assert rec["writepath_stripe_hits"] == 1536
+    assert rec["writepath_stripe_misses"] == 512
+    assert rec["writepath_stripe_evictions"] == 448
+    # bytes are 4x the u32 word counters
+    assert rec["writepath_delta_bytes"] == 4 * 49_152
+    assert rec["writepath_full_bytes"] == 4 * 327_680
+    assert rec["writepath_schedule_entries"] == 7
+    assert rec["writepath_mix_panel"][1]["mix"] == "ssd-skew"
+    json.dumps(rec)  # one JSON line, always serializable
+
+
+def test_writepath_gate_families_cover_acceptance_set():
+    names = [name for name, _, _ in config10.gate_families()]
+    # every minimal-density family AND RS-w8, per the acceptance bar
+    assert names == [
+        "liberation", "blaum_roth", "liber8tion", "cauchy", "rs_w8"
+    ]
+
+
+def test_writepath_record_harvested_by_decide_defaults(tmp_path):
+    import json
+
+    rec = _writepath_record()
+    p = tmp_path / "session.log"
+    p.write_text(json.dumps(rec) + "\n")
+    dd = _load_dd("writepath")
+    g = dd.harvest_guard([str(p)])["writepath_encoded_bytes_per_sec"]
+    # typed WRITEPATH_* fields: cache behavior, byte split, the gate
+    assert g["writepath_n_epochs"] == config10.EPOCHS
+    assert g["writepath_batch"] == 256
+    assert g["writepath_n_sets"] == config10.N_SETS
+    assert g["writepath_ways"] == config10.WAYS
+    assert g["writepath_stripe_hits"] == 1536
+    assert g["writepath_stripe_misses"] == 512
+    assert g["writepath_stripe_evictions"] == 448
+    assert g["writepath_delta_bytes"] == 196_608
+    assert g["writepath_full_bytes"] == 1_310_720
+    assert g["writepath_schedule_entries"] == 7
+    assert g["writepath_hit_rate"] == 0.75
+    assert g["writepath_bitequal"] is True
+    assert g["writepath_scenario"] == config10.SCENARIO
+    assert g["writepath_families"] == (
+        "liberation,blaum_roth,liber8tion,cauchy,rs_w8"
+    )
+    assert "steady_state_clean" not in g
+
+
+def test_writepath_cpu_record_not_harvested(tmp_path):
+    import json
+
+    rec = dict(_writepath_record(), platform="cpu")
+    p = tmp_path / "session.log"
+    p.write_text(json.dumps(rec) + "\n")
+    dd = _load_dd("writepath_cpu")
+    assert dd.harvest_guard([str(p)]) == {}

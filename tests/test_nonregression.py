@@ -44,7 +44,7 @@ def test_hot_paths_compile_once():
     assert set(report) == {
         "pool_mapping", "pattern_decode", "schedule_decode", "scrub_pass",
         "heartbeat_tick", "fused_placement", "epoch_superstep",
-        "fleet_superstep", "reconcile_round",
+        "fleet_superstep", "online_write_batch", "reconcile_round",
     }
     # the superstep's contract: the second scan window syncs NOTHING
     # to host (the staged path's per-epoch device_gets are the cost it
@@ -52,6 +52,7 @@ def test_hot_paths_compile_once():
     # fleet within a pad bucket
     assert report["epoch_superstep"]["in_scan_host_transfers"] == 0
     assert report["fleet_superstep"]["in_scan_host_transfers"] == 0
+    assert report["online_write_batch"]["in_scan_host_transfers"] == 0
     assert report["reconcile_round"]["in_round_host_transfers"] == 0
     for name, counts in report.items():
         assert counts["warm_compiles"] > 0, (name, counts)

@@ -249,7 +249,7 @@ def test_unfiltered_configs_cover_all_baseline_configs():
         "config6_recovery", "config6_recovery_multichip",
         "config6_recovery_scrub", "config6_recovery_liveness",
         "config7_epoch_loop", "config8_fleet", "config9_checkpoint",
-        "tpu_tier",
+        "config10_online_ec", "tpu_tier",
     ]
     # the flag-mode entries re-use the config6 file
     for name, flag in (
