@@ -36,6 +36,20 @@ J011  unseeded-randomness       default_rng()/Random() with no seed; the
                                 global random.*/np.random.* functions
 J012  shard-map-closure-        shard_map body closing over an explicitly
       capture                   placed device array
+J013  unbucketed-dynamic-       data-dependent counts (len/.sum()/nonzero
+      shape                     sizes) reaching jitted shapes without a
+                                pow2 bucketing helper (_pad_to)
+J014  scan-carry-contract       scan/fori carries drifting in dtype/weak
+                                type/structure between init and body
+J015  zero-d-leaf-promotion     ascontiguousarray/atleast_1d/.reshape(-1)
+                                on pytree leaves (the PR-15 restore bug)
+J016  durable-io-crash-         replace without fsync/dir-fsync, append
+      consistency               without torn-tail repair, in durable
+                                modules (checkpoint/journal/wal)
+J017  unregistered-pytree-      frozen dataclasses riding scan carries or
+      carrier                   tree_flatten without pytree registration
+J018  donated-buffer-reuse      reading an argument after donating it via
+                                jit(donate_argnums=...)
 ====  ========================  ============================================
 
 Runtime half: :func:`ceph_tpu.analysis.runtime_guard.track` counts XLA
@@ -47,6 +61,14 @@ compiles and device->host transfers so bench records ``n_compiles`` /
 dynamic twin of J007-J009, enabled by the ``debug_rank_checks`` config
 knob — cross-checks a cheap fingerprint of mesh-seam operands via a
 psum so rank-divergent state fails fast instead of deadlocking.
+The v3 rules add three more twins:
+:func:`~ceph_tpu.analysis.runtime_guard.assert_bucketed` (J013, knob
+``debug_bucket_checks``) asserts seam sizes are powers of two,
+:class:`~ceph_tpu.analysis.runtime_guard.CompileBudget` bounds the
+compiles a warm scope may perform, and
+:class:`~ceph_tpu.analysis.runtime_guard.FsyncAudit` (J016, knob
+``debug_fsync_audit``) verifies the fsync -> replace -> dir-fsync
+ordering on live checkpoint commits.
 
 Suppress a finding with ``# jaxlint: disable=J00x`` on (or directly
 above) the flagged line.
@@ -54,9 +76,11 @@ above) the flagged line.
 
 from .findings import RULES, Finding, Suppressions
 from .runner import (
+    DURABLE_SEGMENTS,
     HOT_SEGMENTS,
     VCLOCK_SEGMENTS,
     LintResult,
+    is_durable,
     is_hot,
     is_vclock,
     iter_py_files,
@@ -65,13 +89,21 @@ from .runner import (
     lint_source,
 )
 from .runtime_guard import (
+    CompileBudget,
     CompileCounter,
+    FsyncAudit,
+    FsyncAuditError,
     GuardStats,
     RankDivergenceError,
     RankStalledError,
     TransferCounter,
+    UnbucketedShapeError,
+    assert_bucketed,
     assert_no_recompile,
     assert_rank_identical,
+    bucket_checks_enabled,
+    fsync_audit_enabled,
+    is_pow2,
     rank_checks_enabled,
     rank_fingerprint,
     track,
@@ -81,22 +113,32 @@ __all__ = [
     "RULES",
     "Finding",
     "Suppressions",
+    "DURABLE_SEGMENTS",
     "HOT_SEGMENTS",
     "VCLOCK_SEGMENTS",
     "LintResult",
+    "is_durable",
     "is_hot",
     "is_vclock",
     "iter_py_files",
     "lint_fields",
     "lint_paths",
     "lint_source",
+    "CompileBudget",
     "CompileCounter",
+    "FsyncAudit",
+    "FsyncAuditError",
     "GuardStats",
     "RankDivergenceError",
     "RankStalledError",
     "TransferCounter",
+    "UnbucketedShapeError",
+    "assert_bucketed",
     "assert_no_recompile",
     "assert_rank_identical",
+    "bucket_checks_enabled",
+    "fsync_audit_enabled",
+    "is_pow2",
     "rank_checks_enabled",
     "rank_fingerprint",
     "track",

@@ -1,4 +1,4 @@
-"""jaxlint AST checkers J001-J012, tuned to this codebase's JAX idioms.
+"""jaxlint AST checkers J001-J018, tuned to this codebase's JAX idioms.
 
 One :class:`Analyzer` instance lints one module.  Three passes:
 
@@ -31,7 +31,12 @@ One :class:`Analyzer` instance lints one module.  Three passes:
    traced name or a ``jnp``/``lax`` call.  Shape/dtype/ndim accesses
    and ``len()`` break the taint (they are static under tracing).
    Parallel per-scope taints track rank-local values (J008), unordered
-   set values (J009) and explicitly placed device arrays (J012).
+   set values (J009), explicitly placed device arrays (J012),
+   dynamic counts and the arrays shaped by them (J013), pytree-leaf
+   sequences (J015), unregistered frozen-dataclass instances (J017)
+   and donated buffers (J018).  Durable-write modules (checkpoint/
+   journal/WAL paths, ``durable=True``) additionally get per-function
+   crash-consistency structure checks (J016).
 
 The dataflow remains an under-approximation where resolution is
 ambiguous: a bare name flowing in from a closure is assumed static and
@@ -118,6 +123,50 @@ _ORDER_SINK_ATTRS = {"append", "extend", "insert", "write",
                      "writelines", "put", "emit", "event", "span",
                      "add_event", "send"}
 
+#: registered power-of-two/bucketing helpers: routing a dynamic count
+#: through one of these clears the J013 taint (the name tails match
+#: ``cluster_state._pad_to``, ``writepath._pow2_bucket`` and
+#: ``parallel.padding``'s multiple-based helpers)
+_BUCKET_HELPERS = {"_pad_to", "_pow2_bucket", "padded_size",
+                   "pad_to_multiple", "next_pow2", "_next_pow2",
+                   "pow2_bucket"}
+
+#: calls yielding a data-dependent Python count (J013 sources)
+_DYN_COUNT_CALLS = {"len", "numpy.count_nonzero",
+                    "jax.numpy.count_nonzero", "numpy.sum",
+                    "jax.numpy.sum"}
+
+#: calls whose result array has a data-dependent size (J013 sources)
+_DYN_SIZE_CALLS = {"numpy.nonzero", "numpy.flatnonzero",
+                   "numpy.argwhere", "jax.numpy.nonzero",
+                   "jax.numpy.flatnonzero", "jax.numpy.argwhere"}
+
+#: fixed-shape array constructors whose shape argument a dynamic
+#: count must not reach (they mint a J013 dynamic-shaped array)
+_ARRAY_CTORS = {
+    f"{root}.{name}"
+    for root in ("numpy", "jax.numpy")
+    for name in ("zeros", "ones", "full", "empty", "arange")
+}
+_PAD_FNS = {"numpy.pad", "jax.numpy.pad"}
+
+#: calls yielding the flattened leaf list of a pytree (J015 sources)
+_LEAF_SEQ_CALLS = {"jax.tree_util.tree_leaves", "jax.tree.leaves",
+                   "jax.tree_leaves"}
+_TREE_FLATTEN_CALLS = {"jax.tree_util.tree_flatten", "jax.tree.flatten",
+                       "jax.tree_flatten"}
+
+#: converters that promote a 0-d leaf to shape (1,) (J015 sinks; the
+#: PR-15 restore bug was numpy.ascontiguousarray on checkpoint leaves)
+_LEAF_PROMOTERS = {"numpy.ascontiguousarray", "numpy.atleast_1d",
+                   "jax.numpy.atleast_1d"}
+
+#: decorator/call name tails that register a class as a pytree (J017)
+_PYTREE_REGISTRARS = {"register_pytree_node_class",
+                      "register_pytree_with_keys_class",
+                      "register_dataclass", "register_pytree_node",
+                      "register_pytree_with_keys"}
+
 _LOOP_NODES = (ast.For, ast.While, ast.AsyncFor)
 _COMP_NODES = (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
 
@@ -158,10 +207,12 @@ class ImportMap:
 
 @dataclass
 class StaticSpec:
-    """static_argnums/static_argnames of one jit wrapper."""
+    """static/donated argument spec of one jit wrapper."""
 
     argnums: frozenset[int] = frozenset()
     argnames: frozenset[str] = frozenset()
+    donated: frozenset[int] = frozenset()
+    donated_names: frozenset[str] = frozenset()
 
 
 def _literal_ints(node: ast.expr) -> frozenset[int]:
@@ -209,17 +260,30 @@ class _Scope:
     #: placed names a shard_map body closes over (J012), reported once
     forbidden_captures: frozenset[str] = frozenset()
     reported_captures: set[str] = field(default_factory=set)
+    #: names holding an unbucketed dynamic count (J013)
+    dyncount_names: set[str] = field(default_factory=set)
+    #: names holding an array whose shape derives from one (J013)
+    dynshape_names: set[str] = field(default_factory=set)
+    #: names holding a pytree leaf *sequence* (tree_leaves result)
+    leafseq_names: set[str] = field(default_factory=set)
+    #: names bound to individual pytree leaves (J015 sink operands)
+    leaf_names: set[str] = field(default_factory=set)
+    #: names holding unregistered frozen-dataclass instances (J017)
+    carrier_names: set[str] = field(default_factory=set)
+    #: donated-buffer names -> donating call line (J018), per function
+    donated: dict[str, int] = field(default_factory=dict)
 
 
 class Analyzer(ast.NodeVisitor):
     """Lint one parsed module; collects :class:`Finding` objects."""
 
     def __init__(self, path: str, tree: ast.Module, hot: bool = True,
-                 vclock: bool = True):
+                 vclock: bool = True, durable: bool = False):
         self.path = path
         self.tree = tree
         self.hot = hot
         self.vclock = vclock
+        self.durable = durable
         self.imports = ImportMap(tree)
         self.findings: list[Finding] = []
         self._scopes: list[_Scope] = [_Scope(traced=False)]
@@ -232,6 +296,8 @@ class Analyzer(ast.NodeVisitor):
         self._mesh_axes: set[str] = set()
         self._defs: dict[str, ast.AST] = {}
         self._def_dupes: set[str] = set()
+        self._frozen_dataclasses: set[str] = set()
+        self._registered_pytrees: set[str] = set()
         self._collect()
         # propagate pass (call graph)
         self._edges: dict[str, set[str]] = {}
@@ -262,12 +328,19 @@ class Analyzer(ast.NodeVisitor):
             return None
         nums: frozenset[int] = frozenset()
         names: frozenset[str] = frozenset()
+        dnums: frozenset[int] = frozenset()
+        dnames: frozenset[str] = frozenset()
         for kw in call.keywords:
             if kw.arg == "static_argnums":
                 nums = _literal_ints(kw.value)
             elif kw.arg == "static_argnames":
                 names = _literal_strs(kw.value)
-        return StaticSpec(argnums=nums, argnames=names)
+            elif kw.arg == "donate_argnums":
+                dnums = _literal_ints(kw.value)
+            elif kw.arg == "donate_argnames":
+                dnames = _literal_strs(kw.value)
+        return StaticSpec(argnums=nums, argnames=names,
+                          donated=dnums, donated_names=dnames)
 
     def _decorator_spec(self, fn: ast.FunctionDef) -> StaticSpec | None:
         for dec in fn.decorator_list:
@@ -300,8 +373,36 @@ class Analyzer(ast.NodeVisitor):
             name, frozenset()
         ) | axes
 
+    def _is_registrar(self, node: ast.expr) -> bool:
+        fn = self.imports.resolve(node)
+        return bool(fn) and fn.rsplit(".", 1)[-1] in _PYTREE_REGISTRARS
+
+    def _collect_class(self, node: ast.ClassDef) -> None:
+        """Record frozen dataclasses and their pytree registration
+        (decorator form) for J017."""
+        frozen = registered = False
+        for dec in node.decorator_list:
+            if isinstance(dec, ast.Call):
+                fn = self.imports.resolve(dec.func)
+                if fn in ("dataclasses.dataclass", "dataclass"):
+                    for kw in dec.keywords:
+                        if kw.arg == "frozen" and isinstance(
+                            kw.value, ast.Constant
+                        ) and kw.value.value is True:
+                            frozen = True
+                elif self._is_registrar(dec.func):
+                    registered = True
+            elif self._is_registrar(dec):
+                registered = True
+        if frozen:
+            self._frozen_dataclasses.add(node.name)
+        if registered:
+            self._registered_pytrees.add(node.name)
+
     def _collect(self) -> None:
         for node in ast.walk(self.tree):
+            if isinstance(node, ast.ClassDef):
+                self._collect_class(node)
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 spec = self._decorator_spec(node)
                 if spec is not None:
@@ -360,6 +461,11 @@ class Analyzer(ast.NodeVisitor):
                     # name bindings)
                     spec = self._jit_target(node) or StaticSpec()
                     self.jitted.setdefault(first.id, spec)
+                if fn.rsplit(".", 1)[-1] in _PYTREE_REGISTRARS and isinstance(
+                    first, ast.Name
+                ):
+                    # call-form registration: register_pytree_node(C, ...)
+                    self._registered_pytrees.add(first.id)
                 if fn.endswith(".Mesh") or fn == "Mesh":
                     if len(node.args) >= 2:
                         self._mesh_axes |= _literal_strs(node.args[1])
@@ -675,6 +781,11 @@ class Analyzer(ast.NodeVisitor):
         scope.ranklocal_names = set(parent.ranklocal_names)
         scope.set_names = set(parent.set_names)
         scope.placed_names = set(parent.placed_names)
+        scope.dyncount_names = set(parent.dyncount_names)
+        scope.dynshape_names = set(parent.dynshape_names)
+        scope.leafseq_names = set(parent.leafseq_names)
+        scope.leaf_names = set(parent.leaf_names)
+        scope.carrier_names = set(parent.carrier_names)
         if traced:
             params = [a.arg for a in node.args.args]
             for i, p in enumerate(params):
@@ -702,6 +813,8 @@ class Analyzer(ast.NodeVisitor):
         elif parent.forbidden_captures:
             scope.forbidden_captures = parent.forbidden_captures
             scope.reported_captures = parent.reported_captures
+        if self.durable:
+            self._check_durable_fn(node)
         self._scopes.append(scope)
         outer_loops = self._host_loop_depth
         if traced:
@@ -762,12 +875,23 @@ class Analyzer(ast.NodeVisitor):
                 "each rank (and each PYTHONHASHSEED) gets its own order; "
                 "iterate sorted(...) instead",
             )
+        if self._leafseq_iter(node.iter):
+            self._mark_leaf_targets(node.target)
         self._visit_host_loop(node)
 
     visit_AsyncFor = visit_For
 
     def visit_Name(self, node: ast.Name) -> None:
         sc = self._scope
+        if isinstance(node.ctx, ast.Load) and node.id in sc.donated:
+            line = sc.donated.pop(node.id)
+            self._report(
+                "J018", node,
+                f"`{node.id}` read after being donated to a jitted "
+                f"call on line {line}: donation handed the buffer to "
+                "XLA (deleted on CPU/GPU, aliased on TPU); rebind the "
+                "name to the call's result or stop donating it",
+            )
         if (
             isinstance(node.ctx, ast.Load)
             and node.id in sc.forbidden_captures
@@ -886,12 +1010,454 @@ class Analyzer(ast.NodeVisitor):
                 return True
         return False
 
+    # ---------------------------------------- J013 dynamic-shape taint
+
+    def _dyn_count_expr(self, node: ast.expr) -> bool:
+        """Expression yielding a data-dependent Python count that has
+        NOT passed through a registered bucketing helper."""
+        if isinstance(node, ast.Name):
+            return node.id in self._scope.dyncount_names
+        if isinstance(node, ast.Call):
+            fn = self.imports.resolve(node.func)
+            if fn and fn.rsplit(".", 1)[-1] in _BUCKET_HELPERS:
+                return False  # bucketed: sizes collapse to one shape
+            if fn in _DYN_COUNT_CALLS:
+                return bool(node.args)
+            if fn in ("int", "abs", "max", "min", "sum"):
+                return any(self._dyn_count_expr(a) for a in node.args)
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "sum"
+                and not node.args
+            ):
+                return True  # x.sum() used as a size
+            return False
+        if isinstance(node, ast.BinOp):
+            return self._dyn_count_expr(node.left) or self._dyn_count_expr(
+                node.right
+            )
+        if isinstance(node, ast.UnaryOp):
+            return self._dyn_count_expr(node.operand)
+        if isinstance(node, ast.IfExp):
+            return self._dyn_count_expr(node.body) or self._dyn_count_expr(
+                node.orelse
+            )
+        if isinstance(node, ast.GeneratorExp):
+            return self._dyn_count_expr(node.elt)
+        return False
+
+    def _shape_arg_dynamic(self, call: ast.Call) -> bool:
+        shape = call.args[0] if call.args else None
+        for kw in call.keywords:
+            if kw.arg == "shape":
+                shape = kw.value
+        if shape is None:
+            return False
+        elts = (
+            shape.elts
+            if isinstance(shape, (ast.Tuple, ast.List))
+            else [shape]
+        )
+        return any(self._dyn_count_expr(e) for e in elts)
+
+    def _dyn_shape_expr(self, node: ast.expr) -> bool:
+        """Array expression whose SHAPE derives from a dynamic count
+        (the J013 recompile-per-batch hazard)."""
+        if isinstance(node, ast.Name):
+            return node.id in self._scope.dynshape_names
+        if isinstance(node, ast.Call):
+            fn = self.imports.resolve(node.func)
+            if fn in _DYN_SIZE_CALLS:
+                return True
+            if fn in ("numpy.where", "jax.numpy.where") and len(
+                node.args
+            ) == 1:
+                return True  # single-arg where: nonzero in disguise
+            if fn in _ARRAY_CTORS:
+                return self._shape_arg_dynamic(node)
+            if fn in _PAD_FNS and len(node.args) >= 2:
+                return any(
+                    self._dyn_count_expr(n)
+                    for n in ast.walk(node.args[1])
+                    if isinstance(n, (ast.Name, ast.Call, ast.BinOp))
+                )
+            if fn in ("numpy.asarray", "numpy.ascontiguousarray",
+                      "jax.numpy.asarray", "jax.device_put"):
+                # shape-preserving conversions pass the taint through
+                return bool(node.args) and self._dyn_shape_expr(
+                    node.args[0]
+                )
+            return False
+        if isinstance(node, ast.Subscript):
+            sl = node.slice
+            if isinstance(sl, ast.Slice):
+                return any(
+                    b is not None and self._dyn_count_expr(b)
+                    for b in (sl.lower, sl.upper)
+                )
+            # np.nonzero(mask)[0]: tuple-indexing a dyn-size result
+            if (
+                isinstance(node.value, ast.Call)
+                and self.imports.resolve(node.value.func)
+                in _DYN_SIZE_CALLS
+            ):
+                return True
+            # gather by a dynamic-size index array keeps its size
+            return self._dyn_shape_expr(sl)
+        return False
+
+    def _check_dynshape_args(self, node: ast.Call, fn: str) -> None:
+        """J013: a dynamic-shaped array at a non-static position of a
+        locally-defined jitted function."""
+        spec = self.jitted.get(fn)
+        if spec is None:
+            return
+        for i, arg in enumerate(node.args):
+            if i in spec.argnums:
+                continue
+            if self._dyn_shape_expr(arg):
+                self._report(
+                    "J013", arg,
+                    f"array with a data-dependent shape passed to "
+                    f"jitted `{fn}`: every distinct count is a fresh "
+                    "program signature (recompile per batch); bucket "
+                    "the size with _pad_to/_pow2_bucket first",
+                )
+        for kw in node.keywords:
+            if kw.arg and kw.arg not in spec.argnames and (
+                self._dyn_shape_expr(kw.value)
+            ):
+                self._report(
+                    "J013", kw.value,
+                    f"array with a data-dependent shape passed to "
+                    f"jitted `{fn}` as `{kw.arg}`: every distinct "
+                    "count is a fresh program signature; bucket the "
+                    "size with _pad_to/_pow2_bucket first",
+                )
+
+    # --------------------------------------------- J014/J017 carries
+
+    def _local_def(self, node: ast.expr | None):
+        if (
+            isinstance(node, ast.Name)
+            and node.id in self._defs
+            and node.id not in self._def_dupes
+        ):
+            d = self._defs[node.id]
+            if isinstance(d, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return d
+        return None
+
+    @staticmethod
+    def _raw_scalar(node: ast.expr) -> bool:
+        return (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, (int, float))
+            and not isinstance(node.value, bool)
+        )
+
+    @staticmethod
+    def _shallow_walk(fndef):
+        """Walk a function body without descending into nested defs."""
+        stack = list(fndef.body)
+        while stack:
+            n = stack.pop()
+            yield n
+            if isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _body_carries(self, fndef, scan: bool) -> list[ast.expr]:
+        """Carry expressions returned by a loop body: for scan the
+        first element of the ``(carry, y)`` pair, else the value."""
+        out = []
+        for n in self._shallow_walk(fndef):
+            if isinstance(n, ast.Return) and n.value is not None:
+                v = n.value
+                if scan:
+                    if isinstance(v, ast.Tuple) and len(v.elts) == 2:
+                        out.append(v.elts[0])
+                else:
+                    out.append(v)
+        return out
+
+    def _compare_carry(
+        self, init: ast.expr, carries: list[ast.expr], which: str
+    ) -> None:
+        """J014: init-vs-body carry drift, where both sides are
+        literal tuples the AST can compare."""
+        if not isinstance(init, ast.Tuple):
+            return
+        for c in carries:
+            if not isinstance(c, ast.Tuple):
+                continue
+            if len(c.elts) != len(init.elts):
+                self._report(
+                    "J014", c,
+                    f"{which} body returns a {len(c.elts)}-leaf carry "
+                    f"for a {len(init.elts)}-leaf init: the carry "
+                    "structure drifts between init and body and fails "
+                    "the aval check at trace time",
+                )
+                continue
+            for a, b in zip(init.elts, c.elts):
+                if self._raw_scalar(b) and not isinstance(
+                    a, ast.Constant
+                ):
+                    self._report(
+                        "J014", b,
+                        f"{which} body re-seeds a carry leaf with the "
+                        f"Python literal {b.value!r} each step: its "
+                        "weak type drifts against the init leaf's "
+                        "dtype; pin with jnp.<dtype>(...)",
+                    )
+
+    def _check_scan(self, node: ast.Call) -> None:
+        init = node.args[1] if len(node.args) >= 2 else None
+        for kw in node.keywords:
+            if kw.arg == "init":
+                init = kw.value
+        if init is None:
+            return
+        self._check_carrier(init, "scan")
+        init_elts = (
+            init.elts if isinstance(init, ast.Tuple) else [init]
+        )
+        for e in init_elts:
+            if self._raw_scalar(e):
+                self._report(
+                    "J014", e,
+                    f"scan carry seeded with raw Python scalar "
+                    f"{e.value!r}: the weak-typed init leaf drifts "
+                    "against the body's strong-typed output; pin with "
+                    "jnp.<dtype>(...)",
+                )
+        fndef = self._local_def(node.args[0] if node.args else None)
+        if fndef is not None:
+            self._compare_carry(
+                init, self._body_carries(fndef, scan=True), "scan"
+            )
+
+    def _check_carrier(self, init: ast.expr, which: str) -> None:
+        """J017: an unregistered frozen-dataclass instance riding a
+        carry (or checkpoint payload)."""
+        elts = (
+            init.elts
+            if isinstance(init, (ast.Tuple, ast.List))
+            else [init]
+        )
+        unregistered = self._frozen_dataclasses - self._registered_pytrees
+        for e in elts:
+            cls = None
+            if isinstance(e, ast.Call) and isinstance(e.func, ast.Name):
+                cls = e.func.id
+            elif (
+                isinstance(e, ast.Name)
+                and e.id in self._scope.carrier_names
+            ):
+                self._report(
+                    "J017", e,
+                    f"`{e.id}` holds a frozen dataclass with no pytree "
+                    f"registration but rides a {which} carry: jax sees "
+                    "one opaque leaf; register the class "
+                    "(register_pytree_node_class / register_dataclass)",
+                )
+                continue
+            if cls in unregistered:
+                self._report(
+                    "J017", e,
+                    f"frozen dataclass `{cls}` used as a {which} carry "
+                    "without pytree registration: jax sees one opaque "
+                    "leaf; register the class "
+                    "(register_pytree_node_class / register_dataclass)",
+                )
+
+    # ------------------------------------------------ J015 leaf taint
+
+    def _leafseq_iter(self, it: ast.expr) -> bool:
+        """Iterable that yields pytree leaves (a tree_leaves result,
+        tree_flatten(...)[0], or enumerate/zip over one)."""
+        if isinstance(it, ast.Name):
+            return it.id in self._scope.leafseq_names
+        if isinstance(it, ast.Call):
+            fn = self.imports.resolve(it.func)
+            if fn in _LEAF_SEQ_CALLS:
+                return True
+            if isinstance(it.func, ast.Name) and it.func.id in (
+                "enumerate", "zip", "reversed", "sorted", "list"
+            ):
+                return any(self._leafseq_iter(a) for a in it.args)
+        if isinstance(it, ast.Subscript):
+            return (
+                isinstance(it.slice, ast.Constant)
+                and it.slice.value == 0
+                and isinstance(it.value, ast.Call)
+                and self.imports.resolve(it.value.func)
+                in _TREE_FLATTEN_CALLS
+            )
+        return False
+
+    def _mark_leaf_targets(self, target: ast.expr) -> None:
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name):
+                self._scope.leaf_names.add(n.id)
+
+    @staticmethod
+    def _is_neg1(node: ast.expr) -> bool:
+        if isinstance(node, ast.Constant):
+            return node.value == -1
+        return (
+            isinstance(node, ast.UnaryOp)
+            and isinstance(node.op, ast.USub)
+            and isinstance(node.operand, ast.Constant)
+            and node.operand.value == 1
+        )
+
+    # --------------------------------------------- J016 durable IO
+
+    def _open_mode(self, call: ast.Call) -> str | None:
+        fn = self.imports.resolve(call.func)
+        if fn not in ("open", "io.open"):
+            return None
+        mode = call.args[1] if len(call.args) >= 2 else None
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if isinstance(mode, ast.Constant) and isinstance(
+            mode.value, str
+        ):
+            return mode.value
+        return "r" if mode is None else None
+
+    def _check_durable_fn(self, fndef) -> None:
+        """J016: per-function crash-consistency structure in a
+        durable-write module — the write -> flush -> fsync ->
+        os.replace -> dir-fsync -> repaired-append chain."""
+        replaces: list[ast.Call] = []
+        append_opens: list[ast.Call] = []
+        has_write = has_fsync = has_dir_fsync = False
+        has_repair = has_truncate = False
+        for n in self._shallow_walk(fndef):
+            if not isinstance(n, ast.Call):
+                continue
+            fn = self.imports.resolve(n.func)
+            if fn in ("os.replace", "os.rename"):
+                replaces.append(n)
+            elif fn == "os.fsync":
+                has_fsync = True
+            elif fn and "fsync_dir" in fn.rsplit(".", 1)[-1]:
+                has_dir_fsync = True
+            elif fn and "repair_torn_tail" in fn:
+                has_repair = True
+            mode = self._open_mode(n)
+            if mode is not None:
+                if mode.startswith("a"):
+                    append_opens.append(n)
+                elif mode.startswith(("w", "x")):
+                    has_truncate = True
+            if isinstance(n.func, ast.Attribute):
+                if n.func.attr in ("write", "writelines"):
+                    has_write = True
+                elif n.func.attr == "truncate":
+                    has_truncate = True
+        for r in replaces:
+            if has_write and not has_fsync:
+                self._report(
+                    "J016", r,
+                    "file written and os.replace'd without os.fsync: "
+                    "the rename can commit before the data, so a "
+                    "crash leaves a truncated or empty 'committed' "
+                    "file; flush + fsync before the replace",
+                )
+            if not has_dir_fsync:
+                self._report(
+                    "J016", r,
+                    "os.replace without a directory fsync: the rename "
+                    "itself is not durable until the parent directory "
+                    "entry is fsync'd (_fsync_dir); a crash can roll "
+                    "the commit back",
+                )
+        for o in append_opens:
+            if not (has_repair or has_truncate):
+                self._report(
+                    "J016", o,
+                    "append-mode open in a durable-write module "
+                    "without repairing a torn tail first: a crash-torn "
+                    "final line glues onto the new record and "
+                    "corrupts both; call _repair_torn_tail(path) "
+                    "before appending",
+                )
+
+    # --------------------------------------------------- J018 donation
+
+    def _register_donation(
+        self, node: ast.Call, spec: StaticSpec
+    ) -> None:
+        for i in spec.donated:
+            if i < len(node.args) and isinstance(node.args[i], ast.Name):
+                self._scope.donated[node.args[i].id] = node.lineno
+        for kw in node.keywords:
+            if kw.arg and kw.arg in spec.donated_names and isinstance(
+                kw.value, ast.Name
+            ):
+                self._scope.donated[kw.value.id] = node.lineno
+
     # --------------------------------------------------------- assigns
 
-    def _track_host_taints(self, targets, value) -> None:
-        """Per-scope rank-local / set / placed-array name tracking.
-        A re-assignment to an untainted value kills the taint."""
+    def _unwrap_passthrough(self, value: ast.expr) -> ast.expr:
+        """Strip shape-preserving wrappers (device_get/list/tuple)."""
+        while (
+            isinstance(value, ast.Call)
+            and value.args
+            and self.imports.resolve(value.func)
+            in ("jax.device_get", "list", "tuple")
+        ):
+            value = value.args[0]
+        return value
+
+    def _track_leafseq(self, targets, value) -> None:
+        """J015: names bound to leaf sequences — ``tree_leaves(...)``,
+        ``tree_flatten(...)[0]``, or ``leaves, treedef = tree_flatten``."""
         sc = self._scope
+        value = self._unwrap_passthrough(value)
+        is_leaves = (
+            isinstance(value, ast.Call)
+            and self.imports.resolve(value.func) in _LEAF_SEQ_CALLS
+        )
+        is_flat_sub = (
+            isinstance(value, ast.Subscript)
+            and isinstance(value.value, ast.Call)
+            and self.imports.resolve(value.value.func)
+            in _TREE_FLATTEN_CALLS
+            and isinstance(value.slice, ast.Constant)
+            and value.slice.value == 0
+        )
+        is_flat = (
+            isinstance(value, ast.Call)
+            and self.imports.resolve(value.func) in _TREE_FLATTEN_CALLS
+        )
+        for t in targets:
+            if isinstance(t, ast.Name):
+                if is_leaves or is_flat_sub:
+                    sc.leafseq_names.add(t.id)
+                else:
+                    sc.leafseq_names.discard(t.id)
+            elif (
+                isinstance(t, (ast.Tuple, ast.List))
+                and t.elts
+                and is_flat
+                and isinstance(t.elts[0], ast.Name)
+            ):
+                sc.leafseq_names.add(t.elts[0].id)
+
+    def _track_host_taints(self, targets, value) -> None:
+        """Per-scope rank-local / set / placed-array / dynamic-shape /
+        leaf-sequence / carrier name tracking.  A re-assignment to an
+        untainted value kills the taint."""
+        sc = self._scope
+        self._track_leafseq(targets, value)
         names: list[str] = []
         for t in targets:
             if isinstance(t, ast.Name):
@@ -904,6 +1470,14 @@ class Analyzer(ast.NodeVisitor):
             return
         ranklocal = self._expr_ranklocal(value)
         unordered = self._is_unordered(value)
+        dyncount = self._dyn_count_expr(value)
+        dynshape = self._dyn_shape_expr(value)
+        carrier = (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Name)
+            and value.func.id in self._frozen_dataclasses
+            and value.func.id not in self._registered_pytrees
+        )
         placed = False
         if isinstance(value, ast.Call):
             fn = self.imports.resolve(value.func)
@@ -915,6 +1489,12 @@ class Analyzer(ast.NodeVisitor):
              else sc.set_names.discard)(name)
             (sc.placed_names.add if placed
              else sc.placed_names.discard)(name)
+            (sc.dyncount_names.add if dyncount
+             else sc.dyncount_names.discard)(name)
+            (sc.dynshape_names.add if dynshape
+             else sc.dynshape_names.discard)(name)
+            (sc.carrier_names.add if carrier
+             else sc.carrier_names.discard)(name)
 
     def visit_Assign(self, node: ast.Assign) -> None:
         self._check_tracer_leak(node.targets, node.value, node)
@@ -923,11 +1503,28 @@ class Analyzer(ast.NodeVisitor):
                 self._mark_targets(tgt)
         self._track_host_taints(node.targets, node.value)
         self.generic_visit(node)
+        # rebinding a donated name (x = f(x)) clears the J018 taint:
+        # the value visit above already registered the donation
+        for tgt in node.targets:
+            for leaf in ast.walk(tgt):
+                if isinstance(leaf, ast.Name):
+                    self._scope.donated.pop(leaf.id, None)
 
     def visit_AugAssign(self, node: ast.AugAssign) -> None:
         self._check_tracer_leak([node.target], node.value, node)
         if self._scope.traced and self._is_traced(node.value):
             self._mark_targets(node.target)
+        if (
+            isinstance(node.target, ast.Name)
+            and node.target.id in self._scope.donated
+        ):
+            line = self._scope.donated.pop(node.target.id)
+            self._report(
+                "J018", node,
+                f"`{node.target.id}` updated in place after being "
+                f"donated on line {line}: the buffer now belongs to "
+                "XLA; rebind the name to the call's result instead",
+            )
         self.generic_visit(node)
 
     def _check_tracer_leak(self, targets, value, node) -> None:
@@ -1036,6 +1633,8 @@ class Analyzer(ast.NodeVisitor):
                 fn.startswith("jax.lax") or fn == "lax.while_loop"
             ):
                 self._check_while_loop(node)
+            elif fn in ("jax.lax.scan", "lax.scan"):
+                self._check_scan(node)
             elif fn in _HOST_SYNC_FUNCS:
                 self._check_host_sync(
                     node, "jax.block_until_ready() inside a host loop"
@@ -1077,7 +1676,39 @@ class Analyzer(ast.NodeVisitor):
                     "a fresh wrapper identity recompiles every "
                     "iteration; hoist it out of the loop",
                 )
+            if fn in _LEAF_PROMOTERS and node.args:
+                a0 = node.args[0]
+                if (
+                    isinstance(a0, ast.Name)
+                    and a0.id in self._scope.leaf_names
+                ):
+                    self._report(
+                        "J015", node,
+                        f"{fn}() on pytree leaf `{a0.id}` promotes 0-d "
+                        "leaves to shape (1,), so every restore fails "
+                        "the template shape check; use np.asarray, "
+                        "which preserves 0-d",
+                    )
+            if fn in (_LEAF_SEQ_CALLS | _TREE_FLATTEN_CALLS) and node.args:
+                a0 = node.args[0]
+                unreg = self._frozen_dataclasses - self._registered_pytrees
+                if (
+                    isinstance(a0, ast.Call)
+                    and isinstance(a0.func, ast.Name)
+                    and a0.func.id in unreg
+                ) or (
+                    isinstance(a0, ast.Name)
+                    and a0.id in self._scope.carrier_names
+                ):
+                    self._report(
+                        "J017", a0,
+                        "unregistered frozen dataclass flattened as a "
+                        "pytree: jax sees one opaque leaf; register "
+                        "the class (register_pytree_node_class / "
+                        "register_dataclass)",
+                    )
             self._check_static_call_args(node, fn)
+            self._check_dynshape_args(node, fn)
         # .item() on anything inside a host loop of a hot module
         if (
             isinstance(node.func, ast.Attribute)
@@ -1085,7 +1716,28 @@ class Analyzer(ast.NodeVisitor):
             and not node.args
         ):
             self._check_host_sync(node, ".item() inside a host loop")
+        # .reshape(-1) on a pytree leaf (J015): flattens 0-d to (1,)
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "reshape"
+            and len(node.args) == 1
+            and self._is_neg1(node.args[0])
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in self._scope.leaf_names
+        ):
+            self._report(
+                "J015", node,
+                f".reshape(-1) on pytree leaf "
+                f"`{node.func.value.id}` promotes 0-d leaves to shape "
+                "(1,); restore-time template checks reject the result",
+            )
         self.generic_visit(node)
+        # J018: register donations only after visiting the call's own
+        # argument loads, so the donating call does not self-flag
+        if fn and fn in self.jitted:
+            spec = self.jitted[fn]
+            if spec.donated or spec.donated_names:
+                self._register_donation(node, spec)
 
     def _device_call(self, node: ast.expr) -> bool:
         """A call plausibly launching device work: a bare local
@@ -1120,10 +1772,26 @@ class Analyzer(ast.NodeVisitor):
                 )
         if len(node.args) >= 4:
             self._check_carry(node.args[3], "fori_loop")
+            self._check_carrier(node.args[3], "fori_loop")
+            fndef = self._local_def(node.args[2])
+            if fndef is not None:
+                self._compare_carry(
+                    node.args[3],
+                    self._body_carries(fndef, scan=False),
+                    "fori_loop",
+                )
 
     def _check_while_loop(self, node: ast.Call) -> None:
         if len(node.args) >= 3:
             self._check_carry(node.args[2], "while_loop")
+            self._check_carrier(node.args[2], "while_loop")
+            fndef = self._local_def(node.args[1])
+            if fndef is not None:
+                self._compare_carry(
+                    node.args[2],
+                    self._body_carries(fndef, scan=False),
+                    "while_loop",
+                )
 
     def _check_carry(self, init: ast.expr, which: str) -> None:
         if isinstance(init, (ast.Tuple, ast.List)):
@@ -1202,6 +1870,9 @@ class Analyzer(ast.NodeVisitor):
     # comprehensions are host loops too (progress paths build lists of
     # per-element host pulls)
     def _visit_comp(self, node) -> None:
+        for g in node.generators:
+            if self._leafseq_iter(g.iter):
+                self._mark_leaf_targets(g.target)
         host = not self._scope.traced
         if host:
             self._host_loop_depth += 1

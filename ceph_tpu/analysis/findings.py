@@ -125,6 +125,68 @@ RULES: dict[str, tuple[str, str]] = {
         "replicated the body sees partial or resharded data.  Pass it "
         "through in_specs instead.",
     ),
+    "J013": (
+        "unbucketed-dynamic-shape",
+        "An array whose shape derives from a dynamic count (len(...), "
+        ".sum(), nonzero/where sizes, dirty-set gathers) is passed to "
+        "a jitted function: every distinct count is a distinct program "
+        "signature, so the compile cache misses per batch — the latent "
+        "recompile bomb of dirty-lane compaction.  Route the count "
+        "through a power-of-two bucketing helper (_pad_to / "
+        "_pow2_bucket / padded_size) so size changes stay values, "
+        "never shapes.",
+    ),
+    "J014": (
+        "scan-carry-contract",
+        "A lax.scan/fori_loop carry whose leaves can drift between "
+        "init and body — raw Python scalars in the scan init (weak "
+        "type vs the body's strong-typed output), a body returning a "
+        "different tuple arity than the init, or a body re-seeding a "
+        "carry leaf with a Python literal each step — fails the carry "
+        "aval check at trace time or silently widens dtypes (the PR-1 "
+        "Mosaic i64 class, generalized to pytree carries).  Pin every "
+        "leaf with jnp.<dtype>(...) and keep init and body structurally "
+        "identical.",
+    ),
+    "J015": (
+        "zero-d-leaf-promotion",
+        "np.ascontiguousarray / np.atleast_1d / .reshape(-1) applied "
+        "to pytree or checkpoint-template leaves promotes 0-d leaves "
+        "(epoch, now, tape_cursor) to shape (1,), so every restore "
+        "fails the template shape check — the exact PR-15 restore bug. "
+        "Use np.asarray, which preserves 0-d.",
+    ),
+    "J016": (
+        "durable-io-crash-consistency",
+        "A durable-write module (checkpoint/journal/WAL) violating the "
+        "commit discipline: writing a tmp file and os.replace-ing it "
+        "without an os.fsync (contents can vanish across the rename), "
+        "os.replace without a directory fsync (the rename itself is "
+        "not durable), or opening a JSONL in append mode without "
+        "repairing a torn tail first (a crash-torn final line glues "
+        "onto the new record and corrupts both).  Follow the "
+        "write -> flush -> fsync -> os.replace -> dir-fsync -> "
+        "repaired-append chain checkpoint.py's save() documents.",
+    ),
+    "J017": (
+        "unregistered-pytree-carrier",
+        "A frozen dataclass instance used as a lax.scan/fori_loop/"
+        "while_loop carry without jax.tree_util registration "
+        "(register_pytree_node_class / register_dataclass): jax treats "
+        "the instance as one opaque leaf, so tracing fails or the "
+        "whole carrier re-materializes host-side per step — and "
+        "unhashable aux fields silently break lru_cache keys on the "
+        "cached-step pattern.  Register the class (the "
+        "StripeBufferState pattern) before it rides a carry.",
+    ),
+    "J018": (
+        "donated-buffer-reuse",
+        "Reading an argument after passing it to a jit(donate_argnums="
+        "...) call: donation hands the buffer to XLA, so the array is "
+        "deleted (RuntimeError on CPU/GPU) or silently aliases the "
+        "output on TPU.  Rebind the name to the call's result, or stop "
+        "donating it.",
+    ),
 }
 
 _SUPPRESS_RE = re.compile(

@@ -3,7 +3,9 @@
 The module scoping mirrors the rule definitions: J003's host-sync rule
 only fires in the hot data-path packages (``HOT_SEGMENTS``), J010's
 wall-clock rule only in VirtualClock-domain packages
-(``VCLOCK_SEGMENTS``); every other rule applies everywhere.
+(``VCLOCK_SEGMENTS``), J016's crash-consistency rule only in
+durable-write modules (``DURABLE_SEGMENTS``); every other rule
+applies everywhere.
 ``lint_source`` is the unit-test entry (fixtures pass source strings),
 ``lint_paths`` the CLI/test-gate entry, and ``lint_fields`` flattens
 per-rule counts for the bench JSON lines ``decide_defaults.py``
@@ -34,6 +36,10 @@ VCLOCK_SEGMENTS = frozenset(
     {"recovery", "workload", "chaos", "liveness", "superstep", "fleet",
      "durability", "reconcile", "online", "writepath"}
 )
+
+#: path segments whose modules perform durable writes (J016): the
+#: crash-consistency commit discipline is checked there
+DURABLE_SEGMENTS = frozenset({"checkpoint", "journal", "wal"})
 
 
 @dataclass
@@ -109,12 +115,20 @@ def is_vclock(path: str) -> bool:
     return any(seg in VCLOCK_SEGMENTS for seg in parts)
 
 
+def is_durable(path: str) -> bool:
+    parts = os.path.normpath(path).split(os.sep)
+    if parts and parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    return any(seg in DURABLE_SEGMENTS for seg in parts)
+
+
 def lint_source(
     source: str,
     path: str = "<string>",
     hot: bool = True,
     select: frozenset[str] | None = None,
     vclock: bool = True,
+    durable: bool = True,
 ) -> LintResult:
     """Lint one source string (the fixture/test entry point)."""
     res = LintResult(files=1)
@@ -123,7 +137,9 @@ def lint_source(
     except SyntaxError as e:
         res.errors.append(f"{path}: syntax error: {e.msg} (line {e.lineno})")
         return res
-    findings = Analyzer(path, tree, hot=hot, vclock=vclock).run()
+    findings = Analyzer(
+        path, tree, hot=hot, vclock=vclock, durable=durable
+    ).run()
     if select is not None:
         findings = [f for f in findings if f.rule in select]
     supp = Suppressions.parse(source)
@@ -164,7 +180,8 @@ def lint_paths(
             res.errors.append(f"{path}: unreadable: {e}")
             continue
         one = lint_source(source, path=path, hot=is_hot(path),
-                          select=select, vclock=is_vclock(path))
+                          select=select, vclock=is_vclock(path),
+                          durable=is_durable(path))
         res.files += 1
         res.findings.extend(one.findings)
         res.errors.extend(one.errors)

@@ -354,3 +354,166 @@ def assert_rank_identical(tag: str, *arrays, mesh, axis=None) -> None:
     if san is None:
         san = _sanitizers[key] = RankSanitizer(mesh, axis)
     san.check(tag, *arrays)
+
+
+# --------------------------------------------------------------------
+# shape-bucket guard: the dynamic twin of J013.  The static rule proves
+# no *un*bucketed count reaches a jitted call; this asserts the seam
+# sizes that DID go through a bucketing helper really are power-of-two
+# (a broken helper, or a seam the linter cannot see, recompiles per
+# batch silently — the counter only shows it after the fact).
+
+
+class UnbucketedShapeError(AssertionError):
+    """A padded seam dimension is not a power of two."""
+
+
+def is_pow2(n: int) -> bool:
+    n = int(n)
+    return n > 0 and (n & (n - 1)) == 0
+
+
+def bucket_checks_enabled() -> bool:
+    """The ``debug_bucket_checks`` config knob (env:
+    ``CEPH_TPU_DEBUG_BUCKET_CHECKS=1``)."""
+    from ..common.config import global_config
+
+    return bool(global_config().get("debug_bucket_checks"))
+
+
+def assert_bucketed(tag: str, *sizes) -> None:
+    """Raise :class:`UnbucketedShapeError` unless every size is a
+    power of two.  Each operand is an int, or an array whose leading
+    dimension is checked (the padded-lane convention).  Call at the
+    seams where bucketed shapes enter jitted programs, gated by
+    :func:`bucket_checks_enabled`."""
+    for s in sizes:
+        n = s if isinstance(s, int) else int(getattr(s, "shape", (0,))[0])
+        if not is_pow2(n):
+            raise UnbucketedShapeError(
+                f"{tag}: seam size {n} is not a power of two — a "
+                "data-dependent count reached a jitted call without "
+                "bucketing (every distinct count is a fresh program "
+                "signature); route it through _pad_to/_pow2_bucket"
+            )
+
+
+class CompileBudget:
+    """Context manager failing the scope when XLA compiles more than
+    ``budget`` programs — ``assert_no_recompile`` generalized to warm
+    paths that legitimately compile a known number of programs.
+
+    ::
+
+        with CompileBudget(0, "fleet superstep, same pad bucket"):
+            driver.sample(4, spec)   # must hit the compile cache
+    """
+
+    def __init__(self, budget: int, what: str = "scope"):
+        self.budget = int(budget)
+        self.what = what
+        self._cc = CompileCounter()
+
+    @property
+    def n_compiles(self) -> int:
+        return self._cc.n_compiles
+
+    def __enter__(self) -> "CompileBudget":
+        self._cc.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self._cc.__exit__(exc_type, exc, tb)
+        if exc_type is None and self._cc.n_compiles > self.budget:
+            raise AssertionError(
+                f"{self.what}: compile budget {self.budget} exceeded — "
+                f"observed {self._cc.backend_compiles} backend "
+                f"compile(s) + {self._cc.cache_hits} cache hit(s)"
+            )
+
+
+# --------------------------------------------------------------------
+# fsync audit: the dynamic twin of J016.  The static rule checks the
+# commit chain's *structure*; this hook checks the *order* on a live
+# run — every os.replace must be preceded by an fsync of a regular
+# file (the data) and followed by an fsync of a directory (the rename)
+# before the audit scope closes.
+
+
+def fsync_audit_enabled() -> bool:
+    """The ``debug_fsync_audit`` config knob (env:
+    ``CEPH_TPU_DEBUG_FSYNC_AUDIT=1``)."""
+    from ..common.config import global_config
+
+    return bool(global_config().get("debug_fsync_audit"))
+
+
+class FsyncAuditError(AssertionError):
+    """A rename committed without the fsyncs that make it durable."""
+
+
+class FsyncAudit:
+    """Records every ``os.fsync``/``os.replace`` in scope and verifies
+    the crash-consistency ordering::
+
+        with FsyncAudit("checkpoint commit") as audit:
+            store.save(...)
+        audit.verify()
+
+    ``verify()`` raises :class:`FsyncAuditError` when a replace had no
+    prior file fsync (contents can vanish across the rename) or no
+    later directory fsync (the rename itself is not durable).
+    """
+
+    def __init__(self, what: str = "durable write"):
+        self.what = what
+        self.events: list[tuple[str, object]] = []
+        self._undo: list = []
+
+    def __enter__(self) -> "FsyncAudit":
+        import os as _os
+        import stat as _stat
+
+        audit = self
+        orig_fsync, orig_replace = _os.fsync, _os.replace
+
+        def fsync(fd):
+            try:
+                is_dir = _stat.S_ISDIR(_os.fstat(fd).st_mode)
+            except OSError:
+                is_dir = False
+            audit.events.append(("fsync_dir" if is_dir else "fsync", fd))
+            return orig_fsync(fd)
+
+        def replace(src, dst, **kw):
+            audit.events.append(("replace", str(dst)))
+            return orig_replace(src, dst, **kw)
+
+        _os.fsync, _os.replace = fsync, replace
+        self._undo = [
+            lambda: setattr(_os, "fsync", orig_fsync),
+            lambda: setattr(_os, "replace", orig_replace),
+        ]
+        return self
+
+    def __exit__(self, *exc) -> None:
+        while self._undo:
+            self._undo.pop()()
+
+    def verify(self) -> None:
+        kinds = [k for k, _ in self.events]
+        for i, kind in enumerate(kinds):
+            if kind != "replace":
+                continue
+            if "fsync" not in kinds[:i]:
+                raise FsyncAuditError(
+                    f"{self.what}: os.replace({self.events[i][1]!r}) "
+                    "with no prior file fsync — the rename can commit "
+                    "before the data"
+                )
+            if "fsync_dir" not in kinds[i + 1:]:
+                raise FsyncAuditError(
+                    f"{self.what}: os.replace({self.events[i][1]!r}) "
+                    "with no later directory fsync — the rename itself "
+                    "is not durable"
+                )

@@ -146,6 +146,20 @@ SCHEMA: list[Option] = [
            "state raises RankDivergenceError on every rank instead of "
            "deadlocking inside the collective.  One tiny collective "
            "per launch — debug/CI only"),
+    Option("debug_bucket_checks", OPT_BOOL, False, LEVEL_ADVANCED,
+           "assert power-of-two bucketing (assert_bucketed) on the "
+           "padded seam sizes entering jitted programs — cluster-state "
+           "incremental pads, fleet tape stacking, writepath batch "
+           "caps: an unbucketed data-dependent count raises "
+           "UnbucketedShapeError at the seam instead of silently "
+           "recompiling per batch (the runtime twin of jaxlint J013).  "
+           "Host-side integer checks only — debug/CI only"),
+    Option("debug_fsync_audit", OPT_BOOL, False, LEVEL_ADVANCED,
+           "audit the durable-write commit chain (FsyncAudit) around "
+           "checkpoint saves: every os.replace must see a prior file "
+           "fsync and a later directory fsync or FsyncAuditError is "
+           "raised (the runtime twin of jaxlint J016).  Patches "
+           "os.fsync/os.replace for the save scope — debug/CI only"),
     Option("osd_op_complaint_time", OPT_FLOAT, 30.0, LEVEL_ADVANCED,
            "an op in flight (or completed) at least this old (seconds) "
            "is a slow op: counted, kept in the slow-op history, and "

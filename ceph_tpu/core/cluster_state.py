@@ -477,6 +477,12 @@ def fleet_incremental_arrays(incs, n_osds: int):
         _pad_to(max(len(i.new_weight) for i in incs)),
         _pad_to(max(len(i.new_primary_affinity) for i in incs)),
     )
+    from ..analysis import runtime_guard
+
+    if runtime_guard.bucket_checks_enabled():
+        runtime_guard.assert_bucketed(
+            "cluster_state.fleet_incremental_arrays pads", *pads
+        )
     per = [incremental_arrays(i, n_osds, pads=pads) for i in incs]
     arrays = tuple(jnp.stack(col) for col in zip(*per))
     epochs = jnp.asarray([int(i.epoch) for i in incs], I32)

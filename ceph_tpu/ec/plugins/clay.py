@@ -420,7 +420,11 @@ class ErasureCodeClay(ErasureCode):
             # aloof partner's U comes from a strictly lower class — the
             # per-class pull is that sequential dependency, not a stray
             # sync
-            U[np.ix_(known, P_pos)] = np.asarray(fn(Cp_dev, jnp.asarray(U)))  # jaxlint: disable=J003
+            # the plane count is a pure function of the (lost, aloof)
+            # cache key, and the kernels are cached per key, so every
+            # cached program sees one fixed shape — no unbounded
+            # recompile despite the data-dependent count
+            U[np.ix_(known, P_pos)] = np.asarray(fn(Cp_dev, jnp.asarray(U)))  # jaxlint: disable=J003,J013
             # batched MDS solve for the class's plane stripe
             avail = {
                 self._base_id(node): U[node][P_pos].reshape(-1)
@@ -433,8 +437,9 @@ class ErasureCodeClay(ErasureCode):
                     len(P_pos), sub
                 )
 
-        # reconstruct the lost chunk over the full plane space (device)
-        out = np.asarray(rebuild_fn(Cp_dev, jnp.asarray(U)))
+        # reconstruct the lost chunk over the full plane space (device);
+        # same per-(lost, aloof)-key shape stability as the class loop
+        out = np.asarray(rebuild_fn(Cp_dev, jnp.asarray(U)))  # jaxlint: disable=J013
         return np.ascontiguousarray(out.reshape(-1))
 
     def _repair_kernels(self, lost: int, aloof_key: frozenset):

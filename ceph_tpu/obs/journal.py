@@ -35,6 +35,15 @@ from typing import Callable
 from ..common.tracing import trace_annotation
 
 
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so renames within it survive a crash."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 class EventJournal:
     """Append-only span/event log.
 
@@ -156,7 +165,14 @@ class EventJournal:
             os.replace(self.path, self.path + ".1")
         else:
             os.remove(self.path)
-        self._fh = open(self.path, "a")
+        # the shift is only durable once the directory entries are:
+        # without this a crash can resurrect pre-rotation names and
+        # double-count segments against the disk cap on resume
+        _fsync_dir(os.path.dirname(self.path) or ".")
+        # fresh live file: the previous one (and any torn tail it
+        # carried) was renamed away above, so there is nothing to
+        # repair before appending
+        self._fh = open(self.path, "a")  # jaxlint: disable=J016
         self._size = 0
 
     def _record(self, kind: str, name: str, **attrs) -> dict:

@@ -342,7 +342,13 @@ class CheckpointStore:
             )
             if self.journal is not None else nullcontext()
         )
-        with span:
+        from ..analysis import runtime_guard
+
+        audit = (
+            runtime_guard.FsyncAudit(f"checkpoint save seq={seq}")
+            if runtime_guard.fsync_audit_enabled() else None
+        )
+        with span, (audit if audit is not None else nullcontext()):
             with open(tmp, "wb") as fh:
                 fh.write(
                     (json.dumps(header, sort_keys=True) + "\n").encode()
@@ -372,6 +378,10 @@ class CheckpointStore:
                 ) + "\n")
                 fh.flush()
                 os.fsync(fh.fileno())
+        if audit is not None:
+            # self-audit the commit chain just performed: fsync before
+            # the replace, dir fsync after (the runtime twin of J016)
+            audit.verify()
         self.bytes_written += total
         if self.health is not None:
             self.health.note_checkpoint()

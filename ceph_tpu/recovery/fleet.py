@@ -161,6 +161,12 @@ def stack_tapes(tapes: list[EventTape]) -> FleetTape:
         raise ValueError("stack_tapes needs at least one tape")
     f_pad = _pad_to(len(tapes))
     r_pad = _pad_to(max(max(len(tp) for tp in tapes), 1))
+    from ..analysis import runtime_guard
+
+    if runtime_guard.bucket_checks_enabled():
+        runtime_guard.assert_bucketed(
+            "fleet.stack_tapes fleet/row pads", f_pad, r_pad
+        )
     cols = [_pad_tape_arrays(tp, r_pad) for tp in tapes]
     empty = _pad_tape_arrays(
         EventTape(

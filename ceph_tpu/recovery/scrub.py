@@ -568,7 +568,7 @@ class DecodeVerifier:
         trusts cached parity as a decode source."""
         from ..ec.online import dense_parity_words
 
-        keys, data, parity = jax.device_get(  # jaxlint: disable=J003
+        keys, data, parity = jax.device_get(
             (buf.keys, buf.data, buf.parity)
         )
         bad: set[int] = set()
@@ -627,7 +627,7 @@ def _scrubber_note_stripe_writes(self, buf) -> np.ndarray:
     against the bytes the writes actually committed — the
     bluestore-CRC discipline of :meth:`Scrubber.note_write` extended
     to cached stripes."""
-    keys, parity = jax.device_get(  # jaxlint: disable=J003
+    keys, parity = jax.device_get(
         (buf.keys, buf.parity)
     )
     self.stripe_checksums = _stripe_parity_crcs(keys, parity)
@@ -643,7 +643,7 @@ def _scrubber_scrub_stripe_buffer(self, buf, bitmatrix) -> StripeScrubResult:
     caught here, never silently committed."""
     from ..ec.online import dense_parity_words
 
-    keys, data, parity = jax.device_get(  # jaxlint: disable=J003
+    keys, data, parity = jax.device_get(
         (buf.keys, buf.data, buf.parity)
     )
     bm = np.asarray(bitmatrix)

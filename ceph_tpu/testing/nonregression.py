@@ -154,7 +154,13 @@ def compile_once_cases() -> dict[str, dict]:
     per-scenario compile counts observed during warm-up, for the
     report.
     """
-    from ..analysis.runtime_guard import CompileCounter, assert_no_recompile
+    from ..analysis.runtime_guard import (
+        CompileBudget,
+        CompileCounter,
+        assert_bucketed,
+        assert_no_recompile,
+    )
+    from ..common.config import global_config
     from ..models.clusters import build_osdmap
     from ..osdmap.mapping import OSDMapMapping
 
@@ -372,7 +378,7 @@ def compile_once_cases() -> dict[str, dict]:
     }
 
     # ---- fleet superstep: vmapped scan -> same pad bucket ---------------
-    from ..recovery.fleet import FleetDriver
+    from ..recovery.fleet import FleetDriver, _pad_to
 
     fdrv = FleetDriver(m_e, seed=3, n_ops=64)
     tls_a = fdrv.sample(3, "ssd-burst")
@@ -380,11 +386,24 @@ def compile_once_cases() -> dict[str, dict]:
         fdrv.run_fleet(8, tls_a, pull=False)
     # a fleet of 4 lands in the same power-of-two pad bucket as 3: the
     # one vmapped scan executable is reused, and with pull=False the
-    # whole fleet window moves zero bytes to host
+    # whole fleet window moves zero bytes to host.  The J013 runtime
+    # twins audit the claim from both ends: the scenario asserts the
+    # two fleets share a bucket, debug_bucket_checks makes the
+    # stack_tapes seam re-check every pad it feeds the vmapped scan,
+    # and CompileBudget(0) holds the warm rerun to zero XLA compiles.
+    assert _pad_to(3) == _pad_to(4), (_pad_to(3), _pad_to(4))
+    assert_bucketed("fleet superstep pad bucket", _pad_to(3), _pad_to(4))
     tls_b = fdrv.sample(4, "ssd-burst")
-    with assert_no_recompile("fleet superstep same pad bucket"):
-        with track() as g_f:
-            fdrv.run_fleet(8, tls_b, pull=False)
+    cfg = global_config()
+    prev_bucket = cfg.get("debug_bucket_checks")
+    cfg.set("debug_bucket_checks", True)
+    try:
+        with CompileBudget(0, "fleet superstep same pad bucket"), \
+                assert_no_recompile("fleet superstep same pad bucket"):
+            with track() as g_f:
+                fdrv.run_fleet(8, tls_b, pull=False)
+    finally:
+        cfg.set("debug_bucket_checks", prev_bucket)
     assert g_f.host_transfers == 0, g_f.host_transfers
     report["fleet_superstep"] = {
         "warm_compiles": warm_f.n_compiles, "second_compiles": 0,
@@ -404,10 +423,21 @@ def compile_once_cases() -> dict[str, dict]:
     # (7 <= 8 slots) is a VALUE of the traced cap, never a shape: the
     # one fused scan — epoch pieces, stripe lookups, LRU maintenance,
     # vmapped parity-delta encode — is reused with zero in-scan host
-    # transfers
-    with assert_no_recompile("online write batch same bucket"):
-        with track() as g_w:
-            wdrv.run_superstep(8, cap=7, pull=False)
+    # transfers.  Same twin pairing as the fleet case: the batch
+    # buffer's bucket is asserted power-of-two, the writepath's own
+    # J013 seam re-checks under debug_bucket_checks, and
+    # CompileBudget(0) enforces the zero-compile warm rerun.
+    assert_bucketed("online write batch bucket", wdrv.batch_size)
+    assert 7 <= wdrv.batch_size, wdrv.batch_size
+    prev_bucket = cfg.get("debug_bucket_checks")
+    cfg.set("debug_bucket_checks", True)
+    try:
+        with CompileBudget(0, "online write batch same bucket"), \
+                assert_no_recompile("online write batch same bucket"):
+            with track() as g_w:
+                wdrv.run_superstep(8, cap=7, pull=False)
+    finally:
+        cfg.set("debug_bucket_checks", prev_bucket)
     assert g_w.host_transfers == 0, g_w.host_transfers
     report["online_write_batch"] = {
         "warm_compiles": warm_w.n_compiles, "second_compiles": 0,

@@ -180,6 +180,12 @@ class WritepathDriver:
             max_writes if max_writes is not None else driver.n_ops
         )
         self.batch_size = _pow2_bucket(self.max_writes)
+        from ..analysis import runtime_guard
+
+        if runtime_guard.bucket_checks_enabled():
+            runtime_guard.assert_bucketed(
+                "writepath batch bucket", self.batch_size
+            )
         self._init_buf = empty_stripe_buffer(
             self.n_sets, self.ways, self.k * self.w, self.m * self.w,
             self.words,

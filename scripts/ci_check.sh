@@ -7,6 +7,14 @@
 # PRs; it is also enforced as a test (tests/test_lint_clean.py), so a
 # lint failure here is the same failure the suite would report —
 # surfaced earlier and annotated.
+#
+# On top of the all-rules pass, the v3 rule families (J013–J018:
+# shape bucketing, carry contracts, leaf promotion, durable-IO crash
+# consistency, pytree carriers, donation reuse) get an explicit
+# zero-active gate of their own — a --select run per family, so a CI
+# log names exactly which family regressed — plus a time-boxed
+# analyzer fuzz soak (budget via CEPH_TPU_FUZZ_SECONDS, default 30s
+# here; 0 skips the soak).
 set -u -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -15,6 +23,31 @@ rc=0
 
 echo "== jaxlint (ceph_tpu/, GitHub annotations) =="
 python -m ceph_tpu.cli.lint ceph_tpu/ --format github || rc=$?
+
+echo "== jaxlint v3 per-rule zero-active gate (J013-J018) =="
+for rule in J013 J014 J015 J016 J017 J018; do
+    if python -m ceph_tpu.cli.lint ceph_tpu/ --select "$rule" \
+        --format json | python -c '
+import json, sys
+doc = json.load(sys.stdin)
+sys.exit(1 if doc.get("n_active", 0) else 0)
+'; then
+        echo "   $rule: clean"
+    else
+        echo "::error title=jaxlint $rule::active $rule finding(s) in tree"
+        rc=1
+    fi
+done
+
+FUZZ_SECONDS="${CEPH_TPU_FUZZ_SECONDS:-30}"
+if [ "$FUZZ_SECONDS" != "0" ]; then
+    echo "== jaxlint fuzz soak (${FUZZ_SECONDS}s) =="
+    env -u PYTHONPATH PYTHONPATH="$PWD" JAX_PLATFORMS=cpu \
+        CEPH_TPU_FUZZ_SECONDS="$FUZZ_SECONDS" \
+        python tests/fuzz_lint.py || rc=$?
+else
+    echo "== jaxlint fuzz soak skipped (CEPH_TPU_FUZZ_SECONDS=0) =="
+fi
 
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log

@@ -113,12 +113,130 @@ def drain(pending, clock):
         out.append(pg + random.random())
     return out, time.perf_counter() - t0
 """,
+    # J013: dynamic counts / gathers feeding jitted shapes
+    """
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    return x * 2
+
+def _pad_to(n):
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+def drive(mask, vals, items):
+    idx = np.nonzero(mask)[0]
+    buf = np.zeros((len(items), 4), np.float32)
+    n = _pad_to(len(items))
+    return step(jnp.asarray(vals[idx])), step(jnp.asarray(buf)), n
+""",
+    # J014: scan carry drift (raw init, arity drift, literal reseed)
+    """
+import jax
+from jax import lax
+import jax.numpy as jnp
+
+def run(xs, c0, n0):
+    def body(carry, x):
+        c, n = carry
+        return (c + x, 0), x
+    def wide(carry, x):
+        c, n = carry
+        return (c, n, x), x
+    a = lax.scan(body, 0.0, xs)
+    b = lax.scan(body, (c0, n0), xs)
+    c = lax.scan(wide, (c0, n0), xs)
+    return a, b, c
+""",
+    # J015: leaf promotion on tree_leaves/tree_flatten sequences
+    """
+import jax
+import numpy as np
+
+def save(state, tree):
+    leaves = jax.tree_util.tree_leaves(state)
+    lanes = [np.ascontiguousarray(a) for a in leaves]
+    flat, treedef = jax.tree_util.tree_flatten(tree)
+    out = []
+    for leaf in flat:
+        out.append(leaf.reshape(-1))
+    return lanes, out, [np.asarray(a) for a in leaves]
+""",
+    # J016: durable-IO commit chains (good and broken variants)
+    """
+import json
+import os
+
+def _fsync_dir(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+def commit(tmp, final, data):
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+    os.replace(tmp, final)
+
+def append_manifest(path, entry):
+    with open(path, "a") as fh:
+        fh.write(json.dumps(entry) + chr(10))
+""",
+    # J017: frozen dataclasses as carries, registered and not
+    """
+import jax
+from jax import lax
+from dataclasses import dataclass
+from jax.tree_util import register_pytree_node_class
+
+@dataclass(frozen=True)
+class Carry:
+    a: int
+
+@register_pytree_node_class
+@dataclass(frozen=True)
+class Good:
+    b: int
+
+def run(xs):
+    def body(c, x):
+        return c, x
+    p = Carry(1)
+    jax.tree_util.tree_flatten(p)
+    return lax.scan(body, Carry(0), xs)
+""",
+    # J018: donated-buffer reuse after jit(donate_argnums=...)
+    """
+import functools
+import jax
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def update(buf, x):
+    return buf + x
+
+def drive(buf, x, y):
+    out = update(buf, x)
+    buf += y
+    buf = update(buf, x)
+    return out + buf.sum()
+""",
 ]
 
 IDENTS = ["x", "jnp", "jax", "fn", "fori_loop", "self", "np", "item",
           "config", "update", "lax", "partial", "kern", "x_ref",
           "psum", "shard_map", "mesh", "placed", "process_index",
-          "set", "time", "random", "default_rng", "device_put"]
+          "set", "time", "random", "default_rng", "device_put",
+          "nonzero", "len", "_pad_to", "scan", "carry", "tree_leaves",
+          "tree_flatten", "ascontiguousarray", "reshape", "open",
+          "os", "replace", "fsync", "_fsync_dir", "dataclass",
+          "Carry", "register_pytree_node_class", "donate_argnums",
+          "buf", "step", "leaves"]
 OPS = [("==", "!="), (">", "<"), ("+", "-"), ("*", "/"), ("(", ""),
        (")", ""), (":", ""), (",", " ")]
 
@@ -169,7 +287,8 @@ def main() -> int:
         try:
             res = lint_source(src, path=f"<mutant-{n}>",
                               hot=bool(rng.getrandbits(1)),
-                              vclock=bool(rng.getrandbits(1)))
+                              vclock=bool(rng.getrandbits(1)),
+                              durable=bool(rng.getrandbits(1)))
         except Exception as e:  # noqa: BLE001 — any escape is the bug
             print(f"FUZZ FAILURE at mutant {n}: {type(e).__name__}: {e}\n"
                   f"--- source ---\n{src}\n--------------")

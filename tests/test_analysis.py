@@ -691,7 +691,7 @@ def test_syntax_error_is_reported_not_raised():
 
 
 def test_rules_registry_complete():
-    assert set(RULES) == {f"J{i:03d}" for i in range(1, 13)}
+    assert set(RULES) == {f"J{i:03d}" for i in range(1, 19)}
     for rid, (name, why) in RULES.items():
         assert name and why, rid
 
@@ -817,3 +817,686 @@ def test_transfer_counter_counts_scalar_coercions():
     base = tc.host_transfers
     float(x)
     assert tc.host_transfers == base
+
+
+# ---------------------------------------------------------------- J013
+
+
+def test_j013_flags_nonzero_gather_into_jitted_call():
+    """The dirty-lane compaction hazard: a gather sized by nonzero()
+    reaching a jitted function recompiles per distinct dirty count."""
+    bad = """
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        return x * 2
+
+    def drive(mask, vals):
+        idx = np.nonzero(mask)[0]
+        return step(jnp.asarray(vals[idx]))
+    """
+    assert "J013" in rules_of(bad)
+
+
+def test_j013_flags_len_sized_buffer():
+    bad = """
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        return x + 1
+
+    def drive(items):
+        buf = np.zeros((len(items), 4), np.float32)
+        return step(jnp.asarray(buf))
+    """
+    assert "J013" in rules_of(bad)
+
+
+def test_j013_clean_when_bucketed():
+    """Routing the count through a pow2 helper kills the taint — the
+    _pad_to discipline cluster_state/fleet/writepath already follow."""
+    good = """
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+
+    def _pad_to(n):
+        p = 1
+        while p < n:
+            p <<= 1
+        return p
+
+    @jax.jit
+    def step(x):
+        return x + 1
+
+    def drive(items):
+        n = _pad_to(len(items))
+        buf = np.zeros((n, 4), np.float32)
+        return step(jnp.asarray(buf))
+    """
+    assert rules_of(good) == []
+
+
+def test_j013_clean_when_count_stays_a_value():
+    """A dynamic count used as a *value* (not a shape) never
+    recompiles; only shape positions are flagged."""
+    good = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def step(x):
+        return x * 2
+
+    def drive(mask, x):
+        n = int(np.count_nonzero(mask))
+        return step(x), n
+    """
+    assert rules_of(good) == []
+
+
+# ---------------------------------------------------------------- J014
+
+
+def test_j014_flags_raw_scalar_scan_init():
+    bad = """
+    import jax
+    from jax import lax
+
+    def run(xs):
+        def body(c, x):
+            return c + x, c
+        return lax.scan(body, 0.0, xs)
+    """
+    assert "J014" in rules_of(bad)
+
+
+def test_j014_flags_carry_structure_drift():
+    """Body returns a 3-leaf carry for a 2-leaf init: fails the carry
+    aval check the moment this scan traces."""
+    bad = """
+    import jax
+    from jax import lax
+
+    def run(xs, c0, acc0):
+        def body(carry, x):
+            c, acc = carry
+            return (c + x, acc + x, x), x
+        return lax.scan(body, (c0, acc0), xs)
+    """
+    assert "J014" in rules_of(bad)
+
+
+def test_j014_flags_body_literal_reseed():
+    """A body re-seeding a carry leaf with a Python literal drifts
+    weak-type against the non-literal init leaf every step."""
+    bad = """
+    import jax
+    from jax import lax
+
+    def run(xs, c0, n0):
+        def body(carry, x):
+            c, n = carry
+            return (c + x, 0), x
+        return lax.scan(body, (c0, n0), xs)
+    """
+    assert "J014" in rules_of(bad)
+
+
+def test_j014_clean_on_pinned_init_and_matched_body():
+    good = """
+    import jax
+    from jax import lax
+    import jax.numpy as jnp
+
+    def run(xs):
+        def body(carry, x):
+            c, n = carry
+            return (c + x, n + 1), x
+        return lax.scan(body, (jnp.float32(0.0), jnp.int32(0)), xs)
+    """
+    assert rules_of(good) == []
+
+
+def test_j014_clean_on_name_init():
+    """Name inits (the fstate/state idiom every in-tree scan uses)
+    are never compared — the rule only reads literal tuples."""
+    good = """
+    import jax
+    from jax import lax
+
+    def run(fstate, xs):
+        def body(carry, x):
+            return carry, x
+        return lax.scan(body, fstate, xs)
+    """
+    assert rules_of(good) == []
+
+
+# ---------------------------------------------------------------- J015
+
+
+def test_j015_flags_pr15_ascontiguousarray_on_leaves():
+    """The literal PR-15 restore bug: ascontiguousarray on checkpoint
+    leaves promoted 0-d leaves (epoch, now, tape_cursor) to (1,), so
+    every restore failed the template shape check."""
+    bad = """
+    import jax
+    import numpy as np
+
+    def save(state):
+        leaves = jax.tree_util.tree_leaves(state)
+        return [np.ascontiguousarray(a) for a in leaves]
+    """
+    assert "J015" in rules_of(bad)
+
+
+def test_j015_flags_reshape_on_flattened_leaves():
+    bad = """
+    import jax
+
+    def pack(tree):
+        flat, treedef = jax.tree_util.tree_flatten(tree)
+        out = []
+        for leaf in flat:
+            out.append(leaf.reshape(-1))
+        return out, treedef
+    """
+    assert "J015" in rules_of(bad)
+
+
+def test_j015_clean_on_asarray():
+    """np.asarray preserves 0-d — the fix checkpoint.py documents."""
+    good = """
+    import jax
+    import numpy as np
+
+    def save(state):
+        leaves = jax.device_get(jax.tree_util.tree_flatten(state)[0])
+        return [np.asarray(a) for a in leaves]
+    """
+    assert rules_of(good) == []
+
+
+def test_j015_clean_on_non_leaf_operands():
+    """Promoting a plain buffer (not a pytree leaf) is fine — the
+    rank_fingerprint idiom."""
+    good = """
+    import numpy as np
+
+    def digest(a):
+        a = np.ascontiguousarray(np.asarray(a))
+        return a.tobytes()
+    """
+    assert rules_of(good) == []
+
+
+# ---------------------------------------------------------------- J016
+
+
+def test_j016_flags_pr15_manifest_append_without_repair():
+    """The PR-15 torn-tail glue bug: appending a manifest entry after
+    a crash-torn final line corrupts both records."""
+    bad = """
+    import json
+
+    def append_manifest(path, entry):
+        with open(path, "a") as fh:
+            fh.write(json.dumps(entry) + "\\n")
+    """
+    assert "J016" in rules_of(bad)
+
+
+def test_j016_flags_replace_without_fsync_or_dir_fsync():
+    bad = """
+    import os
+
+    def commit(tmp, final, data):
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+        os.replace(tmp, final)
+    """
+    rules = rules_of(bad)
+    assert rules.count("J016") == 2  # no file fsync AND no dir fsync
+
+
+def test_j016_clean_on_full_commit_chain():
+    """The checkpoint.py save() discipline: write -> flush -> fsync ->
+    replace -> dir fsync."""
+    good = """
+    import os
+
+    def _fsync_dir(path):
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def commit(tmp, final, data):
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, final)
+        _fsync_dir(os.path.dirname(final))
+    """
+    assert rules_of(good) == []
+
+
+def test_j016_clean_on_repaired_append_and_truncating_reset():
+    good = """
+    def _repair_torn_tail(path):
+        with open(path, "rb") as fh:
+            data = fh.read()
+        if data and not data.endswith(b"\\n"):
+            with open(path, "rb+") as fh:
+                fh.truncate(data.rfind(b"\\n") + 1)
+
+    def append(path, line):
+        _repair_torn_tail(path)
+        with open(path, "a") as fh:
+            fh.write(line)
+
+    def reset(path):
+        with open(path, "w"):
+            pass
+        return open(path, "a")
+    """
+    assert rules_of(good) == []
+
+
+def test_j016_only_fires_in_durable_modules():
+    bad = """
+    def append(path, line):
+        with open(path, "a") as fh:
+            fh.write(line)
+    """
+    assert "J016" in rules_of(bad, durable=True)
+    assert rules_of(bad, durable=False) == []
+
+
+def test_durable_module_classification():
+    from ceph_tpu.analysis import is_durable
+
+    assert is_durable("ceph_tpu/recovery/checkpoint.py")
+    assert is_durable("ceph_tpu/obs/journal.py")
+    assert not is_durable("ceph_tpu/crush/straw2.py")
+    assert not is_durable("ceph_tpu/recovery/fleet.py")
+
+
+# ---------------------------------------------------------------- J017
+
+
+def test_j017_flags_frozen_dataclass_scan_carry():
+    bad = """
+    import jax
+    from jax import lax
+    from dataclasses import dataclass
+
+    @dataclass(frozen=True)
+    class Carry:
+        a: int
+        b: int
+
+    def run(xs):
+        def body(c, x):
+            return c, x
+        return lax.scan(body, Carry(0, 1), xs)
+    """
+    assert "J017" in rules_of(bad)
+
+
+def test_j017_flags_tainted_name_flattened_as_pytree():
+    bad = """
+    import jax
+    from dataclasses import dataclass
+
+    @dataclass(frozen=True)
+    class Payload:
+        a: int
+
+    def save(x):
+        p = Payload(x)
+        return jax.tree_util.tree_flatten(p)
+    """
+    assert "J017" in rules_of(bad)
+
+
+def test_j017_clean_when_registered_by_decorator():
+    good = """
+    import jax
+    from jax import lax
+    from dataclasses import dataclass
+    from jax.tree_util import register_pytree_node_class
+
+    @register_pytree_node_class
+    @dataclass(frozen=True)
+    class Carry:
+        a: int
+
+        def tree_flatten(self):
+            return (self.a,), None
+
+        @classmethod
+        def tree_unflatten(cls, aux, children):
+            return cls(*children)
+
+    def run(xs):
+        def body(c, x):
+            return c, x
+        return lax.scan(body, Carry(0), xs)
+    """
+    assert rules_of(good) == []
+
+
+def test_j017_clean_when_registered_by_call():
+    """The StripeBufferState pattern: register_dataclass called on the
+    class after its definition."""
+    good = """
+    import jax
+    from jax import lax
+    from dataclasses import dataclass
+
+    @dataclass(frozen=True)
+    class Carry:
+        a: int
+
+    jax.tree_util.register_dataclass(
+        Carry, data_fields=["a"], meta_fields=[]
+    )
+
+    def run(xs):
+        def body(c, x):
+            return c, x
+        return lax.scan(body, Carry(0), xs)
+    """
+    assert rules_of(good) == []
+
+
+# ---------------------------------------------------------------- J018
+
+
+def test_j018_flags_read_after_donation():
+    bad = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def update(buf, x):
+        return buf + x
+
+    def drive(buf, x):
+        out = update(buf, x)
+        return out + buf.sum()
+    """
+    assert "J018" in rules_of(bad)
+
+
+def test_j018_flags_augassign_on_donated():
+    bad = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def update(buf, x):
+        return buf + x
+
+    def drive(buf, x, y):
+        out = update(buf, x)
+        buf += y
+        return out
+    """
+    assert "J018" in rules_of(bad)
+
+
+def test_j018_clean_on_rebind():
+    """buf = update(buf, x): the donating call's own arg read is not a
+    reuse, and the rebind clears the taint."""
+    good = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def update(buf, x):
+        return buf + x
+
+    def drive(buf, x):
+        buf = update(buf, x)
+        buf = update(buf, x)
+        return buf.sum()
+    """
+    assert rules_of(good) == []
+
+
+def test_j018_clean_without_donation():
+    good = """
+    import jax
+
+    @jax.jit
+    def step(buf, x):
+        return buf + x
+
+    def drive(buf, x):
+        out = step(buf, x)
+        return out + buf.sum()
+    """
+    assert rules_of(good) == []
+
+
+def test_j018_donate_argnames_keyword_form():
+    bad = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, donate_argnames=("buf",))
+    def update(x, buf=None):
+        return buf + x
+
+    def drive(buf, x):
+        out = update(x, buf=buf)
+        return out + buf.sum()
+    """
+    assert "J018" in rules_of(bad)
+
+
+# ------------------------------------------------- CLI baseline mode
+
+
+def _write(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def test_cli_baseline_roundtrip_and_new_finding(tmp_path, capsys):
+    from ceph_tpu.cli.lint import (
+        EXIT_CLEAN,
+        EXIT_NEW_FINDINGS,
+        main,
+    )
+
+    mod = _write(tmp_path, "mod.py", """
+        import numpy as np
+
+        def f():
+            return np.random.default_rng()
+    """)
+    base = str(tmp_path / "baseline.json")
+    assert main(["--write-baseline", base, mod]) == EXIT_CLEAN
+    capsys.readouterr()
+
+    # unchanged tree: adopted debt passes
+    assert main(["--baseline", base, mod]) == EXIT_CLEAN
+    capsys.readouterr()
+
+    # one NEW instance of the same rule in the same file: blocked
+    _write(tmp_path, "mod.py", """
+        import numpy as np
+
+        def f():
+            return np.random.default_rng()
+
+        def g():
+            return np.random.default_rng()
+    """)
+    assert main(["--baseline", base, mod]) == EXIT_NEW_FINDINGS
+    out = capsys.readouterr().out
+    assert "1 new finding(s)" in out
+
+
+def test_cli_baseline_dead_suppression_exit_code(tmp_path, capsys):
+    from ceph_tpu.cli.lint import EXIT_DEAD_SUPPRESSIONS, main
+
+    mod = _write(tmp_path, "mod.py", """
+        def f():
+            return 1  # jaxlint: disable=J011
+    """)
+    base = str(tmp_path / "baseline.json")
+    assert main(["--write-baseline", base, mod]) == 0
+    capsys.readouterr()
+    assert main(["--baseline", base, mod]) == EXIT_DEAD_SUPPRESSIONS
+    assert "dead suppression" in capsys.readouterr().out
+
+
+def test_cli_baseline_retired_entries_reported(tmp_path, capsys):
+    from ceph_tpu.cli.lint import EXIT_CLEAN, main
+
+    mod = _write(tmp_path, "mod.py", """
+        import numpy as np
+
+        def f():
+            return np.random.default_rng()
+    """)
+    base = str(tmp_path / "baseline.json")
+    assert main(["--write-baseline", base, mod]) == EXIT_CLEAN
+    capsys.readouterr()
+    _write(tmp_path, "mod.py", """
+        import numpy as np
+
+        def f():
+            return np.random.default_rng(0)
+    """)
+    assert main(["--baseline", base, mod]) == EXIT_CLEAN
+    assert "retired" in capsys.readouterr().out
+
+
+def test_cli_baseline_mutually_exclusive_flags(tmp_path):
+    from ceph_tpu.cli.lint import EXIT_USAGE, main
+
+    assert main(
+        ["--baseline", "a.json", "--write-baseline", "b.json",
+         str(tmp_path)]
+    ) == EXIT_USAGE
+
+
+# --------------------------------- runtime twins: J013 / J016 dynamic
+
+
+def test_assert_bucketed_accepts_pow2_and_arrays():
+    import numpy as np
+
+    from ceph_tpu.analysis import assert_bucketed, is_pow2
+
+    assert is_pow2(1) and is_pow2(64) and not is_pow2(0)
+    assert not is_pow2(6)
+    assert_bucketed("seam", 1, 2, 8, np.zeros((16, 3)))
+
+
+def test_assert_bucketed_raises_on_unbucketed():
+    from ceph_tpu.analysis import UnbucketedShapeError, assert_bucketed
+
+    with pytest.raises(UnbucketedShapeError, match="seam size 6"):
+        assert_bucketed("dirty lanes", 8, 6)
+
+
+def test_compile_budget_enforced_and_satisfied():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from ceph_tpu.analysis import CompileBudget
+
+    f = jax.jit(lambda x: x * 2 + 1)
+    x = jnp.arange(8)
+    with CompileBudget(4, "cold trace"):
+        f(x)  # compiles once, inside budget
+    with pytest.raises(AssertionError, match="compile budget 0"):
+        with CompileBudget(0, "warm path"):
+            jax.jit(lambda x: x - 3)(x)  # fresh program: over budget
+    with CompileBudget(0, "warm path"):
+        f(x)  # cached: zero compiles
+
+
+def test_fsync_audit_passes_on_commit_chain(tmp_path):
+    import os
+
+    from ceph_tpu.analysis import FsyncAudit
+
+    tmp = tmp_path / "data.tmp"
+    final = tmp_path / "data.bin"
+    with FsyncAudit("commit") as audit:
+        with open(tmp, "wb") as fh:
+            fh.write(b"payload")
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, final)
+        fd = os.open(tmp_path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    audit.verify()
+    assert [k for k, _ in audit.events] == [
+        "fsync", "replace", "fsync_dir"
+    ]
+
+
+def test_fsync_audit_catches_missing_fsyncs(tmp_path):
+    import os
+
+    from ceph_tpu.analysis import FsyncAudit, FsyncAuditError
+
+    tmp = tmp_path / "a.tmp"
+    final = tmp_path / "a.bin"
+    tmp.write_bytes(b"x")
+    with FsyncAudit("bad commit") as audit:
+        os.replace(tmp, final)
+    with pytest.raises(FsyncAuditError, match="no prior file fsync"):
+        audit.verify()
+
+    tmp.write_bytes(b"x")
+    with FsyncAudit("half commit") as audit:
+        with open(tmp, "r+b") as fh:
+            os.fsync(fh.fileno())
+        os.replace(tmp, final)
+    with pytest.raises(FsyncAuditError, match="no later directory"):
+        audit.verify()
+
+
+def test_checkpoint_save_passes_fsync_audit(tmp_path):
+    """The knob-gated self-audit: CheckpointStore.save under
+    debug_fsync_audit verifies its own commit chain."""
+    pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from ceph_tpu.common.config import global_config
+    from ceph_tpu.recovery.checkpoint import CheckpointStore
+
+    cfg = global_config()
+    cfg.set("debug_fsync_audit", True)
+    try:
+        store = CheckpointStore(str(tmp_path / "ckpt"))
+        path = store.save(
+            {"a": jnp.arange(4), "epoch": jnp.asarray(7)},
+            meta={"cursor": 1},
+        )
+        assert path.endswith(".bin")
+    finally:
+        cfg.set("debug_fsync_audit", False)

@@ -1,15 +1,19 @@
-"""Tier-1 lint gate: the tree must be jaxlint-clean under all 12 rules.
+"""Tier-1 lint gate: the tree must be jaxlint-clean under all 18 rules.
 
 Runs the analyzer over the whole ``ceph_tpu`` package (the same
 invocation as ``python -m ceph_tpu.cli.lint ceph_tpu/``) and fails on
 any unsuppressed finding — so a new Python-branch-on-tracer, unpinned
 loop dtype, stray host sync, recompile-forcer, raw x64 toggle, tracer
 leak, out-of-scope collective, rank-divergent branch, unordered-set
-ordering, wall-clock-in-vclock call, unseeded rng, or shard_map
-closure capture fails CI before it costs a chip session (J001-J012;
-the cross-rank rules guard the multihost deadlock class the runtime
-sanitizer ``assert_rank_identical`` catches dynamically).  Fast (pure
-AST, no jax import in the analyzed path) and deliberately not
+ordering, wall-clock-in-vclock call, unseeded rng, shard_map closure
+capture, unbucketed dynamic shape, drifting scan carry, 0-d leaf
+promotion, broken durable-IO commit chain, unregistered pytree
+carrier, or donated-buffer reuse fails CI before it costs a chip
+session (J001-J018; the cross-rank rules guard the multihost deadlock
+class the runtime sanitizer ``assert_rank_identical`` catches
+dynamically, and the v3 rules have their own twins:
+``assert_bucketed``/``CompileBudget`` and ``FsyncAudit``).  Fast
+(pure AST, no jax import in the analyzed path) and deliberately not
 ``slow``.
 """
 
@@ -64,6 +68,29 @@ def test_suppressions_all_earn_their_keep():
     real finding — dead suppressions rot into lies."""
     res = lint_paths([PKG])
     assert not res.unused_suppressions, res.unused_suppressions
+
+
+def test_v3_rules_zero_active_per_family():
+    """The PR-17 families (J013-J018) each report zero active findings
+    — the same per-family gate scripts/ci_check.sh runs, kept as a
+    test so a regression names the family in the pytest output too."""
+    res = lint_paths([PKG])
+    by_rule = res.by_rule()
+    for rid in ("J013", "J014", "J015", "J016", "J017", "J018"):
+        assert by_rule[rid]["active"] == 0, (rid, by_rule[rid])
+
+
+def test_tree_baseline_roundtrip_is_clean(tmp_path):
+    """Snapshotting the clean tree and re-linting against the snapshot
+    exits 0: no new findings, no retired entries, no dead
+    suppressions — the fixed point the --baseline CI mode gates on."""
+    from ceph_tpu.cli.lint import diff_baseline, load_baseline, write_baseline
+
+    res = lint_paths([PKG])
+    snap = str(tmp_path / "baseline.json")
+    write_baseline(snap, res)
+    new, retired = diff_baseline(res, load_baseline(snap))
+    assert not new and not retired, (new, retired)
 
 
 def test_cli_module_entry_exits_zero():
