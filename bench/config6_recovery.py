@@ -7,7 +7,16 @@ replicated and the recovered-byte counters psum-reduced.  On a CPU
 host the device count is forced to >= 2 virtual devices (XLA_FLAGS,
 set before jax imports) so the collective path is exercised without
 hardware; the JSON line carries ``n_devices``, the psum'd byte/shard
-counters, and the same compile/transfer guard fields.
+counters, and the same compile/transfer guard fields.  A second
+``--multichip`` leg re-runs the same plan through the work-stealing
+dispatcher (``recovery_work_stealing: on``) with one chip pinned by a
+seeded ``chipstall:`` fault — the straggler scenario the dispatcher
+exists for — and emits its own JSON line
+(``recovery_worksteal_bytes_per_sec``) carrying
+``idle_fraction_per_chip`` (vs the all-1.0 static counterfactual),
+``stolen_subshards``, ``hedged_launches``, ``hedge_wasted_bytes``, and
+``chip_convictions``; the rebuilt bytes are asserted bit-equal to the
+static sharded pass before the line is printed.
 
 
 Simulates scenario #1 from the roadmap: a full rack failure on a
@@ -115,6 +124,50 @@ def build_multichip_record(
     }
 
 
+def build_worksteal_record(
+    platform: str,
+    rate: float,
+    n_devices: int,
+    guard: dict,
+    warm: dict,
+    result,
+    chip_fault: str,
+) -> dict:
+    """The work-stealing ``--multichip`` leg's JSON line (pure:
+    schema-tested without running the bench).  ``result`` is the
+    measured run's RecoveryResult with the dispatcher telemetry folded
+    in; ``chip_fault`` is the injected straggler spec, carried as
+    provenance — the idle/steal/hedge counters only mean something
+    next to the fault they were measured under.
+    """
+    from ceph_tpu.analysis import lint_fields
+
+    return {
+        "metric": "recovery_worksteal_bytes_per_sec",
+        "value": round(rate),
+        "unit": "B/s",
+        "platform": platform,
+        "n_devices": int(n_devices),
+        "n_compiles": int(guard["n_compiles"]),
+        "n_compiles_first": int(warm["n_compiles"]),
+        "host_transfers": int(guard["host_transfers"]),
+        "chip_fault": str(chip_fault),
+        "worksteal_launches": int(result.worksteal_launches),
+        "stolen_subshards": int(result.stolen_subshards),
+        "hedged_launches": int(result.hedged_launches),
+        "hedge_wasted_bytes": int(result.hedge_wasted_bytes),
+        "chip_convictions": int(result.chip_convictions),
+        "idle_fraction_per_chip": [
+            round(float(f), 6) for f in result.idle_fraction_per_chip
+        ],
+        "static_idle_fraction_per_chip": [
+            round(float(f), 6)
+            for f in result.static_idle_fraction_per_chip
+        ],
+        **lint_fields(),
+    }
+
+
 def run_multichip() -> None:
     """Mesh-sharded recovery decode over every device; one JSON line."""
     from ceph_tpu.common.compile_cache import enable_persistent_cache
@@ -187,6 +240,62 @@ def run_multichip() -> None:
     print(json.dumps(build_multichip_record(
         jax.default_backend(), rate, n_devices, guard.snapshot(), warm,
         result,
+    )))
+
+    # --- work-stealing leg: same plan, one chip pinned by a seeded
+    # stall — the straggler scenario the dispatcher exists for.  The
+    # static sharded pass above is the bit-equality reference AND the
+    # idle counterfactual (a stalled chip pins the static path's
+    # per-chip idle fractions at 1.0: every chip waits forever).
+    chip_fault = f"chipstall:{n_devices - 1}.0"
+    ws_cfg = Config()
+    ws_cfg.set("recovery_work_stealing", "on")
+    ws = rec.RecoveryExecutor(
+        codec, config=ws_cfg, mesh=mesh, chip_faults=[chip_fault],
+        dispatch_seed=6,
+    )
+    with track() as ws_guard:
+        # first run carries the robustness telemetry: the stall fires,
+        # the chip is convicted, sub-shards get stolen/hedged.  The
+        # conviction is sticky (the dead chip never rejoins), so the
+        # second run measures the warm steady-state rate on the
+        # surviving chips — compile-once, fault already absorbed.
+        ws_result = ws.run(plan, lambda pg, s: store[pg][s])
+        ws_warm = ws_guard.snapshot()
+        t0 = time.perf_counter()
+        timed = ws.run(plan, lambda pg, s: store[pg][s])
+        ws_decode = time.perf_counter() - t0
+    ws_rate = timed.bytes_recovered / ws_decode
+    assert ws_result.worksteal_launches == plan.n_patterns, (
+        ws_result.worksteal_launches, plan.n_patterns
+    )
+    assert ws_result.sharded_launches == 0, ws_result.sharded_launches
+    # the stalled chip must be convicted, and stealing must keep the
+    # healthy chips busier than the static path's all-idle floor
+    assert ws_result.chip_convictions >= 1, ws_result.chip_convictions
+    assert max(ws_result.idle_fraction_per_chip) < 1.0, (
+        ws_result.idle_fraction_per_chip
+    )
+    assert ws_result.static_idle_fraction_per_chip == [1.0] * n_devices
+
+    # every rebuilt byte bit-equal to the static sharded reference
+    assert set(ws_result.shards) == set(ref.shards)
+    for pg in ws_result.shards:
+        for s, chunk in ws_result.shards[pg].items():
+            assert np.array_equal(chunk, ref.shards[pg][s]), (pg, s)
+
+    print(
+        f"worksteal: {n_devices} devices ({chip_fault}), "
+        f"{ws_result.worksteal_launches} launches, "
+        f"{ws_result.stolen_subshards} stolen / "
+        f"{ws_result.hedged_launches} hedged / "
+        f"{ws_result.chip_convictions} convicted, "
+        f"{ws_rate / 1e6:.1f} MB/s",
+        file=sys.stderr,
+    )
+    print(json.dumps(build_worksteal_record(
+        jax.default_backend(), ws_rate, n_devices, ws_guard.snapshot(),
+        ws_warm, ws_result, chip_fault,
     )))
 
 

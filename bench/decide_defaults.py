@@ -52,6 +52,7 @@ KERNEL_TAGS = frozenset(t for t, (k, _) in MODES.items() if k != "0")
 # recovery subsystem's batched repair-decode rate (config6_recovery).
 AUX_METRICS = ("recovery_decode_bytes_per_sec",
                "recovery_multichip_bytes_per_sec",
+               "recovery_worksteal_bytes_per_sec",
                "scrub_crc32c_bytes_per_sec",
                "liveness_heartbeat_ticks_per_sec")
 
@@ -102,6 +103,22 @@ TRAFFIC_STR_FIELDS = ("traffic_health_status",)
 # regression, not a perf result.
 MULTICHIP_GUARD_FIELDS = ("n_devices", "sharded_launches",
                           "psum_bytes_rebuilt", "psum_shards_rebuilt")
+
+# Work-stealing dispatch fields (config6_recovery --multichip, second
+# leg): the straggler run's counters under a seeded ``chipstall:``
+# fault.  The scenario is seeded, so the conviction/steal counts are
+# exact expectations — zero convictions under the pinned-chip fault,
+# or an idle fraction back at the static path's 1.0 floor, means the
+# dispatcher stopped absorbing stragglers (a robustness regression
+# even when the rate metric still looks fine).  ``chip_fault`` is
+# provenance: the counters only mean something next to the fault they
+# were measured under.
+DISPATCH_INT_FIELDS = ("worksteal_launches", "stolen_subshards",
+                       "hedged_launches", "hedge_wasted_bytes",
+                       "chip_convictions")
+DISPATCH_FLOAT_LIST_FIELDS = ("idle_fraction_per_chip",
+                              "static_idle_fraction_per_chip")
+DISPATCH_STR_FIELDS = ("chip_fault",)
 
 # XOR-schedule fields (config2/config4 --xor-schedule): the XOR counts
 # and reduction fraction are exact compile-time properties of the
@@ -363,6 +380,16 @@ def harvest_guard(paths: list[str]) -> dict[str, dict]:
             )
             fields.update(
                 {f: int(d[f]) for f in MULTICHIP_GUARD_FIELDS if f in d}
+            )
+            fields.update(
+                {f: int(d[f]) for f in DISPATCH_INT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: [float(x) for x in d[f]]
+                 for f in DISPATCH_FLOAT_LIST_FIELDS if f in d}
+            )
+            fields.update(
+                {f: str(d[f]) for f in DISPATCH_STR_FIELDS if f in d}
             )
             fields.update(
                 {f: int(d[f]) for f in XOR_SCHEDULE_INT_FIELDS if f in d}

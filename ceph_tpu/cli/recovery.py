@@ -16,6 +16,12 @@ the framework's failure loop, driving
     # ends with a structured convergence report (one JSON line)
     python -m ceph_tpu.cli.recovery --chaos mid-repair-loss
 
+    # same, with the work-stealing dispatcher on and one chip pinned
+    # by a seeded stall: sub-shards are stolen off the straggler, the
+    # chip is convicted, and the dispatch counters land in the report
+    python -m ceph_tpu.cli.recovery --chaos mid-repair-loss --mesh 0 \\
+        --chip-fault chipstall:1.0
+
 With a ``mapfilename`` the map is loaded from the framework's
 versioned encoding (``osdmaptool --createsimple`` output); without
 one a synthetic EC cluster is built in-process (``--num-osd`` etc.).
@@ -56,6 +62,33 @@ def _build_mesh(args, out):
     return mesh
 
 
+def _worksteal_setup(args, cfg):
+    """Apply ``--work-stealing``/``--chip-fault`` to the config and
+    return the parsed chip-fault specs.  Dies loudly on a non-chip
+    spec and on the off+fault contradiction — a fault flag that
+    silently does nothing would fake a passing straggler drill."""
+    from ..recovery.failure import parse_spec
+
+    chip_faults = [parse_spec(text) for text in args.chip_fault]
+    bad = [str(s) for s in chip_faults if not s.is_chip]
+    if bad:
+        raise SystemExit(
+            f"--chip-fault {' '.join(bad)}: not a chip spec "
+            "(chipstall:/chipslow:/chipdrop:)"
+        )
+    ws = args.work_stealing
+    if chip_faults and ws == "off":
+        raise SystemExit(
+            "--chip-fault needs the work-stealing dispatcher; "
+            "drop '--work-stealing off'"
+        )
+    if chip_faults and ws is None:
+        ws = "on"  # a requested fault implies the path that consumes it
+    if ws is not None:
+        cfg.set("recovery_work_stealing", ws)
+    return chip_faults
+
+
 def _run_chaos(args, m, m_prev, pool_id, out) -> int:
     """Drive a named chaos timeline through the supervised executor."""
     import json
@@ -73,6 +106,12 @@ def _run_chaos(args, m, m_prev, pool_id, out) -> int:
         args.chaos, m, start_s=args.chaos_start,
         period_s=args.chaos_period, cycles=args.cycles,
     )
+    # chip specs never reach the map engine: split them off the
+    # timeline (defensive — named scenarios don't schedule them today)
+    # and merge with the --chip-fault flags for the dispatcher
+    from ..recovery.dispatch import strip_chip_specs
+
+    timeline, stripped = strip_chip_specs(timeline)
     print(f"chaos {args.chaos}: {len(timeline)} scheduled events", file=out)
     chaos = ChaosEngine(m, timeline)
     codec = create({
@@ -86,6 +125,7 @@ def _run_chaos(args, m, m_prev, pool_id, out) -> int:
         cfg.set("recovery_max_bytes_per_sec", args.max_bytes_per_sec)
     if args.shard_min_bytes is not None:
         cfg.set("recovery_shard_min_bytes", args.shard_min_bytes)
+    chip_faults = list(stripped) + _worksteal_setup(args, cfg)
     rng = np.random.default_rng(0)
     chunks: dict[tuple[int, int], np.ndarray] = {}
 
@@ -99,9 +139,18 @@ def _run_chaos(args, m, m_prev, pool_id, out) -> int:
 
     mesh = _build_mesh(args, out)
     sup = SupervisedRecovery(
-        codec, chaos, config=cfg, seed=args.seed, mesh=mesh
+        codec, chaos, config=cfg, seed=args.seed, mesh=mesh,
+        chip_faults=chip_faults or None,
     )
-    res = sup.run(m_prev, pool_id, read_shard)
+    from ..recovery import ChipLostError
+
+    try:
+        res = sup.run(m_prev, pool_id, read_shard)
+    except ChipLostError as e:
+        # typed, never a hang: every chip on this rank's mesh slice
+        # was convicted — report which and fail loudly
+        print(f"chaos aborted: all chips convicted ({e.chips})", file=out)
+        return 1
     for ev in chaos.applied:
         specs = " ".join(str(s) for s in ev.specs)
         print(f"  t={ev.t:g}s epoch {ev.epoch}: {specs}", file=out)
@@ -116,6 +165,17 @@ def _run_chaos(args, m, m_prev, pool_id, out) -> int:
         f"{len(res.failed_pgs)} failed",
         file=out,
     )
+    if res.worksteal_launches:
+        idle = ", ".join(f"{f:.2f}" for f in res.idle_fraction_per_chip)
+        print(
+            f"worksteal: {res.worksteal_launches} launches, "
+            f"{res.stolen_subshards} stolen sub-shards, "
+            f"{res.hedged_launches} hedged "
+            f"({res.hedge_wasted_bytes} wasted bytes), "
+            f"{res.chip_convictions} chips convicted, "
+            f"idle/chip [{idle}]",
+            file=out,
+        )
     print(json.dumps({"scenario": args.chaos, "seed": args.seed, **s}),
           file=out)
     return 0 if res.converged else 1
@@ -169,6 +229,17 @@ def main(argv=None) -> int:
                    help="crossover threshold override: smallest group "
                         "operand (bytes) routed to the sharded decode "
                         "(recovery_shard_min_bytes)")
+    p.add_argument("--work-stealing", choices=("auto", "on", "off"),
+                   default=None,
+                   help="work-stealing sub-shard dispatch over the mesh "
+                        "chips (recovery_work_stealing; default 'auto' "
+                        "keeps the static sharded path on CPU hosts)")
+    p.add_argument("--chip-fault", action="append", metavar="SPEC",
+                   default=[],
+                   help="seeded dispatcher chip fault, repeatable "
+                        "(chipstall:<chip>[.<launch>], "
+                        "chipslow:<chip>.<factor>, chipdrop:<chip>); "
+                        "implies --work-stealing on")
     args = p.parse_args(argv)
     out = sys.stdout
 
@@ -268,6 +339,7 @@ def main(argv=None) -> int:
         cfg.set("recovery_max_bytes_per_sec", args.max_bytes_per_sec)
     if args.shard_min_bytes is not None:
         cfg.set("recovery_shard_min_bytes", args.shard_min_bytes)
+    chip_faults = _worksteal_setup(args, cfg)
     k = codec.k
     rng = np.random.default_rng(0)
     chunks: dict[tuple[int, int], np.ndarray] = {}
@@ -280,13 +352,28 @@ def main(argv=None) -> int:
             )
         return chunks[key]
 
-    ex = RecoveryExecutor(codec, config=cfg, mesh=_build_mesh(args, out))
-    result = ex.run(plan, read_shard)
+    ex = RecoveryExecutor(
+        codec, config=cfg, mesh=_build_mesh(args, out),
+        chip_faults=chip_faults or None, dispatch_seed=args.seed,
+    )
+    from ..recovery import ChipLostError
+
+    try:
+        result = ex.run(plan, read_shard)
+    except ChipLostError as e:
+        print(f"execute aborted: all chips convicted ({e.chips})", file=out)
+        return 1
     sharded = (
         f" ({result.sharded_launches} mesh-sharded, "
         f"{result.psum_bytes_rebuilt} psum'd bytes)"
         if result.sharded_launches else ""
     )
+    if result.worksteal_launches:
+        sharded = (
+            f" ({result.worksteal_launches} work-stealing, "
+            f"{result.stolen_subshards} stolen sub-shards, "
+            f"{result.chip_convictions} convicted)"
+        )
     print(
         f"execute: {result.launches} launches{sharded}, "
         f"{result.shards_rebuilt} shards / "

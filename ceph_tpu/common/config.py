@@ -139,6 +139,39 @@ SCHEMA: list[Option] = [
            "supervised scheduling window when a mesh is attached "
            "(async launches round-robined over local devices); 1 "
            "serializes launches as before", min=1),
+    Option("recovery_work_stealing", OPT_STR, "auto", LEVEL_ADVANCED,
+           "route byte-level pattern groups through the fault-tolerant "
+           "work-stealing dispatcher (over-decomposed sub-shards, "
+           "greedy assignment as chips drain, straggler hedging, "
+           "chip conviction): 'auto' enables it on real multi-chip "
+           "meshes and keeps the static sharded path on CPU hosts; "
+           "'on' forces it everywhere (tests/benches); 'off' pins the "
+           "static path", enum_allowed=("auto", "on", "off"),
+           see_also=("recovery_subshards_per_chip",
+                     "recovery_dispatch_hedge_factor",
+                     "recovery_chip_fail_threshold")),
+    Option("recovery_subshards_per_chip", OPT_INT, 4, LEVEL_ADVANCED,
+           "over-decomposition factor for work-stealing dispatch: each "
+           "pattern group splits into ~subshards_per_chip x n_chips "
+           "byte-range sub-shards (power-of-two bucketed widths, so "
+           "the split never recompiles); higher values smooth skewed "
+           "group mixes at the cost of per-launch overhead", min=1,
+           see_also=("recovery_work_stealing",)),
+    Option("recovery_dispatch_hedge_factor", OPT_FLOAT, 3.0,
+           LEVEL_ADVANCED,
+           "straggler deadline multiplier: a sub-shard is overdue (and "
+           "hedge-redispatched to an idle chip) when its launch runs "
+           "longer than hedge_factor x the owning chip's EWMA "
+           "completion-time estimate; first completion wins, the "
+           "loser's bytes are discarded", min=1.0,
+           see_also=("recovery_work_stealing",
+                     "recovery_chip_fail_threshold")),
+    Option("recovery_chip_fail_threshold", OPT_INT, 3, LEVEL_ADVANCED,
+           "consecutive deadline misses before a chip is convicted and "
+           "its queue drains to survivors; ChipLostError is raised "
+           "only when every chip is convicted (never a hang)", min=1,
+           see_also=("recovery_dispatch_hedge_factor",
+                     "recovery_retry_max")),
     Option("debug_rank_checks", OPT_BOOL, False, LEVEL_ADVANCED,
            "cross-check a fingerprint of mesh-seam operands across "
            "ranks via a psum before every sharded decode/scrub/"

@@ -44,6 +44,15 @@ The subsystem that closes the loop the standalone workloads left open
   stall-tolerant degradation (laggy marking, seeded virtual-time
   backoff, :class:`~ceph_tpu.analysis.runtime_guard.RankStalledError`
   on every rank instead of a collective hang).
+- :mod:`~ceph_tpu.recovery.dispatch` — fault-tolerant work-stealing
+  mesh dispatch: pattern groups over-decomposed into power-of-two
+  bucketed byte-range sub-shards assigned greedily as chips drain,
+  with per-chip EWMA health tracking, hedged re-dispatch of overdue
+  sub-shards (sequence-number duplicate-commit guard), seeded
+  bounded backoff on failed launches, chip conviction
+  (``chipstall:``/``chipslow:``/``chipdrop:`` chaos specs), and a
+  typed :class:`~ceph_tpu.recovery.dispatch.ChipLostError` instead
+  of a mesh hang.
 - :mod:`~ceph_tpu.recovery.checkpoint` — crash-consistent
   checkpoint/restore: durable CRC32C-verified snapshots of
   device-resident state (single cluster, fleets, rank views) with
@@ -56,6 +65,7 @@ The subsystem that closes the loop the standalone workloads left open
 
 from .chaos import (
     SCENARIOS,
+    AppliedChipSpec,
     AppliedCorruption,
     AppliedCrashSpec,
     AppliedEvent,
@@ -80,8 +90,17 @@ from .checkpoint import (
     save_divergent,
     strip_crash_specs,
 )
+from .dispatch import (
+    ChipFaultSchedule,
+    ChipLostError,
+    DispatchStats,
+    WorkStealingDispatcher,
+    strip_chip_specs,
+)
 from .failure import (
     ACTIONS,
+    CHIP_ACTIONS,
+    CHIP_SCOPES,
     CRASH_ACTIONS,
     CRASH_SCOPE,
     KNOWN_SCOPES,
@@ -94,6 +113,7 @@ from .failure import (
     FlapRecord,
     UnknownSpecKeyError,
     build_incremental,
+    check_chip,
     check_rank,
     flap,
     inject,
@@ -273,6 +293,15 @@ __all__ = [
     "RANK_ACTIONS",
     "RANK_SCOPES",
     "check_rank",
+    "AppliedChipSpec",
+    "CHIP_ACTIONS",
+    "CHIP_SCOPES",
+    "ChipFaultSchedule",
+    "ChipLostError",
+    "DispatchStats",
+    "WorkStealingDispatcher",
+    "check_chip",
+    "strip_chip_specs",
     "AppliedCrashSpec",
     "CRASH_ACTIONS",
     "CRASH_SCOPE",

@@ -343,6 +343,22 @@ class AppliedCrashSpec:
 
 
 @dataclass
+class AppliedChipSpec:
+    """Audit-trail entry for one chip-scoped spec the engine saw.
+
+    Chip specs never touch the map, the detector, or the simulated
+    cluster — they fault a *device-mesh chip*, and only the
+    work-stealing dispatcher (:mod:`ceph_tpu.recovery.dispatch`)
+    enacts them.  The engine journals and records them so a replay of
+    a chip-fault scenario without the dispatcher still leaves an
+    audit trail."""
+
+    t: float
+    epoch: int
+    spec: FailureSpec
+
+
+@dataclass
 class AppliedRankSpec:
     """Audit-trail entry for one rank-scoped spec the engine saw.
 
@@ -398,6 +414,7 @@ class ChaosEngine:
         self.corruptions: list[AppliedCorruption] = []
         self.rank_applied: list[AppliedRankSpec] = []
         self.crash_applied: list[AppliedCrashSpec] = []
+        self.chip_applied: list[AppliedChipSpec] = []
 
     @property
     def epoch(self) -> int:
@@ -422,10 +439,11 @@ class ChaosEngine:
             net = [s for s in ev.specs if s.is_net]
             rank = [s for s in ev.specs if s.is_rank]
             crash = [s for s in ev.specs if s.is_crash]
+            chip = [s for s in ev.specs if s.is_chip]
             fail = tuple(
                 s for s in ev.specs
                 if not s.is_bitrot and not s.is_net
-                and not s.is_rank and not s.is_crash
+                and not s.is_rank and not s.is_crash and not s.is_chip
             )
             if fail:
                 inc = inject(self.osdmap, list(fail))
@@ -457,6 +475,19 @@ class ChaosEngine:
                 if self.journal is not None:
                     self.journal.event(
                         "chaos.crash",
+                        epoch=self.osdmap.epoch,
+                        sched_t=ev.t,
+                        spec=str(spec),
+                    )
+            for spec in chip:
+                # no map/detector effect — dispatch.py enacts the
+                # fault; this is the audit trail for replay tooling
+                self.chip_applied.append(
+                    AppliedChipSpec(ev.t, self.osdmap.epoch, spec)
+                )
+                if self.journal is not None:
+                    self.journal.event(
+                        "chaos.chip",
                         epoch=self.osdmap.epoch,
                         sched_t=ev.t,
                         spec=str(spec),

@@ -38,6 +38,7 @@ from ..common.tracing import timed_block, trace_annotation
 from ..ec.backend import TableEncoder
 from ..ec.schedule import ScheduleCache, encoder_for_group
 from ..osdmap.map import OSDMap
+from .dispatch import ChipFaultSchedule, WorkStealingDispatcher
 from .peering import (
     PG_STATE_BACKFILL,
     PG_STATE_DEGRADED,
@@ -147,6 +148,18 @@ def _build_counters() -> PerfCounters:
                          "decode outputs re-derived through the dense "
                          "reference path after checksum verification "
                          "rejected a compiled-schedule launch")
+        .add_u64_counter("worksteal_launches",
+                         "pattern groups routed through the "
+                         "work-stealing dispatcher")
+        .add_u64_counter("stolen_subshards",
+                         "sub-shards committed by a chip other than "
+                         "their static round-robin owner")
+        .add_u64_counter("hedged_launches",
+                         "overdue sub-shards hedge-redispatched to an "
+                         "idle chip")
+        .add_u64_counter("chip_convictions",
+                         "mesh chips convicted after consecutive "
+                         "dispatch deadline misses")
         .add_gauge("degraded_pgs", "degraded PGs in the last plan")
         .add_gauge("unrecoverable_pgs", "PGs below k survivors")
         .add_gauge("failed_pgs",
@@ -181,6 +194,19 @@ class RecoveryResult:
     psum_shards_rebuilt: int = 0
     # launches that ran as CSE-shrunk XOR schedules (bit-level groups)
     schedule_launches: int = 0
+    # work-stealing dispatch (ceph_tpu.recovery.dispatch): groups
+    # routed through the dispatcher plus its steal/hedge/conviction
+    # telemetry and the per-chip idle fractions (with the static-
+    # sharding counterfactual for the same work)
+    worksteal_launches: int = 0
+    stolen_subshards: int = 0
+    hedged_launches: int = 0
+    hedge_wasted_bytes: int = 0
+    chip_convictions: int = 0
+    idle_fraction_per_chip: list[float] = field(default_factory=list)
+    static_idle_fraction_per_chip: list[float] = field(
+        default_factory=list
+    )
     # decode-verify: launches re-derived through the dense reference
     # path after the compiled schedule's output failed checksum, and
     # PGs whose rebuilt bytes failed verification on EVERY engine —
@@ -246,6 +272,8 @@ class RecoveryExecutor:
         sleep: Callable[[float], None] = time.sleep,
         mesh=None,
         arbiter=None,
+        chip_faults=None,
+        dispatch_seed: int = 0,
     ):
         self.codec = codec
         cfg = config or global_config()
@@ -294,6 +322,34 @@ class RecoveryExecutor:
             self._devices = [
                 d for d in mesh.devices.flat if d.process_index == proc
             ]
+        # work-stealing dispatch (ceph_tpu.recovery.dispatch): "auto"
+        # activates on real multi-chip meshes only — the CPU host tier
+        # keeps the static sharded path as the bit-equality reference;
+        # "on" forces it (tests/benches, incl. the virtual-device mesh)
+        ws = str(cfg.get("recovery_work_stealing"))
+        self._dispatcher: WorkStealingDispatcher | None = None
+        if ws == "on" or (
+            ws == "auto"
+            and len(self._devices) > 1
+            and jax.default_backend() != "cpu"
+        ):
+            devices = self._devices or [None]
+            if mesh is not None and self._devices:
+                flat = list(mesh.devices.flat)
+                chip_ids = [flat.index(d) for d in self._devices]
+                n_total = len(flat)
+            else:
+                chip_ids = list(range(len(devices)))
+                n_total = len(devices)
+            faults = chip_faults
+            if faults is not None and not isinstance(
+                faults, ChipFaultSchedule
+            ):
+                faults = ChipFaultSchedule.from_specs(faults, n_total)
+            self._dispatcher = WorkStealingDispatcher(
+                devices, cfg, chip_ids=chip_ids, faults=faults,
+                seed=dispatch_seed,
+            )
 
     def _dispatch_group(
         self,
@@ -332,13 +388,33 @@ class RecoveryExecutor:
             self.xor_mode == "on"
             and not self._schedules.is_quarantined(("bitplane", g.mask))
         )
+        # byte-level groups route through the work-stealing dispatcher
+        # when it is active (it subsumes both the sharded and the
+        # round-robin table paths); bit-level groups keep the schedule
+        # engines — their packet-interleaved chunks are not
+        # byte-column sliceable
+        worksteal = self._dispatcher is not None and not bit_level
         sharded = (
-            self._sharded is not None
+            not worksteal
+            and self._sharded is not None
             and nbytes >= self.shard_min_bytes
             and not bit_level
         )
         with trace_annotation(f"recovery:decode:{g.mask:#x}"):
-            if sharded:
+            if worksteal:
+                enc = self._encoders.get(g.mask)
+                if enc is None:
+                    enc = self._encoders[g.mask] = TableEncoder(
+                        g.repair_matrix
+                    )
+                job = self._dispatcher.submit(enc, src)
+                self.pc.inc("worksteal_launches")
+                result.worksteal_launches += 1
+                fl = _Inflight(
+                    g, job, chunk, False, None, None, t0,
+                    post=self._dispatcher.result, engine="worksteal",
+                )
+            elif sharded:
                 out, nb, sh, valid = self._sharded.decode_async(
                     self._sharded.luts_for(g), src, chunk
                 )
@@ -406,6 +482,31 @@ class RecoveryExecutor:
         # time  # jaxlint: disable=J010
         result.decode_s += time.perf_counter() - fl.t_dispatch
         return out, fl.chunk
+
+    def _dispatch_stats_begin(self):
+        """Snapshot the dispatcher's cumulative stats (None when the
+        work-stealing path is inactive) so a run reports deltas."""
+        if self._dispatcher is None:
+            return None
+        return self._dispatcher.stats.copy()
+
+    def _dispatch_stats_end(self, before, result: RecoveryResult) -> None:
+        """Fold this run's dispatcher telemetry into the result and
+        the perf counters."""
+        if self._dispatcher is None or before is None:
+            return
+        d = self._dispatcher.stats.delta(before)
+        result.stolen_subshards += d.stolen_subshards
+        result.hedged_launches += d.hedged_launches
+        result.hedge_wasted_bytes += d.hedge_wasted_bytes
+        result.chip_convictions += d.chip_convictions
+        result.idle_fraction_per_chip = d.idle_fraction_per_chip()
+        result.static_idle_fraction_per_chip = (
+            d.static_idle_fraction_per_chip()
+        )
+        self.pc.inc("stolen_subshards", d.stolen_subshards)
+        self.pc.inc("hedged_launches", d.hedged_launches)
+        self.pc.inc("chip_convictions", d.chip_convictions)
 
     def _launch_group(
         self,
@@ -531,6 +632,7 @@ class RecoveryExecutor:
         group (they do in practice: chunk size is an object/stripe
         property, constant per pool)."""
         result = RecoveryResult(shards={}, unrecoverable=plan.unrecoverable)
+        snap = self._dispatch_stats_begin()
         for g in plan.groups:
             fl = self._dispatch_group(g, read_shard, result)
             out, chunk = self._finalize_group(fl, result)
@@ -538,6 +640,7 @@ class RecoveryExecutor:
                 g, out, chunk, fl.engine, result, read_shard
             )
         result.throttle_wait_s = self.throttle.waited_s
+        self._dispatch_stats_end(snap, result)
         return result
 
 
@@ -585,6 +688,16 @@ class SupervisedResult:
     sharded_launches: int = 0  # routed through the mesh-sharded step
     schedule_launches: int = 0  # executed as CSE-shrunk XOR schedules
     coscheduled_windows: int = 0  # windows that dispatched >1 group
+    # work-stealing dispatch telemetry (zero unless the dispatcher ran)
+    worksteal_launches: int = 0
+    stolen_subshards: int = 0
+    hedged_launches: int = 0
+    hedge_wasted_bytes: int = 0
+    chip_convictions: int = 0
+    idle_fraction_per_chip: list[float] = field(default_factory=list)
+    static_idle_fraction_per_chip: list[float] = field(
+        default_factory=list
+    )
     psum_bytes_rebuilt: int = 0  # collective-reduced byte progress
     plan_revisions: int = 0
     completed_pgs: set[int] = field(default_factory=set)
@@ -625,6 +738,11 @@ class SupervisedResult:
             "salvaged_pgs": self.salvaged_pgs,
             "sharded_launches": self.sharded_launches,
             "schedule_launches": self.schedule_launches,
+            "worksteal_launches": self.worksteal_launches,
+            "stolen_subshards": self.stolen_subshards,
+            "hedged_launches": self.hedged_launches,
+            "hedge_wasted_bytes": self.hedge_wasted_bytes,
+            "chip_convictions": self.chip_convictions,
             "plan_revisions": self.plan_revisions,
             "completed_pgs": len(self.completed_pgs),
             "failed_pgs": sorted(self.failed_pgs),
@@ -691,6 +809,7 @@ class SupervisedRecovery:
         arbiter=None,
         scrubber=None,
         write_shard=None,
+        chip_faults=None,
     ):
         self.codec = codec
         self.chaos = chaos
@@ -751,7 +870,11 @@ class SupervisedRecovery:
             sleep=chaos.clock.sleep,
             mesh=mesh,
             arbiter=arbiter,
+            chip_faults=chip_faults,
+            dispatch_seed=seed,
         )
+        if self.ex._dispatcher is not None:
+            self.ex._dispatcher.journal = journal
         self.pc = self.ex.pc
 
     def _jevent(self, name: str, **attrs) -> None:
@@ -839,6 +962,19 @@ class SupervisedRecovery:
         return "norecover" in flags
 
     @staticmethod
+    def _finalize_order(fl: _Inflight) -> tuple:
+        """Deterministic finalize key for a co-schedule window:
+        (erasure pattern, PG set).  The window used to finalize in
+        scheduling-insertion order, which depended on how the pending
+        dict/list happened to be built — two identical scenarios could
+        commit (and journal) in different orders.  Sorting by the
+        group's content keys makes window finalization replay-stable
+        regardless of construction order (the J009 discipline applied
+        to the window seam)."""
+        g = fl.group
+        return (int(g.mask), tuple(int(p) for p in g.pgs))
+
+    @staticmethod
     def _stale_pgs(
         g: PatternGroup, peering: PeeringResult, m: OSDMap
     ) -> set[int]:
@@ -886,6 +1022,7 @@ class SupervisedRecovery:
 
         inner = RecoveryResult(shards={})
         res = SupervisedResult(shards=inner.shards)
+        dispatch_snap = self.ex._dispatch_stats_begin()
         scrubber = self.scrubber
         if scrubber is not None:
             from .scrub import DecodeVerifier
@@ -1239,6 +1376,10 @@ class SupervisedRecovery:
             incs = chaos.poll()
             if incs:
                 observe(incs)
+            # finalize in deterministic (pattern, PG-set) order — the
+            # dispatch order above already consumed the schedule's
+            # priority; commit order must not depend on it
+            window.sort(key=self._finalize_order)
             for fl in window:
                 g = fl.group
                 out, chunk = self.ex._finalize_group(fl, inner)
@@ -1323,9 +1464,19 @@ class SupervisedRecovery:
                 or last.counts.get("scrubbing", 0)
             ):
                 self._snapshot(peering, inner.bytes_recovered)
+        self.ex._dispatch_stats_end(dispatch_snap, inner)
         res.launches = inner.launches
         res.sharded_launches = inner.sharded_launches
         res.schedule_launches = inner.schedule_launches
+        res.worksteal_launches = inner.worksteal_launches
+        res.stolen_subshards = inner.stolen_subshards
+        res.hedged_launches = inner.hedged_launches
+        res.hedge_wasted_bytes = inner.hedge_wasted_bytes
+        res.chip_convictions = inner.chip_convictions
+        res.idle_fraction_per_chip = list(inner.idle_fraction_per_chip)
+        res.static_idle_fraction_per_chip = list(
+            inner.static_idle_fraction_per_chip
+        )
         res.psum_bytes_rebuilt = inner.psum_bytes_rebuilt
         res.bytes_recovered = inner.bytes_recovered
         res.shards_rebuilt = inner.shards_rebuilt

@@ -68,6 +68,29 @@ RANK_ACTIONS = {
 # target carries (rank[, milliseconds | epochs]).
 _RANK_TARGET_ARITY = {"rankdelay": 2, "rankdrop": 1, "rankstall": 2}
 
+# Chip-scoped chaos: not a map edit, not a cluster condition, not
+# even an observation skew — these shape the *device mesh* the
+# work-stealing dispatcher (:mod:`ceph_tpu.recovery.dispatch`) drives.
+# ``chipstall:D.LAUNCHES`` makes chip D's next LAUNCHES launches hang
+# forever (LAUNCHES=0 = every launch — the conviction acceptance
+# path); ``chipslow:D.FACTOR`` multiplies chip D's completion time by
+# FACTOR (a straggler, the hedge path); ``chipdrop:D`` makes chip D's
+# launches fail fast (the retry/backoff path; ``restore`` ends it).
+# Only the dispatcher consumes chip specs; every other consumer
+# rejects them loudly.
+CHIP_SCOPES = ("chipstall", "chipslow", "chipdrop")
+
+# Allowed actions per chip scope (first entry is the default).
+CHIP_ACTIONS = {
+    "chipstall": ("stall",),
+    "chipslow": ("slow",),
+    "chipdrop": ("drop", "restore"),
+}
+
+# How many dot-separated non-negative integers each chip scope's
+# target carries (chip[, launches | factor]).
+_CHIP_TARGET_ARITY = {"chipstall": 2, "chipslow": 2, "chipdrop": 1}
+
 # Process-lifetime chaos: ``crash:EPOCH[:PHASE]`` kills the *driving
 # process* at a simulated-epoch boundary.  Not a map edit, not a
 # cluster condition, not an observation skew — the simulated cluster
@@ -91,7 +114,7 @@ CRASH_ACTIONS = ("before", "during", "after")
 KNOWN_SCOPES = (
     "osd", "host", "chassis", "rack", "row", "pdu", "pod", "room",
     "datacenter", "dc", "zone", "region", "root", "bitrot",
-) + NET_SCOPES + RANK_SCOPES + (CRASH_SCOPE,)
+) + NET_SCOPES + RANK_SCOPES + CHIP_SCOPES + (CRASH_SCOPE,)
 
 # The keys a dict-form spec may carry (the JSON timeline surface).
 SPEC_KEYS = ("scope", "target", "action")
@@ -175,6 +198,14 @@ class FailureSpec:
         return self.scope in RANK_SCOPES
 
     @property
+    def is_chip(self) -> bool:
+        """Chip-fault spec (chipstall/chipslow/chipdrop): shapes the
+        device mesh the work-stealing dispatcher drives — routed to
+        :mod:`ceph_tpu.recovery.dispatch`, never to build_incremental
+        or the event tape."""
+        return self.scope in CHIP_SCOPES
+
+    @property
     def is_crash(self) -> bool:
         """Process-kill spec (``crash:EPOCH[:PHASE]``): kills the
         driving process itself — routed to
@@ -202,6 +233,22 @@ class FailureSpec:
         parts = self.target.split(".")
         if not self.is_rank or len(parts) != 2:
             raise ValueError(f"{self} carries no rank argument")
+        return int(parts[1])
+
+    def chip(self) -> int:
+        """The local chip index a chip-scoped spec targets (raises for
+        every other scope)."""
+        if not self.is_chip:
+            raise ValueError(f"{self} is not a chip-scoped spec")
+        return int(self.target.split(".")[0])
+
+    def chip_arg(self) -> int:
+        """The second target component of a chip-scoped spec: the
+        stalled-launch count (``chipstall``, 0 = every launch) or the
+        slowdown factor (``chipslow``)."""
+        parts = self.target.split(".")
+        if not self.is_chip or len(parts) != 2:
+            raise ValueError(f"{self} carries no chip argument")
         return int(parts[1])
 
     def crash_epoch(self) -> int:
@@ -235,6 +282,43 @@ def _parse_rank_target(scope: str, target: str) -> str:
             "positive delay or drop the spec"
         )
     return ".".join(str(v) for v in vals)
+
+
+def _parse_chip_target(scope: str, target: str) -> str:
+    """Validate + canonicalize a chip-scoped target (loudly: the same
+    surface as rank targets).  Returns the canonical dotted form with
+    no leading zeros."""
+    want = _CHIP_TARGET_ARITY[scope]
+    shape = {
+        "chipstall": "CHIP.LAUNCHES", "chipslow": "CHIP.FACTOR",
+        "chipdrop": "CHIP",
+    }[scope]
+    parts = target.split(".")
+    if len(parts) != want or not all(p.isdigit() for p in parts):
+        raise UnknownSpecKeyError(
+            f"bad {scope} target {target!r} (want {shape}, "
+            f"{want} non-negative integer(s) — a negative chip index, "
+            "launch count, or slowdown factor is invalid)"
+        )
+    vals = [int(p) for p in parts]
+    if scope == "chipslow" and vals[1] < 2:
+        raise UnknownSpecKeyError(
+            f"chipslow factor {vals[1]} in {target!r} is a no-op; "
+            "schedule a factor >= 2 or drop the spec"
+        )
+    return ".".join(str(v) for v in vals)
+
+
+def check_chip(spec: FailureSpec, n_chips: int) -> int:
+    """Range-check a chip-scoped spec against the mesh it will run
+    under (the consumer-side twin of :func:`check_rank`).  Returns the
+    chip index."""
+    c = spec.chip()
+    if not 0 <= c < n_chips:
+        raise UnknownSpecKeyError(
+            f"{spec}: chip {c} outside [0, {n_chips})"
+        )
+    return c
 
 
 def check_rank(spec: FailureSpec, n_ranks: int) -> int:
@@ -290,6 +374,8 @@ def parse_spec(text, scopes: tuple[str, ...] = KNOWN_SCOPES) -> FailureSpec:
             action = "drop"
         elif scope in RANK_SCOPES:
             action = RANK_ACTIONS[scope][0]
+        elif scope in CHIP_SCOPES:
+            action = CHIP_ACTIONS[scope][0]
         else:
             action = "down"
     elif len(parts) == 3:
@@ -336,6 +422,13 @@ def parse_spec(text, scopes: tuple[str, ...] = KNOWN_SCOPES) -> FailureSpec:
                 f"{RANK_ACTIONS[scope]}, got {action!r}"
             )
         return FailureSpec(scope, _parse_rank_target(scope, target), action)
+    if scope in CHIP_SCOPES:
+        if action not in CHIP_ACTIONS[scope]:
+            raise ValueError(
+                f"{scope} specs only support actions "
+                f"{CHIP_ACTIONS[scope]}, got {action!r}"
+            )
+        return FailureSpec(scope, _parse_chip_target(scope, target), action)
     if scope == CRASH_SCOPE:
         if len(parts) == 2:
             action = CRASH_ACTIONS[0]
@@ -391,6 +484,10 @@ def resolve_targets(m: OSDMap, spec: FailureSpec) -> list[int]:
     if spec.is_rank:
         raise ValueError(
             f"{spec} targets a simulation rank's observations, not OSDs"
+        )
+    if spec.is_chip:
+        raise ValueError(
+            f"{spec} targets a device-mesh chip, not OSDs"
         )
     if spec.is_crash:
         raise ValueError(
@@ -452,6 +549,12 @@ def build_incremental(m: OSDMap, specs) -> Incremental:
                 "map edit; route it through "
                 "ceph_tpu.recovery.reconcile (rank_view_timeline / "
                 "DivergentDriver)"
+            )
+        if spec.is_chip:
+            raise ValueError(
+                f"{spec} faults a device-mesh chip, it is not a map "
+                "edit; route it through the work-stealing dispatcher "
+                "(ceph_tpu.recovery.dispatch)"
             )
         if spec.is_crash:
             raise ValueError(

@@ -30,6 +30,14 @@ only per operand shape, so every pattern group with the same
 ``(n_missing, k, width)`` reuses one executable —
 ``assert_no_recompile`` holds across same-shape groups
 (tests/test_sharded.py).
+
+This static split is also the *bit-equality reference* for the
+fault-tolerant work-stealing dispatcher
+(:mod:`ceph_tpu.recovery.dispatch`): under the
+``recovery_work_stealing`` knob, byte-level groups route through
+over-decomposed sub-shards with straggler hedging and chip conviction
+instead — with recovered bytes provably identical to this path, since
+per-PG byte columns are independent however they are sliced.
 """
 
 from __future__ import annotations

@@ -180,6 +180,14 @@ def compile_event_tape(timeline: ChaosTimeline, m: OSDMap) -> EventTape:
                     "recovery.reconcile.rank_view_timeline before "
                     "compiling a per-rank tape"
                 )
+            if spec.is_chip:
+                raise ValueError(
+                    f"{spec} faults a device-mesh chip, not the "
+                    "simulated cluster; strip it with "
+                    "recovery.dispatch.strip_chip_specs (the "
+                    "work-stealing dispatcher consumes it) before "
+                    "compiling a tape"
+                )
             if spec.is_crash:
                 raise ValueError(
                     f"{spec} kills the driving process, not the "

@@ -147,6 +147,13 @@ def compile_once_cases() -> dict[str, dict]:
       second same-length chunk + merge must reuse both executables
       with zero in-round host transfers (the per-round gather is the
       deliberate host seam, outside this region).
+    - ``worksteal_dispatch``: the work-stealing dispatcher's drain
+      loop (:mod:`ceph_tpu.recovery.dispatch`) — every sub-shard
+      launch is zero-padded to one power-of-two piece bucket, so a
+      second job with a DIFFERENT width (and sub-shard count) inside
+      the same bucket must reuse the one per-device executable with
+      zero in-window host transfers (``result()`` is the single
+      deliberate host seam, outside this region).
 
     Raises ``AssertionError`` (from
     :func:`ceph_tpu.analysis.runtime_guard.assert_no_recompile`) if
@@ -473,6 +480,45 @@ def compile_once_cases() -> dict[str, dict]:
     report["reconcile_round"] = {
         "warm_compiles": warm_r.n_compiles, "second_compiles": 0,
         "in_round_host_transfers": g_r.host_transfers,
+    }
+
+    # ---- work-stealing dispatch: drain -> same piece bucket -> drain ----
+    import jax
+
+    from ..ec.backend import TableEncoder
+    from ..ec.gf import matrix_encode
+    from ..recovery.dispatch import WorkStealingDispatcher, _next_pow2
+
+    wenc = TableEncoder(vandermonde_matrix(k, m_par))
+    disp = WorkStealingDispatcher(list(jax.devices()))
+    denom = len(disp.chips) * disp.subshards_per_chip
+    w_a, w_b = 3000, 4000  # different widths AND sub-shard counts...
+    piece_a = _next_pow2(-(-w_a // denom))
+    piece_b = _next_pow2(-(-w_b // denom))
+    # ...but one power-of-two piece bucket: every launch is [k, piece]
+    assert_bucketed("worksteal piece bucket", piece_a, piece_b)
+    assert piece_a == piece_b, (piece_a, piece_b)
+    rng_w = np.random.default_rng(11)
+    src_a = rng_w.integers(0, 256, (k, w_a), dtype=np.uint8)
+    src_b = rng_w.integers(0, 256, (k, w_b), dtype=np.uint8)
+    with CompileCounter() as warm_d:
+        job_a = disp.submit(wenc, src_a)
+        disp.drain()
+        np.testing.assert_array_equal(
+            disp.result(job_a), matrix_encode(wenc.matrix, src_a)
+        )
+    with CompileBudget(0, "worksteal same piece bucket"), \
+            assert_no_recompile("worksteal same piece bucket"):
+        with track() as g_d:
+            job_b = disp.submit(wenc, src_b)
+            disp.drain()
+    assert g_d.host_transfers == 0, g_d.host_transfers
+    np.testing.assert_array_equal(
+        disp.result(job_b), matrix_encode(wenc.matrix, src_b)
+    )
+    report["worksteal_dispatch"] = {
+        "warm_compiles": warm_d.n_compiles, "second_compiles": 0,
+        "in_window_host_transfers": g_d.host_transfers,
     }
     return report
 

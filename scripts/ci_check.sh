@@ -45,8 +45,17 @@ if [ "$FUZZ_SECONDS" != "0" ]; then
     env -u PYTHONPATH PYTHONPATH="$PWD" JAX_PLATFORMS=cpu \
         CEPH_TPU_FUZZ_SECONDS="$FUZZ_SECONDS" \
         python tests/fuzz_lint.py || rc=$?
+    # work-stealing dispatcher soak: random sub-shard sizes, skewed
+    # job mixes, seeded chip-fault schedules — all bytes committed
+    # exactly once, typed ChipLostError only on an all-faulted mesh,
+    # and the outer timeout is the no-hang proof
+    echo "== dispatch fuzz soak (${FUZZ_SECONDS}s) =="
+    timeout -k 10 $((FUZZ_SECONDS * 4 + 120)) \
+        env -u PYTHONPATH PYTHONPATH="$PWD" JAX_PLATFORMS=cpu \
+        CEPH_TPU_FUZZ_SECONDS="$FUZZ_SECONDS" \
+        python tests/fuzz_dispatch.py || rc=$?
 else
-    echo "== jaxlint fuzz soak skipped (CEPH_TPU_FUZZ_SECONDS=0) =="
+    echo "== jaxlint + dispatch fuzz soaks skipped (CEPH_TPU_FUZZ_SECONDS=0) =="
 fi
 
 echo "== tier-1 tests =="

@@ -1,0 +1,175 @@
+"""Work-stealing dispatcher fuzz: random sub-shard sizes, skewed job
+mixes, and seeded chip-fault schedules must never break the exactly-
+once commit contract or hang the drain loop.
+
+Each iteration draws a batch of jobs (widths from a heavy-tailed mix),
+a random dispatcher config (sub-shards per chip, hedge factor, fail
+threshold), and a random chip-fault schedule (stall/slow/drop over a
+random chip subset), runs the batch, and checks:
+
+- every job's recovered bytes are bit-equal to the GF ground truth
+  (so every byte was committed exactly once, whatever the
+  steal/hedge/retry interleaving), OR
+- a typed :class:`ChipLostError` was raised — legal ONLY when every
+  chip carried a stall or drop fault (the graceful-degradation floor);
+- the drain loop terminated (its internal livelock budget never fired;
+  the CI wrapper's ``timeout`` is the outer no-hang proof).
+
+NOT collected by pytest — run manually:
+
+    env -u PYTHONPATH PYTHONPATH=/root/repo \\
+      JAX_PLATFORMS=cpu python tests/fuzz_dispatch.py
+
+Budget via CEPH_TPU_FUZZ_SECONDS (default 120) or CEPH_TPU_FUZZ_ITERS.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+
+from ceph_tpu.common.config import Config  # noqa: E402
+from ceph_tpu.ec import gf  # noqa: E402
+from ceph_tpu.ec.backend import TableEncoder  # noqa: E402
+from ceph_tpu.recovery.dispatch import (  # noqa: E402
+    ChipFaultSchedule,
+    ChipLostError,
+    WorkStealingDispatcher,
+)
+
+
+def _widths(rng: np.random.Generator) -> list[int]:
+    """A skewed job mix: mostly small groups, sometimes one huge
+    straggler-bait operand, sometimes single-byte slivers."""
+    n_jobs = int(rng.integers(1, 5))
+    out = []
+    for _ in range(n_jobs):
+        kind = rng.random()
+        if kind < 0.2:
+            out.append(int(rng.integers(1, 16)))  # sliver
+        elif kind < 0.9:
+            out.append(int(rng.integers(16, 4000)))
+        else:
+            out.append(int(rng.integers(4000, 40_000)))  # heavy tail
+    return out
+
+
+def _fault_specs(rng: np.random.Generator, n_chips: int) -> list[str]:
+    specs = []
+    n_faulty = int(rng.integers(0, n_chips + 1))
+    chips = rng.choice(n_chips, size=n_faulty, replace=False)
+    for c in chips:
+        kind = rng.random()
+        if kind < 0.4:
+            specs.append(f"chipstall:{int(c)}.{int(rng.integers(0, 4))}")
+        elif kind < 0.7:
+            specs.append(f"chipslow:{int(c)}.{int(rng.integers(2, 10))}")
+        else:
+            specs.append(f"chipdrop:{int(c)}")
+    return specs
+
+
+def _iteration(seed: int, devices, encoders) -> str:
+    rng = np.random.default_rng(seed)
+    n_chips = len(devices)
+    specs = _fault_specs(rng, n_chips)
+    cfg = Config(env={})
+    cfg.set("recovery_subshards_per_chip", int(rng.integers(1, 9)))
+    cfg.set("recovery_dispatch_hedge_factor",
+            float(rng.integers(3, 9)) / 2.0)
+    cfg.set("recovery_chip_fail_threshold", int(rng.integers(1, 5)))
+    faults = (
+        ChipFaultSchedule.from_specs(specs, n_chips) if specs else None
+    )
+    disp = WorkStealingDispatcher(
+        devices, cfg, faults=faults, seed=seed,
+    )
+    k = int(rng.integers(2, 5))
+    enc, mat = encoders[k]
+    jobs = []
+    for w in _widths(rng):
+        src = rng.integers(0, 256, (k, w), dtype=np.uint8)
+        jobs.append((disp.submit(enc, src), src))
+    try:
+        disp.drain()
+    except ChipLostError as e:
+        # the typed error is legal ONLY when every chip carried a
+        # fault: a healthy chip always completes at its expected time
+        # (ratio 1.0 against an EWMA floor of 1.0), so it can never
+        # miss a deadline, never be convicted — any conviction of an
+        # unfaulted chip is a scheduler bug this soak would catch.
+        # (A slow chip CAN be convicted under a tight fail threshold:
+        # straggling past the hedge deadline is exactly what
+        # conviction is for.)
+        assert faults is not None, (seed, specs)
+        assert all(
+            c in faults.dropped
+            or c in faults.stall
+            or c in faults.slow
+            for c in range(n_chips)
+        ), (seed, specs, str(e))
+        assert e.chips == list(range(n_chips)), (seed, e.chips)
+        return "lost"
+    for job, src in jobs:
+        assert job.done, (seed, specs)
+        # exactly-once: one winning launch per sub-shard, no extras
+        assert sorted(job.committed) == [s.seq for s in job.subs], (
+            seed, specs,
+        )
+        got = disp.result(job)
+        want = gf.matrix_encode(mat, src)
+        assert np.array_equal(got, want), (seed, specs, src.shape)
+    return "ok"
+
+
+def main() -> int:
+    budget_s = float(os.environ.get("CEPH_TPU_FUZZ_SECONDS", "120"))
+    max_iters = int(os.environ.get("CEPH_TPU_FUZZ_ITERS", "0")) or None
+    import jax
+
+    devices = list(jax.devices())
+    encoders = {}
+    for k in (2, 3, 4):
+        mat = gf.vandermonde_matrix(k, 2)
+        encoders[k] = (TableEncoder(mat), mat)
+    t0 = time.monotonic()
+    n = ok = lost = 0
+    while time.monotonic() - t0 < budget_s:
+        if max_iters is not None and n >= max_iters:
+            break
+        try:
+            verdict = _iteration(n, devices, encoders)
+        except AssertionError:
+            raise
+        except Exception as e:  # noqa: BLE001 — any escape is the bug
+            print(
+                f"FUZZ FAILURE at iteration {n}: "
+                f"{type(e).__name__}: {e}"
+            )
+            return 1
+        ok += verdict == "ok"
+        lost += verdict == "lost"
+        n += 1
+    print(
+        f"fuzz_dispatch: {n} schedules in {time.monotonic() - t0:.1f}s "
+        f"on {len(devices)} chips — {ok} bit-equal, {lost} typed "
+        "ChipLostError; 0 double-commits, 0 hangs"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

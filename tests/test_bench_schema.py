@@ -65,6 +65,88 @@ def test_multichip_record_schema():
     json.dumps(rec)  # one JSON line, always serializable
 
 
+# --- config6_recovery --multichip work-stealing leg JSON schema ---
+
+
+class _FakeWorkstealResult:
+    worksteal_launches = 21
+    stolen_subshards = 452
+    hedged_launches = 1
+    hedge_wasted_bytes = 8192
+    chip_convictions = 1
+    idle_fraction_per_chip = [
+        0.041536, 0.052079, 0.052079, 0.083326,
+        0.218561, 0.218561, 0.250021, 0.906545,
+    ]
+    static_idle_fraction_per_chip = [1.0] * 8
+
+
+def _worksteal_record():
+    return config6.build_worksteal_record(
+        "tpu",
+        5_894_168.3,
+        8,
+        {"n_compiles": 147, "host_transfers": 1168},
+        {"n_compiles": 147},
+        _FakeWorkstealResult(),
+        "chipstall:7.0",
+    )
+
+
+def test_worksteal_record_schema():
+    import json
+
+    rec = _worksteal_record()
+    assert rec["metric"] == "recovery_worksteal_bytes_per_sec"
+    assert rec["value"] == 5_894_168 and rec["unit"] == "B/s"
+    assert rec["platform"] == "tpu" and rec["n_devices"] == 8
+    assert rec["n_compiles"] == 147 and rec["n_compiles_first"] == 147
+    assert rec["host_transfers"] == 1168
+    # provenance: the injected straggler the counters were measured
+    # under rides along with them
+    assert rec["chip_fault"] == "chipstall:7.0"
+    assert rec["worksteal_launches"] == 21
+    assert rec["stolen_subshards"] == 452
+    assert rec["hedged_launches"] == 1
+    assert rec["hedge_wasted_bytes"] == 8192
+    assert rec["chip_convictions"] == 1
+    # per-chip idle: the stalled chip stands out but never reaches the
+    # static path's 1.0 floor (the counterfactual rides along, gated
+    # at all-1.0 because the fault makes static sharding wait forever)
+    assert rec["idle_fraction_per_chip"] == (
+        _FakeWorkstealResult.idle_fraction_per_chip
+    )
+    assert rec["static_idle_fraction_per_chip"] == [1.0] * 8
+    assert rec["lint_active"] == 0
+    json.dumps(rec)  # one JSON line, always serializable
+
+
+def test_worksteal_record_harvested_by_decide_defaults(tmp_path):
+    import json
+
+    rec = _worksteal_record()
+    p = tmp_path / "session.log"
+    p.write_text(json.dumps(rec) + "\n")
+    dd = _load_dd("worksteal")
+    g = dd.harvest_guard([str(p)])["recovery_worksteal_bytes_per_sec"]
+    # typed DISPATCH_* harvest: ints, float lists, and the fault spec
+    assert g["worksteal_launches"] == 21
+    assert g["stolen_subshards"] == 452
+    assert g["hedged_launches"] == 1
+    assert g["hedge_wasted_bytes"] == 8192
+    assert g["chip_convictions"] == 1
+    assert g["idle_fraction_per_chip"] == (
+        _FakeWorkstealResult.idle_fraction_per_chip
+    )
+    assert g["static_idle_fraction_per_chip"] == [1.0] * 8
+    assert g["chip_fault"] == "chipstall:7.0"
+    assert g["steady_state_clean"] is True
+    # the headline rate is an aux trend metric, never a kernel voter
+    assert dd.harvest_aux([str(p)]) == {
+        "recovery_worksteal_bytes_per_sec": 5_894_168
+    }
+
+
 # --- config6_recovery --chaos JSON schema (obs subsystem verdict) ---
 
 

@@ -1,0 +1,746 @@
+"""Work-stealing dispatch: chip-fault chaos grammar, the dispatcher's
+steal/hedge/retry/convict machinery, bit-equality against the static
+sharded path under every fault matrix cell, the deterministic
+co-schedule finalize order, and the supervised end-to-end acceptance
+(one stalled chip: a conviction, a lower max idle fraction than the
+static counterfactual, zero hangs).  Slow tier: all chips stalled
+across two OS processes — both ranks see the typed
+:class:`ChipLostError`, never a collective hang."""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ceph_tpu import recovery as rec
+from ceph_tpu.common.config import Config
+from ceph_tpu.crush.map import ITEM_NONE
+from ceph_tpu.ec import gf
+from ceph_tpu.ec.backend import MatrixCodec, TableEncoder
+from ceph_tpu.models.clusters import build_osdmap
+from ceph_tpu.obs.journal import EventJournal
+from ceph_tpu.parallel.placement import make_mesh
+from ceph_tpu.recovery.chaos import ChaosEvent, ChaosTimeline
+from ceph_tpu.recovery.dispatch import (
+    ChipFaultSchedule,
+    ChipLostError,
+    WorkStealingDispatcher,
+    _next_pow2,
+    strip_chip_specs,
+)
+from ceph_tpu.recovery.failure import (
+    UnknownSpecKeyError,
+    build_incremental,
+    check_chip,
+    normalize,
+    parse_spec,
+    resolve_targets,
+)
+from ceph_tpu.recovery.peering import PG_STATE_DEGRADED, PeeringResult
+from ceph_tpu.recovery.superstep import compile_event_tape
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---- chip-fault chaos grammar (satellite) ----------------------------
+
+
+def test_chip_spec_roundtrip():
+    """Canonical chip specs are fixed points of parse_spec, and the
+    bare two-part forms pick up each scope's default action."""
+    for s in (
+        "chipstall:2.0:stall",
+        "chipstall:7.3:stall",
+        "chipslow:1.4:slow",
+        "chipdrop:0:drop",
+        "chipdrop:5:restore",
+    ):
+        assert normalize(s) == s
+        assert str(parse_spec(s)) == s
+    assert normalize("chipstall:2.0") == "chipstall:2.0:stall"
+    assert normalize("chipslow:3.2") == "chipslow:3.2:slow"
+    assert normalize("chipdrop:1") == "chipdrop:1:drop"
+    # leading zeros canonicalize away, like rank targets
+    assert normalize("chipstall:02.00") == "chipstall:2.0:stall"
+    sp = parse_spec("chipstall:2.5")
+    assert sp.is_chip and sp.chip() == 2 and sp.chip_arg() == 5
+    assert not sp.is_rank and not sp.is_crash and not sp.is_net
+
+
+def test_chip_spec_rejects_loudly():
+    """Malformed chip targets and unsupported actions die loudly at
+    parse time — the same surface as rank specs, never a silent
+    no-op."""
+    for bad in (
+        "chipstall:2",  # missing launch count
+        "chipstall:2.0.1",  # extra component
+        "chipstall:-1.0",  # negative chip
+        "chipstall:x.0",  # non-integer
+        "chipslow:3",  # missing factor
+        "chipslow:3.1",  # factor < 2 is a no-op: rejected
+        "chipslow:3.0",
+        "chipdrop:1.2",  # drop takes a bare chip index
+    ):
+        with pytest.raises(UnknownSpecKeyError):
+            parse_spec(bad)
+    with pytest.raises(ValueError, match="empty target"):
+        parse_spec("chipdrop:")
+    with pytest.raises(ValueError, match="only support actions"):
+        parse_spec("chipstall:2.0:drop")
+    with pytest.raises(ValueError, match="only support actions"):
+        parse_spec("chipdrop:1:stall")
+    # range check against the mesh is the consumer-side guard
+    assert check_chip(parse_spec("chipdrop:7"), 8) == 7
+    with pytest.raises(UnknownSpecKeyError, match=r"outside \[0, 8\)"):
+        check_chip(parse_spec("chipdrop:8"), 8)
+    with pytest.raises(UnknownSpecKeyError, match="outside"):
+        check_chip(parse_spec("chipstall:9.0"), 4)
+
+
+def test_chip_specs_rejected_outside_dispatch():
+    """Every consumer other than the dispatcher rejects chip specs by
+    name, with a message routing to the right module — mirroring the
+    crash:/rank: discipline."""
+    m = build_osdmap(8, pg_num=8)
+    spec = parse_spec("chipstall:1.0")
+    with pytest.raises(ValueError, match="device-mesh chip"):
+        resolve_targets(m, spec)
+    with pytest.raises(ValueError, match="ceph_tpu.recovery.dispatch"):
+        build_incremental(m, [spec])
+    tl = ChaosTimeline.from_pairs([(0.1, "chipslow:2.3")])
+    with pytest.raises(ValueError, match="strip_chip_specs"):
+        compile_event_tape(tl, m)
+
+
+def test_strip_chip_specs():
+    """Chip specs come off a mixed timeline; chip-only events vanish,
+    map events survive, and the stripped timeline compiles."""
+    tl = ChaosTimeline.from_pairs([
+        (0.1, "chipstall:0.0"),
+        (0.2, "osd:3:down_out"),
+        (0.3, "chipdrop:5"),
+    ])
+    stripped, chip_specs = strip_chip_specs(tl)
+    assert [str(s) for s in chip_specs] == [
+        "chipstall:0.0:stall", "chipdrop:5:drop",
+    ]
+    evs = stripped.events()
+    assert len(evs) == 1 and str(evs[0].specs[0]) == "osd:3:down_out"
+    compile_event_tape(stripped, build_osdmap(8, pg_num=8))
+
+
+def test_chip_fault_schedule_from_specs():
+    sched = ChipFaultSchedule.from_specs(
+        ["chipstall:2.0", "chipslow:3.4", "chipdrop:1",
+         parse_spec("chipdrop:5"), "chipdrop:5:restore"],
+        n_chips=8,
+    )
+    assert sched.stall == {2: 0} and sched.slow == {3: 4}
+    assert sched.dropped == {1}  # the restore cancelled chip 5's drop
+    assert not sched.empty
+    assert sched.faulty(2) and sched.faulty(1)
+    assert not sched.faulty(3)  # slow gates nothing forever
+    assert ChipFaultSchedule(n_chips=8).empty
+    # out-of-mesh chip dies here, not as a silent no-op
+    with pytest.raises(UnknownSpecKeyError, match="outside"):
+        ChipFaultSchedule.from_specs(["chipdrop:8"], n_chips=8)
+    with pytest.raises(ValueError, match="not a chip-scoped spec"):
+        ChipFaultSchedule.from_specs(["osd:3:down"], n_chips=8)
+
+
+def test_chaos_engine_audits_chip_specs():
+    """A chip spec on an engine timeline touches neither map nor
+    detector but leaves the chip_applied audit trail and a chaos.chip
+    journal event (the crash-spec discipline)."""
+    m = build_osdmap(8, pg_num=8)
+    j = EventJournal()
+    tl = ChaosTimeline.from_pairs([
+        (0.5, parse_spec("chipstall:1.0")),
+        (0.5, parse_spec("osd:3")),
+    ])
+    eng = rec.ChaosEngine(m, tl, journal=j)
+    eng.clock.advance(1.0)
+    incs = eng.poll()
+    assert len(incs) == 1  # the map event alone became an epoch
+    assert len(eng.chip_applied) == 1
+    assert eng.chip_applied[0].spec.chip() == 1
+    events = j.by_name("chaos.chip")
+    assert len(events) == 1
+    assert events[0]["attrs"]["spec"] == "chipstall:1.0:stall"
+
+
+# ---- dispatcher unit: bucketing, bit-equality, determinism -----------
+
+
+def _dispatcher(n=8, specs=(), seed=0, **cfg_over):
+    import jax
+
+    cfg = Config(env={})
+    for key, val in cfg_over.items():
+        cfg.set(key, val)
+    devices = list(jax.devices())[:n]
+    faults = (
+        ChipFaultSchedule.from_specs(specs, len(devices))
+        if specs else None
+    )
+    return WorkStealingDispatcher(devices, cfg, faults=faults, seed=seed)
+
+
+def _case(k=4, m_par=2, w=5000, seed=7):
+    mat = gf.vandermonde_matrix(k, m_par)
+    enc = TableEncoder(mat)
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, 256, (k, w), dtype=np.uint8)
+    return enc, src, gf.matrix_encode(mat, src)
+
+
+def test_pow2_piece_bucketing():
+    assert [_next_pow2(n) for n in (0, 1, 2, 3, 4, 5, 64, 65)] == [
+        1, 1, 2, 4, 4, 8, 64, 128,
+    ]
+    disp = _dispatcher()
+    enc, src, _ = _case(w=3000)
+    job = disp.submit(enc, src)
+    target = disp.subshards_per_chip * disp.n_chips
+    piece = job.subs[0].piece
+    assert piece == _next_pow2(-(-3000 // target))
+    assert piece & (piece - 1) == 0
+    assert all(s.piece == piece for s in job.subs)
+    assert sum(s.width for s in job.subs) == 3000
+    # widths inside one bucket decompose to the same launch shape
+    job2 = disp.submit(enc, np.zeros((4, 4000), np.uint8))
+    assert job2.subs[0].piece == piece
+    assert len(job2.subs) != len(job.subs)  # count varies, shape not
+    # a tiny group still yields at least one sub-shard
+    job3 = disp.submit(enc, np.zeros((4, 3), np.uint8))
+    assert len(job3.subs) == 3 and job3.subs[0].piece == 1
+
+
+def test_healthy_dispatch_bit_equal():
+    disp = _dispatcher()
+    enc, src, want = _case(w=4097)  # odd width: the trim path is live
+    job = disp.submit(enc, src)
+    np.testing.assert_array_equal(disp.result(job), want)
+    st = disp.stats
+    assert st.subshards == len(job.subs) == len(job.committed)
+    assert st.launches == st.subshards  # no retries, no hedges
+    assert st.chip_convictions == 0 and st.hedged_launches == 0
+    assert max(st.idle_fraction_per_chip()) < 1.0
+
+
+def test_multi_job_batch_bit_equal():
+    """A co-schedule window of uneven jobs drains as one greedy batch,
+    every byte committed exactly once."""
+    disp = _dispatcher()
+    jobs = []
+    for i, w in enumerate((100, 4096, 777, 12345)):
+        enc, src, want = _case(w=w, seed=i)
+        jobs.append((disp.submit(enc, src), want))
+    disp.drain()
+    for job, want in jobs:
+        assert job.done
+        assert sorted(job.committed) == [s.seq for s in job.subs]
+        np.testing.assert_array_equal(disp.result(job), want)
+
+
+def test_same_seed_same_schedule():
+    """The scheduler is deterministic: same seed, same faults, same
+    batch -> identical stats (steal/hedge decisions replay)."""
+    runs = []
+    for _ in range(2):
+        disp = _dispatcher(specs=["chipslow:3.4", "chipdrop:6"], seed=9)
+        enc, src, want = _case(w=9000)
+        job = disp.submit(enc, src)
+        np.testing.assert_array_equal(disp.result(job), want)
+        runs.append(disp.stats)
+    assert runs[0] == runs[1]
+
+
+def test_all_chips_convicted_raises_typed_error():
+    disp = _dispatcher(specs=[f"chipstall:{c}.0" for c in range(8)])
+    enc, src, _ = _case(w=2000)
+    job = disp.submit(enc, src)
+    with pytest.raises(ChipLostError) as ei:
+        disp.result(job)
+    assert ei.value.chips == list(range(8))
+    assert "convicted" in str(ei.value)
+    assert disp.stats.chip_convictions == 8
+
+
+# ---- the failure matrix: phase x reaction, bit-equal ------------------
+#
+# Each cell kills/stalls/slows a chip at a different dispatch phase and
+# pins the reaction that recovers it; all cells must stay bit-equal to
+# the fault-free decode.
+#
+#   queued    — chipdrop fails the launch as it leaves the queue; the
+#               sub-shard re-queues with seeded backoff (retry), and
+#               enough consecutive failures convict the chip.
+#   in-flight — chipstall hangs the launch mid-flight; the deadline
+#               miss hedges a twin to an idle chip and repeated misses
+#               convict.  chipslow makes a straggler; survivors steal
+#               its backlog.
+#   pre-commit— a slow chip's launch completes AFTER its hedge twin
+#               already committed: the sequence guard discards the
+#               loser's bytes (counted as hedge waste), never a
+#               double commit.
+
+_MATRIX = [
+    ("queued_drop_retry", ["chipdrop:3"], dict(drop_retries=1)),
+    ("queued_drop_convict", ["chipdrop:0"], dict(chip_convictions=1)),
+    ("inflight_stall_hedge", ["chipstall:1.1"], dict(hedged_launches=1)),
+    ("inflight_stall_convict", ["chipstall:1.0"],
+     dict(hedged_launches=1, chip_convictions=1)),
+    ("inflight_slow_steal", ["chipslow:2.6"], dict(stolen_subshards=1)),
+    ("precommit_hedge_race", ["chipslow:5.9"],
+     dict(hedged_launches=1, hedge_wasted_bytes=1)),
+    ("combined", ["chipstall:0.0", "chipdrop:5", "chipslow:6.3"],
+     dict(chip_convictions=1)),
+]
+
+
+@pytest.mark.parametrize("name,specs,floors", _MATRIX,
+                         ids=[c[0] for c in _MATRIX])
+def test_failure_matrix_bit_equal(name, specs, floors):
+    disp = _dispatcher(specs=specs, seed=3)
+    jobs = []
+    for i, w in enumerate((6000, 3000, 9000)):
+        enc, src, want = _case(w=w, seed=i + 1)
+        jobs.append((disp.submit(enc, src), want))
+    disp.drain()
+    for job, want in jobs:
+        np.testing.assert_array_equal(disp.result(job), want)
+        # exactly-once commit: one winning launch per sub-shard
+        assert sorted(job.committed) == [s.seq for s in job.subs]
+    st = disp.stats
+    for field_name, floor in floors.items():
+        assert getattr(st, field_name) >= floor, (
+            name, field_name, getattr(st, field_name), st,
+        )
+    # any stall/drop cell gates the static counterfactual outright
+    if any("stall" in s or "drop" in s for s in specs):
+        assert st.static_idle_fraction_per_chip() == [1.0] * 8
+        assert max(st.idle_fraction_per_chip()) < 1.0
+
+
+def test_convicted_chip_excluded_from_next_batch():
+    disp = _dispatcher(specs=["chipstall:4.0"])
+    enc, src, want = _case(w=4000)
+    np.testing.assert_array_equal(disp.result(disp.submit(enc, src)), want)
+    assert disp.stats.chip_convictions == 1
+    before = disp.stats.copy()
+    enc2, src2, want2 = _case(w=2500, seed=11)
+    np.testing.assert_array_equal(
+        disp.result(disp.submit(enc2, src2)), want2
+    )
+    d = disp.stats.delta(before)
+    assert d.chip_convictions == 0  # convicted once, stays convicted
+    assert d.busy_s[4] == 0.0  # the dead chip served nothing
+
+
+def test_drop_backoff_bounded_and_journaled():
+    """chipdrop launches journal their retries and convict within the
+    threshold — the backoff never spins unbounded."""
+    j = EventJournal()
+    disp = _dispatcher(specs=["chipdrop:2"],
+                       recovery_chip_fail_threshold=2)
+    disp.journal = j
+    enc, src, want = _case(w=7000)
+    np.testing.assert_array_equal(disp.result(disp.submit(enc, src)), want)
+    drops = j.by_name("dispatch.drop")
+    assert len(drops) == disp.stats.drop_retries == 2
+    convicts = j.by_name("dispatch.convict")
+    assert len(convicts) == 1
+    assert convicts[0]["attrs"]["chip"] == 2
+
+
+# ---- executor + supervised routing ------------------------------------
+
+
+def _synth_peering(k, m_par, masks):
+    size = k + m_par
+    n = len(masks)
+    prev = np.arange(n * size, dtype=np.int32).reshape(n, size)
+    acting = prev.copy()
+    flags = np.zeros(n, np.int32)
+    mask_arr = np.zeros(n, np.uint32)
+    for i, mask in enumerate(masks):
+        for s in range(size):
+            if not (mask >> s) & 1:
+                acting[i, s] = ITEM_NONE
+        flags[i] = PG_STATE_DEGRADED
+        mask_arr[i] = mask
+    alive = (acting != ITEM_NONE).sum(axis=1).astype(np.int32)
+    return PeeringResult(
+        pool_id=1, epoch_prev=1, epoch_cur=2, size=size, min_size=k,
+        up=acting.copy(), up_primary=acting[:, 0].copy(),
+        acting=acting, acting_primary=acting[:, 0].copy(),
+        prev_acting=prev, flags=flags, survivor_mask=mask_arr,
+        n_alive=alive,
+    )
+
+
+def _plan_store(k, m_par, codec, chunk=97, seed=7):
+    masks = [0b001111, 0b110011, 0b011110]
+    plan = rec.build_plan(_synth_peering(k, m_par, masks), codec)
+    rng = np.random.default_rng(seed)
+    store = {}
+    for g in plan.groups:
+        for pg in g.pgs:
+            data = rng.integers(0, 256, (k, chunk), dtype=np.uint8)
+            store[int(pg)] = np.vstack([data, codec.encode(data)])
+    return plan, store
+
+
+def test_executor_worksteal_bit_equal_vs_static_sharded():
+    """The knob's differential contract: work-stealing ON recovers
+    bytes identical to both the static sharded path and the
+    single-device executor."""
+    k, m_par = 4, 2
+    codec = MatrixCodec(gf.vandermonde_matrix(k, m_par))
+    plan, store = _plan_store(k, m_par, codec)
+
+    def run(ws):
+        cfg = Config(env={})
+        cfg.set("recovery_shard_min_bytes", 0)
+        cfg.set("recovery_work_stealing", ws)
+        ex = rec.RecoveryExecutor(codec, config=cfg,
+                                  mesh=make_mesh(axis="bytes"))
+        return ex.run(plan, lambda pg, s: store[pg][s])
+
+    res = run("on")
+    assert res.worksteal_launches == res.launches == plan.n_patterns
+    assert res.sharded_launches == 0
+    static = run("off")
+    assert static.sharded_launches == static.launches
+    assert static.worksteal_launches == 0
+    base = rec.RecoveryExecutor(codec).run(plan, lambda pg, s: store[pg][s])
+    for other in (static, base):
+        assert sorted(res.shards) == sorted(other.shards)
+        for pg in other.shards:
+            for s in other.shards[pg]:
+                np.testing.assert_array_equal(
+                    res.shards[pg][s], other.shards[pg][s]
+                )
+
+
+def test_executor_auto_stays_static_on_cpu_host():
+    """'auto' keeps the CPU host tier on the static reference path —
+    virtual devices are not a real multi-chip mesh."""
+    k, m_par = 4, 2
+    codec = MatrixCodec(gf.vandermonde_matrix(k, m_par))
+    plan, store = _plan_store(k, m_par, codec)
+    cfg = Config(env={})
+    cfg.set("recovery_shard_min_bytes", 0)
+    ex = rec.RecoveryExecutor(codec, config=cfg,
+                              mesh=make_mesh(axis="bytes"))
+    assert ex._dispatcher is None
+    res = ex.run(plan, lambda pg, s: store[pg][s])
+    assert res.worksteal_launches == 0
+    assert res.sharded_launches == plan.n_patterns
+
+
+def test_executor_chipstall_acceptance():
+    """The PR's acceptance scenario: one chipstall chip on the
+    8-virtual-device mesh -> at least one conviction, a max idle
+    fraction strictly below the (gated) static counterfactual's, and
+    recovered bytes still exact."""
+    k, m_par = 4, 2
+    codec = MatrixCodec(gf.vandermonde_matrix(k, m_par))
+    plan, store = _plan_store(k, m_par, codec, chunk=997)
+    cfg = Config(env={})
+    cfg.set("recovery_shard_min_bytes", 0)
+    cfg.set("recovery_work_stealing", "on")
+    ex = rec.RecoveryExecutor(
+        codec, config=cfg, mesh=make_mesh(axis="bytes"),
+        chip_faults=[parse_spec("chipstall:2.0")], dispatch_seed=1,
+    )
+    res = ex.run(plan, lambda pg, s: store[pg][s])
+    assert res.chip_convictions >= 1
+    assert res.static_idle_fraction_per_chip == [1.0] * 8
+    assert max(res.idle_fraction_per_chip) < 1.0
+    base = rec.RecoveryExecutor(codec).run(plan, lambda pg, s: store[pg][s])
+    for pg in base.shards:
+        for s in base.shards[pg]:
+            np.testing.assert_array_equal(
+                res.shards[pg][s], base.shards[pg][s]
+            )
+
+
+def test_supervised_worksteal_chip_chaos_end_to_end():
+    """SupervisedRecovery with a chip-fault schedule stripped off a
+    chaos timeline: converges, counts convictions/steals in the
+    summary, and every recovered byte matches the source of truth."""
+    k, m_par = 4, 2
+    codec = MatrixCodec(gf.vandermonde_matrix(k, m_par))
+    tl = ChaosTimeline.from_pairs([(0.05, "chipstall:3.0")])
+    stripped, chip_specs = strip_chip_specs(tl)
+    assert not stripped.events()
+    m = build_osdmap(64, pg_num=32, size=k + m_par, pool_kind="erasure")
+    m_prev = copy.deepcopy(m)
+    rec.inject(m, "host:host0_1:down_out")
+    chaos = rec.ChaosEngine(m, stripped)
+    rng = np.random.default_rng(3)
+    store = {}
+
+    def read_shard(pg, s):
+        if pg not in store:
+            data = rng.integers(0, 256, (k, 64), dtype=np.uint8)
+            store[pg] = np.vstack([data, codec.encode(data)])
+        return store[pg][s]
+
+    cfg = Config(env={})
+    cfg.set("recovery_shard_min_bytes", 0)
+    cfg.set("recovery_work_stealing", "on")
+    sup = rec.SupervisedRecovery(
+        codec, chaos, config=cfg, mesh=make_mesh(axis="bytes"),
+        chip_faults=chip_specs, seed=5,
+    )
+    res = sup.run(m_prev, 1, read_shard)
+    assert res.converged and not res.failed_pgs
+    assert res.worksteal_launches > 0
+    assert res.chip_convictions >= 1
+    assert max(res.idle_fraction_per_chip) < 1.0
+    assert res.static_idle_fraction_per_chip == [1.0] * 8
+    summ = res.summary()
+    assert summ["worksteal_launches"] == res.worksteal_launches
+    assert summ["chip_convictions"] == res.chip_convictions
+    assert summ["stolen_subshards"] == res.stolen_subshards
+    assert summ["hedged_launches"] == res.hedged_launches
+    assert summ["hedge_wasted_bytes"] == res.hedge_wasted_bytes
+    for pg in res.completed_pgs:
+        for s, data in res.shards[pg].items():
+            np.testing.assert_array_equal(data, store[pg][s])
+
+
+# ---- deterministic co-schedule finalize order (satellite) ------------
+
+
+def test_finalize_order_key_is_content_not_insertion():
+    """The window finalize key is (pattern mask, PG set) — pure group
+    content, so any construction order sorts identically."""
+    import random
+    from types import SimpleNamespace
+
+    key = rec.SupervisedRecovery._finalize_order
+    fls = [
+        SimpleNamespace(group=SimpleNamespace(mask=mask, pgs=pgs))
+        for mask, pgs in [
+            (0b110011, (4, 9)), (0b001111, (7,)), (0b001111, (2, 5)),
+            (0b011110, (1,)), (0b110011, (0, 3)),
+        ]
+    ]
+    want = [key(fl) for fl in sorted(fls, key=key)]
+    assert want == sorted(want)
+    rng = random.Random(0)
+    for _ in range(5):
+        shuffled = list(fls)
+        rng.shuffle(shuffled)
+        assert [key(fl) for fl in sorted(shuffled, key=key)] == want
+    # masks order before PG sets; equal masks tie-break on PGs
+    assert want[0][0] <= want[-1][0]
+    assert want[0] == (0b001111, (2, 5))
+
+
+def test_supervised_windows_finalize_in_sorted_order():
+    """Every co-schedule window finalizes in ascending (mask, PG-set)
+    order, whatever order the scheduler dispatched it in — the
+    dict-insertion dependence is gone."""
+    k, m_par = 4, 2
+    codec = MatrixCodec(gf.vandermonde_matrix(k, m_par))
+    m = build_osdmap(64, pg_num=32, size=k + m_par, pool_kind="erasure")
+    m_prev = copy.deepcopy(m)
+    rec.inject(m, "host:host0_1:down_out")
+    chaos = rec.ChaosEngine(m)
+    rng = np.random.default_rng(3)
+    store = {}
+
+    def read_shard(pg, s):
+        if pg not in store:
+            data = rng.integers(0, 256, (k, 64), dtype=np.uint8)
+            store[pg] = np.vstack([data, codec.encode(data)])
+        return store[pg][s]
+
+    def key(g):
+        return (int(g.mask), tuple(int(p) for p in g.pgs))
+
+    trace = []  # ("launch"|"final", key) in wall order
+    cfg = Config(env={})
+    cfg.set("recovery_shard_min_bytes", 1 << 40)  # the window path
+    sup = rec.SupervisedRecovery(
+        codec, chaos, config=cfg, mesh=make_mesh(axis="bytes"),
+        on_decode_launch=lambda g, n: trace.append(("launch", key(g))),
+    )
+    orig = sup.ex._finalize_group
+
+    def spy(fl, result):
+        trace.append(("final", key(fl.group)))
+        return orig(fl, result)
+
+    sup.ex._finalize_group = spy
+    res = sup.run(m_prev, 1, read_shard)
+    assert res.converged and res.coscheduled_windows >= 1
+    # a maximal run of "final" records is one window's commit order
+    windows, launches, cur = [], [], []
+    for kind, gk in trace:
+        if kind == "final":
+            cur.append(gk)
+        else:
+            if cur:
+                windows.append(cur)
+                cur = []
+            launches.append(gk)
+    if cur:
+        windows.append(cur)
+    assert launches and windows
+    assert any(len(w) > 1 for w in windows)
+    for w in windows:
+        assert w == sorted(w), w
+
+
+# ---- two-process (DCN-analog) tier -----------------------------------
+
+
+_CHILD_ALL_STALLED = r"""
+import json, sys
+import numpy as np
+from ceph_tpu.parallel import multihost
+
+rank = int(sys.argv[1])
+multihost.init(coordinator=sys.argv[2], num_processes=2, process_id=rank)
+import jax
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.ec import gf
+from ceph_tpu.ec.backend import MatrixCodec
+from ceph_tpu.parallel.placement import make_mesh
+from ceph_tpu import recovery as rec
+from ceph_tpu.recovery.failure import parse_spec
+
+mesh = multihost.global_mesh(axis="bytes")
+codec = MatrixCodec(gf.vandermonde_matrix(4, 2))
+from ceph_tpu.crush.map import ITEM_NONE
+from ceph_tpu.recovery.peering import PG_STATE_DEGRADED, PeeringResult
+
+size, n = 6, 2
+prev = np.arange(n * size, dtype=np.int32).reshape(n, size)
+acting = prev.copy()
+masks = [0b001111, 0b110011]
+mask_arr = np.zeros(n, np.uint32)
+for i, mask in enumerate(masks):
+    for s in range(size):
+        if not (mask >> s) & 1:
+            acting[i, s] = ITEM_NONE
+    mask_arr[i] = mask
+peering = PeeringResult(
+    pool_id=1, epoch_prev=1, epoch_cur=2, size=size, min_size=4,
+    up=acting.copy(), up_primary=acting[:, 0].copy(),
+    acting=acting, acting_primary=acting[:, 0].copy(),
+    prev_acting=prev,
+    flags=np.full(n, PG_STATE_DEGRADED, np.int32),
+    survivor_mask=mask_arr,
+    n_alive=(acting != ITEM_NONE).sum(axis=1).astype(np.int32),
+)
+plan = rec.build_plan(peering, codec)
+rng = np.random.default_rng(7)
+store = {}
+for g in plan.groups:
+    for pg in g.pgs:
+        data = rng.integers(0, 256, (4, 97), dtype=np.uint8)
+        store[int(pg)] = np.vstack([data, codec.encode(data)])
+
+# ALL 8 global chips stall: each rank's local dispatcher convicts its
+# 4 local chips and raises the typed error -- there is no collective
+# in the work-stealing path, so neither rank can hang on the other
+cfg = Config(env={})
+cfg.set("recovery_shard_min_bytes", 0)
+cfg.set("recovery_work_stealing", "on")
+ex = rec.RecoveryExecutor(
+    codec, config=cfg, mesh=mesh,
+    chip_faults=[parse_spec(f"chipstall:{c}.0") for c in range(8)],
+)
+try:
+    ex.run(plan, lambda pg, s: store[pg][s])
+    out = {"rank": rank, "error": None}
+except rec.ChipLostError as e:
+    out = {"rank": rank, "error": "ChipLostError", "chips": e.chips}
+print("CHILD_RESULT " + json.dumps(out), flush=True)
+"""
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_pair(child_src: str) -> dict:
+    from ceph_tpu.common.hermetic import scrubbed_env
+
+    coord = f"127.0.0.1:{_free_port()}"
+    env = scrubbed_env(_REPO, n_devices=4)
+    import tempfile
+
+    outs = []
+    with tempfile.TemporaryDirectory() as td:
+        files = [open(os.path.join(td, f"r{r}.out"), "w+") for r in (0, 1)]
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", child_src, str(rank), coord],
+                env=env,
+                cwd=_REPO,
+                stdout=files[rank],
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+            for rank in range(2)
+        ]
+        rcs = []
+        try:
+            for p in procs:
+                rcs.append(p.wait(timeout=300))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            for f in files:
+                f.seek(0)
+                outs.append(f.read())
+                f.close()
+            if rcs != [0, 0]:
+                print("child logs:\n" + "\n".join(o[-2000:] for o in outs))
+        assert rcs == [0, 0], f"children failed {rcs}"
+
+    recs = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("CHILD_RESULT "):
+                d = json.loads(line[len("CHILD_RESULT "):])
+                recs[d["rank"]] = d
+    assert set(recs) == {0, 1}
+    return recs
+
+
+@pytest.mark.slow
+def test_two_process_all_chips_stalled_typed_error_no_hang():
+    """Every chip on the two-process global mesh stalls: BOTH ranks
+    get the typed ChipLostError naming their local chips — the
+    dispatcher has no collective, so a dead mesh can never become a
+    cross-host hang (the 300s harness timeout is the proof)."""
+    recs = _run_pair(_CHILD_ALL_STALLED)
+    for r in (0, 1):
+        assert recs[r]["error"] == "ChipLostError", recs[r]
+        # each rank convicts its 4 LOCAL chips (global flat ids)
+        assert len(recs[r]["chips"]) == 4
+    assert recs[0]["chips"] != recs[1]["chips"]
+    assert sorted(recs[0]["chips"] + recs[1]["chips"]) == list(range(8))

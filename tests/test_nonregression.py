@@ -45,6 +45,7 @@ def test_hot_paths_compile_once():
         "pool_mapping", "pattern_decode", "schedule_decode", "scrub_pass",
         "heartbeat_tick", "fused_placement", "epoch_superstep",
         "fleet_superstep", "online_write_batch", "reconcile_round",
+        "worksteal_dispatch",
     }
     # the superstep's contract: the second scan window syncs NOTHING
     # to host (the staged path's per-epoch device_gets are the cost it
@@ -54,6 +55,10 @@ def test_hot_paths_compile_once():
     assert report["fleet_superstep"]["in_scan_host_transfers"] == 0
     assert report["online_write_batch"]["in_scan_host_transfers"] == 0
     assert report["reconcile_round"]["in_round_host_transfers"] == 0
+    # the dispatcher's drain loop never syncs to host: sub-shard
+    # scheduling is pure host bookkeeping over async device launches,
+    # and materialization (result()) is the one seam outside it
+    assert report["worksteal_dispatch"]["in_window_host_transfers"] == 0
     for name, counts in report.items():
         assert counts["warm_compiles"] > 0, (name, counts)
         assert counts["second_compiles"] == 0
