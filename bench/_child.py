@@ -10,13 +10,54 @@ blocked inside a C extension call (a hung TPU attach) never sees the
 signal — and that is the desired outcome: it gets ORPHANED, not killed,
 because a hung attach left alone self-resolves in ~25-45 min whereas a
 kill converts it into an hours-long wedge.
+
+BENCH_r05 recorded the gap in that discipline: a child whose SIGINT
+unwind itself hung burned the grace and lost its measurement.  Benches
+now call :func:`install_sigint_flush` so SIGINT emits the partial
+record and exits promptly, and the parent escalates exactly one step —
+SIGINT then SIGTERM, the escalation noted in the result tail, and
+never, under any timeout, SIGKILL.
 """
 
 from __future__ import annotations
 
+import json
 import signal
 import subprocess
 import sys
+
+
+def install_sigint_flush(partial: dict) -> None:
+    """Child-side half of the timeout handshake (BENCH_r05 fix: the
+    ``pid did not exit on SIGINT after 420s+20s`` hang).
+
+    A bench that measured for minutes and then catches the parent's
+    SIGINT mid-sweep used to die through KeyboardInterrupt unwinding —
+    JAX teardown along that path can block, the grace expires, and the
+    measurement is lost with the orphan.  Instead the bench registers
+    the mutable record dict it fills as it goes; on SIGINT this
+    handler emits it as one JSON line stamped ``status:
+    "interrupted"`` (run_all's salvage path reads it like a timeout
+    record), flushes both pipes so the parent's ``communicate`` sees
+    the bytes, and exits promptly through SystemExit(130) — the
+    conventional 128+SIGINT code — without re-entering the bench
+    frame that was interrupted.
+    """
+
+    def _flush_and_exit(signum, frame):
+        try:
+            rec = dict(partial)
+            rec.setdefault("status", "interrupted")
+            print(json.dumps(rec), flush=True)
+        except Exception:
+            pass
+        try:
+            sys.stderr.flush()
+        except Exception:
+            pass
+        sys.exit(130)
+
+    signal.signal(signal.SIGINT, _flush_and_exit)
 
 
 def communicate_no_kill(
@@ -24,13 +65,20 @@ def communicate_no_kill(
     timeout_s: float,
     grace_s: float = 20.0,
     label: str = "child",
+    term_grace_s: float = 10.0,
 ) -> tuple[str, str, bool]:
     """``proc.communicate`` with the no-kill timeout discipline.
 
     Returns ``(stdout, stderr, timed_out)``.  On timeout the child gets
-    SIGINT and ``grace_s`` to exit cleanly; if it is still alive after
-    that (blocked in a C-level attach), it is left running — NEVER
-    SIGKILLed — and empty output is returned.
+    SIGINT and ``grace_s`` to exit cleanly (a bench that called
+    :func:`install_sigint_flush` flushes its partial record here); if
+    it is still alive after that, SIGTERM and ``term_grace_s`` more —
+    the one escalation step that is still safe, because SIGTERM is
+    deliverable to a child stuck unwinding Python frames while NEVER
+    being SIGKILL (the proven tunnel-wedge).  A child that survives
+    both (blocked in a C-level attach) is left running — orphaned, not
+    killed — and whatever partial output the pipes carried is
+    returned, with the escalation noted in the stderr tail either way.
     """
     try:
         stdout, stderr = proc.communicate(timeout=timeout_s)
@@ -44,10 +92,28 @@ def communicate_no_kill(
     try:
         stdout, stderr = proc.communicate(timeout=grace_s)
         return stdout or "", stderr or "", True
+    except subprocess.TimeoutExpired:
+        pass
+    # escalate once: SIGINT was swallowed (or the unwind hung), so try
+    # SIGTERM — still a catchable, finalizer-friendly signal, never
+    # SIGKILL — and note the escalation in the result tail so the
+    # harvested record shows HOW the child died
+    note = (
+        f"{label}: pid {proc.pid} did not exit on SIGINT after "
+        f"{timeout_s:.0f}s+{grace_s:.0f}s; escalating to SIGTERM"
+    )
+    print(note, file=sys.stderr, flush=True)
+    try:
+        proc.send_signal(signal.SIGTERM)
+    except ProcessLookupError:
+        pass
+    try:
+        stdout, stderr = proc.communicate(timeout=term_grace_s)
+        return stdout or "", (stderr or "") + "\n" + note, True
     except subprocess.TimeoutExpired as e:
         print(
-            f"{label}: pid {proc.pid} did not exit on SIGINT after "
-            f"{timeout_s:.0f}s+{grace_s:.0f}s; leaving it attached — "
+            f"{label}: pid {proc.pid} survived SIGTERM after "
+            f"{term_grace_s:.0f}s more; leaving it attached — "
             "never SIGKILL a TPU-attached process (it wedges the tunnel)",
             file=sys.stderr,
             flush=True,
@@ -57,7 +123,7 @@ def communicate_no_kill(
         # carries the partial output — as bytes even with text=True
         out, err = _decode(e.stdout), _decode(e.stderr)
         _detach(proc)
-        return out, err, True
+        return out, err + "\n" + note + " -> SIGTERM (orphaned)", True
 
 
 def _detach(proc: subprocess.Popen) -> None:

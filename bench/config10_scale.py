@@ -1,0 +1,344 @@
+"""BASELINE config 10: production scale — dirty-lane compaction sweep.
+
+The 10k-OSD / 100k-PG production geometry as a recorded bench line.
+Each grid cell builds the same dirty-set size walk (epoch ``j`` downs
+a batch of ``2**j`` OSDs with a quiet epoch between batches, so one
+compiled scan crosses every compaction-ladder rung) and times the
+compacted superstep (``sparse_dirty_compaction=on``) against the
+dense reference (``off``) on identical timelines.  Per cell the
+record keeps both rates, the ratio, state bytes per OSD, the dirty
+fraction of the walk, the ladder the geometry produced, bit-equality
+of the pulled series, and a compile-once guard: after warmup the
+whole walk — every rung, every dirty-set size — must re-run with
+zero fresh compiles and zero host transfers (``debug_bucket_checks``
+stays on for the compacted driver the entire time).
+
+The fleet leg is the decisive one: at the config8 geometry (256
+lanes, ssd-burst) a dense fleet peers **all** lanes whenever any lane
+is dirty — the union-dirty residual recorded there as the 0.57x
+vs-warm-sequential line.  The compacted fleet gathers only the dirty
+lane bucket through the same ladder, so ``fleet_compacted_speedup``
+(compacted rate / dense rate, same timelines, warm-timed) must beat
+1.0 — and ``fleet_vs_seq_warm`` shows where the 0.57x residual moved.
+
+Single-cluster honesty note: on CPU the per-call cost of the fused
+peer is dominated by the CRUSH weight-pack transform, which is
+O(n_osds) regardless of how many PGs are peered, so the per-cell
+``compacted_vs_dense`` ratio can sit near 1.0 even though the ladder
+provably peers 32 PGs instead of 100k.  PERF_MODEL.md's
+compaction-roofline section derives the crossover; the fleet leg is
+where the win is structural rather than backend-dependent.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+#: the scale grid, "osds:pgs" cells — headline is the LAST cell
+GRID = os.environ.get(
+    "CEPH_TPU_BENCH_SCALE_GRID", "1000:8192,4000:32768,10000:100000"
+)
+EPOCHS = int(os.environ.get("CEPH_TPU_BENCH_SCALE_EPOCHS", 48))
+N_OPS = int(os.environ.get("CEPH_TPU_BENCH_SCALE_OPS", 32))
+SEED = int(os.environ.get("CEPH_TPU_BENCH_SCALE_SEED", 0))
+#: fleet leg: config8 geometry so the 0.57x union-dirty residual
+#: recorded there is directly comparable
+FLEET = int(os.environ.get("CEPH_TPU_BENCH_SCALE_FLEET", 256))
+FLEET_OSDS = int(os.environ.get("CEPH_TPU_BENCH_SCALE_FLEET_OSDS", 32))
+FLEET_PGS = int(os.environ.get("CEPH_TPU_BENCH_SCALE_FLEET_PGS", 16))
+FLEET_EPOCHS = int(
+    os.environ.get("CEPH_TPU_BENCH_SCALE_FLEET_EPOCHS", 256)
+)
+FLEET_SCENARIO = os.environ.get(
+    "CEPH_TPU_BENCH_SCALE_FLEET_SCENARIO", "ssd-burst"
+)
+SEQ = int(os.environ.get("CEPH_TPU_BENCH_SCALE_SEQ", 2))
+EC_K, EC_M = 4, 2
+
+
+def walk_pairs(n_osds: int, dt: float = 0.25):
+    """The dirty-set size walk: batches of 1, 2, 4, ... OSDs go down,
+    one batch per event with a quiet epoch between.  Doubling batch
+    sizes cross every ladder rung inside ONE compiled scan — the
+    shape the compile-once guard pins.  Two caps keep the walk honest
+    about what it measures: an eighth of the cluster (beyond that
+    CRUSH's rejection sampling — not the ladder — dominates the
+    epoch), and 64 OSDs per batch (the event tape pads EVERY epoch's
+    apply stage to the largest batch in the timeline, and 64 downed
+    OSDs already dirty ~size × pg_num/n_osds × 64 PGs — past the top
+    rung at every grid cell).  Returns (t, [specs]) pairs so each
+    driver gets its own (consumable) ChaosTimeline built from the
+    same schedule."""
+    pairs, start, batch, t = [], 0, 1, 0.1
+    while start + batch <= min(max(2, n_osds // 8), 127):
+        pairs.append(
+            (t, [f"osd:{i}" for i in range(start, start + batch)])
+        )
+        start += batch
+        batch *= 2
+        t += 2 * dt
+    return pairs
+
+
+def build_scale_record(platform, cells, fleet, n_compiles,
+                       n_compiles_first, host_transfers):
+    """One JSON line for the production-scale headline.
+
+    ``value`` is the compacted epoch rate of the LAST (largest) grid
+    cell; ``vs_baseline`` divides by the dense rate on the same cell.
+    The ``scale_*`` / ``fleet_compacted_*`` fields are the
+    ``decide_defaults`` harvest surface; ``scale_grid`` keeps every
+    cell for the status CLI.  ``status`` is ``"ok"`` for a completed
+    measurement (run_all stamps ``"timeout"`` on salvage).
+    """
+    head = cells[-1]
+    rec = {
+        "metric": "scale_epoch_rate_per_sec",
+        "status": "ok",
+        "value": round(head["rate_on"], 1),
+        "unit": "epochs/s",
+        "vs_baseline": round(head["rate_on"] / head["rate_off"], 3)
+        if head["rate_off"] else 0.0,
+        "platform": platform,
+        "scale_n_osds": int(head["n_osds"]),
+        "scale_pg_num": int(head["pg_num"]),
+        "scale_n_epochs": int(EPOCHS),
+        "scale_epoch_rate_per_sec": round(head["rate_on"], 2),
+        "scale_epoch_rate_dense_per_sec": round(head["rate_off"], 2),
+        "scale_compacted_vs_dense": round(
+            head["rate_on"] / head["rate_off"], 3
+        ) if head["rate_off"] else 0.0,
+        "scale_hbm_bytes_per_osd": round(head["hbm_bytes_per_osd"], 1),
+        "scale_dirty_fraction": round(head["dirty_fraction"], 4),
+        "scale_ladder": head["ladder"],
+        "scale_scenario": "dirty-walk",
+        "scale_bitequal": all(c["bitequal"] for c in cells),
+        "scale_zero_recompile_walk": all(
+            c["zero_recompile_walk"] for c in cells
+        ),
+        "scale_grid": cells,
+        "scale_fleet_n_clusters": int(FLEET),
+        "fleet_compacted_speedup": round(fleet["speedup"], 3),
+        "fleet_compacted_rate_per_sec": round(fleet["rate_on"], 1),
+        "fleet_dense_rate_per_sec": round(fleet["rate_off"], 1),
+        "fleet_vs_seq_warm": round(fleet["vs_seq_warm"], 3),
+        "fleet_bitequal": bool(fleet["bitequal"]),
+        "n_compiles": int(n_compiles),
+        "n_compiles_first": int(n_compiles_first),
+        "host_transfers": int(host_transfers),
+    }
+    return rec
+
+
+def main() -> None:
+    import argparse
+
+    global GRID, EPOCHS, FLEET, FLEET_EPOCHS, SEQ
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="CI leg: one small cell + a small fleet, same guards",
+    )
+    ap.add_argument(
+        "--grid", default=None,
+        help="override the osds:pgs sweep cells (comma separated)",
+    )
+    args = ap.parse_args()
+    if args.smoke:
+        GRID = os.environ.get(
+            "CEPH_TPU_BENCH_SCALE_SMOKE_GRID", "256:512"
+        )
+        EPOCHS = min(EPOCHS, 24)
+        FLEET = 16
+        FLEET_EPOCHS = 64
+        SEQ = 1
+    if args.grid:
+        GRID = args.grid
+
+    # partial record: SIGINT mid-sweep flushes what's measured so far
+    # (BENCH_r05 discipline — see bench/_child.py)
+    from _child import install_sigint_flush
+
+    partial = {
+        "metric": "scale_epoch_rate_per_sec",
+        "status": "interrupted",
+        "scale_grid": [],
+    }
+    install_sigint_flush(partial)
+
+    from ceph_tpu.common.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    import jax
+    import numpy as np
+
+    from ceph_tpu.analysis.runtime_guard import track
+    from ceph_tpu.common.config import Config
+    from ceph_tpu.models.clusters import build_osdmap
+    from ceph_tpu.recovery.chaos import ChaosTimeline
+    from ceph_tpu.recovery.fleet import FleetDriver
+    from ceph_tpu.recovery.superstep import EpochDriver
+    from ceph_tpu.workload.traffic import dirty_fraction
+
+    def state_bytes(state) -> int:
+        return sum(
+            a.nbytes for a in jax.tree_util.tree_leaves(state)
+        )
+
+    # -- the scale grid ------------------------------------------------
+    cells = partial["scale_grid"]  # same list: SIGINT sees every
+    # cell completed so far
+    n_compiles_first = 0
+    n_compiles_steady = 0
+    host_transfers_steady = 0
+    for cell in GRID.split(","):
+        n_osds, pg_num = (int(x) for x in cell.strip().split(":"))
+        t_cell = time.perf_counter()
+        m = build_osdmap(
+            n_osds, pg_num=pg_num, size=EC_K + EC_M,
+            pool_kind="erasure",
+        )
+        pairs = walk_pairs(n_osds)
+
+        cfg_on = Config(env={})
+        cfg_on.set("sparse_dirty_compaction", "on")
+        cfg_on.set("debug_bucket_checks", True)
+        cfg_off = Config(env={})
+        cfg_off.set("sparse_dirty_compaction", "off")
+
+        d_on = EpochDriver(
+            m, ChaosTimeline.from_pairs(pairs), seed=SEED,
+            n_ops=N_OPS, config=cfg_on,
+        )
+        d_off = EpochDriver(
+            m, ChaosTimeline.from_pairs(pairs), seed=SEED,
+            n_ops=N_OPS, config=cfg_off,
+        )
+        print(
+            f"cell {n_osds}:{pg_num}: drivers built "
+            f"(ladder {d_on._dirty_ladder}) "
+            f"in {time.perf_counter() - t_cell:.1f}s",
+            file=sys.stderr,
+        )
+
+        # warm both paths; the pulled series double as the
+        # bit-equality references and the dirty-fraction source
+        with track() as first:
+            s_on = d_on.run_superstep(EPOCHS)
+        n_compiles_first += first.n_compiles
+        s_off = d_off.run_superstep(EPOCHS)
+        diff = s_on.diff(s_off)
+        if diff:
+            print(
+                f"BITEQUAL FAIL {n_osds}:{pg_num}: {diff}",
+                file=sys.stderr,
+            )
+
+        # steady state, timed device-resident — and guarded: the walk
+        # crosses every rung, so zero compiles here is the claim that
+        # dirty-set SIZE is a value, never a shape
+        with track() as guard:
+            t0 = time.perf_counter()
+            state, rows = d_on.run_superstep(EPOCHS, pull=False)
+            jax.block_until_ready(rows)
+            dt_on = time.perf_counter() - t0
+        zero_walk = (
+            guard.n_compiles == 0 and guard.host_transfers == 0
+        )
+        n_compiles_steady += guard.n_compiles
+        host_transfers_steady += guard.host_transfers
+
+        t0 = time.perf_counter()
+        _, rows_off = d_off.run_superstep(EPOCHS, pull=False)
+        jax.block_until_ready(rows_off)
+        dt_off = time.perf_counter() - t0
+
+        cells.append({
+            "n_osds": n_osds,
+            "pg_num": pg_num,
+            "rate_on": EPOCHS / dt_on,
+            "rate_off": EPOCHS / dt_off,
+            "bitequal": not diff,
+            "zero_recompile_walk": bool(zero_walk),
+            "hbm_bytes_per_osd": state_bytes(state) / n_osds,
+            "dirty_fraction": dirty_fraction(s_on),
+            "ladder": ",".join(str(w) for w in d_on._dirty_ladder),
+        })
+        c = cells[-1]
+        print(
+            f"cell {n_osds}:{pg_num}: compacted "
+            f"{c['rate_on']:.1f} ep/s, dense {c['rate_off']:.1f}, "
+            f"dirty_fraction={c['dirty_fraction']:.3f}, "
+            f"{c['hbm_bytes_per_osd']:.0f} B/OSD, "
+            f"bitequal={'ok' if c['bitequal'] else 'FAIL'}, "
+            f"zero_recompile_walk="
+            f"{'ok' if c['zero_recompile_walk'] else 'FAIL'}",
+            file=sys.stderr,
+        )
+
+    # -- fleet leg: the union-dirty residual, compacted ---------------
+    fm = build_osdmap(
+        FLEET_OSDS, pg_num=FLEET_PGS, size=EC_K + EC_M,
+        pool_kind="erasure",
+    )
+
+    def fleet_rate(mode):
+        cfg = Config(env={})
+        cfg.set("sparse_dirty_compaction", mode)
+        fd = FleetDriver(fm, seed=SEED, n_ops=N_OPS, config=cfg)
+        tls = fd.sample(FLEET, FLEET_SCENARIO)
+        state, rows = fd.run_fleet(FLEET_EPOCHS, tls, pull=False)
+        jax.block_until_ready(state)
+        t0 = time.perf_counter()
+        state, rows = fd.run_fleet(FLEET_EPOCHS, tls, pull=False)
+        jax.block_until_ready(rows)
+        return FLEET * FLEET_EPOCHS / (time.perf_counter() - t0), \
+            rows, fd, tls
+
+    r_on, rows_on, fd_on, tls = fleet_rate("on")
+    r_off, rows_off, fd_off, _ = fleet_rate("off")
+    fleet_bitequal = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(rows_on),
+            jax.tree_util.tree_leaves(rows_off),
+        )
+    )
+    # the config8 0.57x line: fleet rate over the warm one-lane scan
+    fd_off.run_sequential(FLEET_EPOCHS, tls[:SEQ])
+    t0 = time.perf_counter()
+    fd_off.run_sequential(FLEET_EPOCHS, tls[:SEQ])
+    seq_warm = SEQ * FLEET_EPOCHS / (time.perf_counter() - t0)
+    fleet = {
+        "speedup": r_on / r_off if r_off else 0.0,
+        "rate_on": r_on,
+        "rate_off": r_off,
+        "vs_seq_warm": r_on / seq_warm if seq_warm else 0.0,
+        "bitequal": fleet_bitequal,
+    }
+    print(
+        f"fleet {FLEET_SCENARIO}: {FLEET} lanes x {FLEET_EPOCHS} "
+        f"epochs: compacted {r_on:.0f} cluster-epochs/s, dense "
+        f"{r_off:.0f} (-> {fleet['speedup']:.2f}x), vs seq warm "
+        f"{fleet['vs_seq_warm']:.2f}x, "
+        f"bitequal={'ok' if fleet_bitequal else 'FAIL'}",
+        file=sys.stderr,
+    )
+
+    # n_compiles is cumulative (warmup + steady walk) so the harvest's
+    # ``steady_state_clean`` (n_compiles == n_compiles_first) reads
+    # "the walk added nothing after warmup"
+    print(json.dumps(build_scale_record(
+        jax.default_backend(), cells, fleet,
+        n_compiles_first + n_compiles_steady,
+        n_compiles_first, host_transfers_steady,
+    )))
+
+
+if __name__ == "__main__":
+    main()

@@ -301,6 +301,31 @@ WRITEPATH_FLOAT_FIELDS = ("writepath_hit_rate",)
 WRITEPATH_BOOL_FIELDS = ("writepath_bitequal",)
 WRITEPATH_STR_FIELDS = ("writepath_scenario", "writepath_families")
 
+# config10_scale.py (PR 19): the production-scale sweep — compacted
+# (dirty-set ladder) vs dense epoch rates at the 10k-OSD / 100k-PG
+# headline cell, state bytes per OSD, and the decisive fleet metric:
+# ``fleet_compacted_speedup`` is the compacted 256-lane fleet over the
+# dense one on identical timelines, and must stay above 1.0 (the
+# union-dirty residual config8 recorded at 0.57x vs warm sequential).
+# ``scale_bitequal`` gates everything — the ladder is an execution
+# strategy, never a different answer — and
+# ``scale_zero_recompile_walk`` pins that a dirty-set size walk
+# crossing every rung re-runs with zero compiles and zero host
+# transfers after warmup.
+SCALE_INT_FIELDS = ("scale_n_osds", "scale_pg_num", "scale_n_epochs",
+                    "scale_fleet_n_clusters")
+SCALE_FLOAT_FIELDS = ("scale_epoch_rate_per_sec",
+                      "scale_epoch_rate_dense_per_sec",
+                      "scale_compacted_vs_dense",
+                      "scale_hbm_bytes_per_osd",
+                      "scale_dirty_fraction",
+                      "fleet_compacted_speedup",
+                      "fleet_compacted_rate_per_sec",
+                      "fleet_dense_rate_per_sec",
+                      "fleet_vs_seq_warm")
+SCALE_BOOL_FIELDS = ("scale_bitequal", "scale_zero_recompile_walk")
+SCALE_STR_FIELDS = ("scale_ladder", "scale_scenario")
+
 
 def harvest_aux(paths: list[str]) -> dict[str, int]:
     """Collect auxiliary metric -> best value from the logs.
@@ -496,6 +521,20 @@ def harvest_guard(paths: list[str]) -> dict[str, dict]:
             )
             fields.update(
                 {f: str(d[f]) for f in WRITEPATH_STR_FIELDS if f in d}
+            )
+            fields.update(
+                {f: int(d[f]) for f in SCALE_INT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: float(d[f])
+                 for f in SCALE_FLOAT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: bool(d[f])
+                 for f in SCALE_BOOL_FIELDS if f in d}
+            )
+            fields.update(
+                {f: str(d[f]) for f in SCALE_STR_FIELDS if f in d}
             )
             # jaxlint per-rule counters (lint_active, lint_J007_active,
             # ...): dynamic key set — one field per registered rule, so

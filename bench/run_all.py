@@ -48,6 +48,7 @@ CONFIGS = [
     ("config8_fleet", "bench/config8_fleet.py"),
     ("config9_checkpoint", "bench/config9_checkpoint.py"),
     ("config10_online_ec", "bench/config10_online_ec.py"),
+    ("config10_scale", "bench/config10_scale.py"),
     ("tpu_tier", "bench/tpu_tier.py"),
 ]
 

@@ -125,6 +125,8 @@ def _run_chaos(args, m, m_prev, pool_id, out) -> int:
         cfg.set("recovery_max_bytes_per_sec", args.max_bytes_per_sec)
     if args.shard_min_bytes is not None:
         cfg.set("recovery_shard_min_bytes", args.shard_min_bytes)
+    if args.dirty_compaction is not None:
+        cfg.set("sparse_dirty_compaction", args.dirty_compaction)
     chip_faults = list(stripped) + _worksteal_setup(args, cfg)
     rng = np.random.default_rng(0)
     chunks: dict[tuple[int, int], np.ndarray] = {}
@@ -234,6 +236,13 @@ def main(argv=None) -> int:
                    help="work-stealing sub-shard dispatch over the mesh "
                         "chips (recovery_work_stealing; default 'auto' "
                         "keeps the static sharded path on CPU hosts)")
+    p.add_argument("--dirty-compaction", choices=("auto", "on", "off"),
+                   default=None,
+                   help="dirty-set compaction for the epoch engines "
+                        "(sparse_dirty_compaction): peer/classify only "
+                        "the gathered dirty PG bucket instead of every "
+                        "PG; default 'auto' keeps small demo geometries "
+                        "on the dense reference path")
     p.add_argument("--chip-fault", action="append", metavar="SPEC",
                    default=[],
                    help="seeded dispatcher chip fault, repeatable "

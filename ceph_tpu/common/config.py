@@ -172,6 +172,29 @@ SCHEMA: list[Option] = [
            "only when every chip is convicted (never a hang)", min=1,
            see_also=("recovery_dispatch_hedge_factor",
                      "recovery_retry_max")),
+    Option("sparse_dirty_compaction", OPT_STR, "auto", LEVEL_ADVANCED,
+           "route peering, PG classification and pg_hist refolds "
+           "through the compacted dirty-set path (gather dirty lanes, "
+           "compute on a power-of-two bucket, scatter back) instead of "
+           "dense full-width launches: 'auto' enables it when the "
+           "geometry is large enough for the ladder to have at least "
+           "one rung below the dense width; 'on' forces it everywhere "
+           "(tests/benches); 'off' pins the dense reference path",
+           enum_allowed=("auto", "on", "off"),
+           see_also=("sparse_min_bucket", "sparse_ladder_rungs")),
+    Option("sparse_min_bucket", OPT_INT, 32, LEVEL_ADVANCED,
+           "smallest power-of-two bucket width in the dirty-set "
+           "compaction ladder; dirty sets smaller than this still pay "
+           "for min_bucket lanes.  Every rung is compiled into the "
+           "one scanned program (lax.switch), so smaller buckets cost "
+           "compile time, not recompiles", min=1,
+           see_also=("sparse_dirty_compaction",)),
+    Option("sparse_ladder_rungs", OPT_INT, 4, LEVEL_ADVANCED,
+           "maximum number of compacted bucket widths below the dense "
+           "width (each 4x the last, starting at sparse_min_bucket); "
+           "the dense full-width branch is always appended as the "
+           "ladder's top rung and bit-equality reference", min=1,
+           see_also=("sparse_dirty_compaction", "sparse_min_bucket")),
     Option("debug_rank_checks", OPT_BOOL, False, LEVEL_ADVANCED,
            "cross-check a fingerprint of mesh-seam operands across "
            "ranks via a psum before every sharded decode/scrub/"

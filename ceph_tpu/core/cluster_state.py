@@ -510,3 +510,107 @@ def apply_incremental_fleet(fleet: ClusterState, incs) -> ClusterState:
     n_osds = int(fleet.pool.osd_weight.shape[-1])
     epochs, arrays, pads = fleet_incremental_arrays(incs, n_osds)
     return _apply_fleet_delta_fn(*pads)(fleet, epochs, *arrays)
+
+
+# ---------------------------------------------------------------------------
+# dirty-set compaction: gather -> compute-on-bucket -> scatter
+#
+# The dense epoch engines peer/classify every PG (and every fleet lane)
+# each dirty epoch even when a single OSD flap touched a handful of
+# PGs.  The compacted path packs the dirty indices to the front of a
+# fixed-width power-of-two bucket, runs the per-row kernels on the
+# bucket only, and scatters results back with drop-mode OOB sentinels —
+# the same bucketing discipline as the incremental-delta scatters
+# above, so dirty-set *size* never changes a jit signature (J013 clean
+# by construction).  Bucket widths form a small static ladder; a
+# ``lax.switch`` on the traced dirty count picks the narrowest rung
+# that fits, with the dense full-width path as the top rung (the
+# bit-equality reference and the graceful-degradation fallback).
+
+
+def compact_dirty_indices(dirty):
+    """Stable-compact a boolean dirty mask into front-packed indices.
+
+    Returns ``(take, n_dirty)`` where ``take`` is a length-``n`` i32
+    vector whose first ``n_dirty`` entries are the dirty row indices in
+    ascending order and whose remaining entries are the out-of-range
+    sentinel ``n`` — so ``take[:W]`` feeds a clamped gather and a
+    drop-mode scatter without any extra masking for the pad slots.
+    Pure device arithmetic (one cumsum + one scatter); safe under jit
+    and ``lax.scan``."""
+    n = dirty.shape[0]
+    flag = dirty.astype(I32)
+    pos = jnp.cumsum(flag) - 1
+    take = jnp.full((n,), n, I32).at[
+        jnp.where(dirty, pos, n)
+    ].set(jnp.arange(n, dtype=I32), mode="drop")
+    return take, jnp.sum(flag)
+
+
+def dirty_ladder(
+    total: int, *, min_bucket: int = 32, growth: int = 4,
+    max_rungs: int = 4,
+) -> tuple[int, ...]:
+    """Static compacted bucket widths strictly below ``total``.
+
+    Each rung is the power-of-two bucket (:func:`_pad_to`) of the
+    previous rung scaled by ``growth``, starting from ``min_bucket``,
+    capped at ``max_rungs`` entries.  The dense full width is NOT
+    included — callers append their existing dense branch as the top
+    rung.  An empty tuple means the geometry is too small for
+    compaction to have any rung below dense (callers fall back to the
+    dense path).  Host-side ints only; widths are asserted
+    power-of-two under ``debug_bucket_checks``."""
+    widths: list[int] = []
+    w = _pad_to(max(1, int(min_bucket)))
+    while w < int(total) and len(widths) < int(max_rungs):
+        widths.append(w)
+        w = _pad_to(w * max(2, int(growth)))
+    from ..analysis import runtime_guard
+
+    if widths and runtime_guard.bucket_checks_enabled():
+        runtime_guard.assert_bucketed(
+            "cluster_state.dirty_ladder widths", *widths
+        )
+    return tuple(widths)
+
+
+def ladder_rung(n_dirty, widths: tuple[int, ...]):
+    """Traced ladder index for a traced dirty count: the narrowest
+    rung in ``widths`` that holds ``n_dirty`` rows, or ``len(widths)``
+    (the caller's dense branch) when none does.  The comparison runs on
+    device so the selection never forces a host transfer inside the
+    scanned epoch body."""
+    if not widths:
+        return jnp.int32(0)
+    return jnp.sum(n_dirty > jnp.asarray(widths, I32)).astype(I32)
+
+
+def gather_rows(table, take, width: int):
+    """Gather the first ``width`` compacted rows of ``table``.
+
+    ``width`` must be a static ladder rung (power of two from
+    :func:`dirty_ladder`); pad slots carry the sentinel index and clamp
+    to row ``n - 1`` — garbage rows that the matching
+    :func:`scatter_rows` drops on the way back."""
+    n = table.shape[0]
+    idx = jnp.clip(take[:width], 0, n - 1)
+    return table[idx]
+
+
+def scatter_rows(table, take, width: int, vals):
+    """Scatter ``width`` computed rows back to their dirty slots.
+
+    Pad slots of ``take`` hold the out-of-range sentinel ``n`` and are
+    dropped by the scatter, so clean rows keep their carried values
+    bit-for-bit; the dirty indices are unique by construction (one
+    cumsum slot each) so there are no duplicate-write races."""
+    return table.at[take[:width]].set(vals, mode="drop")
+
+
+def bucket_valid(n_dirty, width: int):
+    """Boolean validity mask for a compacted bucket: lane ``j`` holds a
+    real dirty row iff ``j < n_dirty``.  Needed only by reductions that
+    fold bucket lanes into scalars (e.g. pg_hist deltas) — plain
+    gather/scatter round-trips are already pad-safe via the sentinel."""
+    return jnp.arange(width, dtype=I32) < n_dirty

@@ -43,7 +43,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..core.cluster_state import _pad_to, stack_states
+from ..core.cluster_state import (
+    _pad_to,
+    compact_dirty_indices,
+    dirty_ladder,
+    ladder_rung,
+    stack_states,
+)
 from ..osdmap.map import OSDMap
 from .chaos import ChaosTimeline, build_scenario
 from .superstep import (
@@ -308,7 +314,22 @@ class FleetDriver:
         clean lanes' state untouched — the same select semantics the
         vmapped cond would have used, so every lane's values stay
         bit-equal to its own sequential run (asserted in
-        ``tests/test_fleet.py``)."""
+        ``tests/test_fleet.py``).
+
+        With ``sparse_dirty_compaction`` enabled the divergent-epoch
+        branch goes one step further: instead of peering *all* lanes
+        and select-discarding the clean ones (the recorded union-dirty
+        residual — a fleet epoch is dirty if ANY lane's map moved, so
+        most peered lanes are wasted work), the dirty lane indices
+        compact onto a static power-of-two lane-bucket ladder
+        (``lax.switch`` at scan level, never under vmap — a vmapped
+        switch would lower to select and run every rung), the vmapped
+        peering pass runs on the gathered bucket only, and results
+        scatter back with drop-mode sentinels.  The dense
+        ``peer_select`` stays as the ladder's top rung and the
+        bit-equality reference.  The per-lane PG peering inside each
+        lane stays dense here: the lane bodies are vmapped, and a
+        per-lane PG-ladder switch under vmap would run all rungs."""
         if self._fleet_scan is None:
             drv = self.driver
 
@@ -321,8 +342,52 @@ class FleetDriver:
                     peered, fstate,
                 )
 
+            sdc = drv._sparse_mode
+
             @jax.jit
             def scan_fn(fstate, steps, t, kind, osd, bump, salts):
+                # trace-time fleet pad for THIS shape bucket: the lane
+                # ladder starts at one lane (a single dirty cluster is
+                # the common divergent epoch) and is gated like the
+                # superstep's PG ladder — 'auto' needs a fleet wide
+                # enough for compaction to beat one fused dense launch
+                f_pad = int(fstate.epoch.shape[0])
+                lane_widths = (
+                    dirty_ladder(
+                        f_pad, min_bucket=1, growth=4,
+                        max_rungs=drv._sparse_rungs,
+                    )
+                    if sdc == "on" or (sdc == "auto" and f_pad >= 8)
+                    else ()
+                )
+
+                def lane_compact(op, W: int):
+                    fs, take, dirty = op
+                    idx = jnp.clip(take[:W], 0, f_pad - 1)
+                    sub = jax.tree_util.tree_map(
+                        lambda l: l[idx], fs
+                    )
+                    peered = jax.vmap(drv._peer_hist_fn)(sub)
+                    return jax.tree_util.tree_map(
+                        lambda l, p: l.at[take[:W]].set(
+                            p, mode="drop"
+                        ),
+                        fs, peered,
+                    )
+
+                lane_branches = [
+                    (lambda op, W=W: lane_compact(op, W))
+                    for W in lane_widths
+                ] + [lambda op: peer_select(op[0], op[2])]
+
+                def peer_dirty(fs, dirty):
+                    if not lane_widths:
+                        return peer_select(fs, dirty)
+                    take, n_dirty = compact_dirty_indices(dirty)
+                    return jax.lax.switch(
+                        ladder_rung(n_dirty, lane_widths),
+                        lane_branches, (fs, take, dirty),
+                    )
                 def lane_pre(st, ti, ki, oi, bi, step):
                     prev_now = st.now
                     st, tape_dirty = drv._tape_apply(
@@ -349,7 +414,7 @@ class FleetDriver:
                     )
                     carry = jax.lax.cond(
                         jnp.any(dirty),
-                        lambda s: peer_select(s, dirty),
+                        lambda s: peer_dirty(s, dirty),
                         lambda s: s,
                         carry,
                     )

@@ -65,7 +65,15 @@ import jax
 import jax.numpy as jnp
 
 from ..common.config import global_config
-from ..core.cluster_state import ClusterState
+from ..core.cluster_state import (
+    ClusterState,
+    bucket_valid,
+    compact_dirty_indices,
+    dirty_ladder,
+    gather_rows,
+    ladder_rung,
+    scatter_rows,
+)
 from ..crush.map import ITEM_NONE
 from ..osdmap.map import OSDMap
 from ..osdmap.mapping import build_pool_state
@@ -411,6 +419,29 @@ class EpochDriver:
         self._crush_arg = crush_arg
         self._fused = fused
         self._pg_idx = jnp.arange(self.pg_num, dtype=jnp.uint32)
+        # dirty-set compaction ladder, gated like recovery_work_stealing
+        # gates dispatch: 'on' forces the compacted peer/classify path
+        # wherever the geometry leaves a rung below dense, 'auto'
+        # enables it only when the dense width dwarfs the smallest
+        # bucket (small demos keep the dense single-launch shape),
+        # 'off' pins the dense reference.  An empty ladder always means
+        # dense — the switch degrades to the plain dirty cond.
+        sdc = str(cfg.get("sparse_dirty_compaction"))
+        self._sparse_mode = sdc
+        self._sparse_min_bucket = int(cfg.get("sparse_min_bucket"))
+        self._sparse_rungs = int(cfg.get("sparse_ladder_rungs"))
+        ladder = dirty_ladder(
+            self.pg_num,
+            min_bucket=self._sparse_min_bucket,
+            max_rungs=self._sparse_rungs,
+        )
+        if sdc == "off" or (
+            sdc == "auto"
+            and self.pg_num < 64 * self._sparse_min_bucket
+        ):
+            ladder = ()
+        self._dirty_ladder: tuple[int, ...] = ladder
+        self.compaction_enabled = bool(ladder)
         # previous-epoch reference for survivor classification: the
         # baseline (pre-chaos) placement, fixed for the run — the
         # executor's convention of diffing against the epoch the last
@@ -438,6 +469,11 @@ class EpochDriver:
             )
         hist, aux = self._hist_fn(init)
         self._init_state = replace(init, pg_hist=hist, pg_aux=aux)
+        if self._dirty_ladder:
+            # build the compacted branch eagerly, outside any trace:
+            # its closure constants must be concrete, and the first
+            # touch would otherwise happen inside the scanned cond
+            self._peer_hist_compact_fn
         self._scan_fn = None
 
     # -- the jitted pieces (shared verbatim by both drivers) -----------
@@ -727,6 +763,121 @@ class EpochDriver:
         self._peer_hist_fn_c = peer_hist_fn
         return peer_hist_fn
 
+    @property
+    def _peer_hist_compact_fn(self):
+        """The dirty branch routed through the dirty-set ladder:
+        ``(state, prev_up, prev_w) -> state``.
+
+        The predicate splits dirty epochs in two.  *Heavy* epochs (any
+        weight edit, or an OSD coming up) can re-rank CRUSH draws for
+        any PG, so every PG is dirty and the switch lands on the dense
+        top rung — the exact :attr:`_peer_hist_fn` computation.
+        *Down-flip-only* epochs can only change PGs whose candidate
+        sets contain a flipped OSD: the carried ``up``/``acting`` rows
+        plus the static ``pg_temp``/``primary_temp`` overrides are
+        exactly those sets (peering re-ran on every prior pool edit,
+        so the tables are in sync with the pool by construction).
+        Those PG indices compact onto the narrowest ladder rung that
+        fits (``lax.switch`` on a traced count — only the selected
+        branch executes inside the scan), peer on the bucket, scatter
+        the seven tables back (pad slots carry the OOB sentinel and
+        drop), and refold pg_hist/pg_aux by exact integer deltas:
+        ``_reduce`` over the bucket's old rows subtracted, over its new
+        rows added, with the pad lanes masked out of both."""
+        fn = getattr(self, "_peer_hist_compact_fn_c", None)
+        if fn is not None:
+            return fn
+        widths = self._dirty_ladder
+        if not widths:
+            raise RuntimeError(
+                "compacted peer path requested with an empty ladder "
+                "(sparse_dirty_compaction off or geometry too small)"
+            )
+        from ..obs.pg_states import _reduce
+
+        fused = self._fused
+        crush_arg = self._crush_arg
+        state_prev = self._state_prev
+        # host-side scalars (np, not jnp): this property may first be
+        # touched inside an active trace, where jnp constants would be
+        # staged as tracers and leak through the closure cache
+        min_size = np.int32(self.min_size)
+        k = np.int32(self.k)
+        size = np.int32(self.size)
+        pg_num = self.pg_num
+        peer_hist_dense = self._peer_hist_fn
+
+        def compact_branch(op, W: int):
+            state, take, n_dirty = op
+            idx = jnp.clip(take[:W], 0, pg_num - 1).astype(jnp.uint32)
+            (up, upp, acting, actp, _prev_acting, flags, mask,
+             n_alive) = fused(
+                crush_arg, state_prev, state.pool, idx, min_size
+            )
+            valid = bucket_valid(n_dirty, W)
+            old_hist, old_aux = _reduce(
+                gather_rows(state.survivor_mask, take, W),
+                gather_rows(state.n_alive, take, W),
+                gather_rows(state.flags, take, W),
+                k, size, valid,
+            )
+            new_hist, new_aux = _reduce(mask, n_alive, flags, k, size,
+                                        valid)
+            return replace(
+                state,
+                up=scatter_rows(state.up, take, W, up),
+                up_primary=scatter_rows(state.up_primary, take, W, upp),
+                acting=scatter_rows(state.acting, take, W, acting),
+                acting_primary=scatter_rows(
+                    state.acting_primary, take, W, actp
+                ),
+                flags=scatter_rows(state.flags, take, W, flags),
+                survivor_mask=scatter_rows(
+                    state.survivor_mask, take, W, mask
+                ),
+                n_alive=scatter_rows(state.n_alive, take, W, n_alive),
+                pg_hist=state.pg_hist + new_hist - old_hist,
+                pg_aux=state.pg_aux + new_aux - old_aux,
+            )
+
+        branches = [
+            (lambda op, W=W: compact_branch(op, W)) for W in widths
+        ] + [lambda op: peer_hist_dense(op[0])]
+
+        @jax.jit
+        def compact_fn(state: ClusterState, prev_up, prev_w):
+            cur_up = state.pool.osd_up
+            up_flip = prev_up ^ cur_up
+            heavy = (
+                jnp.any(prev_w != state.pool.osd_weight)
+                | jnp.any(up_flip & cur_up)
+            )
+            down_flip = up_flip & ~cur_up
+            flip_pad = jnp.concatenate(
+                [down_flip, jnp.zeros((1,), bool)]
+            )
+            n = down_flip.shape[0]
+
+            def member(tbl):
+                ids = jnp.where((tbl >= 0) & (tbl < n), tbl, n)
+                return jnp.any(flip_pad[ids], axis=-1)
+
+            dirty_pg = (
+                member(state.up)
+                | member(state.acting)
+                | member(state.pool.pg_temp)
+                | member(state.pool.primary_temp[:, None])
+                | heavy
+            )
+            take, n_dirty = compact_dirty_indices(dirty_pg)
+            return jax.lax.switch(
+                ladder_rung(n_dirty, widths), branches,
+                (state, take, n_dirty),
+            )
+
+        self._peer_hist_compact_fn_c = compact_fn
+        return compact_fn
+
     def _traffic_apply(self, state: ClusterState, step, salt_base):
         """The traffic step over an explicit per-run salt base — the
         body :attr:`_traffic_fn` jits with this driver's seed baked in,
@@ -867,6 +1018,11 @@ class EpochDriver:
 
     def _epoch_step(self, state: ClusterState, step):
         prev_now = state.now
+        # the pool lanes before this epoch's tape/detector edits — the
+        # compacted dirty branch diffs against them to find which PGs
+        # the edits can actually reach
+        prev_up = state.pool.osd_up
+        prev_w = state.pool.osd_weight
         state, tape_dirty = self._tape_fn(state, step)
         state, (nd, nu, no, down_total, down_ck, trans) = self._live_fn(
             state
@@ -877,9 +1033,17 @@ class EpochDriver:
         # inside the dirty branch and quiet epochs carry it forward —
         # value-identical to reclassifying unchanged inputs, and it
         # keeps the [pg_num, N_STATES] reduce off the quiet floor
-        state = jax.lax.cond(
-            dirty, self._peer_hist_fn, lambda s: s, state
-        )
+        if self._dirty_ladder:
+            state = jax.lax.cond(
+                dirty,
+                lambda op: self._peer_hist_compact_fn(*op),
+                lambda op: op[0],
+                (state, prev_up, prev_w),
+            )
+        else:
+            state = jax.lax.cond(
+                dirty, self._peer_hist_fn, lambda s: s, state
+            )
         (counts, lat_hist, qd_hist, sums, max_rho, writes,
          deg_reads) = self._traffic_fn(state, step)
         scrub_due = self._scrub_fn(prev_now, state.now)

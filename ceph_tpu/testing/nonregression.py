@@ -135,6 +135,14 @@ def compile_once_cases() -> dict[str, dict]:
       power-of-two pad bucket (3 -> 4 clusters) must reuse the one
       compiled program with zero in-scan host transfers; fleet size is
       a value, never a shape.
+    - ``compacted_superstep``: the dirty-set compaction ladder
+      (``sparse_dirty_compaction``) — a chaos walk whose dirty-PG set
+      grows 1 -> max crosses every power-of-two rung inside one scan;
+      the warm rerun must hold ``CompileBudget(0)`` with zero in-scan
+      host transfers under ``debug_bucket_checks`` (dirty-set size is
+      the traced switch index, never a shape), and the compacted
+      series must be bit-equal to the dense reference on the same
+      walk.
     - ``online_write_batch``: the fused write-path scan
       (:mod:`ceph_tpu.workload.writepath`) — the per-epoch write cap
       is a traced scalar and the batch buffer is its power-of-two
@@ -415,6 +423,60 @@ def compile_once_cases() -> dict[str, dict]:
     report["fleet_superstep"] = {
         "warm_compiles": warm_f.n_compiles, "second_compiles": 0,
         "in_scan_host_transfers": g_f.host_transfers,
+    }
+
+    # ---- compacted superstep: dirty-set size walk -> rerun --------------
+    from ..common.config import Config
+
+    m_c = build_osdmap(64, pg_num=128, size=6, pool_kind="erasure")
+    cfg_c = Config(env={})
+    cfg_c.set("sparse_dirty_compaction", "on")
+    cfg_c.set("sparse_min_bucket", 4)
+    cfg_c.set("debug_bucket_checks", True)
+    # batches of 1, 2, 4, 8, 16 OSDs go down on successive epochs: the
+    # dirty-PG set walks 1 -> max across every compaction-ladder rung
+    # inside ONE compiled scan — dirty-set size must be a traced
+    # VALUE (the switch index), never part of the program signature
+    walk, start, batch, t = [], 0, 1, 0.3
+    while start + batch <= 32:
+        walk.append(ChaosEvent(t, tuple(
+            parse_spec(f"osd:{i}") for i in range(start, start + batch)
+        )))
+        start += batch
+        batch *= 2
+        t += 0.5
+    cdrv = EpochDriver(
+        m_c, ChaosTimeline(walk), n_ops=64, config=cfg_c,
+    )
+    assert cdrv.compaction_enabled, "ladder empty with compaction on"
+    for w in cdrv._dirty_ladder:
+        assert_bucketed("compacted superstep ladder rung", w)
+    with CompileCounter() as warm_c:
+        series_c = cdrv.run_superstep(24)
+    # the dense reference on the SAME walk: the ladder is an execution
+    # strategy, never a different answer
+    cfg_d = Config(env={})
+    cfg_d.set("sparse_dirty_compaction", "off")
+    ddrv = EpochDriver(
+        m_c, ChaosTimeline(list(walk)), n_ops=64, config=cfg_d,
+    )
+    diff_c = series_c.diff(ddrv.run_superstep(24))
+    assert not diff_c, f"compacted vs dense diverged: {diff_c}"
+    prev_bucket = cfg.get("debug_bucket_checks")
+    cfg.set("debug_bucket_checks", True)
+    try:
+        with CompileBudget(0, "compacted superstep dirty-set walk"), \
+                assert_no_recompile("compacted superstep dirty-set walk"):
+            with track() as g_c:
+                cdrv.run_superstep(24, pull=False)
+    finally:
+        cfg.set("debug_bucket_checks", prev_bucket)
+    assert g_c.host_transfers == 0, g_c.host_transfers
+    report["compacted_superstep"] = {
+        "warm_compiles": warm_c.n_compiles, "second_compiles": 0,
+        "in_scan_host_transfers": g_c.host_transfers,
+        "ladder": ",".join(str(w) for w in cdrv._dirty_ladder),
+        "bitequal": not diff_c,
     }
 
     # ---- online write batch: scan -> smaller cap, same bucket ----------

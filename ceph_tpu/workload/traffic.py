@@ -317,6 +317,22 @@ def sharded_traffic_step(
     )
 
 
+def dirty_fraction(series) -> float:
+    """Fraction of a run's epochs whose map moved (peering re-ran) —
+    the workload-side marker the dirty-set compaction ladder keys on:
+    a low dirty fraction means most epochs skip peering entirely, and
+    within the dirty epochs the compacted path touches only the PG
+    bucket the flips reach.  Accepts any series with a per-epoch
+    ``dirty`` lane (:class:`~ceph_tpu.recovery.superstep.EpochSeries`
+    or one fleet lane of it); recorded by ``bench/config10_scale`` as
+    the ``dirty_fraction`` metric that positions a workload against
+    the compaction-roofline crossover in ``bench/PERF_MODEL.md``."""
+    n = len(series)
+    if not n:
+        return 0.0
+    return float(np.asarray(series.dirty, dtype=np.int64).sum()) / n
+
+
 @dataclass
 class TrafficSample:
     """One epoch's client-traffic telemetry (host-side)."""

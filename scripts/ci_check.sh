@@ -58,6 +58,24 @@ else
     echo "== jaxlint + dispatch fuzz soaks skipped (CEPH_TPU_FUZZ_SECONDS=0) =="
 fi
 
+echo "== config10_scale smoke (compacted vs dense, bit-equality) =="
+# time-boxed production-scale leg: one small cell + a small fleet,
+# same guards as the full sweep — the JSON gates (bit-equality on
+# every cell, the zero-recompile dirty-set walk, fleet speedup > 0)
+# are asserted here so a silent FAIL in the stderr tail cannot pass
+timeout -k 10 420 env -u PYTHONPATH PYTHONPATH="$PWD" JAX_PLATFORMS=cpu \
+    python bench/config10_scale.py --smoke | python -c '
+import json, sys
+rec = json.loads(sys.stdin.readline())
+ok = (rec.get("status") == "ok"
+      and rec.get("scale_bitequal") is True
+      and rec.get("scale_zero_recompile_walk") is True
+      and rec.get("fleet_bitequal") is True
+      and rec.get("fleet_compacted_speedup", 0) > 0)
+print("scale smoke:", "ok" if ok else f"FAIL {rec}")
+sys.exit(0 if ok else 1)
+' || rc=1
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \

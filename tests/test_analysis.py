@@ -884,6 +884,59 @@ def test_j013_clean_when_bucketed():
     assert rules_of(good) == []
 
 
+def test_j013_flags_unbucketed_dirty_gather_scatter():
+    """The compaction helper's anti-pattern: sizing the gather slice
+    by the raw dirty count makes every distinct dirty-set size a new
+    program signature — the exact recompile class the ladder's
+    power-of-two rungs exist to prevent."""
+    bad = """
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+
+    @jax.jit
+    def peer_rows(rows):
+        return rows + 1
+
+    def drive(table, dirty):
+        take = np.nonzero(dirty)[0]
+        w = len(take)
+        rows = peer_rows(jnp.asarray(table[take[:w]]))
+        table[take[:w]] = np.asarray(rows)
+        return table
+    """
+    assert "J013" in rules_of(bad)
+
+
+def test_j013_clean_for_ladder_gather_scatter():
+    """The shipped shape of cluster_state.gather_rows/scatter_rows:
+    the slice width is a ladder rung from a pow2 helper, the dirty
+    count stays a traced value (the switch index) — no taint."""
+    good = """
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+
+    def _pad_to(n):
+        p = 1
+        while p < n:
+            p <<= 1
+        return p
+
+    @jax.jit
+    def peer_rows(rows):
+        return rows + 1
+
+    def drive(table, dirty):
+        w = _pad_to(int(dirty.sum()))
+        take = np.nonzero(dirty)[0][:w]
+        rows = peer_rows(jnp.asarray(table[take]))
+        table[take] = np.asarray(rows)
+        return table
+    """
+    assert rules_of(good) == []
+
+
 def test_j013_clean_when_count_stays_a_value():
     """A dynamic count used as a *value* (not a shape) never
     recompiles; only shape positions are flagged."""

@@ -1453,3 +1453,126 @@ def test_writepath_cpu_record_not_harvested(tmp_path):
     p.write_text(json.dumps(rec) + "\n")
     dd = _load_dd("writepath_cpu")
     assert dd.harvest_guard([str(p)]) == {}
+
+
+# --- config10_scale JSON schema (production-scale sweep) --------------
+
+_CONFIG10S = os.path.join(
+    os.path.dirname(_BENCH), "bench", "config10_scale.py"
+)
+_spec10s = importlib.util.spec_from_file_location(
+    "bench_config10_scale", _CONFIG10S
+)
+config10s = importlib.util.module_from_spec(_spec10s)
+_spec10s.loader.exec_module(config10s)
+
+_SCALE_CELLS = [
+    {"n_osds": 1000, "pg_num": 8192, "rate_on": 70.4, "rate_off": 68.1,
+     "bitequal": True, "zero_recompile_walk": True,
+     "hbm_bytes_per_osd": 1720.5, "dirty_fraction": 0.25,
+     "ladder": "32,128,512,2048"},
+    {"n_osds": 10000, "pg_num": 100000, "rate_on": 13.2,
+     "rate_off": 13.7, "bitequal": True, "zero_recompile_walk": True,
+     "hbm_bytes_per_osd": 2044.3, "dirty_fraction": 0.5,
+     "ladder": "32,128,512,2048"},
+]
+
+_SCALE_FLEET = {
+    "speedup": 1.844, "rate_on": 18262.0, "rate_off": 9906.0,
+    "vs_seq_warm": 1.09, "bitequal": True,
+}
+
+
+def _scale_record():
+    return config10s.build_scale_record(
+        "tpu", [dict(c) for c in _SCALE_CELLS], dict(_SCALE_FLEET),
+        3, 3, 0,
+    )
+
+
+def test_scale_record_schema():
+    import json
+
+    rec = _scale_record()
+    assert rec["metric"] == "scale_epoch_rate_per_sec"
+    assert rec["status"] == "ok"
+    assert rec["unit"] == "epochs/s"
+    # headline = the LAST (largest) grid cell
+    assert rec["value"] == 13.2
+    assert rec["scale_n_osds"] == 10000
+    assert rec["scale_pg_num"] == 100000
+    assert rec["scale_epoch_rate_per_sec"] == 13.2
+    assert rec["scale_epoch_rate_dense_per_sec"] == 13.7
+    assert rec["scale_compacted_vs_dense"] == round(13.2 / 13.7, 3)
+    assert rec["vs_baseline"] == round(13.2 / 13.7, 3)
+    assert rec["scale_hbm_bytes_per_osd"] == 2044.3
+    assert rec["scale_dirty_fraction"] == 0.5
+    assert rec["scale_ladder"] == "32,128,512,2048"
+    assert rec["scale_scenario"] == "dirty-walk"
+    # the acceptance gates, in-record: bit-equality on every cell and
+    # the compile-once dirty-set size walk
+    assert rec["scale_bitequal"] is True
+    assert rec["scale_zero_recompile_walk"] is True
+    # the decisive fleet metric: compacted over dense at 256 lanes
+    assert rec["fleet_compacted_speedup"] == 1.844
+    assert rec["fleet_compacted_rate_per_sec"] == 18262.0
+    assert rec["fleet_dense_rate_per_sec"] == 9906.0
+    assert rec["fleet_vs_seq_warm"] == 1.09
+    assert rec["fleet_bitequal"] is True
+    assert rec["n_compiles"] == 3
+    assert rec["n_compiles_first"] == 3
+    assert rec["host_transfers"] == 0
+    assert len(rec["scale_grid"]) == 2
+    json.dumps(rec)  # one JSON line, always serializable
+
+
+def test_scale_record_gates_fail_when_any_cell_fails():
+    cells = [dict(c) for c in _SCALE_CELLS]
+    cells[0]["bitequal"] = False
+    cells[1]["zero_recompile_walk"] = False
+    rec = config10s.build_scale_record(
+        "tpu", cells, dict(_SCALE_FLEET), 3, 3, 0,
+    )
+    assert rec["scale_bitequal"] is False
+    assert rec["scale_zero_recompile_walk"] is False
+
+
+def test_scale_record_harvested_by_decide_defaults(tmp_path):
+    import json
+
+    rec = _scale_record()
+    p = tmp_path / "session.log"
+    p.write_text(json.dumps(rec) + "\n")
+    dd = _load_dd("scale")
+    g = dd.harvest_guard([str(p)])["scale_epoch_rate_per_sec"]
+    # typed SCALE_* fields: geometry, both rates, the gates
+    assert g["scale_n_osds"] == 10000
+    assert g["scale_pg_num"] == 100000
+    assert g["scale_n_epochs"] == rec["scale_n_epochs"]
+    assert g["scale_fleet_n_clusters"] == rec["scale_fleet_n_clusters"]
+    assert g["scale_epoch_rate_per_sec"] == 13.2
+    assert g["scale_epoch_rate_dense_per_sec"] == 13.7
+    assert g["scale_compacted_vs_dense"] == round(13.2 / 13.7, 3)
+    assert g["scale_hbm_bytes_per_osd"] == 2044.3
+    assert g["scale_dirty_fraction"] == 0.5
+    assert g["scale_ladder"] == "32,128,512,2048"
+    assert g["scale_scenario"] == "dirty-walk"
+    assert g["scale_bitequal"] is True
+    assert g["scale_zero_recompile_walk"] is True
+    assert g["fleet_compacted_speedup"] == 1.844
+    assert g["fleet_compacted_rate_per_sec"] == 18262.0
+    assert g["fleet_dense_rate_per_sec"] == 9906.0
+    assert g["fleet_vs_seq_warm"] == 1.09
+    # n_compiles == n_compiles_first: the steady-state walk added
+    # zero compiles after warmup
+    assert g["steady_state_clean"] is True
+
+
+def test_scale_cpu_record_not_harvested(tmp_path):
+    import json
+
+    rec = dict(_scale_record(), platform="cpu")
+    p = tmp_path / "session.log"
+    p.write_text(json.dumps(rec) + "\n")
+    dd = _load_dd("scale_cpu")
+    assert dd.harvest_guard([str(p)]) == {}

@@ -1,0 +1,209 @@
+"""Dirty-set compaction: an execution strategy, never a different answer.
+
+The ladder (``sparse_dirty_compaction``) gathers the dirty PG rows into
+a power-of-two bucket, re-peers only those, and scatters the results
+back — so every series it produces must be bit-equal to the dense
+reference on the same chaos timeline, across the whole failure zoo and
+through every consumer (fleet lanes, the writepath scan).  Floats
+compared exactly, no tolerance, same as test_superstep.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.core.cluster_state import (
+    bucket_valid,
+    compact_dirty_indices,
+    dirty_ladder,
+    gather_rows,
+    ladder_rung,
+    scatter_rows,
+)
+from ceph_tpu.models.clusters import build_osdmap
+from ceph_tpu.recovery import EpochDriver, build_scenario
+from ceph_tpu.recovery.chaos import ChaosEvent, ChaosTimeline
+from ceph_tpu.recovery.failure import parse_spec
+from ceph_tpu.recovery.fleet import FleetDriver, FleetSeries
+from ceph_tpu.workload.traffic import dirty_fraction
+from ceph_tpu.workload.writepath import WritepathDriver
+
+ZOO = (
+    "flap",
+    "rack-cascade",
+    "mid-repair-loss",
+    "silent-bitrot",
+    "scrub-storm",
+    "flapping-osd",
+)
+
+
+def _map(n_osd=64, pg_num=128):
+    return build_osdmap(n_osd, pg_num=pg_num, size=6, pool_kind="erasure")
+
+
+def _cfg(mode, min_bucket=4, **extra):
+    cfg = Config(env={})
+    cfg.set("sparse_dirty_compaction", mode)
+    cfg.set("sparse_min_bucket", min_bucket)
+    for key, val in extra.items():
+        cfg.set(key, val)
+    return cfg
+
+
+# --- the primitives ---------------------------------------------------
+
+
+def test_compact_dirty_indices_stable_with_sentinel_tail():
+    take, n = compact_dirty_indices(jnp.asarray([0, 1, 0, 1, 1, 0], bool))
+    assert int(n) == 3
+    # dirty indices in ascending order, then the out-of-range sentinel
+    # (== len) that makes downstream gathers clamp and scatters drop
+    assert np.asarray(take).tolist() == [1, 3, 4, 6, 6, 6]
+
+
+def test_compact_dirty_indices_edges():
+    take, n = compact_dirty_indices(jnp.zeros(4, bool))
+    assert int(n) == 0 and np.asarray(take).tolist() == [4, 4, 4, 4]
+    take, n = compact_dirty_indices(jnp.ones(4, bool))
+    assert int(n) == 4 and np.asarray(take).tolist() == [0, 1, 2, 3]
+
+
+def test_dirty_ladder_geometry_and_rung_selection():
+    widths = dirty_ladder(100_000)
+    assert widths == (32, 128, 512, 2048)  # power-of-two, growth 4
+    # the rung is the count of widths the dirty-set size outgrew:
+    # n_dirty <= 32 fits the first bucket, 2049 falls off the ladder
+    # onto the dense branch (index == len(widths))
+    for n_dirty, rung in ((1, 0), (32, 0), (33, 1), (128, 1), (129, 2),
+                          (2048, 3), (2049, 4)):
+        assert int(ladder_rung(jnp.int32(n_dirty), widths)) == rung, n_dirty
+    # a geometry smaller than the smallest bucket has no ladder at all
+    assert dirty_ladder(16, min_bucket=32) == ()
+
+
+def test_gather_scatter_roundtrip_preserves_clean_rows():
+    table = jnp.arange(12, dtype=jnp.int32).reshape(6, 2)
+    dirty = jnp.asarray([0, 1, 0, 0, 1, 0], bool)
+    take, n_dirty = compact_dirty_indices(dirty)
+    W = 4
+    rows = gather_rows(table, take, W)
+    valid = bucket_valid(n_dirty, W)
+    assert np.asarray(valid).tolist() == [True, True, False, False]
+    out = scatter_rows(table, take, W, rows * 10)
+    expect = np.arange(12, dtype=np.int32).reshape(6, 2)
+    expect[1] *= 10
+    expect[4] *= 10
+    # sentinel slots dropped: rows 0/2/3/5 untouched bit for bit
+    assert np.array_equal(np.asarray(out), expect)
+
+
+# --- gating -----------------------------------------------------------
+
+
+def test_compaction_gating():
+    m = _map()
+    tape = ChaosTimeline([ChaosEvent(0.3, (parse_spec("osd:3"),))])
+
+    def drv(cfg):
+        return EpochDriver(m, tape, n_ops=16, config=cfg)
+
+    assert drv(_cfg("on")).compaction_enabled
+    assert not drv(_cfg("off")).compaction_enabled
+    # 'on' with a min bucket wider than the pool: ladder has no rung
+    # below dense, so even the forced mode degrades to dense
+    assert not drv(_cfg("on", min_bucket=256)).compaction_enabled
+    # 'auto' needs the dense width to dwarf the smallest bucket
+    # (pg_num >= 64 * min_bucket): 128 < 64*4 stays dense, 128 >= 64*2
+    # compacts
+    assert not drv(_cfg("auto", min_bucket=4)).compaction_enabled
+    assert drv(_cfg("auto", min_bucket=2)).compaction_enabled
+
+
+# --- the failure matrix: compacted == dense, bit for bit --------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scenario", ZOO)
+def test_compacted_bitequal_over_zoo(scenario):
+    m = _map()
+    d_on = EpochDriver(
+        m, build_scenario(scenario, m), n_ops=64, config=_cfg("on"),
+    )
+    assert d_on.compaction_enabled, d_on._dirty_ladder
+    d_off = EpochDriver(
+        m, build_scenario(scenario, m), n_ops=64, config=_cfg("off"),
+    )
+    sup = d_on.run_superstep(40)
+    assert sup.diff(d_off.run_superstep(40)) == []
+    # the workload marker the ladder keys on agrees with the series
+    assert dirty_fraction(sup) == float(np.asarray(sup.dirty).sum()) / 40
+
+
+def test_compacted_bitequal_netsplit_hold():
+    # mark-down -> auto-out -> restore transitions: the out flip is a
+    # weight change, so the walk crosses the heavy (dense-rung) branch
+    # of the compacted predicate too
+    m = _map()
+    timeline = [
+        ChaosEvent(0.3, (parse_spec("netsplit:3"), parse_spec("netsplit:9"))),
+        ChaosEvent(8.0, (parse_spec("netsplit:3:restore"),
+                         parse_spec("netsplit:9:restore"))),
+    ]
+    knobs = {"osd_heartbeat_grace": 0.5, "mon_osd_down_out_interval": 2.0}
+    d_on = EpochDriver(
+        m, ChaosTimeline(list(timeline)), n_ops=64,
+        config=_cfg("on", **knobs),
+    )
+    d_off = EpochDriver(
+        m, ChaosTimeline(list(timeline)), n_ops=64,
+        config=_cfg("off", **knobs),
+    )
+    sup = d_on.run_superstep(48)
+    assert sup.diff(d_off.run_superstep(48)) == []
+    assert sup.eff_down.sum() == 2 and sup.eff_out.sum() == 2
+
+
+@pytest.mark.slow
+def test_compacted_fleet_bitequal_and_matches_sequential():
+    m = build_osdmap(32, pg_num=16, size=6, pool_kind="erasure")
+    n, epochs = 5, 24
+
+    def fleet(mode):
+        fd = FleetDriver(m, seed=0, n_ops=32, config=_cfg(mode))
+        tls = fd.sample(n, "ssd-burst")
+        _, rows = fd.run_fleet(epochs, tls, pull=False)
+        return FleetSeries.from_device(rows, n), fd, tls
+
+    fs_on, fd_on, tls = fleet("on")
+    fs_off, _, _ = fleet("off")
+    seqs = fd_on.run_sequential(epochs, tls)
+    for k in range(n):
+        assert fs_on.cluster(k).diff(fs_off.cluster(k)) == []
+        assert fs_on.cluster(k).diff(seqs[k]) == []
+
+
+def test_compacted_writepath_bitequal():
+    # the writepath scan composes the driver's epoch pieces: routing
+    # them through the ladder must leave stripe cache hits, parity
+    # deltas and the traffic lanes bit-identical
+    m = _map()
+    tape = [
+        ChaosEvent(0.3, (parse_spec("osd:3"),)),
+        ChaosEvent(0.8, (parse_spec("osd:7"), parse_spec("osd:11"))),
+    ]
+
+    def run(mode):
+        d = EpochDriver(
+            m, ChaosTimeline(list(tape)), n_ops=64, config=_cfg(mode),
+        )
+        w = WritepathDriver(d, n_sets=8, ways=2, max_writes=8)
+        return w.run_superstep(16, cap=5)
+
+    es_on, ws_on = run("on")
+    es_off, ws_off = run("off")
+    assert es_on.diff(es_off) == []
+    assert ws_on.diff(ws_off) == []
+    assert dirty_fraction(es_on) > 0

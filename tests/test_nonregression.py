@@ -44,8 +44,8 @@ def test_hot_paths_compile_once():
     assert set(report) == {
         "pool_mapping", "pattern_decode", "schedule_decode", "scrub_pass",
         "heartbeat_tick", "fused_placement", "epoch_superstep",
-        "fleet_superstep", "online_write_batch", "reconcile_round",
-        "worksteal_dispatch",
+        "fleet_superstep", "compacted_superstep", "online_write_batch",
+        "reconcile_round", "worksteal_dispatch",
     }
     # the superstep's contract: the second scan window syncs NOTHING
     # to host (the staged path's per-epoch device_gets are the cost it
@@ -53,6 +53,11 @@ def test_hot_paths_compile_once():
     # fleet within a pad bucket
     assert report["epoch_superstep"]["in_scan_host_transfers"] == 0
     assert report["fleet_superstep"]["in_scan_host_transfers"] == 0
+    # the compaction ladder's contract: a dirty-set size walk across
+    # every rung is one compiled scan (the switch index is a traced
+    # value) and the compacted answer is the dense answer, bit for bit
+    assert report["compacted_superstep"]["in_scan_host_transfers"] == 0
+    assert report["compacted_superstep"]["bitequal"] is True
     assert report["online_write_batch"]["in_scan_host_transfers"] == 0
     assert report["reconcile_round"]["in_round_host_transfers"] == 0
     # the dispatcher's drain loop never syncs to host: sub-shard

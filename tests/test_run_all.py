@@ -169,10 +169,11 @@ def test_communicate_no_kill_salvages_stdout_on_grace_exit():
     assert "RESULT 42" in out
 
 
-def test_communicate_no_kill_salvages_stdout_from_orphan():
-    """Even a child that never dies (SIGINT ignored — the C-blocked
-    PJRT-detach hang mode) must hand back what it printed before
-    blocking: TimeoutExpired carries the partial output."""
+def test_communicate_no_kill_escalates_sigint_to_sigterm():
+    """BENCH_r05: a child that ignores SIGINT gets one SIGTERM after the
+    grace window, with the escalation recorded in the stderr tail —
+    never a SIGKILL."""
+    import signal
     import subprocess
     import sys
 
@@ -182,11 +183,36 @@ def test_communicate_no_kill_salvages_stdout_from_orphan():
          "print('BANKED 7', flush=True)\nimport time\ntime.sleep(15)"],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
     )
-    out, _err, timed_out = run_all.communicate_no_kill(
+    out, err, timed_out = run_all.communicate_no_kill(
         proc, 1.0, grace_s=1.0
     )
     assert timed_out
     assert "BANKED 7" in out
+    assert "did not exit on SIGINT" in err and "SIGTERM" in err
+    assert proc.poll() == -signal.SIGTERM  # escalation landed, no SIGKILL
+
+
+def test_communicate_no_kill_salvages_stdout_from_orphan():
+    """Even a child that never dies (SIGINT *and* SIGTERM ignored — the
+    C-blocked PJRT-detach hang mode) must hand back what it printed
+    before blocking: TimeoutExpired carries the partial output."""
+    import subprocess
+    import sys
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import signal\n"
+         "signal.signal(signal.SIGINT, signal.SIG_IGN)\n"
+         "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+         "print('BANKED 7', flush=True)\nimport time\ntime.sleep(15)"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    out, err, timed_out = run_all.communicate_no_kill(
+        proc, 1.0, grace_s=1.0, term_grace_s=1.0
+    )
+    assert timed_out
+    assert "BANKED 7" in out
+    assert "orphaned" in err
     assert proc.poll() is None  # orphaned, not killed
 
 
@@ -249,7 +275,7 @@ def test_unfiltered_configs_cover_all_baseline_configs():
         "config6_recovery", "config6_recovery_multichip",
         "config6_recovery_scrub", "config6_recovery_liveness",
         "config7_epoch_loop", "config8_fleet", "config9_checkpoint",
-        "config10_online_ec", "tpu_tier",
+        "config10_online_ec", "config10_scale", "tpu_tier",
     ]
     # the flag-mode entries re-use the config6 file
     for name, flag in (
