@@ -84,7 +84,8 @@ def walk_pairs(n_osds: int, dt: float = 0.25):
 
 
 def build_scale_record(platform, cells, fleet, n_compiles,
-                       n_compiles_first, host_transfers):
+                       n_compiles_first, host_transfers,
+                       *, flight=None):
     """One JSON line for the production-scale headline.
 
     ``value`` is the compacted epoch rate of the LAST (largest) grid
@@ -93,6 +94,15 @@ def build_scale_record(platform, cells, fleet, n_compiles,
     ``decide_defaults`` harvest surface; ``scale_grid`` keeps every
     cell for the status CLI.  ``status`` is ``"ok"`` for a completed
     measurement (run_all stamps ``"timeout"`` on salvage).
+
+    ``flight`` (optional, keyword-only so older callers/tests keep
+    their positional shape) is the telemetry-on-vs-off differential
+    of the headline cell: the recorder must be invisible
+    (``flight_bitequal`` over every epoch lane), cheap
+    (``flight_overhead_fraction``, gated by decide_defaults), and
+    shape-stable (``flight_ring_walk_zero_recompile`` across ring
+    sizes); ``flight_crash_dump_ok`` pins the injected-failure
+    forensics path end to end.
     """
     head = cells[-1]
     rec = {
@@ -130,6 +140,21 @@ def build_scale_record(platform, cells, fleet, n_compiles,
         "n_compiles_first": int(n_compiles_first),
         "host_transfers": int(host_transfers),
     }
+    if flight is not None:
+        rec.update({
+            "flight_overhead_fraction": round(
+                float(flight["overhead_fraction"]), 4
+            ),
+            "flight_bitequal": bool(flight["bitequal"]),
+            "flight_ring_walk_zero_recompile": bool(
+                flight["ring_walk_zero_recompile"]
+            ),
+            "flight_crash_dump_ok": bool(flight["crash_dump_ok"]),
+            "flight_ring_epochs": int(flight["ring_epochs"]),
+            "flight_ring_drops": int(flight["ring_drops"]),
+            "flight_dump_count": int(flight["dump_count"]),
+            "flight_ring_walk": flight["ring_walk"],
+        })
     return rec
 
 
@@ -330,6 +355,128 @@ def main() -> None:
         file=sys.stderr,
     )
 
+    # -- flight recorder differential: the telemetry tax -------------
+    # Same headline cell, same timeline, recorder on.  Three claims:
+    # the pulled series is bit-equal to the recorder-off run on every
+    # lane (the recorder composes the same jitted pieces, it never
+    # forks the math); the steady-state rate pays <= the decide gate;
+    # and ring SIZE is a shape constant, not a recompile axis.
+    import tempfile
+
+    from ceph_tpu.analysis.runtime_guard import CompileBudget
+    from ceph_tpu.obs.flight import (
+        FLIGHT_LANES,
+        crash_dump_guard,
+        drain_flight,
+        journal_drain,
+        read_flight_dump,
+    )
+    from ceph_tpu.obs.journal import EventJournal
+    from ceph_tpu.recovery.dispatch import ChipLostError
+
+    def flight_driver(ring):
+        cfg = Config(env={})
+        cfg.set("sparse_dirty_compaction", "on")
+        cfg.set("debug_bucket_checks", True)
+        cfg.set("flight_recorder", "on")
+        cfg.set("flight_ring_epochs", ring)
+        return EpochDriver(
+            m, ChaosTimeline.from_pairs(pairs), seed=SEED,
+            n_ops=N_OPS, config=cfg,
+        )
+
+    FLIGHT_RING = 64  # pow2 >= EPOCHS at every grid/smoke setting
+    d_fl = flight_driver(FLIGHT_RING)
+    s_fl = d_fl.run_superstep(EPOCHS)  # warm + bitequal reference
+    fl_diff = s_on.diff(s_fl)
+    if fl_diff:
+        print(f"FLIGHT BITEQUAL FAIL: {fl_diff}", file=sys.stderr)
+
+    t0 = time.perf_counter()
+    _, rows_fl = d_fl.run_superstep(EPOCHS, pull=False)
+    jax.block_until_ready(rows_fl)
+    dt_fl = time.perf_counter() - t0
+    fl_drain = drain_flight(d_fl.flight)
+
+    # ring-size walk: each size warms once, then must re-run with
+    # zero fresh compiles and zero host transfers (the recorder is
+    # carry state, not a tracing hazard)
+    ring_walk = []
+    for ring in (16, FLIGHT_RING, 256):
+        d_w = d_fl if ring == FLIGHT_RING else flight_driver(ring)
+        if d_w is not d_fl:
+            d_w.run_superstep(EPOCHS, pull=False)
+        ok = False
+        try:
+            with CompileBudget(0, f"flight ring={ring} walk"), \
+                    track() as g:
+                _, rw = d_w.run_superstep(EPOCHS, pull=False)
+                jax.block_until_ready(rw)
+            ok = g.n_compiles == 0 and g.host_transfers == 0
+        except AssertionError as e:
+            print(f"flight ring={ring}: {e}", file=sys.stderr)
+        ring_walk.append({"ring": int(ring), "ok": bool(ok)})
+    ring_walk_ok = all(w["ok"] for w in ring_walk)
+
+    # crash-dump forensics: inject a typed chip loss under the guard,
+    # then check the committed dump against the journal's final
+    # drained epoch — the post-mortem must agree with the telemetry
+    crash_ok = False
+    dump_count = 0
+    with tempfile.TemporaryDirectory() as td:
+        journal = EventJournal(os.path.join(td, "journal.jsonl"))
+        drained = journal_drain(journal, d_fl.flight, source="scale")
+        try:
+            with crash_dump_guard(
+                td, flight=lambda: d_fl.flight, journal=journal,
+                state={"bench": "config10_scale"},
+            ) as guard_cm:
+                raise ChipLostError([0])  # bench-injected chip loss
+        except ChipLostError:
+            pass
+        dumps = sorted(
+            f for f in os.listdir(td) if f.startswith("flightdump-")
+        )
+        dump_count = len(dumps)
+        if dumps and drained is not None:
+            try:
+                doc = read_flight_dump(os.path.join(td, dumps[-1]))
+            except ValueError as e:
+                print(f"flight dump invalid: {e}", file=sys.stderr)
+            else:
+                last = doc["flight"]["rows"][-1]
+                epoch_idx = FLIGHT_LANES.index("epoch")
+                drain_rec = [
+                    r for r in journal.records
+                    if r.get("name") == "flight.drain"
+                ]
+                crash_ok = bool(
+                    guard_cm.dump_path is not None
+                    and drain_rec
+                    and int(last[epoch_idx])
+                    == int(drain_rec[-1]["attrs"]["epoch_last"])
+                )
+
+    flight = {
+        "overhead_fraction": dt_fl / dt_on - 1.0 if dt_on else 0.0,
+        "bitequal": not fl_diff,
+        "ring_walk_zero_recompile": ring_walk_ok,
+        "crash_dump_ok": crash_ok,
+        "ring_epochs": FLIGHT_RING,
+        "ring_drops": int(fl_drain["drops"]),
+        "dump_count": dump_count,
+        "ring_walk": ring_walk,
+    }
+    print(
+        f"flight: overhead {flight['overhead_fraction']:+.1%}, "
+        f"bitequal={'ok' if flight['bitequal'] else 'FAIL'}, "
+        f"ring walk "
+        f"{'ok' if ring_walk_ok else 'FAIL'} "
+        f"({','.join(str(w['ring']) for w in ring_walk)}), "
+        f"crash dump={'ok' if crash_ok else 'FAIL'}",
+        file=sys.stderr,
+    )
+
     # n_compiles is cumulative (warmup + steady walk) so the harvest's
     # ``steady_state_clean`` (n_compiles == n_compiles_first) reads
     # "the walk added nothing after warmup"
@@ -337,6 +484,7 @@ def main() -> None:
         jax.default_backend(), cells, fleet,
         n_compiles_first + n_compiles_steady,
         n_compiles_first, host_transfers_steady,
+        flight=flight,
     )))
 
 

@@ -34,7 +34,8 @@ CHUNK = 256
 
 def build_epoch_record(platform, sup_rate, staged_rate, bitequal,
                        epochs_measured, n_compiles, n_compiles_first,
-                       host_transfers, superstep_enabled):
+                       host_transfers, superstep_enabled,
+                       *, flight_rate=None, flight_bitequal=None):
     """One JSON line for the epoch-loop headline.
 
     ``value`` is the superstep rate; ``vs_baseline`` the
@@ -45,8 +46,16 @@ def build_epoch_record(platform, sup_rate, staged_rate, bitequal,
     process measured under.  ``status`` is ``"ok"`` for a completed
     measurement; the run_all harness stamps ``"timeout"`` on value-less
     salvage from a hung child so harvests skip it.
+
+    ``flight_rate``/``flight_bitequal`` (keyword-only; older callers
+    keep their positional shape) ride along when the flight-recorder
+    differential ran: recorder-on epochs/s against the same warm
+    superstep, and lane-for-lane agreement of the pulled series.  The
+    authoritative overhead gate lives in config10_scale's
+    ``flight_overhead_fraction``; this is the acceptance-geometry
+    cross-check.
     """
-    return {
+    rec = {
         "metric": "epoch_loop_rate_per_sec",
         "status": "ok",
         "value": round(sup_rate),
@@ -68,6 +77,13 @@ def build_epoch_record(platform, sup_rate, staged_rate, bitequal,
         "n_compiles_first": int(n_compiles_first),
         "host_transfers": int(host_transfers),
     }
+    if flight_rate is not None:
+        rec["epoch_rate_flight_per_sec"] = round(flight_rate, 1)
+        rec["epoch_flight_overhead_fraction"] = round(
+            sup_rate / flight_rate - 1.0, 4
+        ) if flight_rate else 0.0
+        rec["epoch_flight_bitequal"] = bool(flight_bitequal)
+    return rec
 
 
 def _bitequal_check() -> bool:
@@ -132,18 +148,49 @@ def main() -> None:
 
     bitequal = _bitequal_check()
 
+    # flight-recorder cross-check at the acceptance geometry: same
+    # map, same tape, recorder on.  The authoritative overhead gate is
+    # config10_scale's; this leg pins that the acceptance-geometry
+    # loop rate and series survive the recorder too.
+    from ceph_tpu.common.config import Config
+
+    cfg_fl = Config(env={})
+    cfg_fl.set("flight_recorder", "on")
+    cfg_fl.set("flight_ring_epochs", CHUNK)
+    d_fl = EpochDriver(
+        m,
+        ChaosTimeline([
+            ChaosEvent(
+                0.1, (parse_spec("slow:5"), parse_spec("slow:17"))
+            ),
+        ]),
+        n_ops=N_OPS, config=cfg_fl,
+    )
+    s_fl = d_fl.run_superstep(CHUNK, snapshot_every=CHUNK)  # warm
+    fl_diff = driver.run_superstep(
+        CHUNK, snapshot_every=CHUNK
+    ).diff(s_fl)
+    if fl_diff:
+        print(f"FLIGHT BITEQUAL FAIL: {fl_diff}", file=sys.stderr)
+    t0 = time.perf_counter()
+    d_fl.run_superstep(EPOCHS, snapshot_every=CHUNK)
+    flight_rate = EPOCHS / (time.perf_counter() - t0)
+
     print(
         f"epoch loop: {N_OSDS} OSDs / {PG_NUM} PGs, n_ops={N_OPS}: "
         f"superstep {sup_rate:.0f} ep/s ({EPOCHS} epochs), "
         f"staged {staged_rate:.0f} ep/s ({STAGED_EPOCHS} epochs) -> "
         f"{sup_rate / staged_rate:.1f}x, "
-        f"bitequal={'ok' if bitequal else 'FAIL'}",
+        f"bitequal={'ok' if bitequal else 'FAIL'}, "
+        f"flight {flight_rate:.0f} ep/s "
+        f"(bitequal={'ok' if not fl_diff else 'FAIL'})",
         file=sys.stderr,
     )
     print(json.dumps(build_epoch_record(
         jax.default_backend(), sup_rate, staged_rate, bitequal,
         EPOCHS, guard.n_compiles, warm["n_compiles"],
         guard.host_transfers, epoch_superstep_enabled(),
+        flight_rate=flight_rate, flight_bitequal=not fl_diff,
     )))
 
 

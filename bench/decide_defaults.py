@@ -326,6 +326,33 @@ SCALE_FLOAT_FIELDS = ("scale_epoch_rate_per_sec",
 SCALE_BOOL_FIELDS = ("scale_bitequal", "scale_zero_recompile_walk")
 SCALE_STR_FIELDS = ("scale_ladder", "scale_scenario")
 
+# config10_scale flight-recorder differential (PR 20): the telemetry
+# tax at the headline cell.  ``flight_bitequal`` is the recorder's
+# whole claim (same answer, lanes on the side);
+# ``flight_ring_walk_zero_recompile`` pins ring size as a shape
+# constant; ``flight_crash_dump_ok`` the injected-failure forensics
+# round trip.  ``decide_flight`` flips the ``flight_recorder=auto``
+# default to on only when all three hold AND the overhead fraction is
+# under the gate.
+FLIGHT_INT_FIELDS = ("flight_ring_epochs", "flight_ring_drops",
+                     "flight_dump_count")
+FLIGHT_FLOAT_FIELDS = ("flight_overhead_fraction",
+                       "epoch_flight_overhead_fraction",
+                       "epoch_rate_flight_per_sec")
+FLIGHT_BOOL_FIELDS = ("flight_bitequal",
+                      "flight_ring_walk_zero_recompile",
+                      "flight_crash_dump_ok", "epoch_flight_bitequal")
+
+#: ceiling on flight_overhead_fraction for the auto->on default flip
+#: (ISSUE 20: recorder must cost <= 3% at the 10k-OSD/100k-PG cell)
+FLIGHT_OVERHEAD_GATE = 0.03
+
+#: where the flight decision lands — read by
+#: ceph_tpu.obs.flight.resolve_flight_recorder for ``auto``
+FLIGHT_DEFAULTS_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "flight_defaults.json"
+)
+
 
 def harvest_aux(paths: list[str]) -> dict[str, int]:
     """Collect auxiliary metric -> best value from the logs.
@@ -536,6 +563,17 @@ def harvest_guard(paths: list[str]) -> dict[str, dict]:
             fields.update(
                 {f: str(d[f]) for f in SCALE_STR_FIELDS if f in d}
             )
+            fields.update(
+                {f: int(d[f]) for f in FLIGHT_INT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: float(d[f])
+                 for f in FLIGHT_FLOAT_FIELDS if f in d}
+            )
+            fields.update(
+                {f: bool(d[f])
+                 for f in FLIGHT_BOOL_FIELDS if f in d}
+            )
             # jaxlint per-rule counters (lint_active, lint_J007_active,
             # ...): dynamic key set — one field per registered rule, so
             # new rules flow through without touching this harvest
@@ -680,6 +718,76 @@ def decide(
     return out
 
 
+def decide_flight(guard: dict[str, dict]) -> dict:
+    """The ``flight_recorder=auto`` default flip, from the harvested
+    config10_scale differential.
+
+    Quarantine discipline mirrors the kernel decision: the recorder
+    only self-enables when the evidence says it is invisible
+    (``flight_bitequal``), shape-stable
+    (``flight_ring_walk_zero_recompile``), forensically sound
+    (``flight_crash_dump_ok``) AND cheap (overhead fraction at or
+    under :data:`FLIGHT_OVERHEAD_GATE`).  Any missing or failing gate
+    decides "off" — auto must never cost an unmeasured tax.
+    """
+    scale = guard.get("scale_epoch_rate_per_sec", {})
+    out: dict = {"metric": "flight_decision",
+                 "overhead_gate": FLIGHT_OVERHEAD_GATE}
+    if "flight_bitequal" not in scale:
+        out["decision"] = ("no flight differential measured — "
+                           "defaults unchanged")
+        return out
+    overhead = float(scale.get("flight_overhead_fraction", 1.0))
+    gates = {
+        "flight_bitequal": bool(scale.get("flight_bitequal")),
+        "flight_ring_walk_zero_recompile": bool(
+            scale.get("flight_ring_walk_zero_recompile")
+        ),
+        "flight_crash_dump_ok": bool(scale.get("flight_crash_dump_ok")),
+        "flight_overhead_under_gate":
+            overhead <= FLIGHT_OVERHEAD_GATE,
+    }
+    out.update(
+        gates=gates,
+        flight_overhead_fraction=overhead,
+        flight_ring_epochs=scale.get("flight_ring_epochs"),
+        flight_ring_drops=scale.get("flight_ring_drops"),
+        flight_dump_count=scale.get("flight_dump_count"),
+        flight_recorder="on" if all(gates.values()) else "off",
+        failed_gates=sorted(g for g, ok in gates.items() if not ok),
+    )
+    return out
+
+
+def write_flight_defaults(decision: dict,
+                          path: str | None = None) -> None:
+    """Persist the flight decision where ``flight_recorder=auto``
+    resolution reads it, with the gate evidence attached so the flip
+    is auditable.  A failing decision writes ``"off"`` — recording
+    the negative verdict beats leaving a stale ``"on"`` behind."""
+    if "flight_recorder" not in decision:
+        raise ValueError(
+            "no flight differential in decision — refusing to write "
+            "flight defaults"
+        )
+    path = path or FLIGHT_DEFAULTS_PATH
+    out = {
+        "flight_recorder": decision["flight_recorder"],
+        "overhead_gate": decision["overhead_gate"],
+        "flight_overhead_fraction": decision.get(
+            "flight_overhead_fraction"
+        ),
+        "gates": decision.get("gates", {}),
+        "failed_gates": decision.get("failed_gates", []),
+        "timestamp_utc": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        ),
+    }
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+
+
 def write_defaults(decision: dict, path: str | None = None) -> None:
     """Persist the winning modes as the committed engine defaults,
     with full provenance so the flip is auditable.
@@ -784,6 +892,8 @@ def main() -> int:
     guard = harvest_guard(paths)
     if guard:
         out["guard_metrics"] = guard
+    flight = decide_flight(guard)
+    out["flight_decision"] = flight
     print(json.dumps(out), flush=True)
     if write:
         try:
@@ -792,6 +902,13 @@ def main() -> int:
         except ValueError as e:
             print(f"decide_defaults: {e}", file=sys.stderr)
             return 3
+        if "flight_recorder" in flight:
+            write_flight_defaults(flight)
+            print(
+                f"decide_defaults: wrote {FLIGHT_DEFAULTS_PATH} "
+                f"(flight_recorder={flight['flight_recorder']})",
+                file=sys.stderr,
+            )
     return 0
 
 

@@ -107,6 +107,130 @@ def _run_one(name: str, path: str, timeout: int,
     return rec
 
 
+# ---------------------------------------------------------------------------
+# cross-round trajectory: BENCH_TRAJECTORY.json
+
+TRAJECTORY_SCHEMA_VERSION = 1
+
+#: a round must beat (1 - this) x the best prior ok round or it is
+#: flagged as a regression
+TRAJECTORY_REGRESSION_FRACTION = 0.10
+
+
+def collect_round_records(repo: str = _REPO) -> dict[int, dict]:
+    """round number -> {config name: headline record} from every
+    banked artifact: ``BENCH_r*.json`` (one ``parsed`` headline per
+    round, keyed by its metric) and ``BENCH_DETAIL_r*.json`` (one
+    ``result`` per config, keyed by config name)."""
+    import glob
+    import re
+
+    rounds: dict[int, dict] = {}
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            doc = json.load(open(path))
+        except (OSError, json.JSONDecodeError):
+            continue
+        parsed = doc.get("parsed") if isinstance(doc, dict) else None
+        if isinstance(parsed, dict) and parsed.get("metric"):
+            rounds.setdefault(int(m.group(1)), {})[
+                parsed["metric"]] = parsed
+    for path in sorted(
+        glob.glob(os.path.join(repo, "BENCH_DETAIL_r*.json"))
+    ):
+        try:
+            doc = json.load(open(path))
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not isinstance(doc, dict):
+            continue
+        n = doc.get("round")
+        if n is None:
+            m = re.search(r"BENCH_DETAIL_r(\d+)\.json$", path)
+            n = int(m.group(1)) if m else None
+        if n is None:
+            continue
+        for rec in doc.get("records", []):
+            result = rec.get("result")
+            if isinstance(result, dict) and rec.get("config"):
+                rounds.setdefault(int(n), {})[rec["config"]] = result
+    return rounds
+
+
+def build_trajectory(rounds: dict[int, dict]) -> dict:
+    """Collate per-config value series across rounds and flag
+    regressions: an ok round whose value drops more than
+    ``TRAJECTORY_REGRESSION_FRACTION`` below the best prior ok round.
+    Non-ok rounds (``status: "timeout"`` salvage, value-less errors)
+    ride along in the series but never vote — a hung child must not
+    read as a perf cliff, and must not reset the bar either."""
+    configs: dict[str, dict] = {}
+    for n in sorted(rounds):
+        for name, rec in sorted(rounds[n].items()):
+            entry = {
+                "round": int(n),
+                "value": rec.get("value"),
+                "status": rec.get("status", "ok"),
+                "vs_baseline": rec.get("vs_baseline"),
+                "platform": rec.get("platform"),
+            }
+            configs.setdefault(name, {"series": []})["series"].append(
+                entry
+            )
+    floor = 1.0 - TRAJECTORY_REGRESSION_FRACTION
+    for name, c in configs.items():
+        best = None
+        for e in c["series"]:
+            v = e["value"]
+            ok = (
+                e["status"] == "ok"
+                and isinstance(v, (int, float))
+                and not isinstance(v, bool)
+                and v > 0
+            )
+            e["regression"] = bool(
+                ok and best is not None and v < floor * best
+            )
+            if ok:
+                best = v if best is None else max(best, v)
+        c["best_value"] = best
+        ok_entries = [e for e in c["series"] if "value" in e
+                      and e["status"] == "ok"
+                      and isinstance(e["value"], (int, float))]
+        c["latest_value"] = (
+            ok_entries[-1]["value"] if ok_entries else None
+        )
+        c["latest_round"] = (
+            ok_entries[-1]["round"] if ok_entries else None
+        )
+        c["regressed"] = bool(
+            ok_entries and ok_entries[-1]["regression"]
+        )
+    return {
+        "schema_version": TRAJECTORY_SCHEMA_VERSION,
+        "regression_fraction": TRAJECTORY_REGRESSION_FRACTION,
+        "rounds": sorted(int(n) for n in rounds),
+        "configs": configs,
+        "regressions": sorted(
+            name for name, c in configs.items() if c["regressed"]
+        ),
+    }
+
+
+def write_trajectory(repo: str = _REPO,
+                     dest: str | None = None) -> str:
+    """Rebuild BENCH_TRAJECTORY.json from every banked round."""
+    traj = build_trajectory(collect_round_records(repo))
+    dest = dest or os.path.join(repo, "BENCH_TRAJECTORY.json")
+    with open(dest, "w") as f:
+        json.dump(traj, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return dest
+
+
 _PROBE_SRC = """
 import jax, jax.numpy as jnp
 import sys
@@ -249,6 +373,11 @@ def main() -> int:
 
     bank(records)
     print(f"wrote {dest}", file=sys.stderr)
+    try:
+        traj = write_trajectory()
+        print(f"wrote {traj}", file=sys.stderr)
+    except Exception as e:  # collation must never cost the round
+        print(f"trajectory collation failed: {e}", file=sys.stderr)
     return 0
 
 

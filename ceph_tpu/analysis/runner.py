@@ -27,19 +27,21 @@ from .findings import RULES, Finding, Suppressions
 HOT_SEGMENTS = frozenset(
     {"crush", "ec", "recovery", "osdmap", "balancer", "cli", "core",
      "parallel", "obs", "workload", "liveness", "superstep", "fleet",
-     "durability", "reconcile", "online", "writepath"}
+     "durability", "reconcile", "online", "writepath", "flight",
+     "traceexport"}
 )
 
 #: path segments whose modules run on the VirtualClock (J010): real
 #: wall-clock reads there need a justified suppression
 VCLOCK_SEGMENTS = frozenset(
     {"recovery", "workload", "chaos", "liveness", "superstep", "fleet",
-     "durability", "reconcile", "online", "writepath"}
+     "durability", "reconcile", "online", "writepath", "flight",
+     "traceexport"}
 )
 
 #: path segments whose modules perform durable writes (J016): the
 #: crash-consistency commit discipline is checked there
-DURABLE_SEGMENTS = frozenset({"checkpoint", "journal", "wal"})
+DURABLE_SEGMENTS = frozenset({"checkpoint", "journal", "wal", "flight"})
 
 
 @dataclass

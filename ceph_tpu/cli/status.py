@@ -30,7 +30,13 @@ bench logs only, like ``fleet``), ``writepath`` (the online-EC
 write-path panel: stripe-cache hit/miss/evict, parity-delta vs
 full-stripe bytes, and encoded GB/s — from the latest
 ``config10_online_ec`` bench record, or live from a daemon's
-``dump_stripe_cache`` hook when ``--socket`` is given).
+``dump_stripe_cache`` hook when ``--socket`` is given), ``crash``
+(also reachable as ``--crash``: the flight-recorder post-mortem panel
+from the latest crash-consistent ``flightdump-*.json`` — found via an
+explicit ``--dump`` path, a journal's ``flight.dump`` reference
+(``--journal-path``), or a ``--dump-dir`` scan — reason, failing
+error, preserved dispatcher/EWMA state keys, and the last recorded
+ring rows).
 """
 
 from __future__ import annotations
@@ -40,7 +46,7 @@ import json
 import sys
 
 COMMANDS = ("status", "health", "timeline", "journal", "caches",
-            "fleet", "ranks", "checkpoint", "writepath")
+            "fleet", "ranks", "checkpoint", "writepath", "crash")
 
 #: CLI command -> admin-socket prefix (identity unless listed)
 _SOCKET_PREFIX = {
@@ -302,6 +308,72 @@ def render_writepath(rec: dict, out) -> None:
         )
 
 
+def find_crash_dump(
+    dump: str | None = None,
+    root: str = ".",
+    journal_path: str | None = None,
+) -> str | None:
+    """Locate the flight dump to render: an explicit path wins; else
+    the last ``flight.dump`` reference in the journal (the guard emits
+    one per dump); else the newest ``flightdump-*.json`` in ``root``
+    (dumps are numbered, so lexical order is creation order)."""
+    import glob
+    import os
+
+    if dump:
+        return dump
+    if journal_path and os.path.exists(journal_path):
+        from ..obs.journal import EventJournal
+
+        path = None
+        for rec in EventJournal.read(journal_path):
+            if rec.get("name") == "flight.dump":
+                path = rec.get("attrs", {}).get("path")
+        if path:
+            return path
+    hits = sorted(glob.glob(os.path.join(root, "flightdump-*.json")))
+    return hits[-1] if hits else None
+
+
+def render_crash(doc: dict, out, *, tail: int = 8) -> None:
+    """The post-mortem panel for one validated flight dump: the typed
+    failure, the preserved state snapshot, ring occupancy, and the
+    last recorded telemetry rows."""
+    print(
+        f"crash: {doc.get('reason', '?')}: "
+        f"{doc.get('error', '') or '(no message)'}",
+        file=out,
+    )
+    state = doc.get("state") or {}
+    if state:
+        for key in sorted(state):
+            print(f"  state.{key} = {json.dumps(state[key], sort_keys=True)}",
+                  file=out)
+    fl = doc.get("flight")
+    if not fl:
+        print("  (no flight ring in dump — recorder was off)", file=out)
+        return
+    print(
+        f"  flight ring: {fl.get('occupancy', 0)}/"
+        f"{fl.get('ring_epochs', 0)} rows, head={fl.get('head', 0)}, "
+        f"drops={fl.get('drops', 0)}",
+        file=out,
+    )
+    lanes = fl.get("lanes") or []
+    rows = fl.get("rows") or []
+    show = ("epoch", "dirty", "rung", "dirty_pgs", "served",
+            "degraded", "blocked", "down_total", "cycles_peer")
+    cols = [(n, lanes.index(n)) for n in show if n in lanes]
+    # per-lane (fleet) rings nest one level deeper; render lane 0
+    if rows and rows[0] and isinstance(rows[0][0], list):
+        rows = rows[0]
+    for row in rows[-int(tail):]:
+        print(
+            "    " + " ".join(f"{n}={row[i]}" for n, i in cols),
+            file=out,
+        )
+
+
 def _demo(args, out) -> tuple[dict, dict]:
     """Seeded in-process chaos run -> replies for every command."""
     import copy
@@ -525,8 +597,45 @@ def main(argv=None) -> int:
                    help="bench JSONL file(s) for the fleet panel "
                         "(repeatable; default: BENCH*.json in the "
                         "working directory)")
+    p.add_argument("--crash", action="store_true",
+                   help="alias for the 'crash' command: render the "
+                        "flight-recorder post-mortem panel")
+    p.add_argument("--dump", metavar="PATH", default=None,
+                   help="explicit flightdump-*.json for the crash "
+                        "panel")
+    p.add_argument("--dump-dir", metavar="DIR", default=".",
+                   help="directory scanned for flightdump-*.json "
+                        "(default: working directory)")
     args = p.parse_args(argv)
     out = sys.stdout
+    if args.crash:
+        args.command = "crash"
+
+    if args.command == "crash":
+        from ..obs.flight import read_flight_dump
+
+        path = find_crash_dump(
+            args.dump, args.dump_dir, args.journal_path
+        )
+        if path is None:
+            print(
+                "status: no flight dump found (pass --dump, "
+                "--dump-dir, or --journal-path with a flight.dump "
+                "reference)",
+                file=sys.stderr,
+            )
+            return 1
+        try:
+            doc = read_flight_dump(path)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"status: cannot read {path}: {e}", file=sys.stderr)
+            return 1
+        if args.as_json:
+            print(json.dumps(doc, sort_keys=True), file=out)
+        else:
+            print(f"dump: {path}", file=out)
+            render_crash(doc, out)
+        return 0
 
     if args.command == "fleet":
         rec = load_fleet_record(args.bench_log)

@@ -195,6 +195,26 @@ SCHEMA: list[Option] = [
            "the dense full-width branch is always appended as the "
            "ladder's top rung and bit-equality reference", min=1,
            see_also=("sparse_dirty_compaction", "sparse_min_bucket")),
+    Option("flight_recorder", OPT_STR, "auto", LEVEL_ADVANCED,
+           "device-resident flight recorder: a fixed-shape ring of "
+           "per-epoch telemetry lanes (ladder rung + dirty-set size, "
+           "dense-vs-compact branch, stripe-cache traffic, outcome "
+           "counts, per-stage cycle proxies) recorded inside the "
+           "compiled epoch superstep and drained at snapshot "
+           "boundaries into the journal / Perfetto exporter: 'on' "
+           "records everywhere, 'off' pins the recorder-free scan, "
+           "'auto' follows the bench-decided default "
+           "(bench/flight_defaults.json; absent -> off)",
+           enum_allowed=("auto", "on", "off"),
+           see_also=("flight_ring_epochs",)),
+    Option("flight_ring_epochs", OPT_INT, 1024, LEVEL_ADVANCED,
+           "rows in the flight recorder's device ring (one telemetry "
+           "row per epoch; power of two — the write cursor is a "
+           "traced value masked by ring_epochs-1, so ring occupancy "
+           "never becomes a shape).  Once the ring wraps, older "
+           "epochs overwrite: crash dumps carry the last ring_epochs "
+           "epochs", min=2,
+           see_also=("flight_recorder",)),
     Option("debug_rank_checks", OPT_BOOL, False, LEVEL_ADVANCED,
            "cross-check a fingerprint of mesh-seam operands across "
            "ranks via a psum before every sharded decode/scrub/"

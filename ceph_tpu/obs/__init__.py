@@ -10,9 +10,27 @@ The observability layer over the recovery/chaos machinery:
   graded into ``HEALTH_OK/WARN/ERR`` healthchecks.
 - :mod:`~ceph_tpu.obs.journal` — correlated JSONL span/event log.
 - :mod:`~ceph_tpu.obs.status` — ``ceph -s`` analog + admin-socket trio.
+- :mod:`~ceph_tpu.obs.flight` — device-resident flight recorder:
+  in-scan telemetry ring + crash-dump forensics.
+- :mod:`~ceph_tpu.obs.traceexport` — Chrome-trace/Perfetto export of
+  journal spans + drained flight rows.
 """
 
+from .flight import (
+    FLIGHT_LANES,
+    FlightState,
+    crash_dump_guard,
+    drain_flight,
+    empty_flight,
+    flight_record,
+    flight_row,
+    journal_drain,
+    read_flight_dump,
+    resolve_flight_recorder,
+    write_flight_dump,
+)
 from .journal import EventJournal
+from .traceexport import build_trace, export_trace, validate_trace
 from .pg_states import (
     N_STATES,
     STATE_NAMES,
@@ -33,6 +51,8 @@ from .timeline import (
 
 __all__ = [
     "EventJournal",
+    "FLIGHT_LANES",
+    "FlightState",
     "HEALTH_ERR",
     "HEALTH_OK",
     "HEALTH_WARN",
@@ -44,11 +64,23 @@ __all__ = [
     "PGStateClassifier",
     "SLOSpec",
     "STATE_NAMES",
+    "build_trace",
+    "crash_dump_guard",
+    "drain_flight",
+    "empty_flight",
     "evaluate",
+    "export_trace",
+    "flight_record",
+    "flight_row",
+    "journal_drain",
     "pg_state_step",
+    "read_flight_dump",
     "register_admin_hooks",
     "render_status",
+    "resolve_flight_recorder",
     "sharded_pg_state_step",
     "status_dict",
+    "validate_trace",
     "worst_status",
+    "write_flight_dump",
 ]

@@ -1,0 +1,417 @@
+"""Device-resident flight recorder for the fused epoch loop.
+
+Since the epoch superstep fused the whole per-epoch pipeline into one
+``lax.scan`` (PR 12), the observability layer can only see snapshot
+boundaries: per-stage cost, ladder-rung selection (PR 19), and
+stripe-cache behavior (PR 16) are invisible between host exits.  The
+flight recorder closes that gap the same way a hardware flight data
+recorder does — a fixed-shape ring buffer riding the scan carry, one
+row of telemetry lanes per epoch, recorded *inside* the compiled
+program with zero mid-scan host transfers:
+
+- :class:`FlightState` is a registered frozen-dataclass pytree:
+  ``ring`` (i64 ``[..., R, L]``; ``R`` = power-of-two ring rows, ``L``
+  = the static :data:`FLIGHT_LANES` schema, optional leading fleet
+  axis) plus a scalar ``head`` counting every epoch ever recorded.
+  The write cursor is ``head & (R - 1)`` — a *traced* value used only
+  as a dynamic index, never a shape (jaxlint J013), so walking ring
+  sizes re-uses one compiled program per ring bucket and recording N
+  epochs into any ring never recompiles.
+- Per-stage cost is carried as **cycle proxies** — deterministic
+  op-count counters (chosen bucket width for peering, routed-op total
+  for traffic, due-window size for scrub), the existing counter
+  discipline, never wall clock: this module stays on the virtual
+  clock (jaxlint J010).
+- :func:`drain_flight` unrotates the ring on the host at snapshot
+  boundaries; :func:`journal_drain` lands the summary as a typed
+  ``flight.drain`` journal record; :func:`write_flight_dump` commits
+  a crash-consistent ``flightdump-*.json`` (tmp + fsync + replace +
+  directory fsync — the PR-15 checkpoint discipline, jaxlint J016)
+  and :func:`crash_dump_guard` arms it around typed failures so
+  ``cli.status crash`` can render a post-mortem panel.
+
+The recorder is gated by the ``flight_recorder on/off/auto`` knob;
+'auto' consults the bench-decided default written by
+``bench/decide_defaults.py --write`` (absent -> off), mirroring the
+kernel-defaults quarantine discipline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+#: static per-epoch lane schema (ring columns, i64 each).  Stage
+#: grouping: epoch identity, dirty-set/ladder telemetry (PR 19),
+#: traffic outcomes, liveness transitions, scrub, stripe cache
+#: (PR 16; zero when no write path rides the scan), and the
+#: per-stage cycle proxies.
+FLIGHT_LANES = (
+    "epoch",               # scan step index (absolute epoch)
+    "dirty",               # 1 = peering re-ran this epoch
+    "rung",                # ladder rung chosen (-1 quiet, n_rungs dense)
+    "dirty_pgs",           # dirty-set size entering the ladder
+    "compact",             # 1 = compacted branch taken (vs dense)
+    "heavy",               # heavy-epoch flag (weight edit / OSD up)
+    "served",              # traffic outcome counts
+    "degraded",
+    "blocked",
+    "writes",              # committed client writes
+    "deg_reads",           # degraded reads served
+    "eff_down",            # liveness transitions become map edits
+    "eff_up",
+    "eff_out",
+    "down_total",          # detector-down OSDs after the tick
+    "scrub_due",           # PGs whose scrub window ticked
+    "stripe_hits",         # stripe-cache traffic (writepath runs)
+    "stripe_misses",
+    "stripe_evictions",
+    "stripe_delta_words",  # parity-delta payload (u32 words)
+    "cycles_peer",         # per-stage self-timed cycle proxies
+    "cycles_traffic",      # (counter discipline, never wall clock)
+    "cycles_scrub",
+)
+
+N_FLIGHT_LANES = len(FLIGHT_LANES)
+
+#: journal/dump envelope version for drained flight payloads
+FLIGHT_SCHEMA_VERSION = 1
+
+#: where `flight_recorder auto` looks for the bench-decided default
+DEFAULTS_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+    "bench", "flight_defaults.json",
+)
+
+
+def _is_pow2(n: int) -> bool:
+    return n >= 1 and (n & (n - 1)) == 0
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass(frozen=True)
+class FlightState:
+    """The recorder's scan-carry leaves: the lane ring and the epoch
+    head.  ``head`` counts every epoch ever recorded (occupancy is
+    ``min(head, R)``, drops are ``max(head - R, 0)``); the ring row a
+    record lands in is ``head & (R - 1)`` — traced, never a shape."""
+
+    ring: jnp.ndarray   # i64 [..., R, N_FLIGHT_LANES]
+    head: jnp.ndarray   # i64 scalar: epochs recorded since empty
+
+    def tree_flatten(self):
+        return (self.ring, self.head), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        ring, head = children
+        return cls(ring=ring, head=head)
+
+    @property
+    def ring_epochs(self) -> int:
+        return int(self.ring.shape[-2])
+
+
+def empty_flight(ring_epochs: int, *, fleet: int | None = None
+                 ) -> FlightState:
+    """A zeroed recorder.  ``ring_epochs`` must be a power of two (the
+    cursor mask depends on it); ``fleet`` adds a leading per-lane axis
+    for the vmapped fleet superstep."""
+    r = int(ring_epochs)
+    if not _is_pow2(r):
+        raise ValueError(
+            f"flight_ring_epochs must be a power of two, got {r}"
+        )
+    shape = (r, N_FLIGHT_LANES) if fleet is None else (
+        int(fleet), r, N_FLIGHT_LANES
+    )
+    return FlightState(
+        ring=jnp.zeros(shape, I64), head=jnp.zeros((), I64)
+    )
+
+
+def flight_row(**lanes) -> jnp.ndarray:
+    """Assemble one i64 lane row (or a ``[fleet, L]`` block when the
+    values carry a leading fleet axis) in :data:`FLIGHT_LANES` order.
+    Missing lanes default to zero; unknown lane names raise."""
+    unknown = set(lanes) - set(FLIGHT_LANES)
+    if unknown:
+        raise ValueError(f"unknown flight lanes: {sorted(unknown)}")
+    vals = [
+        jnp.asarray(lanes.get(name, 0)).astype(I64)
+        for name in FLIGHT_LANES
+    ]
+    return jnp.stack(jnp.broadcast_arrays(*vals), axis=-1)
+
+
+def flight_record(fs: FlightState, row) -> FlightState:
+    """Record one epoch's lane row into the ring — the in-scan write.
+    The cursor is traced (``head & (R-1)``); the update is a dynamic
+    row scatter, so ring occupancy never shapes the program."""
+    ring = fs.ring
+    r = ring.shape[-2]
+    idx = (fs.head & jnp.int64(r - 1)).astype(I32)
+    if ring.ndim == 2:
+        ring = ring.at[idx].set(row)
+    else:
+        ring = ring.at[:, idx].set(row)
+    return FlightState(ring=ring, head=fs.head + 1)
+
+
+# ---------------------------------------------------------------------------
+# host-side drain
+
+
+def drain_flight(fs: FlightState) -> dict:
+    """Pull the ring to the host and unrotate it: a pure READ (the
+    device state is untouched, so checkpointed carries stay bit-equal
+    across drains).  Returns occupancy bookkeeping plus the valid
+    rows oldest-to-newest (``[occupancy, L]``, or
+    ``[fleet, occupancy, L]`` for per-lane rings)."""
+    ring = np.asarray(jax.device_get(fs.ring))
+    head = int(jax.device_get(fs.head))
+    r = ring.shape[-2]
+    occ = min(head, r)
+    if head <= r:
+        rows = ring[..., :head, :]
+    else:
+        cut = head & (r - 1)
+        rows = np.concatenate(
+            [ring[..., cut:, :], ring[..., :cut, :]], axis=-2
+        )
+    return {
+        "v": FLIGHT_SCHEMA_VERSION,
+        "lanes": list(FLIGHT_LANES),
+        "ring_epochs": r,
+        "head": head,
+        "occupancy": occ,
+        "drops": max(head - r, 0),
+        "rows": rows,
+    }
+
+
+def _lane_col(drain: dict, name: str) -> np.ndarray:
+    return drain["rows"][..., FLIGHT_LANES.index(name)]
+
+
+def journal_drain(journal, fs: FlightState, **extra) -> dict | None:
+    """Land a drained ring summary as a typed ``flight.drain`` journal
+    record (aggregates only — the rows stay host-side with the caller;
+    the trace exporter re-joins them by epoch).  Returns the drain
+    dict, or None when the ring is empty."""
+    drain = drain_flight(fs)
+    if drain["occupancy"] == 0:
+        return None
+    epochs = _lane_col(drain, "epoch")
+    dirty = _lane_col(drain, "dirty")
+    attrs = {
+        "v": drain["v"],
+        "ring_epochs": drain["ring_epochs"],
+        "head": drain["head"],
+        "occupancy": drain["occupancy"],
+        "drops": drain["drops"],
+        "epoch_first": int(epochs.min()),
+        "epoch_last": int(epochs.max()),
+        "dirty_epochs": int(dirty.sum()),
+        "stripe_hits": int(_lane_col(drain, "stripe_hits").sum()),
+        "stripe_misses": int(_lane_col(drain, "stripe_misses").sum()),
+        **extra,
+    }
+    journal.event("flight.drain", **attrs)
+    return drain
+
+
+# ---------------------------------------------------------------------------
+# knob resolution
+
+
+def resolve_flight_recorder(mode: str,
+                            defaults_path: str | None = None) -> bool:
+    """Map the ``flight_recorder`` knob onto a concrete on/off.
+    'auto' consults the bench-decided default file (written by
+    ``decide_defaults --write`` once the telemetry differential has
+    proven bit-equality and the overhead gate); a missing or
+    malformed file means off — the recorder never self-enables
+    without recorded evidence."""
+    mode = str(mode)
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    if mode != "auto":
+        raise ValueError(f"flight_recorder must be on/off/auto, "
+                         f"got {mode!r}")
+    path = DEFAULTS_PATH if defaults_path is None else defaults_path
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return False
+    return doc.get("flight_recorder") == "on"
+
+
+# ---------------------------------------------------------------------------
+# crash-dump forensics
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so renames within it survive a crash."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _next_dump_path(root: str, reason: str) -> str:
+    """A fresh ``flightdump-<reason>-<k>.json`` name: numbered, not
+    timestamped — this module stays off the wall clock."""
+    k = 0
+    while True:
+        path = os.path.join(root, f"flightdump-{reason}-{k:04d}.json")
+        if not os.path.exists(path) and not os.path.exists(
+            path + ".tmp"
+        ):
+            return path
+        k += 1
+
+
+def write_flight_dump(
+    root: str,
+    fs: FlightState | None,
+    *,
+    reason: str,
+    error: str = "",
+    state: dict | None = None,
+    journal=None,
+) -> str:
+    """Commit a crash-consistent flight dump and return its path.
+
+    The payload is the drained ring (last-N-epoch rows, lane schema,
+    occupancy bookkeeping) plus free-form ``state`` (dispatcher/EWMA
+    snapshots, checkpoint metadata — whatever the failing layer can
+    still reach).  The commit chain is the PR-15 checkpoint
+    discipline: write ``.tmp``, flush + fsync the file, ``os.replace``
+    onto the final name, fsync the directory — a crash at any point
+    leaves either no dump or a complete one, never a torn tail.  When
+    a journal is given, a ``flight.dump`` event referencing the path
+    is emitted so the status CLI can find the dump from the journal
+    alone."""
+    root = str(root)
+    os.makedirs(root, exist_ok=True)
+    drain = drain_flight(fs) if fs is not None else None
+    payload = {
+        "v": FLIGHT_SCHEMA_VERSION,
+        "kind": "flight.dump",
+        "reason": str(reason),
+        "error": str(error),
+        "state": state or {},
+    }
+    if drain is not None:
+        payload["flight"] = {
+            **{k: drain[k] for k in (
+                "v", "lanes", "ring_epochs", "head", "occupancy",
+                "drops",
+            )},
+            "rows": np.asarray(drain["rows"]).tolist(),
+        }
+    final = _next_dump_path(root, str(reason))
+    tmp = final + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh, sort_keys=True)
+        fh.write("\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, final)
+    _fsync_dir(root)
+    if journal is not None:
+        journal.event(
+            "flight.dump", path=final, reason=str(reason),
+            error=str(error),
+        )
+    return final
+
+
+def read_flight_dump(path: str) -> dict:
+    """Parse a dump back; raises ValueError on a structurally invalid
+    file (the validation half of the crash-dump contract)."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    problems = validate_flight_dump(doc)
+    if problems:
+        raise ValueError(f"{path}: invalid flight dump: {problems}")
+    return doc
+
+
+def validate_flight_dump(doc) -> list[str]:
+    """Minimal schema check for a dump payload; [] = valid."""
+    out = []
+    if not isinstance(doc, dict):
+        return ["dump is not an object"]
+    for key in ("v", "kind", "reason", "state"):
+        if key not in doc:
+            out.append(f"missing key {key!r}")
+    if doc.get("kind") != "flight.dump":
+        out.append(f"kind is {doc.get('kind')!r}")
+    fl = doc.get("flight")
+    if fl is not None:
+        if not isinstance(fl, dict):
+            return out + ["flight is not an object"]
+        if fl.get("lanes") != list(FLIGHT_LANES):
+            out.append("flight.lanes does not match FLIGHT_LANES")
+        rows = fl.get("rows")
+        if not isinstance(rows, list):
+            out.append("flight.rows is not a list")
+        elif rows and not _is_pow2(int(fl.get("ring_epochs", 0))):
+            out.append("flight.ring_epochs is not a power of two")
+    return out
+
+
+class crash_dump_guard:
+    """Context manager arming crash-dump forensics around a run: any
+    escaping typed failure (``ChipLostError``, ``RankStalledError``,
+    ``CheckpointError``, verify-failed quarantine — anything matching
+    ``types``) dumps the recorder's last-N-epoch ring plus the
+    supplied state snapshot, then re-raises.  ``flight`` may be a
+    :class:`FlightState` or a zero-arg callable resolved at failure
+    time (the driver's live carry)."""
+
+    def __init__(self, root: str, flight=None, *, journal=None,
+                 state: dict | None = None, types=None):
+        self.root = str(root)
+        self.flight = flight
+        self.journal = journal
+        self.state = state or {}
+        if types is None:
+            from ..analysis.runtime_guard import RankStalledError
+            from ..recovery.checkpoint import CheckpointError
+            from ..recovery.dispatch import ChipLostError
+
+            types = (ChipLostError, RankStalledError, CheckpointError)
+        self.types = tuple(types)
+        self.dump_path: str | None = None
+
+    def __enter__(self) -> "crash_dump_guard":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc_type is None or not issubclass(exc_type, self.types):
+            return False
+        fs = self.flight() if callable(self.flight) else self.flight
+        self.dump_path = write_flight_dump(
+            self.root, fs,
+            reason=exc_type.__name__,
+            error=str(exc),
+            state=self.state,
+            journal=self.journal,
+        )
+        return False

@@ -34,6 +34,10 @@ from typing import Callable
 
 from ..common.tracing import trace_annotation
 
+#: journal envelope version (the ``v`` field on every record).
+#: v2 added ``v`` + the monotonic ``seq`` emission counter.
+SCHEMA_VERSION = 2
+
 
 def _fsync_dir(path: str) -> None:
     """fsync a directory so renames within it survive a crash."""
@@ -78,6 +82,7 @@ class EventJournal:
             )
         self.records: list[dict] = []
         self._next_span = 0
+        self._next_seq = 0  # emission order, assigned at _emit time
         self._open: list[int] = []  # span-id stack for parent linkage
         self._fh = None
         self._size = 0
@@ -97,6 +102,11 @@ class EventJournal:
         file."""
         if os.path.exists(self.path):
             self._repair_torn_tail(self.path)
+            self._reseed_seq(self.path)
+        if self._next_seq == 0 and os.path.exists(self.path + ".1"):
+            # crash between rotation and the first fresh append: the
+            # stream's tail is the newest rotated segment
+            self._reseed_seq(self.path + ".1")
         base = os.path.basename(self.path)
         d = os.path.dirname(self.path) or "."
         for fn in sorted(os.listdir(d)):
@@ -107,6 +117,29 @@ class EventJournal:
                 os.remove(os.path.join(d, fn))
         self._fh = open(self.path, "a")
         self._size = os.path.getsize(self.path)
+
+    def _reseed_seq(self, path: str) -> None:
+        """Continue the emission counter past a restart: seq must stay
+        monotonic across the FILE, not per process, or every resume
+        would manufacture a phantom gap (or mask a real one)."""
+        try:
+            with open(path, "rb") as fh:
+                data = fh.read()
+        except OSError:
+            return
+        for raw in reversed(data.splitlines()):
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                rec = json.loads(raw)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict) and isinstance(
+                rec.get("seq"), int
+            ):
+                self._next_seq = max(self._next_seq, rec["seq"] + 1)
+                return
 
     @staticmethod
     def _repair_torn_tail(path: str) -> None:
@@ -134,6 +167,11 @@ class EventJournal:
     # ---- emission ---------------------------------------------------
 
     def _emit(self, record: dict) -> dict:
+        # seq is assigned HERE, not in _record: span ids are allocated
+        # at open but spans land at close, so only emission order is
+        # monotonic in the file — the property the gap reader checks
+        record["seq"] = self._next_seq
+        self._next_seq += 1
         self.records.append(record)
         if self._fh is not None:
             line = json.dumps(record, sort_keys=True) + "\n"
@@ -179,6 +217,7 @@ class EventJournal:
         span_id = self._next_span
         self._next_span += 1
         record = {
+            "v": SCHEMA_VERSION,
             "trace_id": self.trace_id,
             "span_id": span_id,
             "parent_id": self._open[-1] if self._open else None,
@@ -217,7 +256,42 @@ class EventJournal:
         return [r for r in self.records if r["name"] == name]
 
     @staticmethod
-    def read(path: str, *, tolerate_torn: bool = True) -> list[dict]:
+    def _with_gap_records(records: list[dict]) -> list[dict]:
+        """Surface missing emission counters as synthetic
+        ``journal.gap`` records, in place in the stream.
+
+        Torn-tail repair (and surgical segment truncation) removes
+        whole records from the middle of a rotated stream; the seq
+        counter makes the loss *visible*: any jump between
+        consecutive seq-carrying records becomes a synthetic event
+        naming the window, so post-mortem replay knows what it is
+        missing instead of silently reading a shorter history.
+        Records without ``seq`` (pre-v2 files) are passed through and
+        never flagged."""
+        out: list[dict] = []
+        prev: int | None = None
+        for rec in records:
+            seq = rec.get("seq") if isinstance(rec, dict) else None
+            if isinstance(seq, int) and prev is not None and (
+                seq > prev + 1
+            ):
+                out.append({
+                    "v": SCHEMA_VERSION,
+                    "kind": "journal.gap",
+                    "name": "journal.gap",
+                    "synthetic": True,
+                    "seq_before": prev,
+                    "seq_after": seq,
+                    "n_missing": seq - prev - 1,
+                })
+            if isinstance(seq, int):
+                prev = seq
+            out.append(rec)
+        return out
+
+    @staticmethod
+    def read(path: str, *, tolerate_torn: bool = True,
+             detect_gaps: bool = True) -> list[dict]:
         """Parse a journal file back into records — crash-tolerant.
 
         Every record is flushed as it is emitted, so the only damage a
@@ -254,6 +328,8 @@ class EventJournal:
                 "journal segment (rotation moves whole files, so "
                 "only the stream's last segment may end torn)"
             )
+        if detect_gaps:
+            out = EventJournal._with_gap_records(out)
         return out
 
     @staticmethod
@@ -284,7 +360,12 @@ class EventJournal:
             tail = path
         out: list[dict] = []
         for seg in stream:
+            # per-segment gap detection is deferred: a gap spanning a
+            # rotation boundary is only visible on the stitched stream
             out.extend(
-                EventJournal.read(seg, tolerate_torn=(seg == tail))
+                EventJournal.read(
+                    seg, tolerate_torn=(seg == tail),
+                    detect_gaps=False,
+                )
             )
-        return out
+        return EventJournal._with_gap_records(out)

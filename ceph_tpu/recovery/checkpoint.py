@@ -624,17 +624,29 @@ def checkpointed_superstep(
     chaos zoo and every kill phase).  ``crashes`` are
     :class:`CrashPoint`\\ s (or ``(epoch, phase[, action])`` tuples) —
     pass the points still pending; a resumed run normally passes
-    none."""
+    none.
+
+    With the driver's flight recorder on, the snapshot pytree is the
+    ``(ClusterState, FlightState)`` carry: the telemetry ring resumes
+    with the state it observed, so a killed run's post-resume drain
+    is bit-equal to an uninterrupted run's (the flight cell of the
+    kill-at-every-point matrix)."""
     n_epochs = int(n_epochs)
     every = int(snapshot_every) or max(n_epochs, 1)
     sched = _CrashSchedule(crashes)
-    scan_fn = driver.compile_superstep()
-    resume = store.load_latest(driver._init_state, with_series=True)
+    flight_on = bool(getattr(driver, "flight_on", False))
+    if flight_on:
+        scan_fn = driver.compile_superstep_flight()
+        template = (driver._init_state, driver._init_flight)
+    else:
+        scan_fn = driver.compile_superstep()
+        template = driver._init_state
+    resume = store.load_latest(template, with_series=True)
     if resume is None:
-        state, start = driver._init_state, 0
+        carry, start = template, 0
         cols = None
     else:
-        meta, state, series = resume
+        meta, carry, series = resume
         start = int(meta.get("next_epoch", 0))
         cols = {f: series[f] for f in _SERIES_FIELDS} if series else None
     if start == 0:
@@ -642,7 +654,13 @@ def checkpointed_superstep(
     while start < n_epochs:
         end = _aligned_end(start, n_epochs, every)
         steps = jnp.arange(start, end, dtype=I32)
-        state, rows = scan_fn(state, steps)
+        if flight_on:
+            state, fs, rows = scan_fn(*carry, steps)
+            carry = (state, fs)
+            driver.flight = fs
+        else:
+            state, rows = scan_fn(carry, steps)
+            carry = state
         part = EpochSeries.from_device(rows)
         cols = {
             f: (np.concatenate([cols[f], getattr(part, f)])
@@ -655,7 +673,7 @@ def checkpointed_superstep(
             store._crash_hook = lambda phase: during.fire()
         try:
             store.save(
-                state,
+                carry,
                 meta={"next_epoch": end, "n_epochs": n_epochs},
                 series=cols,
             )
@@ -670,13 +688,19 @@ def checkpointed_superstep(
             )
         sched.fire(end, "after")
         start = end
+    state = carry[0] if flight_on else carry
     driver.final_state = state
     if cols is None:
         # zero-epoch run: one empty scan pull gives correctly-shaped
         # zero-length columns
-        _, rows = scan_fn(
-            driver._init_state, jnp.arange(0, 0, dtype=I32)
-        )
+        if flight_on:
+            _, _, rows = scan_fn(
+                *template, jnp.arange(0, 0, dtype=I32)
+            )
+        else:
+            _, rows = scan_fn(
+                template, jnp.arange(0, 0, dtype=I32)
+            )
         return EpochSeries.from_device(rows)
     return EpochSeries(**cols)
 

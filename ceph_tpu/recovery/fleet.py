@@ -266,8 +266,12 @@ class FleetDriver:
             m, ChaosTimeline(), seed=seed, **driver_kwargs
         )
         self._fleet_scan = None
+        self._fleet_flight_scan = None
         self._seq_scan = None
         self._init_cache: dict[int, object] = {}
+        self._flight_cache: dict[int, object] = {}
+        #: live per-lane recorder carry after the most recent run
+        self.flight = None
 
     # -- inputs --------------------------------------------------------
 
@@ -331,112 +335,202 @@ class FleetDriver:
         lane stays dense here: the lane bodies are vmapped, and a
         per-lane PG-ladder switch under vmap would run all rungs."""
         if self._fleet_scan is None:
-            drv = self.driver
-
-            def peer_select(fstate, dirty):
-                peered = jax.vmap(drv._peer_hist_fn)(fstate)
-                return jax.tree_util.tree_map(
-                    lambda p, s: jnp.where(
-                        dirty.reshape((-1,) + (1,) * (p.ndim - 1)), p, s
-                    ),
-                    peered, fstate,
-                )
-
-            sdc = drv._sparse_mode
-
-            @jax.jit
-            def scan_fn(fstate, steps, t, kind, osd, bump, salts):
-                # trace-time fleet pad for THIS shape bucket: the lane
-                # ladder starts at one lane (a single dirty cluster is
-                # the common divergent epoch) and is gated like the
-                # superstep's PG ladder — 'auto' needs a fleet wide
-                # enough for compaction to beat one fused dense launch
-                f_pad = int(fstate.epoch.shape[0])
-                lane_widths = (
-                    dirty_ladder(
-                        f_pad, min_bucket=1, growth=4,
-                        max_rungs=drv._sparse_rungs,
-                    )
-                    if sdc == "on" or (sdc == "auto" and f_pad >= 8)
-                    else ()
-                )
-
-                def lane_compact(op, W: int):
-                    fs, take, dirty = op
-                    idx = jnp.clip(take[:W], 0, f_pad - 1)
-                    sub = jax.tree_util.tree_map(
-                        lambda l: l[idx], fs
-                    )
-                    peered = jax.vmap(drv._peer_hist_fn)(sub)
-                    return jax.tree_util.tree_map(
-                        lambda l, p: l.at[take[:W]].set(
-                            p, mode="drop"
-                        ),
-                        fs, peered,
-                    )
-
-                lane_branches = [
-                    (lambda op, W=W: lane_compact(op, W))
-                    for W in lane_widths
-                ] + [lambda op: peer_select(op[0], op[2])]
-
-                def peer_dirty(fs, dirty):
-                    if not lane_widths:
-                        return peer_select(fs, dirty)
-                    take, n_dirty = compact_dirty_indices(dirty)
-                    return jax.lax.switch(
-                        ladder_rung(n_dirty, lane_widths),
-                        lane_branches, (fs, take, dirty),
-                    )
-                def lane_pre(st, ti, ki, oi, bi, step):
-                    prev_now = st.now
-                    st, tape_dirty = drv._tape_apply(
-                        st, step, (ti, ki, oi, bi)
-                    )
-                    st, (nd, nu, no, down_total, down_ck, trans) = (
-                        drv._live_fn(st)
-                    )
-                    return st, (
-                        tape_dirty | trans, prev_now,
-                        nd, nu, no, down_total, down_ck,
-                    )
-
-                def lane_post(st, salt, prev_now, step):
-                    traffic = drv._traffic_apply(st, step, salt)
-                    scrub_due = drv._scrub_fn(prev_now, st.now)
-                    return traffic, scrub_due
-
-                def sbody(carry, step):
-                    carry, (dirty, prev_now, nd, nu, no, dtot, dck) = (
-                        jax.vmap(
-                            lane_pre, in_axes=(0, 0, 0, 0, 0, None)
-                        )(carry, t, kind, osd, bump, step)
-                    )
-                    carry = jax.lax.cond(
-                        jnp.any(dirty),
-                        lambda s: peer_dirty(s, dirty),
-                        lambda s: s,
-                        carry,
-                    )
-                    (
-                        (counts, lat_hist, qd_hist, sums, max_rho,
-                         writes, deg_reads),
-                        scrub_due,
-                    ) = jax.vmap(
-                        lane_post, in_axes=(0, 0, 0, None)
-                    )(carry, salts, prev_now, step)
-                    row = (
-                        carry.now, carry.epoch, dirty.astype(I32),
-                        carry.pg_hist, carry.pg_aux, counts, lat_hist,
-                        qd_hist, sums, max_rho, writes, deg_reads,
-                        dtot, nd, nu, no, dck, scrub_due,
-                    )
-                    return carry, row
-
-                return jax.lax.scan(sbody, fstate, steps)
-
-            self._fleet_scan = scan_fn
+            self._fleet_scan = self._build_fleet_scan(with_flight=False)
         return self._fleet_scan
+
+    def _fleet_flight_scan_fn(self):
+        """The flight-recorder variant: ``(fleet_state, fs, steps, t,
+        kind, osd, bump, salts) -> (fleet_state, fs, rows)``.  Same
+        builder, same jitted pieces, same epoch math — the recorder
+        carry is write-only telemetry, so the 18 series lanes stay
+        bit-equal to the plain scan by construction.  Each cluster
+        lane gets its own ring row block (``ring[fleet, R, L]``); the
+        lane-ladder stats (rung, dirty-lane count, chosen bucket
+        width) are scalar per epoch and broadcast across lanes."""
+        if self._fleet_flight_scan is None:
+            self._fleet_flight_scan = self._build_fleet_scan(
+                with_flight=True
+            )
+        return self._fleet_flight_scan
+
+    def _flight_state(self, f_pad: int):
+        """A zeroed per-lane flight ring, cached per fleet pad bucket
+        (mirrors :meth:`_fleet_state`)."""
+        from ..obs.flight import empty_flight
+
+        fs = self._flight_cache.get(f_pad)
+        if fs is None:
+            fs = empty_flight(
+                self.driver.flight_ring_epochs, fleet=f_pad
+            )
+            self._flight_cache[f_pad] = fs
+        return fs
+
+    def _build_fleet_scan(self, *, with_flight: bool):
+        drv = self.driver
+        # deferred: obs package reaches back into recovery
+        from ..obs.flight import flight_record, flight_row
+
+        def peer_select(fstate, dirty):
+            peered = jax.vmap(drv._peer_hist_fn)(fstate)
+            return jax.tree_util.tree_map(
+                lambda p, s: jnp.where(
+                    dirty.reshape((-1,) + (1,) * (p.ndim - 1)), p, s
+                ),
+                peered, fstate,
+            )
+
+        sdc = drv._sparse_mode
+
+        def _impl(fstate, frec, steps, t, kind, osd, bump, salts):
+            # trace-time fleet pad for THIS shape bucket: the lane
+            # ladder starts at one lane (a single dirty cluster is
+            # the common divergent epoch) and is gated like the
+            # superstep's PG ladder — 'auto' needs a fleet wide
+            # enough for compaction to beat one fused dense launch
+            f_pad = int(fstate.epoch.shape[0])
+            lane_widths = (
+                dirty_ladder(
+                    f_pad, min_bucket=1, growth=4,
+                    max_rungs=drv._sparse_rungs,
+                )
+                if sdc == "on" or (sdc == "auto" and f_pad >= 8)
+                else ()
+            )
+            n_rungs = len(lane_widths)
+            # chosen lane-bucket width per rung (dense rung = f_pad):
+            # the peering-stage cycle proxy (counter discipline)
+            cyc_table = jnp.asarray(
+                tuple(lane_widths) + (f_pad,), jnp.int64
+            )
+
+            def lane_compact(op, W: int):
+                fst, take, dirty = op
+                idx = jnp.clip(take[:W], 0, f_pad - 1)
+                sub = jax.tree_util.tree_map(
+                    lambda l: l[idx], fst
+                )
+                peered = jax.vmap(drv._peer_hist_fn)(sub)
+                return jax.tree_util.tree_map(
+                    lambda l, p: l.at[take[:W]].set(
+                        p, mode="drop"
+                    ),
+                    fst, peered,
+                )
+
+            lane_branches = [
+                (lambda op, W=W: lane_compact(op, W))
+                for W in lane_widths
+            ] + [lambda op: peer_select(op[0], op[2])]
+
+            def peer_dirty(fst, dirty):
+                if not lane_widths:
+                    return peer_select(fst, dirty)
+                take, n_dirty = compact_dirty_indices(dirty)
+                return jax.lax.switch(
+                    ladder_rung(n_dirty, lane_widths),
+                    lane_branches, (fst, take, dirty),
+                )
+            def lane_pre(st, ti, ki, oi, bi, step):
+                prev_now = st.now
+                st, tape_dirty = drv._tape_apply(
+                    st, step, (ti, ki, oi, bi)
+                )
+                st, (nd, nu, no, down_total, down_ck, trans) = (
+                    drv._live_fn(st)
+                )
+                return st, (
+                    tape_dirty | trans, prev_now,
+                    nd, nu, no, down_total, down_ck,
+                )
+
+            def lane_post(st, salt, prev_now, step):
+                traffic = drv._traffic_apply(st, step, salt)
+                scrub_due = drv._scrub_fn(prev_now, st.now)
+                return traffic, scrub_due
+
+            def sbody(carry, step):
+                if with_flight:
+                    carry, rec = carry
+                carry, (dirty, prev_now, nd, nu, no, dtot, dck) = (
+                    jax.vmap(
+                        lane_pre, in_axes=(0, 0, 0, 0, 0, None)
+                    )(carry, t, kind, osd, bump, step)
+                )
+                carry = jax.lax.cond(
+                    jnp.any(dirty),
+                    lambda s: peer_dirty(s, dirty),
+                    lambda s: s,
+                    carry,
+                )
+                (
+                    (counts, lat_hist, qd_hist, sums, max_rho,
+                     writes, deg_reads),
+                    scrub_due,
+                ) = jax.vmap(
+                    lane_post, in_axes=(0, 0, 0, None)
+                )(carry, salts, prev_now, step)
+                row = (
+                    carry.now, carry.epoch, dirty.astype(I32),
+                    carry.pg_hist, carry.pg_aux, counts, lat_hist,
+                    qd_hist, sums, max_rho, writes, deg_reads,
+                    dtot, nd, nu, no, dck, scrub_due,
+                )
+                if not with_flight:
+                    return carry, row
+                # lane-ladder stats are scalar per epoch (the ladder
+                # is fleet-level); per-lane lanes come off the row
+                n_dl = jnp.sum(dirty.astype(I32))
+                anyd = jnp.any(dirty)
+                rung = jnp.where(
+                    anyd,
+                    ladder_rung(n_dl, lane_widths).astype(I32),
+                    jnp.int32(-1),
+                )
+                frow = flight_row(
+                    epoch=step,
+                    dirty=dirty.astype(I32),
+                    rung=rung,
+                    dirty_pgs=n_dl,
+                    compact=anyd & (rung < n_rungs),
+                    served=counts[..., 0],
+                    degraded=counts[..., 1],
+                    blocked=counts[..., 2],
+                    writes=writes,
+                    deg_reads=deg_reads,
+                    eff_down=nd, eff_up=nu, eff_out=no,
+                    down_total=dtot,
+                    scrub_due=scrub_due,
+                    cycles_peer=jnp.where(
+                        anyd,
+                        cyc_table[jnp.clip(rung, 0, n_rungs)],
+                        jnp.int64(0),
+                    ),
+                    cycles_traffic=(
+                        counts[..., 0] + counts[..., 1]
+                        + counts[..., 2]
+                    ),
+                    cycles_scrub=scrub_due,
+                )
+                return (carry, flight_record(rec, frow)), row
+
+            if with_flight:
+                (fstate, frec), rows = jax.lax.scan(
+                    sbody, (fstate, frec), steps
+                )
+                return fstate, frec, rows
+            return jax.lax.scan(sbody, fstate, steps)
+
+        if with_flight:
+            return jax.jit(_impl)
+
+        @jax.jit
+        def scan_fn(fstate, steps, t, kind, osd, bump, salts):
+            return _impl(
+                fstate, None, steps, t, kind, osd, bump, salts
+            )
+
+        return scan_fn
 
     def _seq_scan_fn(self):
         """The one-cluster scan with (tape, salt) traced in — swapping
@@ -464,20 +558,38 @@ class FleetDriver:
         *,
         seeds=None,
         pull: bool = True,
+        journal=None,
     ):
         """Advance every timeline ``n_epochs`` epochs in one vmapped
         scan.  Returns a cropped :class:`FleetSeries`, or — with
         ``pull=False`` — the device-resident ``(state, rows)`` pair
         (the zero-host-transfer path the ``fleet_superstep``
-        nonregression scenario pins)."""
+        nonregression scenario pins).  When the template driver's
+        flight recorder is on, a per-lane ring rides the carry
+        (``self.flight`` afterwards; drained into ``journal`` when
+        given) without touching the series lanes."""
         tls = list(timelines)
         tapes = [compile_event_tape(tl, self.m) for tl in tls]
         ftape = stack_tapes(tapes)
         salts = self._salts(len(tls), ftape.fleet_pad, seeds)
         fstate = self._fleet_state(ftape.fleet_pad)
         steps = jnp.arange(int(n_epochs), dtype=I32)
-        scan_fn = self._fleet_scan_fn()
-        state, rows = scan_fn(fstate, steps, *ftape.device(), salts)
+        if getattr(self.driver, "flight_on", False):
+            scan_fn = self._fleet_flight_scan_fn()
+            state, frec, rows = scan_fn(
+                fstate, self._flight_state(ftape.fleet_pad), steps,
+                *ftape.device(), salts,
+            )
+            self.flight = frec
+            if journal is not None:
+                from ..obs.flight import journal_drain
+
+                journal_drain(journal, frec, fleet=len(tls))
+        else:
+            scan_fn = self._fleet_scan_fn()
+            state, rows = scan_fn(
+                fstate, steps, *ftape.device(), salts
+            )
         self.final_state = state
         if not pull:
             return state, rows

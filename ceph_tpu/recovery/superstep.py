@@ -475,6 +475,29 @@ class EpochDriver:
             # touch would otherwise happen inside the scanned cond
             self._peer_hist_compact_fn
         self._scan_fn = None
+        # flight recorder: gated like the ladder — 'on'/'off' decide
+        # here, 'auto' follows the bench-decided default (off until
+        # the telemetry differential has proven bit-equality and the
+        # overhead gate).  Deferred import: obs pulls recovery.peering
+        # through its package __init__, which loads this module.
+        from ..obs.flight import empty_flight, resolve_flight_recorder
+
+        self._flight_mode = str(cfg.get("flight_recorder"))
+        self.flight_ring_epochs = int(cfg.get("flight_ring_epochs"))
+        self.flight_on = resolve_flight_recorder(self._flight_mode)
+        if self.flight_on:
+            from ..analysis import runtime_guard
+
+            if runtime_guard.bucket_checks_enabled():
+                runtime_guard.assert_bucketed(
+                    "flight ring", self.flight_ring_epochs
+                )
+            self._init_flight = empty_flight(self.flight_ring_epochs)
+        else:
+            self._init_flight = None
+        #: the live recorder carry after the most recent run/chunk
+        self.flight = self._init_flight
+        self._scan_flight_fn = None
 
     # -- the jitted pieces (shared verbatim by both drivers) -----------
 
@@ -1083,6 +1106,169 @@ class EpochDriver:
         )
         return state, row
 
+    # -- flight recorder (read-only telemetry riding the carry) --------
+
+    @property
+    def _flight_stats_fn(self):
+        """``(state_post_live, prev_up, prev_w, dirty) -> (rung,
+        n_dirty, heavy)`` — the recorder's read-only replica of the
+        compacted branch's dirty-set predicate, evaluated under its
+        own dirty cond so quiet epochs pay nothing.  Quiet epochs
+        report ``(-1, 0, False)``; dense epochs report the
+        past-the-ladder rung index."""
+        fn = getattr(self, "_flight_stats_fn_c", None)
+        if fn is not None:
+            return fn
+        widths = self._dirty_ladder
+
+        @jax.jit
+        def stats_fn(state, prev_up, prev_w, dirty):
+            def quiet(op):
+                return (jnp.int32(-1), jnp.int32(0),
+                        jnp.asarray(False))
+
+            def probe(op):
+                st, p_up, p_w = op
+                cur_up = st.pool.osd_up
+                up_flip = p_up ^ cur_up
+                heavy = (
+                    jnp.any(p_w != st.pool.osd_weight)
+                    | jnp.any(up_flip & cur_up)
+                )
+                down_flip = up_flip & ~cur_up
+                flip_pad = jnp.concatenate(
+                    [down_flip, jnp.zeros((1,), bool)]
+                )
+                n = down_flip.shape[0]
+
+                def member(tbl):
+                    ids = jnp.where((tbl >= 0) & (tbl < n), tbl, n)
+                    return jnp.any(flip_pad[ids], axis=-1)
+
+                dirty_pg = (
+                    member(st.up)
+                    | member(st.acting)
+                    | member(st.pool.pg_temp)
+                    | member(st.pool.primary_temp[:, None])
+                    | heavy
+                )
+                n_dirty = jnp.sum(dirty_pg.astype(I32)).astype(I32)
+                return (ladder_rung(n_dirty, widths), n_dirty, heavy)
+
+            return jax.lax.cond(
+                dirty, probe, quiet, (state, prev_up, prev_w)
+            )
+
+        self._flight_stats_fn_c = stats_fn
+        return stats_fn
+
+    def _epoch_step_traced(self, state: ClusterState, step):
+        """:meth:`_epoch_step` with the flight recorder's lane extras
+        riding along: the SAME jitted piece functions composed in the
+        same order (the ``run_staged`` bit-equality argument), plus
+        the read-only dirty-set probe — so all 18 epoch lanes are
+        bit-equal to the recorder-off body by construction."""
+        prev_now = state.now
+        prev_up = state.pool.osd_up
+        prev_w = state.pool.osd_weight
+        state, tape_dirty = self._tape_fn(state, step)
+        state, (nd, nu, no, down_total, down_ck, trans) = self._live_fn(
+            state
+        )
+        dirty = tape_dirty | trans
+        rung, n_dirty, heavy = self._flight_stats_fn(
+            state, prev_up, prev_w, dirty
+        )
+        if self._dirty_ladder:
+            state = jax.lax.cond(
+                dirty,
+                lambda op: self._peer_hist_compact_fn(*op),
+                lambda op: op[0],
+                (state, prev_up, prev_w),
+            )
+        else:
+            state = jax.lax.cond(
+                dirty, self._peer_hist_fn, lambda s: s, state
+            )
+        (counts, lat_hist, qd_hist, sums, max_rho, writes,
+         deg_reads) = self._traffic_fn(state, step)
+        scrub_due = self._scrub_fn(prev_now, state.now)
+        row = (
+            state.now, state.epoch, dirty.astype(I32), state.pg_hist,
+            state.pg_aux, counts, lat_hist, qd_hist, sums, max_rho,
+            writes, deg_reads, down_total, nd, nu, no, down_ck,
+            scrub_due,
+        )
+        extras = (step, dirty, rung, n_dirty, heavy)
+        return state, row, extras
+
+    def _flight_row(self, row, extras, wrow=None):
+        """One i64 lane row for the recorder ring, assembled from the
+        epoch row + probe extras (+ the write path's stripe lanes when
+        it rides the scan).  Cycle proxies are deterministic op
+        counts: the chosen peering bucket width (dense width on the
+        top rung), routed-op total for traffic, due-window size for
+        scrub — never wall clock."""
+        from ..obs.flight import flight_row
+
+        step, dirty, rung, n_dirty, heavy = extras
+        widths = self._dirty_ladder
+        counts = row[5]
+        served = counts[..., 0]
+        degraded = counts[..., 1]
+        blocked = counts[..., 2]
+        table = jnp.asarray(
+            tuple(widths) + (self.pg_num,), jnp.int64
+        )
+        cycles_peer = jnp.where(
+            rung >= 0, table[jnp.clip(rung, 0, len(widths))], 0
+        )
+        stripe = {}
+        if wrow is not None:
+            from ..ec.online import WP_LANES
+
+            stripe = {
+                "stripe_hits": wrow[..., WP_LANES.index("hits")],
+                "stripe_misses": wrow[..., WP_LANES.index("misses")],
+                "stripe_evictions": wrow[
+                    ..., WP_LANES.index("evictions")
+                ],
+                "stripe_delta_words": wrow[
+                    ..., WP_LANES.index("delta_words")
+                ],
+            }
+        return flight_row(
+            epoch=step,
+            dirty=dirty,
+            rung=rung,
+            dirty_pgs=n_dirty,
+            compact=(rung >= 0) & (rung < len(widths)),
+            heavy=heavy,
+            served=served,
+            degraded=degraded,
+            blocked=blocked,
+            writes=row[10],
+            deg_reads=row[11],
+            eff_down=row[13],
+            eff_up=row[14],
+            eff_out=row[15],
+            down_total=row[12],
+            scrub_due=row[17],
+            cycles_peer=cycles_peer,
+            cycles_traffic=served + degraded + blocked,
+            cycles_scrub=row[17],
+            **stripe,
+        )
+
+    def _epoch_step_flight(self, carry, step):
+        """The scan body with the recorder riding the carry."""
+        from ..obs.flight import flight_record
+
+        state, fs = carry
+        state, row, extras = self._epoch_step_traced(state, step)
+        fs = flight_record(fs, self._flight_row(row, extras))
+        return (state, fs), row
+
     # -- drivers -------------------------------------------------------
 
     def compile_superstep(self):
@@ -1098,16 +1284,56 @@ class EpochDriver:
             self._scan_fn = scan_fn
         return self._scan_fn
 
+    def compile_superstep_flight(self):
+        """The recorder-carrying twin of :meth:`compile_superstep`:
+        ``(state, flight, steps) -> (state, flight, rows)``.  The
+        recorder-off program is untouched — gating happens at driver
+        level, never inside a traced branch, so 'off' compiles
+        today's exact graph."""
+        if self._scan_flight_fn is None:
+
+            @jax.jit
+            def scan_fn(state, fs, steps):
+                (state, fs), rows = jax.lax.scan(
+                    self._epoch_step_flight, (state, fs), steps
+                )
+                return state, fs, rows
+
+            self._scan_flight_fn = scan_fn
+        return self._scan_flight_fn
+
+    def drain_flight(self) -> dict:
+        """Host-side drain of the recorder ring — a pure read (device
+        state untouched, so checkpointed carries stay bit-equal
+        across drains)."""
+        from ..obs.flight import drain_flight
+
+        if self.flight is None:
+            raise RuntimeError(
+                "flight recorder is off for this driver "
+                "(flight_recorder=on, or auto with a bench-decided "
+                "default, enables it)"
+            )
+        return drain_flight(self.flight)
+
     def run_superstep(
         self, n_epochs: int, *, snapshot_every: int = 0,
-        on_snapshot=None, pull: bool = True,
+        on_snapshot=None, pull: bool = True, journal=None,
     ):
         """Drive the compiled scan; host exits only at snapshot
         boundaries (every ``snapshot_every`` epochs; 0 = one chunk).
         ``on_snapshot(start_epoch, series_chunk)`` sees each pulled
         chunk — the journaling seam.  With ``pull=False`` and no
         snapshots, returns ``(state, rows)`` device-resident (the
-        zero-host-transfer path the nonregression scenario pins)."""
+        zero-host-transfer path the nonregression scenario pins).
+        With the flight recorder on, the ring rides the scan carry
+        and — when a ``journal`` is given — drains a typed
+        ``flight.drain`` record at every snapshot boundary."""
+        if self.flight_on:
+            return self._run_superstep_flight(
+                n_epochs, snapshot_every=snapshot_every,
+                on_snapshot=on_snapshot, pull=pull, journal=journal,
+            )
         scan_fn = self.compile_superstep()
         state = self._init_state
         if int(n_epochs) <= 0:
@@ -1128,6 +1354,53 @@ class EpochDriver:
             size = min(chunk, n_epochs - start)
             steps = jnp.arange(start, start + size, dtype=I32)
             state, rows = scan_fn(state, steps)
+            if pull or on_snapshot is not None:
+                part = EpochSeries.from_device(rows)
+                parts.append(part)
+                if on_snapshot is not None:
+                    on_snapshot(start, part)
+            else:
+                dev_rows = rows
+            start += size
+        self.final_state = state
+        if not pull and on_snapshot is None:
+            return state, dev_rows
+        return EpochSeries.concat(parts)
+
+    def _run_superstep_flight(
+        self, n_epochs: int, *, snapshot_every: int = 0,
+        on_snapshot=None, pull: bool = True, journal=None,
+    ):
+        """:meth:`run_superstep` with the recorder riding the carry:
+        same chunking, same snapshot seam, zero extra host exits —
+        the ring is only pulled when a journal drain asks for it, at
+        a boundary the host was already visiting.  The live carry
+        persists on :attr:`flight` for drains, dumps and
+        checkpoints."""
+        from ..obs.flight import journal_drain
+
+        scan_fn = self.compile_superstep_flight()
+        state = self._init_state
+        fs = self._init_flight
+        if int(n_epochs) <= 0:
+            state, fs, rows = scan_fn(
+                state, fs, jnp.arange(0, dtype=I32)
+            )
+            self.final_state, self.flight = state, fs
+            if not pull and on_snapshot is None:
+                return state, rows
+            return EpochSeries.from_device(rows)
+        chunk = int(snapshot_every) or int(n_epochs)
+        parts: list[EpochSeries] = []
+        dev_rows = None
+        start = 0
+        while start < n_epochs:
+            size = min(chunk, n_epochs - start)
+            steps = jnp.arange(start, start + size, dtype=I32)
+            state, fs, rows = scan_fn(state, fs, steps)
+            self.flight = fs
+            if journal is not None:
+                journal_drain(journal, fs, chunk_start=start)
             if pull or on_snapshot is not None:
                 part = EpochSeries.from_device(rows)
                 parts.append(part)

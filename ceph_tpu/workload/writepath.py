@@ -193,9 +193,12 @@ class WritepathDriver:
         self.name = str(name)
         self.pc = writepath_counters()
         self._scan_fn = None
+        self._flight_scan_fn = None
         self._one_fn = None
         self.final_state = None
         self.final_buf: StripeBufferState | None = None
+        #: live recorder carry after the most recent flight-on run
+        self.flight = None
         register_stripe_cache(self)
 
     # -- the per-epoch write batch (drawn from the traffic step) -------
@@ -281,6 +284,30 @@ class WritepathDriver:
         )
         return (state, buf), (row, wrow)
 
+    def _wp_epoch_flight(self, carry, step, cap):
+        """The flight-recorder twin of :meth:`_wp_epoch`: the traced
+        epoch body plus the in-scan ring write.  The stripe lanes land
+        in the ring from ``wrow``, so a writepath flight row carries
+        live cache telemetry where the bare superstep records zeros."""
+        from ..obs.flight import flight_record
+
+        state, buf, fs = carry
+        state, row, extras = self.driver._epoch_step_traced(
+            state, step
+        )
+        bkeys, bchunks, bfulls, bseeds, bvalid, _nw = (
+            self._write_batch(state, step, cap)
+        )
+        buf, wrow = stripe_buffer_step(
+            buf, self._steps_dev, self.schedule.n_out,
+            self.schedule.n_bufs, self.k, self.w,
+            bkeys, bchunks, bfulls, bseeds, bvalid,
+        )
+        fs = flight_record(
+            fs, self.driver._flight_row(row, extras, wrow=wrow)
+        )
+        return (state, buf, fs), (row, wrow)
+
     # -- drivers -------------------------------------------------------
 
     def compile_writepath(self):
@@ -303,6 +330,26 @@ class WritepathDriver:
             self._scan_fn = scan_fn
         return self._scan_fn
 
+    def compile_writepath_flight(self):
+        """The flight-on program: ``(state, buf, fs, steps, cap) ->
+        (state, buf, fs, rows, wrows)`` — same epoch math, ring riding
+        the carry (the 18 epoch lanes and every WP lane stay bit-equal
+        to the plain scan; only the extra telemetry carry differs)."""
+        if self._flight_scan_fn is None:
+
+            @jax.jit
+            def scan_fn(state, buf, fs, steps, cap):
+                def body(carry, step):
+                    return self._wp_epoch_flight(carry, step, cap)
+
+                (state, buf, fs), (rows, wrows) = jax.lax.scan(
+                    body, (state, buf, fs), steps
+                )
+                return state, buf, fs, rows, wrows
+
+            self._flight_scan_fn = scan_fn
+        return self._flight_scan_fn
+
     def _note_totals(self, wseries: WritepathSeries) -> None:
         self.engine.pc_inc(self.pc, wseries.lanes.sum(axis=0))
 
@@ -310,20 +357,34 @@ class WritepathDriver:
         self, n_epochs: int, *, cap: int | None = None,
         snapshot_every: int = 0, pull: bool = True,
         buf: StripeBufferState | None = None, start_epoch: int = 0,
+        journal=None,
     ):
         """Drive the fused scan; mirrors
         :meth:`EpochDriver.run_superstep` (host exits only at snapshot
         boundaries; ``pull=False`` returns device-resident
-        ``(state, buf, rows, wrows)``)."""
-        scan_fn = self.compile_writepath()
+        ``(state, buf, rows, wrows)``).  With the wrapped driver's
+        flight recorder on, the ring rides the carry and drains into
+        ``journal`` at each boundary (``self.flight`` afterwards)."""
+        flight_on = bool(getattr(self.driver, "flight_on", False))
+        scan_fn = (
+            self.compile_writepath_flight() if flight_on
+            else self.compile_writepath()
+        )
         state = self.driver._init_state
         buf = self._init_buf if buf is None else buf
+        fs = self.driver._init_flight if flight_on else None
         cap_t = jnp.int32(self.max_writes if cap is None else cap)
         n_epochs = int(n_epochs)
         if n_epochs <= 0:
-            state, buf, rows, wrows = scan_fn(
-                state, buf, jnp.arange(0, dtype=I32), cap_t
-            )
+            if flight_on:
+                state, buf, fs, rows, wrows = scan_fn(
+                    state, buf, fs, jnp.arange(0, dtype=I32), cap_t
+                )
+                self.flight = fs
+            else:
+                state, buf, rows, wrows = scan_fn(
+                    state, buf, jnp.arange(0, dtype=I32), cap_t
+                )
             self.final_state, self.final_buf = state, buf
             self.driver.final_state = state
             if not pull:
@@ -341,9 +402,22 @@ class WritepathDriver:
         while start < end_at:
             size = min(chunk, end_at - start)
             steps = jnp.arange(start, start + size, dtype=I32)
-            state, buf, rows, wrows = scan_fn(
-                state, buf, steps, cap_t
-            )
+            if flight_on:
+                state, buf, fs, rows, wrows = scan_fn(
+                    state, buf, fs, steps, cap_t
+                )
+                self.flight = fs
+                if journal is not None:
+                    from ..obs.flight import journal_drain
+
+                    journal_drain(
+                        journal, fs, chunk_start=start,
+                        source="writepath",
+                    )
+            else:
+                state, buf, rows, wrows = scan_fn(
+                    state, buf, steps, cap_t
+                )
             if pull:
                 parts.append(EpochSeries.from_device(rows))
                 wparts.append(WritepathSeries.from_device(wrows))

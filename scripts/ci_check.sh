@@ -63,7 +63,7 @@ echo "== config10_scale smoke (compacted vs dense, bit-equality) =="
 # same guards as the full sweep — the JSON gates (bit-equality on
 # every cell, the zero-recompile dirty-set walk, fleet speedup > 0)
 # are asserted here so a silent FAIL in the stderr tail cannot pass
-timeout -k 10 420 env -u PYTHONPATH PYTHONPATH="$PWD" JAX_PLATFORMS=cpu \
+timeout -k 10 480 env -u PYTHONPATH PYTHONPATH="$PWD" JAX_PLATFORMS=cpu \
     python bench/config10_scale.py --smoke | python -c '
 import json, sys
 rec = json.loads(sys.stdin.readline())
@@ -71,9 +71,43 @@ ok = (rec.get("status") == "ok"
       and rec.get("scale_bitequal") is True
       and rec.get("scale_zero_recompile_walk") is True
       and rec.get("fleet_bitequal") is True
-      and rec.get("fleet_compacted_speedup", 0) > 0)
+      and rec.get("fleet_compacted_speedup", 0) > 0
+      # flight-recorder gates: the recorder must be invisible
+      # (bit-equal lanes), shape-stable across the ring-size walk,
+      # and forensically sound; the numeric <=3% overhead gate is
+      # decide_defaults territory (CPU smoke timing is noise), but
+      # the field must at least be measured and present
+      and rec.get("flight_bitequal") is True
+      and rec.get("flight_ring_walk_zero_recompile") is True
+      and rec.get("flight_crash_dump_ok") is True
+      and isinstance(rec.get("flight_overhead_fraction"),
+                     (int, float)))
 print("scale smoke:", "ok" if ok else f"FAIL {rec}")
 sys.exit(0 if ok else 1)
+' || rc=1
+
+echo "== flight trace export (selftest + Chrome-trace schema) =="
+# the exporter round-trips a synthetic journal+ring into trace.json;
+# the schema assertions here are the minimal Chrome-trace contract
+# (traceEvents list, required keys per event, ph in B/E/X/C/i/M)
+rm -f /tmp/_trace.json
+timeout -k 10 120 env -u PYTHONPATH PYTHONPATH="$PWD" JAX_PLATFORMS=cpu \
+    python -m ceph_tpu.obs.traceexport --selftest \
+    --out /tmp/_trace.json || rc=1
+python -c '
+import json, sys
+doc = json.load(open("/tmp/_trace.json"))
+assert isinstance(doc, dict), "trace root must be an object"
+evs = doc.get("traceEvents")
+assert isinstance(evs, list) and evs, "traceEvents missing/empty"
+for ev in evs:
+    assert isinstance(ev, dict), f"event not an object: {ev!r}"
+    assert ev.get("ph") in {"B", "E", "X", "C", "i", "M"}, ev
+    assert isinstance(ev.get("name"), str) and ev["name"], ev
+    if ev["ph"] != "M":
+        assert isinstance(ev.get("ts"), (int, float)), ev
+        assert "pid" in ev and "tid" in ev, ev
+print(f"trace export: ok ({len(evs)} events)")
 ' || rc=1
 
 echo "== tier-1 tests =="

@@ -1576,3 +1576,229 @@ def test_scale_cpu_record_not_harvested(tmp_path):
     p.write_text(json.dumps(rec) + "\n")
     dd = _load_dd("scale_cpu")
     assert dd.harvest_guard([str(p)]) == {}
+
+
+# --- flight-recorder differential fields + the auto->on flip ----------
+
+_SCALE_FLIGHT = {
+    "overhead_fraction": 0.0112, "bitequal": True,
+    "ring_walk_zero_recompile": True, "crash_dump_ok": True,
+    "ring_epochs": 64, "ring_drops": 0, "dump_count": 1,
+    "ring_walk": [{"ring": 16, "ok": True}, {"ring": 64, "ok": True},
+                  {"ring": 256, "ok": True}],
+}
+
+
+def _scale_flight_record(**over):
+    flight = dict(_SCALE_FLIGHT, **over)
+    return config10s.build_scale_record(
+        "tpu", [dict(c) for c in _SCALE_CELLS], dict(_SCALE_FLEET),
+        3, 3, 0, flight=flight,
+    )
+
+
+def test_scale_record_flight_fields_optional_and_typed():
+    import json
+
+    # without the differential, no flight_* fields leak into the line
+    base = _scale_record()
+    assert not [k for k in base if k.startswith("flight_")]
+    rec = _scale_flight_record()
+    assert rec["flight_overhead_fraction"] == 0.0112
+    assert rec["flight_bitequal"] is True
+    assert rec["flight_ring_walk_zero_recompile"] is True
+    assert rec["flight_crash_dump_ok"] is True
+    assert rec["flight_ring_epochs"] == 64
+    assert rec["flight_ring_drops"] == 0
+    assert rec["flight_dump_count"] == 1
+    assert len(rec["flight_ring_walk"]) == 3
+    # the positional surface is unchanged: same keys as before plus
+    # only the flight_* ones
+    assert set(rec) - set(base) == {
+        "flight_overhead_fraction", "flight_bitequal",
+        "flight_ring_walk_zero_recompile", "flight_crash_dump_ok",
+        "flight_ring_epochs", "flight_ring_drops",
+        "flight_dump_count", "flight_ring_walk",
+    }
+    json.dumps(rec)
+
+
+def test_epoch_record_flight_fields_keyword_only():
+    rec = config7.build_epoch_record(
+        "tpu", 19_990.4, 642.3, True, 1024, 4, 4, 36, True,
+        flight_rate=19_500.0, flight_bitequal=True,
+    )
+    assert rec["epoch_rate_flight_per_sec"] == 19_500.0
+    assert rec["epoch_flight_overhead_fraction"] == round(
+        19_990.4 / 19_500.0 - 1.0, 4
+    )
+    assert rec["epoch_flight_bitequal"] is True
+    # absent differential -> absent fields (older rounds' lines)
+    bare = config7.build_epoch_record(
+        "tpu", 19_990.4, 642.3, True, 1024, 4, 4, 36, True,
+    )
+    assert "epoch_rate_flight_per_sec" not in bare
+    assert "epoch_flight_bitequal" not in bare
+
+
+def test_flight_record_harvested_by_decide_defaults(tmp_path):
+    import json
+
+    rec = _scale_flight_record()
+    p = tmp_path / "session.log"
+    p.write_text(json.dumps(rec) + "\n")
+    dd = _load_dd("flight")
+    g = dd.harvest_guard([str(p)])["scale_epoch_rate_per_sec"]
+    assert g["flight_overhead_fraction"] == 0.0112
+    assert g["flight_bitequal"] is True
+    assert g["flight_ring_walk_zero_recompile"] is True
+    assert g["flight_crash_dump_ok"] is True
+    assert g["flight_ring_epochs"] == 64
+    assert g["flight_ring_drops"] == 0
+    assert g["flight_dump_count"] == 1
+
+
+def test_decide_flight_flips_only_when_every_gate_holds(tmp_path):
+    import json
+
+    dd = _load_dd("flight_decide")
+
+    def decision(**over):
+        rec = _scale_flight_record(**over)
+        p = tmp_path / "session.log"
+        p.write_text(json.dumps(rec) + "\n")
+        return dd.decide_flight(dd.harvest_guard([str(p)]))
+
+    on = decision()
+    assert on["flight_recorder"] == "on" and on["failed_gates"] == []
+    assert on["overhead_gate"] == dd.FLIGHT_OVERHEAD_GATE == 0.03
+    # each gate vetoes the flip on its own
+    over = decision(overhead_fraction=0.08)
+    assert over["flight_recorder"] == "off"
+    assert over["failed_gates"] == ["flight_overhead_under_gate"]
+    assert decision(bitequal=False)["flight_recorder"] == "off"
+    assert decision(
+        ring_walk_zero_recompile=False
+    )["flight_recorder"] == "off"
+    assert decision(crash_dump_ok=False)["flight_recorder"] == "off"
+    # no differential measured -> no flip either way
+    empty = dd.decide_flight({})
+    assert "flight_recorder" not in empty
+    assert "defaults unchanged" in empty["decision"]
+
+
+def test_write_flight_defaults_round_trips_into_auto(tmp_path):
+    import json
+
+    from ceph_tpu.obs.flight import resolve_flight_recorder
+
+    dd = _load_dd("flight_write")
+    rec = _scale_flight_record()
+    p = tmp_path / "session.log"
+    p.write_text(json.dumps(rec) + "\n")
+    decision = dd.decide_flight(dd.harvest_guard([str(p)]))
+    out = str(tmp_path / "flight_defaults.json")
+    dd.write_flight_defaults(decision, out)
+    doc = json.load(open(out))
+    assert doc["flight_recorder"] == "on"
+    assert doc["gates"]["flight_overhead_under_gate"] is True
+    assert resolve_flight_recorder("auto", out) is True
+    # a failing decision writes "off" — auditable, and auto stays off
+    p.write_text(json.dumps(
+        _scale_flight_record(crash_dump_ok=False)) + "\n")
+    dd.write_flight_defaults(
+        dd.decide_flight(dd.harvest_guard([str(p)])), out)
+    assert resolve_flight_recorder("auto", out) is False
+    # an unmeasured decision refuses to write at all
+    import pytest
+
+    with pytest.raises(ValueError, match="refusing"):
+        dd.write_flight_defaults(dd.decide_flight({}), out)
+
+
+# --- cross-round BENCH_TRAJECTORY.json schema -------------------------
+
+_RUN_ALL_PATH = os.path.join(os.path.dirname(_BENCH), "bench", "run_all.py")
+_spec_ra = importlib.util.spec_from_file_location(
+    "bench_run_all_traj", _RUN_ALL_PATH
+)
+run_all_traj = importlib.util.module_from_spec(_spec_ra)
+_spec_ra.loader.exec_module(run_all_traj)
+
+
+def _rounds():
+    return {
+        1: {"cfgA": {"value": 100, "status": "ok", "platform": "tpu"}},
+        2: {"cfgA": {"value": 120, "status": "ok", "platform": "tpu"},
+            "cfgB": {"value": 50, "status": "ok", "platform": "tpu"}},
+        3: {"cfgA": {"value": 95, "status": "ok", "platform": "tpu"},
+            "cfgB": {"value": 51, "status": "ok", "platform": "tpu"}},
+    }
+
+
+def test_trajectory_schema_and_regression_flags():
+    import json
+
+    traj = run_all_traj.build_trajectory(_rounds())
+    assert traj["schema_version"] == run_all_traj.TRAJECTORY_SCHEMA_VERSION
+    assert traj["regression_fraction"] == 0.10
+    assert traj["rounds"] == [1, 2, 3]
+    a = traj["configs"]["cfgA"]
+    # 95 < 0.9 * 120: flagged, and the config lands in the headline list
+    assert [e["regression"] for e in a["series"]] == [False, False, True]
+    assert a["best_value"] == 120 and a["latest_value"] == 95
+    assert a["regressed"] is True
+    b = traj["configs"]["cfgB"]
+    assert b["regressed"] is False and b["best_value"] == 51
+    assert traj["regressions"] == ["cfgA"]
+    json.dumps(traj)
+
+
+def test_trajectory_ignores_non_ok_rounds():
+    rounds = _rounds()
+    # a timeout salvage with a junk value must neither flag nor set
+    # the bar; a valueless error row rides along unflagged
+    rounds[4] = {"cfgA": {"value": 1, "status": "timeout",
+                          "platform": "tpu"},
+                 "cfgB": {"value": None, "status": "error"}}
+    traj = run_all_traj.build_trajectory(rounds)
+    a = traj["configs"]["cfgA"]
+    assert [e["regression"] for e in a["series"]] == [
+        False, False, True, False,
+    ]
+    assert a["best_value"] == 120  # the timeout's 1 never votes
+    # latest OK value is still round 3's
+    assert a["latest_round"] == 3 and a["latest_value"] == 95
+    assert traj["configs"]["cfgB"]["series"][-1]["regression"] is False
+
+
+def test_trajectory_collects_both_bank_formats(tmp_path):
+    import json
+
+    # BENCH_rN.json: one parsed headline; BENCH_DETAIL_rN.json: one
+    # result per config — both shapes must land in the same rounds map
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+        "n": 1, "cmd": "x", "rc": 0, "tail": [],
+        "parsed": {"metric": "headline", "value": 10, "status": "ok"},
+    }))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps({
+        "n": 2, "cmd": "x", "rc": 0, "tail": [], "parsed": None,
+    }))
+    (tmp_path / "BENCH_DETAIL_r02.json").write_text(json.dumps({
+        "round": 2, "records": [
+            {"config": "cfgA",
+             "result": {"metric": "m", "value": 7, "status": "ok"}},
+            {"config": "broken", "result": None},
+        ],
+    }))
+    (tmp_path / "BENCH_r03.json").write_text("not json{")
+    rounds = run_all_traj.collect_round_records(str(tmp_path))
+    assert sorted(rounds) == [1, 2]
+    assert rounds[1]["headline"]["value"] == 10
+    assert rounds[2]["cfgA"]["value"] == 7
+    assert "broken" not in rounds[2]
+    dest = run_all_traj.write_trajectory(str(tmp_path))
+    assert dest == str(tmp_path / "BENCH_TRAJECTORY.json")
+    doc = json.load(open(dest))
+    assert doc["schema_version"] == 1
+    assert set(doc["configs"]) == {"headline", "cfgA"}

@@ -90,7 +90,11 @@ def _legacy_map(alg: int, n: int = 9, weights=None) -> CrushMap:
     return m
 
 
-@pytest.mark.parametrize("alg", [ALG_STRAW, ALG_LIST, ALG_TREE])
+@pytest.mark.parametrize("alg", [
+    ALG_STRAW,
+    pytest.param(ALG_LIST, marks=pytest.mark.slow),
+    pytest.param(ALG_TREE, marks=pytest.mark.slow),
+])
 def test_bucket_choose_cpp_vs_python_oracle(alg):
     m = _legacy_map(alg)
     dense = m.to_dense()
@@ -114,7 +118,11 @@ def test_bucket_choose_cpp_vs_python_oracle(alg):
             assert got == want, (alg, int(x), r)
 
 
-@pytest.mark.parametrize("alg", [ALG_STRAW, ALG_LIST, ALG_TREE])
+@pytest.mark.parametrize("alg", [
+    ALG_STRAW,
+    pytest.param(ALG_LIST, marks=pytest.mark.slow),
+    pytest.param(ALG_TREE, marks=pytest.mark.slow),
+])
 def test_legacy_map_places_through_public_engine(alg):
     m = _legacy_map(alg, n=12)
     dense = m.to_dense()

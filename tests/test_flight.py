@@ -1,0 +1,505 @@
+"""Device-resident flight recorder: invisible, shape-stable, forensic.
+
+The recorder's whole contract, pinned here:
+
+- **invisible** — every series a flight-enabled run produces is
+  bit-equal to the recorder-off run on the same timeline (the flight
+  paths compose the SAME jitted piece functions; the ring only rides
+  the carry), across the superstep, the vmapped fleet, the writepath
+  scan and a checkpoint/kill/restore cycle;
+- **shape-stable** — ring occupancy and the write cursor are traced
+  values (jaxlint J013), so recording N epochs into any ring and
+  walking ring sizes re-runs with zero fresh compiles and zero host
+  transfers after warmup;
+- **forensic** — the drain unrotates exactly the last-N epochs,
+  ``journal_drain`` lands a typed summary, crash dumps commit with the
+  PR-15 tmp+fsync+replace discipline and round-trip through
+  ``cli.status crash``.
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ceph_tpu.analysis.runtime_guard import CompileBudget, track
+from ceph_tpu.common.config import Config
+from ceph_tpu.models.clusters import build_osdmap
+from ceph_tpu.obs import traceexport
+from ceph_tpu.obs.flight import (
+    FLIGHT_LANES,
+    FLIGHT_SCHEMA_VERSION,
+    N_FLIGHT_LANES,
+    FlightState,
+    crash_dump_guard,
+    drain_flight,
+    empty_flight,
+    flight_record,
+    flight_row,
+    journal_drain,
+    read_flight_dump,
+    resolve_flight_recorder,
+    validate_flight_dump,
+    write_flight_dump,
+)
+from ceph_tpu.obs.journal import EventJournal
+from ceph_tpu.recovery import EpochDriver, build_scenario
+from ceph_tpu.recovery.checkpoint import (
+    CheckpointStore,
+    SimulatedCrash,
+    checkpointed_superstep,
+)
+from ceph_tpu.recovery.dispatch import ChipLostError
+from ceph_tpu.recovery.fleet import FleetDriver
+from ceph_tpu.workload.writepath import WritepathDriver
+
+N_EPOCHS = 12
+RING = 8  # < N_EPOCHS on purpose: the wrap path is the common case
+
+
+def _map(n_osd=32, pg_num=64):
+    return build_osdmap(n_osd, pg_num=pg_num, size=6, pool_kind="erasure")
+
+
+def _cfg(flight="on", ring=RING, **extra):
+    cfg = Config(env={})
+    cfg.set("flight_recorder", flight)
+    cfg.set("flight_ring_epochs", ring)
+    for key, val in extra.items():
+        cfg.set(key, val)
+    return cfg
+
+
+# one flight-on driver + recorder-off reference for the whole module:
+# the compiled scans are cached per driver instance
+_cache: dict = {}
+
+
+def _pair():
+    if not _cache:
+        m = _map()
+        d_off = EpochDriver(m, build_scenario("flap", m), n_ops=64,
+                            config=_cfg("off"))
+        d_on = EpochDriver(m, build_scenario("flap", m), n_ops=64,
+                           config=_cfg("on"))
+        s_off = d_off.run_superstep(N_EPOCHS)
+        s_on = d_on.run_superstep(N_EPOCHS)
+        _cache["pair"] = (d_off, d_on, s_off, s_on)
+    return _cache["pair"]
+
+
+# ---- the ring primitive ----------------------------------------------
+
+
+def test_lane_schema_is_static_and_unique():
+    assert len(FLIGHT_LANES) == N_FLIGHT_LANES
+    assert len(set(FLIGHT_LANES)) == N_FLIGHT_LANES
+    # the forensically load-bearing lanes must exist by name (the
+    # trace exporter and the status panel index by them)
+    for lane in ("epoch", "dirty", "rung", "dirty_pgs", "compact",
+                 "heavy", "stripe_hits", "stripe_misses",
+                 "cycles_peer", "cycles_traffic", "cycles_scrub"):
+        assert lane in FLIGHT_LANES, lane
+
+
+def test_empty_flight_shapes_and_pow2_validation():
+    fs = empty_flight(8)
+    assert fs.ring.shape == (8, N_FLIGHT_LANES)
+    assert fs.ring.dtype == jnp.int64 and int(fs.head) == 0
+    assert fs.ring_epochs == 8
+    ffs = empty_flight(4, fleet=6)
+    assert ffs.ring.shape == (6, 4, N_FLIGHT_LANES)
+    for bad in (0, 3, 12, -8):
+        with pytest.raises(ValueError, match="power of two"):
+            empty_flight(bad)
+
+
+def test_flight_state_is_a_pytree_jit_carryable():
+    fs = empty_flight(4)
+    leaves, treedef = jax.tree_util.tree_flatten(fs)
+    assert len(leaves) == 2
+    back = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert isinstance(back, FlightState)
+
+    @jax.jit
+    def bump(s):
+        return flight_record(s, flight_row(epoch=s.head))
+
+    out = bump(bump(fs))
+    assert isinstance(out, FlightState) and int(out.head) == 2
+
+
+def test_flight_row_order_defaults_and_unknown_lane():
+    row = np.asarray(flight_row(epoch=3, served=7, rung=-1))
+    assert row.shape == (N_FLIGHT_LANES,)
+    assert row[FLIGHT_LANES.index("epoch")] == 3
+    assert row[FLIGHT_LANES.index("served")] == 7
+    assert row[FLIGHT_LANES.index("rung")] == -1
+    # unnamed lanes default to zero
+    assert row[FLIGHT_LANES.index("stripe_hits")] == 0
+    with pytest.raises(ValueError, match="unknown flight lanes"):
+        flight_row(epoch=0, wallclock=1)
+
+
+def test_flight_row_fleet_broadcast():
+    # scalar lanes broadcast against per-lane vectors -> [fleet, L]
+    row = np.asarray(flight_row(
+        epoch=2, dirty=jnp.asarray([1, 0, 1], jnp.int32),
+    ))
+    assert row.shape == (3, N_FLIGHT_LANES)
+    assert row[:, FLIGHT_LANES.index("epoch")].tolist() == [2, 2, 2]
+    assert row[:, FLIGHT_LANES.index("dirty")].tolist() == [1, 0, 1]
+
+
+def test_record_wraps_and_drain_unrotates():
+    fs = empty_flight(4)
+    for e in range(6):
+        fs = flight_record(fs, flight_row(epoch=e, served=10 * e))
+    d = drain_flight(fs)
+    assert d["v"] == FLIGHT_SCHEMA_VERSION
+    assert d["lanes"] == list(FLIGHT_LANES)
+    assert d["ring_epochs"] == 4 and d["head"] == 6
+    assert d["occupancy"] == 4 and d["drops"] == 2
+    # oldest-to-newest, exactly the last ring_epochs epochs
+    epochs = d["rows"][:, FLIGHT_LANES.index("epoch")].tolist()
+    assert epochs == [2, 3, 4, 5]
+    served = d["rows"][:, FLIGHT_LANES.index("served")].tolist()
+    assert served == [20, 30, 40, 50]
+
+
+def test_drain_is_a_pure_read():
+    fs = empty_flight(4)
+    fs = flight_record(fs, flight_row(epoch=0, writes=9))
+    d1 = drain_flight(fs)
+    d2 = drain_flight(fs)
+    assert int(fs.head) == 1  # device state untouched
+    assert np.array_equal(d1["rows"], d2["rows"])
+    assert d1["occupancy"] == d2["occupancy"] == 1
+
+
+def test_journal_drain_event_and_empty_ring():
+    j = EventJournal()
+    assert journal_drain(j, empty_flight(4)) is None
+    assert j.by_name("flight.drain") == []
+    fs = empty_flight(4)
+    for e in range(3):
+        fs = flight_record(fs, flight_row(
+            epoch=e, dirty=e % 2, stripe_hits=5,
+        ))
+    drain = journal_drain(j, fs, source="test")
+    assert drain is not None and drain["occupancy"] == 3
+    (rec,) = j.by_name("flight.drain")
+    attrs = rec["attrs"]
+    assert attrs["epoch_first"] == 0 and attrs["epoch_last"] == 2
+    assert attrs["occupancy"] == 3 and attrs["drops"] == 0
+    assert attrs["dirty_epochs"] == 1 and attrs["stripe_hits"] == 15
+    assert attrs["source"] == "test"
+
+
+# ---- knob resolution -------------------------------------------------
+
+
+def test_resolve_flight_recorder_modes(tmp_path):
+    assert resolve_flight_recorder("on") is True
+    assert resolve_flight_recorder("off") is False
+    with pytest.raises(ValueError, match="on/off/auto"):
+        resolve_flight_recorder("maybe")
+    missing = str(tmp_path / "nope.json")
+    assert resolve_flight_recorder("auto", missing) is False
+    p = tmp_path / "flight_defaults.json"
+    p.write_text(json.dumps({"flight_recorder": "on"}))
+    assert resolve_flight_recorder("auto", str(p)) is True
+    p.write_text(json.dumps({"flight_recorder": "off"}))
+    assert resolve_flight_recorder("auto", str(p)) is False
+    p.write_text("not json{")
+    assert resolve_flight_recorder("auto", str(p)) is False
+
+
+# ---- superstep integration -------------------------------------------
+
+
+def test_superstep_flight_is_bit_invisible():
+    _d_off, _d_on, s_off, s_on = _pair()
+    # every epoch lane of the pulled series, exact — the recorder
+    # composes the same jitted pieces, it never forks the math
+    assert s_off.diff(s_on) == []
+
+
+def test_superstep_flight_ring_contents():
+    _d_off, d_on, _s_off, _s_on = _pair()
+    d = d_on.drain_flight()
+    assert d["occupancy"] == RING and d["drops"] == N_EPOCHS - RING
+    epochs = d["rows"][:, FLIGHT_LANES.index("epoch")].tolist()
+    assert epochs == list(range(N_EPOCHS - RING, N_EPOCHS))
+    # the flap scenario alternates dirty epochs; the dirty lane must
+    # see at least one of each and rung must be -1 exactly on quiet
+    dirty = d["rows"][:, FLIGHT_LANES.index("dirty")]
+    rung = d["rows"][:, FLIGHT_LANES.index("rung")]
+    assert set(dirty.tolist()) == {0, 1}
+    assert np.all((rung == -1) == (dirty == 0))
+    # cycle proxies: peering costs only on dirty epochs
+    cyc = d["rows"][:, FLIGHT_LANES.index("cycles_peer")]
+    assert np.all((cyc > 0) == (dirty == 1))
+
+
+def test_superstep_flight_off_has_no_ring():
+    d_off, _d_on, _s_off, _s_on = _pair()
+    assert d_off.flight is None
+    with pytest.raises(RuntimeError, match="flight recorder is off"):
+        d_off.drain_flight()
+
+
+def test_flight_ring_size_walk_zero_recompile():
+    # ring size is a shape BUCKET, occupancy a value: after warmup,
+    # re-running any ring size must add zero compiles and zero
+    # in-scan host transfers
+    m = _map()
+    for ring in (4, 16):
+        d = EpochDriver(m, build_scenario("flap", m), n_ops=64,
+                        config=_cfg("on", ring=ring))
+        d.run_superstep(N_EPOCHS, pull=False)  # warm
+        with CompileBudget(0, f"flight ring={ring}"), track() as g:
+            _state, rows = d.run_superstep(N_EPOCHS, pull=False)
+            jax.block_until_ready(rows)
+        assert g.n_compiles == 0, ring
+        assert g.host_transfers == 0, ring
+
+
+# ---- fleet + writepath integration -----------------------------------
+
+
+@pytest.mark.slow
+def test_fleet_flight_per_lane_rings_bitequal():
+    m = _map()
+    tls_seed = dict(seed=0, n_ops=32)
+    fd_off = FleetDriver(m, config=_cfg("off"), **tls_seed)
+    fd_on = FleetDriver(m, config=_cfg("on", ring=16), **tls_seed)
+    tls = fd_off.sample(4, "ssd-burst")
+    s_off = fd_off.run_fleet(24, tls)
+    j = EventJournal()
+    s_on = fd_on.run_fleet(24, tls, journal=j)
+    for i in range(len(tls)):
+        assert s_off.cluster(i).diff(s_on.cluster(i)) == [], i
+    # per-lane ring: leading fleet axis, one row per epoch per lane
+    d = drain_flight(fd_on.flight)
+    assert d["rows"].ndim == 3
+    assert d["rows"].shape[-1] == N_FLIGHT_LANES
+    assert d["occupancy"] == 16 and d["drops"] == 24 - 16
+    # lanes diverge: per-cluster dirty traces are not all identical
+    dirty = d["rows"][:, :, FLIGHT_LANES.index("dirty")]
+    assert len({tuple(r) for r in dirty[: len(tls)].tolist()}) > 1
+    (rec,) = j.by_name("flight.drain")
+    assert rec["attrs"]["fleet"] == len(tls)
+
+
+@pytest.mark.slow
+def test_writepath_flight_bitequal_and_stripe_lanes():
+    m = _map()
+    d_off = EpochDriver(m, build_scenario("flap", m), n_ops=64,
+                        config=_cfg("off"))
+    d_on = EpochDriver(m, build_scenario("flap", m), n_ops=64,
+                       config=_cfg("on", ring=16))
+    wp_kw = dict(n_sets=8, ways=2, max_writes=32, full_permille=250)
+    w_off = WritepathDriver(d_off, **wp_kw)
+    w_on = WritepathDriver(d_on, **wp_kw)
+    sup_off, w_series_off = w_off.run_superstep(N_EPOCHS)
+    j = EventJournal()
+    sup_on, w_series_on = w_on.run_superstep(N_EPOCHS, journal=j)
+    assert sup_off.diff(sup_on) == []
+    assert w_series_off.diff(w_series_on) == []
+    d = drain_flight(w_on.flight)
+    # the stripe-cache lanes are live on the writepath scan
+    hits = d["rows"][:, FLIGHT_LANES.index("stripe_hits")]
+    misses = d["rows"][:, FLIGHT_LANES.index("stripe_misses")]
+    writes = d["rows"][:, FLIGHT_LANES.index("writes")]
+    assert int(hits.sum() + misses.sum()) > 0
+    assert int(writes.sum()) > 0
+    assert j.by_name("flight.drain")
+
+
+# ---- checkpoint/restore: the kill-matrix flight cell -----------------
+
+
+@pytest.mark.slow
+def test_checkpoint_kill_restore_flight_ring_bitequal(tmp_path):
+    m = _map()
+    d = EpochDriver(m, build_scenario("flap", m), n_ops=64,
+                    config=_cfg("on", ring=16))
+    # uninterrupted reference: series AND drained ring
+    ref = checkpointed_superstep(
+        d, N_EPOCHS, store=CheckpointStore(str(tmp_path / "ref")),
+        snapshot_every=4,
+    )
+    ref_drain = d.drain_flight()
+    # kill at the epoch-8 boundary, then resume from disk
+    store = CheckpointStore(str(tmp_path / "kill"))
+    with pytest.raises(SimulatedCrash):
+        checkpointed_superstep(
+            d, N_EPOCHS, store=store, snapshot_every=4,
+            crashes=((8, "after"),),
+        )
+    out = checkpointed_superstep(
+        d, N_EPOCHS, store=CheckpointStore(str(tmp_path / "kill")),
+        snapshot_every=4,
+    )
+    assert ref.diff(out) == []
+    resumed = d.drain_flight()
+    # the ring rides the checkpoint carry: post-resume drained rows
+    # are bit-equal to the uninterrupted run's
+    assert resumed["head"] == ref_drain["head"]
+    assert np.array_equal(resumed["rows"], ref_drain["rows"])
+
+
+# ---- crash-dump forensics --------------------------------------------
+
+
+def _small_ring(n=3):
+    fs = empty_flight(4)
+    for e in range(n):
+        fs = flight_record(fs, flight_row(epoch=e, dirty=e % 2))
+    return fs
+
+
+def test_write_read_validate_dump_roundtrip(tmp_path):
+    fs = _small_ring()
+    path = write_flight_dump(
+        str(tmp_path), fs, reason="ChipLostError",
+        error="all 1 dispatch chips convicted",
+        state={"chunk": 2},
+    )
+    assert os.path.basename(path) == "flightdump-ChipLostError-0000.json"
+    doc = read_flight_dump(path)
+    assert validate_flight_dump(doc) == []
+    assert doc["reason"] == "ChipLostError" and doc["state"] == {"chunk": 2}
+    assert doc["flight"]["lanes"] == list(FLIGHT_LANES)
+    assert len(doc["flight"]["rows"]) == 3
+    # numbered, never timestamped: a second dump gets the next slot
+    p2 = write_flight_dump(str(tmp_path), fs, reason="ChipLostError")
+    assert p2.endswith("-0001.json")
+    # no torn tmp files survive the commit chain
+    assert not glob.glob(str(tmp_path / "*.tmp"))
+
+
+def test_read_flight_dump_rejects_tampered(tmp_path):
+    path = write_flight_dump(str(tmp_path), _small_ring(), reason="x")
+    doc = json.load(open(path))
+    doc["kind"] = "not.a.dump"
+    open(path, "w").write(json.dumps(doc))
+    with pytest.raises(ValueError, match="invalid flight dump"):
+        read_flight_dump(path)
+    doc["kind"] = "flight.dump"
+    doc["flight"]["lanes"] = ["wrong"]
+    open(path, "w").write(json.dumps(doc))
+    assert any("lanes" in p for p in validate_flight_dump(doc))
+
+
+def test_crash_dump_guard_typed_failures_only(tmp_path):
+    j = EventJournal()
+    fs = _small_ring()
+    # a typed failure dumps, journals the path, and re-raises
+    with pytest.raises(ChipLostError):
+        with crash_dump_guard(
+            str(tmp_path), flight=lambda: fs, journal=j,
+            state={"where": "test"},
+        ) as g:
+            raise ChipLostError([0, 1])
+    assert g.dump_path and os.path.exists(g.dump_path)
+    (rec,) = j.by_name("flight.dump")
+    assert rec["attrs"]["path"] == g.dump_path
+    assert rec["attrs"]["reason"] == "ChipLostError"
+    doc = read_flight_dump(g.dump_path)
+    assert doc["state"] == {"where": "test"}
+    # an untyped failure passes through untouched — no dump
+    before = sorted(os.listdir(tmp_path))
+    with pytest.raises(ValueError):
+        with crash_dump_guard(str(tmp_path), flight=fs) as g2:
+            raise ValueError("not a typed infra failure")
+    assert g2.dump_path is None
+    assert sorted(os.listdir(tmp_path)) == before
+
+
+def test_status_crash_panel_end_to_end(tmp_path, capsys):
+    from ceph_tpu.cli import status as status_cli
+
+    jpath = str(tmp_path / "journal.jsonl")
+    j = EventJournal(path=jpath)
+    fs = _small_ring()
+    journal_drain(j, fs)
+    with pytest.raises(ChipLostError):
+        with crash_dump_guard(str(tmp_path), flight=fs, journal=j):
+            raise ChipLostError([0])
+    # discovery: explicit path > journal reference > directory scan
+    found = status_cli.find_crash_dump(journal_path=jpath)
+    assert found and os.path.exists(found)
+    scanned = status_cli.find_crash_dump(root=str(tmp_path))
+    assert scanned == found
+    rc = status_cli.main(["crash", "--dump", found])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "ChipLostError" in out and "epoch" in out
+    # the dump's last ring row is the journal's final drained epoch
+    doc = read_flight_dump(found)
+    last = doc["flight"]["rows"][-1]
+    (drain_rec,) = j.by_name("flight.drain")
+    assert (last[FLIGHT_LANES.index("epoch")]
+            == drain_rec["attrs"]["epoch_last"])
+
+
+# ---- trace export ----------------------------------------------------
+
+
+def test_trace_export_flight_slices_and_schema(tmp_path):
+    fs = empty_flight(8)
+    for e in range(5):
+        fs = flight_record(fs, flight_row(
+            epoch=e, dirty=e % 2, rung=0 if e % 2 else -1,
+            served=100, degraded=2, writes=25,
+            cycles_peer=32 * (e % 2), cycles_traffic=102,
+            cycles_scrub=1,
+        ))
+    records = [
+        {"kind": "span", "name": "epoch.chunk", "t": 0.0,
+         "t_end": 5.0, "attrs": {"chunk": 0}},
+        {"kind": "event", "name": "flight.drain", "t": 5.0,
+         "attrs": {"occupancy": 5}},
+    ]
+    out = str(tmp_path / "trace.json")
+    doc = traceexport.export_trace(out, records, drain_flight(fs))
+    assert traceexport.validate_trace(doc) == []
+    assert traceexport.validate_trace(json.load(open(out))) == []
+    evs = doc["traceEvents"]
+    flight = [e for e in evs if e.get("cat") == "flight"]
+    # one slice per stage per recorded epoch
+    assert len(flight) == 5 * len(traceexport._STAGE_LANES)
+    assert {e["tid"] for e in flight} == {"peer", "traffic", "scrub"}
+    # the journal span landed as a complete event with its duration
+    (span,) = [e for e in evs if e["ph"] == "X" and e["pid"] == "journal"]
+    assert span["name"] == "epoch.chunk" and span["dur"] == 5e6
+    # cycle proxies render as durations, never wall clock: a dirty
+    # epoch's peer slice is exactly its bucket-width proxy
+    peer = [e for e in flight if e["tid"] == "peer"]
+    assert {e["dur"] for e in peer} == {0.0, 32.0}
+
+
+def test_trace_export_fleet_ring_one_process_per_lane(tmp_path):
+    fs = empty_flight(4, fleet=3)
+    for e in range(2):
+        fs = flight_record(fs, flight_row(
+            epoch=e, dirty=jnp.asarray([1, 0, 1], jnp.int32),
+        ))
+    doc = traceexport.build_trace((), drain_flight(fs))
+    assert traceexport.validate_trace(doc) == []
+    pids = {e["pid"] for e in doc["traceEvents"]
+            if e.get("cat") == "flight"}
+    assert pids == {"flight/lane0", "flight/lane1", "flight/lane2"}
+
+
+def test_trace_selftest_cli(tmp_path):
+    out = str(tmp_path / "trace.json")
+    assert traceexport.main(["--selftest", "--out", out]) == 0
+    assert traceexport.main(["--validate", out]) == 0

@@ -913,6 +913,82 @@ def test_read_rotated_tolerates_torn_tail_of_stream_only(tmp_path):
         EventJournal.read_rotated(path)
 
 
+# ---- journal v2 envelope: schema_version + seq gaps (satellite) ------
+
+
+def test_journal_v2_envelope_and_monotonic_seq(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    with EventJournal(path=path) as j:
+        j.event("tick", i=0)
+        with j.span("work"):
+            j.event("tick", i=1)
+    back = EventJournal.read(path)
+    from ceph_tpu.obs.journal import SCHEMA_VERSION
+
+    assert all(r["v"] == SCHEMA_VERSION for r in back)
+    seqs = [r["seq"] for r in back]
+    # seq counts EMISSION order (spans land at close), dense from 0
+    assert seqs == list(range(len(back)))
+    assert EventJournal._with_gap_records(back) == back  # no gaps
+
+
+def test_journal_resume_continues_seq_without_gap(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    with EventJournal(path=path) as j:
+        for i in range(3):
+            j.event("tick", i=i)
+    with EventJournal(path=path) as j:
+        j.event("after-restart")
+    back = EventJournal.read(path)
+    assert [r["seq"] for r in back] == [0, 1, 2, 3]
+    assert not [r for r in back if r["name"] == "journal.gap"]
+
+
+def test_journal_truncated_middle_surfaces_gap(tmp_path):
+    # regression: surgically removing whole records from the middle of
+    # a journal (disk salvage, partial copy) must surface as a typed
+    # journal.gap synthetic event, never as a silently shorter history
+    path = str(tmp_path / "j.jsonl")
+    with EventJournal(path=path) as j:
+        for i in range(6):
+            j.event("tick", i=i)
+    lines = open(path).read().splitlines(keepends=True)
+    open(path, "w").writelines(lines[:2] + lines[4:])  # drop seq 2,3
+    back = EventJournal.read(path)
+    gaps = [r for r in back if r["name"] == "journal.gap"]
+    assert len(gaps) == 1
+    (gap,) = gaps
+    assert gap["synthetic"] is True and gap["kind"] == "journal.gap"
+    assert gap["seq_before"] == 1 and gap["seq_after"] == 4
+    assert gap["n_missing"] == 2
+    # the gap record sits in stream position, between its neighbors
+    i = back.index(gap)
+    assert back[i - 1]["seq"] == 1 and back[i + 1]["seq"] == 4
+    # detect_gaps=False restores the raw stream
+    assert not [r for r in EventJournal.read(path, detect_gaps=False)
+                if r["name"] == "journal.gap"]
+
+
+def test_journal_gap_across_rotation_boundary(tmp_path):
+    # a truncated rotated segment only shows its loss on the STITCHED
+    # stream — per-segment reads can't see a jump that spans files
+    path = str(tmp_path / "j.jsonl")
+    with EventJournal(path=path, max_bytes=400, max_segments=4) as j:
+        for i in range(30):
+            j.event("tick", i=i)
+    seg = path + ".1"
+    lines = open(seg).read().splitlines(keepends=True)
+    assert len(lines) > 1
+    open(seg, "w").writelines(lines[:-1])  # drop the segment's tail
+    back = EventJournal.read_rotated(path)
+    gaps = [r for r in back if r["name"] == "journal.gap"]
+    assert len(gaps) == 1 and gaps[0]["n_missing"] == 1
+    # pre-v2 records (no seq) pass through unflagged
+    legacy = [{"kind": "event", "name": "old"},
+              {"kind": "event", "name": "old"}]
+    assert EventJournal._with_gap_records(legacy) == legacy
+
+
 # ---- divergent-rank timeline hooks + SLO_RANK_STALL (satellite) ------
 
 

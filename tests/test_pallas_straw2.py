@@ -1,6 +1,8 @@
 """Fused Pallas straw2 kernel vs the jnp path (bit-exact, interpret)."""
 
 import numpy as np
+import pytest
+
 import jax.numpy as jnp
 
 from ceph_tpu.core import hashes
@@ -111,6 +113,7 @@ def test_engine_with_fused_path_matches(monkeypatch):
     np.testing.assert_array_equal(np.asarray(got_len), np.asarray(want_len))
 
 
+@pytest.mark.slow
 def test_engine_with_level_kernel_matches(monkeypatch):
     """Whole batch engine with the Pallas level-descent kernel forced
     (interpret on CPU) must match the XLA matmul path exactly."""

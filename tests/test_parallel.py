@@ -6,6 +6,7 @@ fused rebalance-sim streaming step (BASELINE config 5).
 """
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -67,6 +68,7 @@ def test_rebalance_sim_matches_unsharded_count():
     assert 0 < moved < n  # sanity: some but not all objects moved
 
 
+@pytest.mark.slow
 def test_rebalance_sim_start_offset():
     _, rule, dense = _setup()
     mesh = make_mesh(8)
